@@ -1,0 +1,17 @@
+# Build the native (C++) runtime components.
+PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
+CXX ?= g++
+CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra
+
+.PHONY: native clean test
+
+native: $(PKG)/runtime/librt_loader.so
+
+$(PKG)/runtime/librt_loader.so: $(PKG)/runtime/loader.cpp
+	$(CXX) $(CXXFLAGS) -shared -o $@ $<
+
+clean:
+	rm -f $(PKG)/runtime/librt_loader.so
+
+test: native
+	python -m pytest tests/ -x -q

@@ -1,0 +1,12 @@
+#!/usr/bin/env python3
+"""Entry point preserving the reference CLI (reference main.cu:195-422):
+
+    python main.py -g <graph.bin> -q <query.bin> -gn <numChips>
+"""
+
+import sys
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,0 +1,45 @@
+"""Short import alias: ``import msbfs_tpu`` == the full-length package.
+
+The canonical package name mirrors the reference repo
+(``parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu``); this
+shim registers every loaded submodule under the ``msbfs_tpu`` prefix so both
+spellings resolve to the *same* module objects (no duplicate pytree
+registrations or split state).
+"""
+
+import importlib
+import sys
+
+_LONG = "parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu"
+_real = importlib.import_module(_LONG)
+
+# Import every submodule under its canonical name FIRST, so the alias loop
+# below covers the whole tree and a later ``import msbfs_tpu.x.y`` can never
+# re-execute a module under the short name.
+for _sub in (
+    "cli",
+    "models",
+    "models.csr",
+    "models.generators",
+    "ops",
+    "ops.bfs",
+    "ops.engine",
+    "ops.objective",
+    "parallel",
+    "parallel.mesh",
+    "parallel.scheduler",
+    "parallel.distributed",
+    "parallel.sharded_csr",
+    "runtime",
+    "runtime.native_loader",
+    "utils",
+    "utils.io",
+    "utils.report",
+    "utils.timing",
+):
+    importlib.import_module(f"{_LONG}.{_sub}")
+
+sys.modules["msbfs_tpu"] = _real
+for _name, _mod in list(sys.modules.items()):
+    if _name.startswith(_LONG + "."):
+        sys.modules["msbfs_tpu" + _name[len(_LONG):]] = _mod

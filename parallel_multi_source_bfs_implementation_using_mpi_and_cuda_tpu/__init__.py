@@ -1,0 +1,68 @@
+"""TPU-native multi-source BFS / distance-to-set framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``irmakerkol/Parallel-Multi-Source-BFS-Implementation-Using-MPI-and-CUDA``
+(reference: ``/root/reference/main.cu``): given an undirected graph G and K
+query groups of source vertices, run a multi-source BFS per group, compute
+F(U_k) = sum of distances over reached vertices, and report the group with the
+minimum F (ties -> lowest query index, 1-based in the report).
+
+Layer map (mirrors SURVEY.md section 1):
+
+==========================  =====================================================
+Reference layer             This package
+==========================  =====================================================
+CLI / driver                :mod:`.cli`
+Data I/O (binary loaders)   :mod:`.utils.io` (+ native C++ fast path
+                            in ``runtime/loader.cpp`` via :mod:`.runtime`)
+Distributed runtime / MPI   :mod:`.parallel` (mesh + shard_map + XLA collectives)
+Scheduler (query distrib.)  :mod:`.parallel.scheduler` (cyclic, reference-exact)
+Device compute (BFS)        :mod:`.ops` (lax.while_loop BFS, vmap batching,
+                            dense-MXU + Pallas frontier kernels)
+==========================  =====================================================
+
+Design stance: BFS is a pure-functional level-synchronous iteration inside
+``jax.lax.while_loop`` — the per-level host<->device flag round-trip of the
+reference (main.cu:61-71) disappears entirely; the convergence test is an
+on-device ``jnp.any``.  Queries are vmap-batched per chip and shard_map-sharded
+across chips on a ``('q',)`` mesh axis with the reference's exact cyclic
+assignment (main.cu:303-307).
+"""
+
+from jax import config as _jax_config
+
+# F(U) sums can exceed int32 (n * diameter), matching the reference's
+# `long long` accumulator (main.cu:75-89).  All other arrays in this package
+# carry explicit int32 dtypes, so enabling x64 only affects the objective
+# accumulator (int64 is software-emulated on TPU; it is used only for the
+# final O(n) reduction).
+_jax_config.update("jax_enable_x64", True)
+
+from .models.csr import CSRGraph, DeviceCSR  # noqa: E402
+from .ops.bfs import multi_source_bfs, batched_multi_source_bfs  # noqa: E402
+from .ops.objective import f_of_u, select_best  # noqa: E402
+from .ops.engine import Engine  # noqa: E402
+from .utils.io import (  # noqa: E402
+    load_graph_bin,
+    load_query_bin,
+    save_graph_bin,
+    save_query_bin,
+    pad_queries,
+)
+
+__all__ = [
+    "CSRGraph",
+    "DeviceCSR",
+    "multi_source_bfs",
+    "batched_multi_source_bfs",
+    "f_of_u",
+    "select_best",
+    "Engine",
+    "load_graph_bin",
+    "load_query_bin",
+    "save_graph_bin",
+    "save_query_bin",
+    "pad_queries",
+]
+
+__version__ = "0.1.0"

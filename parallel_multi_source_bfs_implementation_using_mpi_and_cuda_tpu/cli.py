@@ -1,0 +1,119 @@
+"""CLI driver — the public entry point, preserving the reference contract.
+
+Reference (main.cu:195-422): ``mpirun -np <ranks> ./main -g <graph.bin>
+-q <query.bin> -gn <numGPU>``.  Here: ``python main.py -g <graph.bin>
+-q <query.bin> -gn <numChips>`` (no mpirun; the mesh covers all chips in
+one process per host).  Contract kept exactly:
+
+* hand-rolled argv scan for -g/-q/-gn, unknown flags silently ignored,
+  ``-gn`` defaults to 1 (main.cu:214-224);
+* fewer than 4 post-program args -> usage on stderr, exit code -1
+  (main.cu:204-212);
+* two timing spans and the 7-line rank-0 report, 9-decimal fixed times,
+  1-based winning query (main.cu:403-414).
+
+``-gn`` maps to the number of mesh devices used for query sharding (the
+reference's GPUs-per-node device binding, main.cu:227-228); it is clamped to
+the available chips but *reported* as given, like the reference reports the
+flag value (main.cu:411).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+
+def parse_args(argv: List[str]):
+    """Linear argv scan, reference-exact (main.cu:216-224)."""
+    graph_file: Optional[str] = None
+    query_file: Optional[str] = None
+    num_gpu = 1
+    i = 1
+    while i < len(argv):
+        if argv[i] == "-g" and i + 1 < len(argv):
+            i += 1
+            graph_file = argv[i]
+        elif argv[i] == "-q" and i + 1 < len(argv):
+            i += 1
+            query_file = argv[i]
+        elif argv[i] == "-gn" and i + 1 < len(argv):
+            i += 1
+            try:
+                num_gpu = int(argv[i])
+            except ValueError:
+                num_gpu = 0  # atoi semantics: non-numeric -> 0
+        i += 1
+    return graph_file, query_file, num_gpu
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv if argv is None else argv)
+    if len(argv) < 5:  # argc < 5, reference main.cu:204-212
+        print(
+            f"Usage: python {argv[0] if argv else 'main.py'} "
+            "-g <graph.bin> -q <query.bin> -gn <numChips>",
+            file=sys.stderr,
+        )
+        return -1
+
+    graph_file, query_file, num_gpu = parse_args(argv)
+    if graph_file is None or query_file is None:
+        print("Missing -g or -q argument", file=sys.stderr)
+        return -1
+
+    import jax
+
+    from .ops.engine import Engine
+    from .parallel.distributed import DistributedEngine
+    from .parallel.mesh import default_mesh
+    from .utils.io import load_graph_bin, load_query_bin, pad_queries
+    from .utils.report import format_report
+    from .utils.timing import Span
+
+    # ---- preprocessing span: load + device placement (+ XLA compile),
+    # the analog of main.cu:235-298 (load + MPI broadcast + H2D upload).
+    with Span() as pre:
+        try:
+            graph = load_graph_bin(graph_file)
+        except (IOError, OSError, ValueError):
+            # ValueError covers corrupt contents (out-of-range vertex ids),
+            # where the reference would hit undefined behavior (main.cu:114).
+            print(f"Could not open graph file {graph_file}", file=sys.stderr)
+            return 1  # reference exits EXIT_FAILURE (main.cu:95-99)
+        try:
+            queries = load_query_bin(query_file)
+        except (IOError, OSError, ValueError):
+            print(f"Could not open query file {query_file}", file=sys.stderr)
+            return 1
+        padded = pad_queries(queries)
+        n_chips = max(1, min(num_gpu, len(jax.devices())))
+        if n_chips > 1:
+            mesh = default_mesh(max_devices=n_chips)
+            engine = DistributedEngine(mesh, graph)
+        else:
+            engine = Engine(graph.to_device())
+        engine.compile(padded.shape)
+
+    # ---- computation span: all BFS + objective + argmin (main.cu:301-400).
+    with Span() as comp:
+        min_f, min_k = engine.best(np.asarray(padded))
+
+    sys.stdout.write(
+        format_report(
+            graph_path=graph_file,
+            query_path=query_file,
+            min_k=min_k,
+            min_f=min_f,
+            num_gpu=num_gpu,
+            preprocessing_time=pre.seconds,
+            computation_time=comp.seconds,
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""Graph representations: host CSR, device-resident CSR, dense adjacency."""
+
+from .csr import CSRGraph, DeviceCSR
+from . import generators
+
+__all__ = ["CSRGraph", "DeviceCSR", "generators"]

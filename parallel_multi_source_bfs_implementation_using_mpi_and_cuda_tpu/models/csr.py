@@ -1,0 +1,150 @@
+"""CSR graph containers: host-side (NumPy) and device-side (JAX pytree).
+
+The reference keeps the graph as two int arrays, ``row_offsets`` (n+1) and
+``col_indices`` (2m), built by doubling every undirected edge record
+(reference main.cu:106-129) and uploaded to the device once, reused across all
+queries (main.cu:282-295).  This module reproduces those semantics with two
+reference-hazard fixes called out in SURVEY.md C4:
+
+* ``row_offsets`` is int64 on the host, so 2m > 2^31 does not silently
+  overflow (the reference uses int: main.cu:119-121).
+* The device container additionally carries ``edge_src`` — the CSR row id of
+  every directed-edge slot — which turns the reference's one-thread-per-vertex
+  row scan (main.cu:24-35) into a flat, sorted-segment formulation that XLA
+  vectorizes well on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class CSRGraph:
+    """Host-side CSR of an undirected graph.
+
+    ``m`` is the number of undirected edge *records* in the input file; the
+    CSR holds ``2m`` directed slots (each record inserted both ways, with
+    duplicates and self-loops preserved exactly as the reference does at
+    main.cu:114-115 — no dedup, no sort, insertion order).
+    """
+
+    n: int
+    m: int  # undirected edge records
+    row_offsets: np.ndarray  # (n+1,) int64
+    col_indices: np.ndarray  # (2m,) int32
+
+    @property
+    def num_directed_edges(self) -> int:
+        return int(self.row_offsets[-1])
+
+    @property
+    def degrees(self) -> np.ndarray:
+        return np.diff(self.row_offsets)
+
+    @staticmethod
+    def from_edges(n: int, edges: np.ndarray) -> "CSRGraph":
+        """Build CSR from an (m, 2) int array of undirected edge records.
+
+        Reproduces the reference's insertion-order adjacency exactly
+        (main.cu:106-129): for record i = (u, v), v is appended to adj[u] and
+        u to adj[v], in file order.  A stable counting sort over the
+        interleaved directed sequence [(u0,v0),(v0,u0),(u1,v1),...] yields the
+        identical CSR without materializing per-vertex lists.
+        """
+        edges = np.asarray(edges)
+        m = edges.shape[0]
+        if m and (edges.min() < 0 or edges.max() >= n):
+            # The reference indexes adj[u]/adj[v] unchecked (main.cu:114-115)
+            # — undefined behavior on a corrupt file; fail loudly instead.
+            raise ValueError(f"edge endpoint out of range [0, {n})")
+        if m == 0:
+            return CSRGraph(
+                n=n,
+                m=0,
+                row_offsets=np.zeros(n + 1, dtype=np.int64),
+                col_indices=np.zeros(0, dtype=np.int32),
+            )
+        # Interleave (u,v) and (v,u) so directed slot order matches the
+        # reference's per-record double push_back.
+        src = np.empty(2 * m, dtype=np.int64)
+        dst = np.empty(2 * m, dtype=np.int32)
+        src[0::2] = edges[:, 0]
+        src[1::2] = edges[:, 1]
+        dst[0::2] = edges[:, 1]
+        dst[1::2] = edges[:, 0]
+        counts = np.bincount(src, minlength=n).astype(np.int64)
+        row_offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(counts, out=row_offsets[1:])
+        order = np.argsort(src, kind="stable")
+        col_indices = dst[order]
+        return CSRGraph(n=n, m=m, row_offsets=row_offsets, col_indices=col_indices)
+
+    def to_device(self, sharding=None) -> "DeviceCSR":
+        return DeviceCSR.from_host(self, sharding=sharding)
+
+
+@jax.tree_util.register_pytree_node_class
+class DeviceCSR:
+    """Device-resident CSR, created once and reused across all queries
+    (the analog of the reference's one-time cudaMemcpy at main.cu:282-295).
+
+    Fields
+    ------
+    row_offsets : (n+1,) int32  — CSR offsets (int64 host side guards overflow;
+        device arrays stay int32 while 2m < 2^31, which covers every
+        BASELINE.json config below the sharded-CSR tier).
+    col_indices : (E,) int32    — neighbor ids, E = 2m directed slots.
+    edge_src    : (E,) int32    — row id owning each slot (sorted ascending).
+    """
+
+    def __init__(self, row_offsets, col_indices, edge_src, n: int, num_edges: int):
+        self.row_offsets = row_offsets
+        self.col_indices = col_indices
+        self.edge_src = edge_src
+        self.n = int(n)
+        self.num_edges = int(num_edges)
+
+    @staticmethod
+    def from_host(g: CSRGraph, sharding=None) -> "DeviceCSR":
+        E = g.num_directed_edges
+        if E >= 2**31:
+            raise ValueError(
+                "2m >= 2^31 directed slots: use the sharded-CSR path "
+                "(parallel.sharded_csr), which splits edge arrays per shard."
+            )
+        edge_src = np.repeat(
+            np.arange(g.n, dtype=np.int32), g.degrees.astype(np.int64)
+        )
+        put = (
+            (lambda x: jax.device_put(x, sharding))
+            if sharding is not None
+            else jnp.asarray
+        )
+        return DeviceCSR(
+            row_offsets=put(g.row_offsets.astype(np.int32)),
+            col_indices=put(g.col_indices.astype(np.int32)),
+            edge_src=put(edge_src),
+            n=g.n,
+            num_edges=E,
+        )
+
+    def tree_flatten(self):
+        return (
+            (self.row_offsets, self.col_indices, self.edge_src),
+            (self.n, self.num_edges),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        row_offsets, col_indices, edge_src = children
+        n, num_edges = aux
+        return cls(row_offsets, col_indices, edge_src, n, num_edges)
+
+    def __repr__(self):
+        return f"DeviceCSR(n={self.n}, directed_edges={self.num_edges})"

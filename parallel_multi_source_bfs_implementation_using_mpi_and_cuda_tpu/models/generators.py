@@ -1,0 +1,83 @@
+"""Graph and query generators for tests/benchmarks (no reference analog —
+the reference ships no generators or fixtures; SURVEY.md section 4 calls for
+creating them from scratch).
+
+Covers the BASELINE.json config families: RMAT (power-law, low diameter),
+2-D grid (road-like, high diameter), and uniform G(n, m).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def rmat_edges(
+    scale: int,
+    edge_factor: int = 16,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: int = 0,
+) -> Tuple[int, np.ndarray]:
+    """Graph500-style R-MAT: n = 2^scale vertices, m = edge_factor * n records.
+
+    Vectorized quadrant sampling (one (m, scale) draw), no per-edge Python.
+    Returns (n, edges[m, 2] int32); duplicates/self-loops are kept, matching
+    the reference loader's no-dedup behavior (main.cu:106-116).
+    """
+    n = 1 << scale
+    m = edge_factor * n
+    d = 1.0 - a - b - c
+    rng = np.random.default_rng(seed)
+    # Level-by-level quadrant sampling (keeps peak memory at O(m), not
+    # O(m * scale)): P(u_bit=1) = c+d; P(v_bit=1 | u_bit) = b/(a+b) or
+    # d/(c+d) — the same joint distribution as drawing the quadrant.
+    u = np.zeros(m, dtype=np.int64)
+    v = np.zeros(m, dtype=np.int64)
+    p_u1 = c + d
+    p_v1_given_u0 = b / (a + b)
+    p_v1_given_u1 = d / (c + d)
+    for _ in range(scale):
+        u_bit = rng.random(m) < p_u1
+        p_v1 = np.where(u_bit, p_v1_given_u1, p_v1_given_u0)
+        v_bit = rng.random(m) < p_v1
+        u = (u << 1) | u_bit
+        v = (v << 1) | v_bit
+    # Permute vertex ids so degree is not correlated with id (standard
+    # Graph500 step, keeps the power-law but randomizes layout).
+    perm = rng.permutation(n).astype(np.int64)
+    edges = np.stack([perm[u.astype(np.int64)], perm[v.astype(np.int64)]], axis=1)
+    return n, edges.astype(np.int32)
+
+
+def grid_edges(rows: int, cols: int) -> Tuple[int, np.ndarray]:
+    """4-neighbor grid: n = rows*cols, high diameter (road-network stand-in
+    for the USA-road-d config in BASELINE.json)."""
+    idx = np.arange(rows * cols, dtype=np.int32).reshape(rows, cols)
+    right = np.stack([idx[:, :-1].ravel(), idx[:, 1:].ravel()], axis=1)
+    down = np.stack([idx[:-1, :].ravel(), idx[1:, :].ravel()], axis=1)
+    edges = np.concatenate([right, down], axis=0).astype(np.int32)
+    return rows * cols, edges
+
+
+def gnm_edges(n: int, m: int, seed: int = 0) -> Tuple[int, np.ndarray]:
+    """Uniform G(n, m) multigraph (duplicates and self-loops possible)."""
+    rng = np.random.default_rng(seed)
+    edges = rng.integers(0, n, size=(m, 2), dtype=np.int64).astype(np.int32)
+    return n, edges
+
+
+def random_queries(
+    n: int, k: int, max_group: int = 128, seed: int = 0
+) -> List[np.ndarray]:
+    """K ragged source groups with sizes in [1, max_group] (query format
+    limits: K <= 255, group size <= 255; reference comments say 64/128,
+    main.cu:145,152)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(k):
+        size = int(rng.integers(1, max_group + 1))
+        out.append(rng.integers(0, n, size=size, dtype=np.int64).astype(np.int32))
+    return out

@@ -1,0 +1,14 @@
+"""Device compute: BFS engines, objective, batched execution."""
+
+from .bfs import multi_source_bfs, batched_multi_source_bfs, init_distances
+from .objective import f_of_u, select_best
+from .engine import Engine
+
+__all__ = [
+    "multi_source_bfs",
+    "batched_multi_source_bfs",
+    "init_distances",
+    "f_of_u",
+    "select_best",
+    "Engine",
+]

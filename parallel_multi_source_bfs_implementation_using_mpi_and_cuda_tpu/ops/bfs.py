@@ -1,0 +1,128 @@
+"""Level-synchronous multi-source BFS as a pure-functional XLA program.
+
+Reference semantics being reproduced (main.cu:16-73):
+
+* distances init to -1, sources (bounds-checked: ``0 <= s < n``, main.cu:49)
+  set to 0;
+* per level, every vertex at distance == level labels its unvisited
+  (-1) CSR neighbors with level+1 (main.cu:21-35);
+* iterate until a level produces no update (main.cu:61-71).
+
+TPU-native redesign (SURVEY.md C1/C2): the per-level host round-trip of a
+1-byte ``updated`` flag plus ``cudaDeviceSynchronize`` (main.cu:64-69) is
+replaced by a ``jax.lax.while_loop`` whose convergence predicate is an
+on-device ``jnp.any`` — zero host involvement per level.  Frontier expansion
+uses the *pull* dual of the reference's push (equivalent because every edge
+record is stored in both directions, main.cu:114-115):
+
+    reached[v] = any(dist[u] == level for u in neighbors(v))
+
+expressed as a flat gather over ``col_indices`` followed by a sorted
+segment-max over ``edge_src`` — both dense, statically-shaped ops that XLA
+vectorizes on TPU (no scalar row loops, no thread divergence, no write race:
+the reference's benign race at main.cu:30-33 disappears in the functional
+formulation).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.csr import DeviceCSR
+
+NOT_REACHED = jnp.int32(-1)
+
+
+def init_distances(n: int, sources: jax.Array) -> jax.Array:
+    """Distance init: -1 everywhere, 0 at in-range sources.
+
+    Out-of-range entries (including the -1 padding used for ragged query
+    groups) are dropped — exactly the reference's ``s >= 0 && s < n`` guard
+    (main.cu:46-51), which is what makes -1 padding semantics-preserving.
+    """
+    sources = sources.astype(jnp.int32)
+    dist = jnp.full((n,), NOT_REACHED, dtype=jnp.int32)
+    in_range = (sources >= 0) & (sources < n)
+    safe = jnp.where(in_range, sources, n)  # n is out of bounds -> dropped
+    return dist.at[safe].set(0, mode="drop")
+
+
+def frontier_expand(dist: jax.Array, level: jax.Array, graph: DeviceCSR) -> jax.Array:
+    """One level of expansion; returns the bool mask of newly-reached vertices.
+
+    Pull formulation over the flat directed-slot arrays: gather the frontier
+    membership of every slot's endpoint, reduce per owning row with a sorted
+    segment-max.  Cost O(E) per level — the reference's kernel is O(n +
+    edges(frontier)) per level (main.cu:18-26), so totals are within a small
+    factor (O(D*E) vs O(D*n + E)); the Pallas/dense engines recover the rest.
+    """
+    frontier = dist == level
+    slot_active = jnp.take(frontier, graph.col_indices, axis=0)
+    reached = jax.ops.segment_max(
+        slot_active.astype(jnp.int8),  # int8: the (E,) intermediate is the
+        graph.edge_src,  # bandwidth hot spot; 1 B/slot suffices for a flag
+        num_segments=graph.n,
+        indices_are_sorted=True,
+    )
+    return (dist == NOT_REACHED) & (reached > 0)
+
+
+def multi_source_bfs(
+    graph: DeviceCSR,
+    sources: jax.Array,
+    max_levels: Optional[int] = None,
+    expand=frontier_expand,
+) -> jax.Array:
+    """BFS from a (possibly -1-padded) int32 source set; returns (n,) int32
+    distances, -1 for unreached vertices (reference main.cu:40-73).
+
+    ``max_levels`` optionally bounds the level loop (diameter cap); ``None``
+    iterates to convergence like the reference's ``while(h_updated)``.
+    ``expand`` lets alternate frontier engines (dense-MXU, Pallas) plug in
+    behind the same interface.
+    """
+
+    def cond(carry):
+        _, level, updated = carry
+        go = updated
+        if max_levels is not None:
+            go = jnp.logical_and(go, level < max_levels)
+        return go
+
+    def body(carry):
+        dist, level, _ = carry
+        new = expand(dist, level, graph)
+        dist = jnp.where(new, level + 1, dist)
+        return (dist, level + 1, jnp.any(new))
+
+    dist0 = init_distances(graph.n, sources)
+    # Initial "updated" flag: true iff any valid source exists.  (An empty
+    # source set terminates immediately with all -1, like the reference's
+    # single no-op kernel launch.)  Deriving it from dist0 — rather than a
+    # literal True — also gives it dist0's varying-axes type, so the same
+    # loop works unchanged inside shard_map shards.
+    updated0 = jnp.any(dist0 == 0)
+    dist, _, _ = lax.while_loop(cond, body, (dist0, jnp.int32(0), updated0))
+    return dist
+
+
+def batched_multi_source_bfs(
+    graph: DeviceCSR,
+    sources: jax.Array,
+    max_levels: Optional[int] = None,
+    expand=frontier_expand,
+) -> jax.Array:
+    """vmap of :func:`multi_source_bfs` over a (K, S) query batch -> (K, n).
+
+    Under vmap the while_loop runs until *every* query has converged, masking
+    converged lanes — the TPU-native replacement for the reference's serial
+    per-query loop (main.cu:312-322).  Queries that converge early idle
+    harmlessly (their frontier is empty, so their carry is a fixed point).
+    """
+    fn = partial(multi_source_bfs, graph, max_levels=max_levels, expand=expand)
+    return jax.vmap(fn)(sources)

@@ -1,0 +1,57 @@
+"""Distance-to-set objective F(U) and best-query selection.
+
+Reference semantics (main.cu:75-89, 379-397):
+
+* F(U) = sum of distances over *reached* vertices only (negatives skipped,
+  main.cu:84-85), accumulated in ``long long``;
+* the winning query is the one with minimum F over entries >= 0, ties broken
+  toward the lowest query index (strict ``<`` scan, main.cu:391-396);
+* if no query has a valid F, (minF, minK) stay (-1, -1) (main.cu:379-380).
+
+TPU-native redesign: the reference copies all n distances to the host and
+sums there per query (main.cu:79-87); here both the sum and the argmin stay
+on device.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def f_of_u(dist: jax.Array) -> jax.Array:
+    """Sum of non-negative distances, int64 (reference main.cu:75-89)."""
+    contrib = jnp.where(dist >= 0, dist, 0).astype(jnp.int64)
+    return jnp.sum(contrib)
+
+
+def select_best(
+    f_values: jax.Array, valid: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """(minF, minK) over valid entries; ties -> lowest index; none -> (-1,-1).
+
+    Matches the reference's two-scan argmin (main.cu:379-397) including its
+    tie-break (first strict minimum in index order) and its convention that a
+    query never computed (F < 0) is excluded.
+    """
+    if f_values.shape[0] == 0:
+        # K = 0: the reference's scans never run and (-1, -1) is reported
+        # (main.cu:379-380); argmin of an empty array would raise.
+        return jnp.int64(-1), jnp.int32(-1)
+    f_values = f_values.astype(jnp.int64)
+    valid = valid & (f_values >= 0)
+    big = jnp.iinfo(jnp.int64).max
+    keyed = jnp.where(valid, f_values, big)
+    min_k = jnp.argmin(keyed)  # argmin returns the first occurrence: tie-break
+    min_f = keyed[min_k]
+    any_valid = jnp.any(valid)
+    min_f = jnp.where(any_valid, min_f, jnp.int64(-1))
+    min_k = jnp.where(any_valid, min_k, -1).astype(jnp.int32)
+    return min_f, min_k
+
+
+# Shared jitted instance: every engine's best() goes through this one
+# wrapper so selection is traced/compiled once per shape, not per call.
+select_best_jit = jax.jit(select_best)

@@ -1,0 +1,24 @@
+"""Distributed layer: mesh bring-up, query scheduling, XLA collectives.
+
+Replaces the reference's MPI runtime (SURVEY.md C7-C10): process bring-up
+(MPI_Init, main.cu:197-201) becomes ``jax.distributed`` + a
+``jax.sharding.Mesh``; the graph broadcast (main.cu:242-280) becomes a
+replicated sharding; the round-robin query assignment (main.cu:303-307)
+becomes a cyclic reshape sharded over the ``'q'`` mesh axis; the
+Gather/Gatherv of (q, F) pairs with a custom struct datatype
+(main.cu:324-368) becomes a fixed-shape pmax merge of a (K,) int64 array —
+SPMD static shapes replace the ragged wire format.
+"""
+
+from .mesh import make_mesh, default_mesh
+from .scheduler import cyclic_assignment, cyclic_grid, QUERY_AXIS
+from .distributed import DistributedEngine
+
+__all__ = [
+    "make_mesh",
+    "default_mesh",
+    "cyclic_assignment",
+    "cyclic_grid",
+    "QUERY_AXIS",
+    "DistributedEngine",
+]

@@ -1,0 +1,113 @@
+"""Distributed query execution: shard_map over the ('q', 'v') mesh.
+
+End-to-end replacement for the reference's MPI phase structure:
+
+* graph broadcast (main.cu:242-255)  -> replicated NamedSharding device_put;
+* round-robin assignment (303-307)   -> cyclic grid sharded over 'q';
+* per-rank BFS loop (312-322)        -> vmap-batched BFS per shard;
+* Gather/Gatherv of (q, F) pairs with a custom MPI struct (324-368)
+                                     -> fixed-shape (K,) int64 pmax merge
+                                        (each shard contributes its slots,
+                                        -1 elsewhere; SPMD static shapes
+                                        replace the ragged wire format);
+* rank-0 argmin (379-397)            -> on-device masked argmin, replicated.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.csr import CSRGraph, DeviceCSR
+from ..ops.bfs import frontier_expand, multi_source_bfs
+from ..ops.engine import QueryEngineBase
+from ..ops.objective import f_of_u
+from .mesh import QUERY_AXIS, VERTEX_AXIS
+from .scheduler import merge_local_f, shard_queries
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "k", "k_pad", "w", "query_chunk", "max_levels", "expand"),
+)
+def _distributed_f_values(
+    mesh: Mesh,
+    graph: DeviceCSR,
+    query_grid: jax.Array,  # (W, J, S) cyclic layout
+    k: int,
+    k_pad: int,
+    w: int,
+    query_chunk: int,
+    max_levels,
+    expand,
+) -> jax.Array:
+    """Returns the merged (k_pad,) int64 F array, replicated on every device."""
+
+    def shard_body(graph, qblock):
+        # qblock arrives as (1, J, S): the mesh-sharded leading axis keeps
+        # rank with local extent W/W = 1.  Drop it -> this shard's J queries
+        # in cyclic order.
+        qblock = qblock[0]
+        j = qblock.shape[0]
+
+        def one(q):
+            dist = multi_source_bfs(graph, q, max_levels=max_levels, expand=expand)
+            return f_of_u(dist)
+
+        chunked = qblock.reshape(j // query_chunk, query_chunk, qblock.shape[1])
+        f_local = lax.map(jax.vmap(one), chunked).reshape(j)
+        return merge_local_f(f_local, j, w, k, k_pad, (QUERY_AXIS, VERTEX_AXIS))
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(QUERY_AXIS)),
+        out_specs=P(),
+    )(graph, query_grid)
+
+
+class DistributedEngine(QueryEngineBase):
+    """Query-sharded execution over a mesh, graph replicated per device
+    (the reference's full-graph-per-rank model, SURVEY.md C8)."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        graph: CSRGraph | DeviceCSR,
+        max_levels: Optional[int] = None,
+        query_chunk: Optional[int] = None,
+        expand=frontier_expand,
+    ):
+        self.mesh = mesh
+        self.w = mesh.shape[QUERY_AXIS]
+        replicated = NamedSharding(mesh, P())
+        if isinstance(graph, CSRGraph):
+            graph = DeviceCSR.from_host(graph, sharding=replicated)
+        self.graph = graph
+        self.max_levels = max_levels
+        self.query_chunk = query_chunk
+        self.expand = expand
+
+    def f_values(self, queries: np.ndarray) -> jax.Array:
+        """(K, S) -1-padded queries -> (K,) int64 F values (replicated)."""
+        sharded, k, k_pad, chunk = shard_queries(
+            self.mesh, np.asarray(queries), self.query_chunk
+        )
+        merged = _distributed_f_values(
+            self.mesh,
+            self.graph,
+            sharded,
+            k,
+            k_pad,
+            self.w,
+            chunk,
+            self.max_levels,
+            self.expand,
+        )
+        return merged[:k]

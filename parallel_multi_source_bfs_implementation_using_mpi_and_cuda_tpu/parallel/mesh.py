@@ -1,0 +1,65 @@
+"""Mesh construction and multi-host bring-up.
+
+The reference binds rank r to GPU ``r % numGPU`` (main.cu:227-228) and runs
+one MPI process per rank.  TPU-native: one process per host, all chips in a
+``jax.sharding.Mesh``; ICI/DCN collectives are inserted by XLA from sharding
+annotations, so there is no explicit rank/device arithmetic anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+QUERY_AXIS = "q"
+VERTEX_AXIS = "v"
+
+
+def initialize_distributed(**kwargs) -> None:
+    """Multi-host bring-up (the analog of MPI_Init, main.cu:197).
+
+    On a single host this is a no-op; on a multi-host TPU slice pass
+    coordinator_address/num_processes/process_id or rely on the TPU
+    environment's auto-detection.
+    """
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (RuntimeError, ValueError):
+        # Already initialized or single-process environment.
+        pass
+
+
+def make_mesh(
+    num_query_shards: Optional[int] = None,
+    num_vertex_shards: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ('q', 'v') mesh: query-parallel x vertex-parallel.
+
+    ``num_query_shards=None`` uses all remaining devices on the query axis.
+    A (W, 1) mesh reproduces the reference's pure query-level data
+    parallelism; a (W, P) mesh adds the sharded-CSR extension axis.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if num_query_shards is None:
+        if len(devs) % num_vertex_shards:
+            raise ValueError(
+                f"{len(devs)} devices not divisible by {num_vertex_shards} vertex shards"
+            )
+        num_query_shards = len(devs) // num_vertex_shards
+    total = num_query_shards * num_vertex_shards
+    if total > len(devs):
+        raise ValueError(f"mesh wants {total} devices, only {len(devs)} available")
+    grid = np.array(devs[:total]).reshape(num_query_shards, num_vertex_shards)
+    return Mesh(grid, (QUERY_AXIS, VERTEX_AXIS))
+
+
+def default_mesh(max_devices: Optional[int] = None) -> Mesh:
+    """1-D query mesh over up to ``max_devices`` chips (reference ``-gn``)."""
+    devs = jax.devices()
+    if max_devices is not None:
+        devs = devs[: max(1, min(max_devices, len(devs)))]
+    return make_mesh(num_query_shards=len(devs), devices=devs)

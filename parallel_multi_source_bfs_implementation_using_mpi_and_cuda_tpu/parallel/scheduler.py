@@ -1,0 +1,84 @@
+"""Query scheduling: the reference's static round-robin, mesh-sharded.
+
+The reference assigns query k to rank ``k % world_size`` (the
+``for(kidx = world_rank; kidx < K; kidx += world_size)`` loop,
+main.cu:303-307).  Here the (K, S) padded query array is laid out as a
+(W, J, S) cyclic grid — slot [r, j] holds global query ``r + j*W`` — and the
+leading axis is sharded over the ``'q'`` mesh axis, so shard r receives
+exactly the reference's query set, in the reference's order.
+
+No work stealing and no cost model, faithfully (SURVEY.md C9 notes the load
+imbalance is inherited behavior; improving it is an opt-in extension).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import QUERY_AXIS
+
+
+def cyclic_assignment(k: int, w: int) -> List[List[int]]:
+    """Global query ids owned by each of w shards (reference main.cu:303-307)."""
+    return [list(range(r, k, w)) for r in range(w)]
+
+
+def cyclic_grid(
+    queries: np.ndarray, w: int, min_j_multiple: int = 1
+) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Lay out (K, S) -1-padded queries as a (W, J, S) cyclic grid.
+
+    Returns (grid, gids, k_pad) where ``grid[r, j] = queries[r + j*w]``,
+    ``gids[r, j] = r + j*w`` and rows past K are -1 padding (excluded from
+    the result merge — the analog of main.cu:325's -1-initialized
+    all_F_values).  J is rounded up to ``min_j_multiple`` (query-chunk
+    alignment).
+    """
+    k, s = queries.shape
+    j = max(1, -(-k // w))
+    j = -(-j // min_j_multiple) * min_j_multiple
+    k_pad = w * j
+    padded = np.full((k_pad, s), -1, dtype=np.int32)
+    padded[:k] = queries
+    grid = padded.reshape(j, w, s).transpose(1, 0, 2)  # grid[r, j] = padded[r + j*w]
+    gids = (np.arange(w)[:, None] + np.arange(j)[None, :] * w).astype(np.int32)
+    return np.ascontiguousarray(grid), gids, k_pad
+
+
+def shard_queries(
+    mesh, queries: np.ndarray, query_chunk: Optional[int]
+) -> Tuple[jax.Array, int, int, int]:
+    """Cyclic-grid a (K, S) query array and place it sharded over 'q'.
+
+    Returns (sharded (W, J, S) grid, k, k_pad, chunk) — the common prologue
+    of every distributed engine.
+    """
+    w = mesh.shape[QUERY_AXIS]
+    k = queries.shape[0]
+    chunk = query_chunk or max(1, -(-k // w))
+    grid, _, k_pad = cyclic_grid(np.asarray(queries), w, min_j_multiple=chunk)
+    sharded = jax.device_put(grid, NamedSharding(mesh, P(QUERY_AXIS)))
+    return sharded, k, k_pad, chunk
+
+
+def merge_local_f(f_local: jax.Array, j: int, w: int, k: int, k_pad: int, axes):
+    """Merge one shard's (J,) F values into the replicated (k_pad,) result.
+
+    Each shard writes its cyclic slots (gid = r + j*W) and -1 elsewhere —
+    padding slots stay "never computed" like the reference's -1-initialized
+    all_F_values (main.cu:325, 370-375) — then a max all-reduce over ``axes``
+    reconstructs the full array (every real slot is >= 0 on exactly one
+    shard): the SPMD fixed-shape analog of MPI_Gatherv + scatter-by-q
+    (main.cu:362-375).
+    """
+    r = lax.axis_index(QUERY_AXIS)
+    gids = r.astype(jnp.int32) + jnp.arange(j, dtype=jnp.int32) * w
+    f_local = jnp.where(gids < k, f_local, jnp.int64(-1))
+    merged = jnp.full((k_pad,), jnp.int64(-1)).at[gids].set(f_local)
+    return lax.pmax(merged, axes)

@@ -1,0 +1,234 @@
+"""Vertex-sharded CSR BFS: the scale-out extension beyond the reference.
+
+The reference replicates the full graph on every rank (main.cu:242-255);
+SURVEY.md section 5 ("long-context") identifies vertex-space CSR sharding as
+the framework's "scale the big dimension" axis, analogous to
+sequence/context parallelism in an ML stack.  Design:
+
+* the vertex space is padded to P*L and shard p of the ``'v'`` mesh axis
+  owns rows [p*L, (p+1)*L): its slice of distances, row offsets and edge
+  slots live only in that shard's HBM — an n-vertex, E-edge graph needs
+  only ~(n + E)/P per chip;
+* per BFS level each shard pulls from a replicated (n_pad,) frontier
+  bitmap, expands its own rows locally, then contributes its newly-reached
+  slice to the next frontier via ``lax.all_gather`` over ICI — one
+  fixed-shape collective per level (the halo exchange);
+* the convergence flag is computed from the gathered global frontier, so
+  every shard sees the identical value and the while_loop trip count stays
+  uniform across the mesh (a requirement for collectives inside the loop);
+* F(U) is a local partial sum + ``lax.psum`` over 'v'.
+
+Composes with the ``'q'`` query axis of :mod:`.distributed`: queries are
+round-robin-sharded over 'q' while the graph is sharded over 'v', giving the
+full ('q','v') = (data-parallel, graph-parallel) mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.csr import CSRGraph
+from ..ops.engine import QueryEngineBase
+from .mesh import QUERY_AXIS, VERTEX_AXIS
+from .scheduler import merge_local_f, shard_queries
+
+
+class ShardedCSR:
+    """Host-side vertex partition of a CSR graph into P row blocks.
+
+    Stacked layout (leading axis = shard): ``row_offsets`` (P, L+1) rebased
+    per shard, ``col_indices``/``edge_src`` (P, E_max) padded — padding slots
+    carry ``edge_src = L`` which is out of range for the per-shard
+    segment-reduce and therefore dropped (no masking pass needed).
+    """
+
+    def __init__(self, g: CSRGraph, num_shards: int):
+        n, p = g.n, num_shards
+        L = -(-max(n, 1) // p)
+        n_pad = p * L
+        degrees = np.zeros(n_pad, dtype=np.int64)
+        degrees[:n] = g.degrees
+        block_deg = degrees.reshape(p, L)
+        e_max = int(block_deg.sum(axis=1).max()) if n else 0
+        e_max = max(e_max, 1)
+
+        row_offsets = np.zeros((p, L + 1), dtype=np.int64)
+        np.cumsum(block_deg, axis=1, out=row_offsets[:, 1:])
+        col_indices = np.zeros((p, e_max), dtype=np.int32)
+        edge_src = np.full((p, e_max), L, dtype=np.int32)  # L => dropped pad
+        global_src = np.repeat(np.arange(n_pad, dtype=np.int64), degrees)
+        for b in range(p):
+            lo = int(g.row_offsets[min(b * L, n)]) if n else 0
+            hi = int(g.row_offsets[min((b + 1) * L, n)]) if n else 0
+            col_indices[b, : hi - lo] = g.col_indices[lo:hi]
+            edge_src[b, : hi - lo] = (global_src[lo:hi] - b * L).astype(np.int32)
+
+        self.n = n
+        self.n_pad = n_pad
+        self.block = L
+        self.num_shards = p
+        self.e_max = e_max
+        self.row_offsets = row_offsets
+        self.col_indices = col_indices
+        self.edge_src = edge_src
+
+
+def _sharded_bfs_f(
+    col_indices,  # (E_max,) this shard's edge slots (global neighbor ids)
+    edge_src,  # (E_max,) local row per slot, == L for padding (dropped)
+    sources,  # (S,) global source ids, -1 padded
+    n: int,
+    n_pad: int,
+    block: int,
+    max_levels,
+):
+    """One query's BFS on one 'v' shard; returns this shard's partial F.
+
+    Runs identically (SPMD) on every 'v' shard; the all_gather is the only
+    cross-shard dependency.
+    """
+    shard = lax.axis_index(VERTEX_AXIS)
+    offset = shard.astype(jnp.int32) * block
+
+    sources = sources.astype(jnp.int32)
+    in_range = (sources >= 0) & (sources < n)  # reference bounds check
+    # Global frontier bitmap (replicated value on every shard).
+    safe_global = jnp.where(in_range, sources, n_pad)
+    frontier = (
+        jnp.zeros((n_pad,), dtype=jnp.bool_)
+        .at[safe_global]
+        .set(True, mode="drop")
+    )
+    # Local distance slice.
+    local_src = sources - offset
+    owned = in_range & (local_src >= 0) & (local_src < block)
+    safe_local = jnp.where(owned, local_src, block)
+    dist_local = (
+        jnp.full((block,), jnp.int32(-1)).at[safe_local].set(0, mode="drop")
+    )
+
+    def cond(carry):
+        _, _, level, updated = carry
+        go = updated
+        if max_levels is not None:
+            go = jnp.logical_and(go, level < max_levels)
+        return go
+
+    def body(carry):
+        dist_local, frontier, level, _ = carry
+        slot_active = jnp.take(frontier, col_indices, axis=0)
+        reached = jax.ops.segment_max(
+            slot_active.astype(jnp.int8),
+            edge_src,
+            num_segments=block,  # edge_src == block (padding) is dropped
+            indices_are_sorted=True,
+        )
+        new_local = (dist_local == -1) & (reached > 0)
+        dist_local = jnp.where(new_local, level + 1, dist_local)
+        # Halo exchange: every shard's newly-reached slice -> next global
+        # frontier.  One (n_pad,) all_gather per level over ICI.
+        frontier = lax.all_gather(new_local, VERTEX_AXIS, tiled=True)
+        return (dist_local, frontier, level + 1, jnp.any(frontier))
+
+    # The body's frontier/updated come out of an all_gather over 'v', so they
+    # carry a ('q','v') varying type; give the initial values (built only
+    # from 'q'-varying sources) the same type.
+    frontier = lax.pcast(frontier, (VERTEX_AXIS,), to="varying")
+    updated0 = jnp.any(frontier)
+    dist_local, _, _, _ = lax.while_loop(
+        cond, body, (dist_local, frontier, jnp.int32(0), updated0)
+    )
+    partial_f = jnp.sum(jnp.where(dist_local >= 0, dist_local, 0).astype(jnp.int64))
+    return lax.psum(partial_f, VERTEX_AXIS)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "n", "n_pad", "block", "k", "k_pad", "w", "query_chunk", "max_levels",
+    ),
+)
+def _sharded_f_values(
+    mesh: Mesh,
+    col_indices,  # (P, E_max) sharded over 'v'
+    edge_src,  # (P, E_max) sharded over 'v'
+    query_grid,  # (W, J, S) sharded over 'q'
+    n: int,
+    n_pad: int,
+    block: int,
+    k: int,
+    k_pad: int,
+    w: int,
+    query_chunk: int,
+    max_levels,
+):
+    def shard_body(col_indices, edge_src, qblock):
+        col_indices = col_indices[0]  # local leading extent 1 on 'v'
+        edge_src = edge_src[0]
+        qblock = qblock[0]  # local leading extent 1 on 'q'
+        j = qblock.shape[0]
+
+        def one(q):
+            return _sharded_bfs_f(
+                col_indices, edge_src, q, n, n_pad, block, max_levels
+            )
+
+        chunked = qblock.reshape(j // query_chunk, query_chunk, qblock.shape[1])
+        f_local = lax.map(jax.vmap(one), chunked).reshape(j)
+        return merge_local_f(f_local, j, w, k, k_pad, (QUERY_AXIS, VERTEX_AXIS))
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(VERTEX_AXIS), P(VERTEX_AXIS), P(QUERY_AXIS)),
+        out_specs=P(),
+    )(col_indices, edge_src, query_grid)
+
+
+class ShardedEngine(QueryEngineBase):
+    """Query execution with the CSR sharded over the 'v' mesh axis and
+    queries round-robin over 'q' — the full ('q','v') mesh."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        graph: CSRGraph,
+        max_levels: Optional[int] = None,
+        query_chunk: Optional[int] = None,
+    ):
+        self.mesh = mesh
+        self.w = mesh.shape[QUERY_AXIS]
+        p = mesh.shape[VERTEX_AXIS]
+        self.parts = ShardedCSR(graph, p)
+        vspec = NamedSharding(mesh, P(VERTEX_AXIS))
+        self.col_indices = jax.device_put(self.parts.col_indices, vspec)
+        self.edge_src = jax.device_put(self.parts.edge_src, vspec)
+        self.max_levels = max_levels
+        self.query_chunk = query_chunk
+
+    def f_values(self, queries: np.ndarray) -> jax.Array:
+        sharded, k, k_pad, chunk = shard_queries(
+            self.mesh, np.asarray(queries), self.query_chunk
+        )
+        merged = _sharded_f_values(
+            self.mesh,
+            self.col_indices,
+            self.edge_src,
+            sharded,
+            self.parts.n,
+            self.parts.n_pad,
+            self.parts.block,
+            k,
+            k_pad,
+            self.w,
+            chunk,
+            self.max_levels,
+        )
+        return merged[:k]

@@ -1,0 +1,12 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference's data layer is native C++ (LoadGraphBin/LoadQueryBin,
+main.cu:92-164); this package provides the TPU framework's native
+equivalents — a fast mmap'd graph decoder + CSR builder (``loader.cpp``)
+compiled to ``librt_loader.so`` — with pure-NumPy fallbacks so the framework
+works unbuilt.  Build with ``make native`` at the repo root.
+"""
+
+from . import native_loader
+
+__all__ = ["native_loader"]

@@ -1,0 +1,83 @@
+"""ctypes bindings for the native graph loader (runtime/loader.cpp).
+
+Protocol (caller-allocated buffers, no cross-language ownership):
+  1. ``msbfs_graph_header(path, &n, &m)`` reads the header;
+  2. Python allocates ``row_offsets`` (n+1 int64) and ``col_indices``
+     (2m int32);
+  3. ``msbfs_load_graph_csr(path, n, m, row_offsets, col_indices)`` decodes
+     the edge list and builds the insertion-order CSR (the exact adjacency
+     order of reference main.cu:106-129) in one pass.
+
+Falls back cleanly when the shared library has not been built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..models.csr import CSRGraph
+
+_LIB_NAME = "librt_loader.so"
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), _LIB_NAME)
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    path = _lib_path()
+    if not os.path.exists(path):
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.msbfs_graph_header.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.msbfs_graph_header.restype = ctypes.c_int
+        lib.msbfs_load_graph_csr.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(dtype=np.int64, ndim=1, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, ndim=1, flags="C_CONTIGUOUS"),
+        ]
+        lib.msbfs_load_graph_csr.restype = ctypes.c_int
+        _lib = lib
+    except OSError:
+        _load_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+def load_graph_csr(path: str) -> CSRGraph:
+    lib = _get_lib()
+    if lib is None:
+        raise RuntimeError(f"{_LIB_NAME} not built (run `make native`)")
+    n = ctypes.c_int64()
+    m = ctypes.c_int64()
+    rc = lib.msbfs_graph_header(path.encode(), ctypes.byref(n), ctypes.byref(m))
+    if rc != 0:
+        raise IOError(f"native loader: cannot read header of {path} (rc={rc})")
+    row_offsets = np.zeros(n.value + 1, dtype=np.int64)
+    col_indices = np.zeros(2 * m.value, dtype=np.int32)
+    rc = lib.msbfs_load_graph_csr(path.encode(), n.value, m.value, row_offsets, col_indices)
+    if rc != 0:
+        raise IOError(f"native loader: failed to decode {path} (rc={rc})")
+    return CSRGraph(
+        n=int(n.value), m=int(m.value), row_offsets=row_offsets, col_indices=col_indices
+    )

@@ -1,0 +1,21 @@
+"""Host-side utilities: binary I/O, timing spans, the rank-0 report."""
+
+from .io import (
+    load_graph_bin,
+    load_query_bin,
+    save_graph_bin,
+    save_query_bin,
+    pad_queries,
+)
+from .report import format_report
+from .timing import Span
+
+__all__ = [
+    "load_graph_bin",
+    "load_query_bin",
+    "save_graph_bin",
+    "save_query_bin",
+    "pad_queries",
+    "format_report",
+    "Span",
+]

@@ -1,0 +1,124 @@
+"""Binary graph/query I/O, byte-for-byte compatible with the reference formats.
+
+Graph format (reference LoadGraphBin, main.cu:92-130):
+    int32  n                      -- vertex count          (main.cu:102)
+    int64  m                      -- undirected edge count (main.cu:104)
+    m x (int32 u, int32 v)        -- edge records          (main.cu:108-116)
+All little-endian native ints.  Every record is inserted in BOTH adjacency
+lists (undirected doubling, main.cu:114-115); duplicates and self-loops are
+preserved; neighbor order is insertion order.
+
+Query format (reference LoadQueryBin, main.cu:134-164):
+    uint8  K                      -- number of query groups ("up to 64")
+    per group: uint8 set_size, then set_size x int32 vertex ids
+
+The reference reads one int per fread (2m+2 calls for the graph — its I/O
+hot loop, SURVEY.md section 3 hot-loop #3); here the whole file is read in
+one shot and decoded with NumPy, with an optional native C++ decoder
+(:mod:`..runtime`) for the CSR build.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..models.csr import CSRGraph
+
+GRAPH_HEADER = struct.Struct("<iq")  # int32 n, int64 m
+
+
+def load_graph_bin(path: str | os.PathLike, native: Optional[bool] = None) -> CSRGraph:
+    """Load a reference-format binary graph into a host CSR.
+
+    ``native=True`` forces the C++ runtime loader, ``False`` the NumPy path,
+    ``None`` auto-selects (native when the shared library is built).
+    """
+    if native is None or native:
+        from ..runtime import native_loader
+
+        if native_loader.available():
+            return native_loader.load_graph_csr(os.fspath(path))
+        if native:
+            raise RuntimeError(
+                "native loader requested but librt_loader.so is not built "
+                "(run `make -C runtime` / `make native`)"
+            )
+    with open(path, "rb") as f:
+        header = f.read(GRAPH_HEADER.size)
+        if len(header) < GRAPH_HEADER.size:
+            raise IOError(f"truncated graph header in {path}")
+        n, m = GRAPH_HEADER.unpack(header)
+        edges = np.fromfile(f, dtype=np.int32, count=2 * m)
+    if edges.size != 2 * m:
+        raise IOError(f"truncated edge list in {path}: wanted {2*m} ints, got {edges.size}")
+    return CSRGraph.from_edges(n, edges.reshape(m, 2))
+
+
+def save_graph_bin(path: str | os.PathLike, n: int, edges: np.ndarray) -> None:
+    """Write the reference graph format from an (m, 2) int array."""
+    edges = np.ascontiguousarray(np.asarray(edges, dtype=np.int32))
+    if edges.ndim != 2 or edges.shape[1] != 2:
+        raise ValueError("edges must be (m, 2)")
+    with open(path, "wb") as f:
+        f.write(GRAPH_HEADER.pack(int(n), int(edges.shape[0])))
+        edges.tofile(f)
+
+
+def load_query_bin(path: str | os.PathLike) -> List[np.ndarray]:
+    """Load the reference query format -> list of K int32 arrays (ragged)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) < 1:
+        raise IOError(f"empty query file {path}")
+    k = data[0]
+    queries: List[np.ndarray] = []
+    off = 1
+    for _ in range(k):
+        if off >= len(data):
+            raise IOError(f"truncated query file {path}")
+        size = data[off]
+        off += 1
+        if len(data) - off < 4 * size:  # pre-check: frombuffer would raise
+            raise IOError(f"truncated query group in {path}")  # ValueError
+        ids = np.frombuffer(data, dtype=np.int32, count=size, offset=off)
+        off += 4 * size
+        queries.append(ids.copy())
+    return queries
+
+
+def save_query_bin(path: str | os.PathLike, queries: Sequence[Sequence[int]]) -> None:
+    """Write the reference query format (uint8 K, per-group uint8 size + int32s)."""
+    if len(queries) > 255:
+        raise ValueError("K must fit in uint8 (reference main.cu:143-145)")
+    with open(path, "wb") as f:
+        f.write(bytes([len(queries)]))
+        for q in queries:
+            q = np.asarray(q, dtype=np.int32)
+            if q.size > 255:
+                raise ValueError("group size must fit in uint8 (main.cu:150-152)")
+            f.write(bytes([q.size]))
+            q.tofile(f)
+
+
+def pad_queries(
+    queries: Sequence[Sequence[int]], pad_to: Optional[int] = None
+) -> np.ndarray:
+    """Pad ragged query groups to a (K, S) int32 array with -1 fill.
+
+    -1 padding is semantics-preserving because the BFS source init drops
+    out-of-range ids exactly as the reference's bounds check does
+    (main.cu:46-51).  ``pad_to`` overrides S (>= max group size).
+    """
+    K = len(queries)
+    max_s = max((len(q) for q in queries), default=0)
+    S = pad_to if pad_to is not None else max(max_s, 1)
+    if S < max_s:
+        raise ValueError(f"pad_to={S} < largest group size {max_s}")
+    out = np.full((K, S), -1, dtype=np.int32)
+    for i, q in enumerate(queries):
+        out[i, : len(q)] = np.asarray(q, dtype=np.int32)
+    return out

@@ -1,0 +1,29 @@
+"""The rank-0 stdout report — the reference's CLI output contract.
+
+Format reproduced verbatim from main.cu:403-414: fixed 9-decimal times, the
+winning query reported 1-based (``minK + 1``, main.cu:409), and the literal
+``GPU # : <numGPU> GPU`` line (the flag name is part of the public contract
+even though the devices are TPU chips here).
+"""
+
+from __future__ import annotations
+
+
+def format_report(
+    graph_path: str,
+    query_path: str,
+    min_k: int,
+    min_f: int,
+    num_gpu: int,
+    preprocessing_time: float,
+    computation_time: float,
+) -> str:
+    return (
+        f"Graph: {graph_path}\n"
+        f"Query: {query_path}\n"
+        f"Query number (k) with minimum F value: {min_k + 1}\n"
+        f"Minimum F value: {min_f}\n"
+        f"GPU # : {num_gpu} GPU\n"
+        f"Preprocessing time: {preprocessing_time:.9f} s\n"
+        f"Computation time: {computation_time:.9f} s\n"
+    )

@@ -1,0 +1,30 @@
+"""Two-span wall-clock timing, mirroring the reference's report (SURVEY C11).
+
+The reference times exactly two spans with ``chrono::high_resolution_clock``:
+preprocessing = load + broadcast + H2D upload (main.cu:235-298) and
+computation = all BFS runs + gather + argmin (main.cu:301-400).  Here the
+spans keep the same boundaries, with jit compilation counted as
+preprocessing (the CUDA reference's kernels are compiled offline by nvcc, so
+charging XLA compilation to the compute span would mis-compare).  Callers
+must ``block_until_ready`` before closing a span — XLA dispatch is async.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Span:
+    """``with Span() as s: ...`` then ``s.seconds``."""
+
+    def __init__(self):
+        self.seconds = 0.0
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.seconds = time.perf_counter() - self._t0
+        return False

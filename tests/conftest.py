@@ -1,0 +1,56 @@
+"""Test harness: force an 8-device virtual CPU platform.
+
+Multi-chip sharding (shard_map over the ('q','v') mesh) is exercised on CPU
+via XLA's host-platform device-count override, per the test strategy in
+SURVEY.md section 4(d).  Set MSBFS_TEST_TPU=1 to run the suite on the real
+device(s) instead.
+
+This environment's sitecustomize registers a TPU PJRT plugin in every
+interpreter when PALLAS_AXON_POOL_IPS is set; once registered, initializing
+the CPU backend deadlocks.  The only reliable fix is to restart pytest with
+the plugin env cleared BEFORE interpreter start, so pytest_configure
+re-execs exactly once (after stopping pytest's fd capture, which the child
+would otherwise inherit as its stdout).
+"""
+
+import os
+import sys
+
+
+def _needs_reexec() -> bool:
+    return bool(
+        not os.environ.get("MSBFS_TEST_TPU")
+        and os.environ.get("PALLAS_AXON_POOL_IPS")
+    )
+
+
+if not os.environ.get("MSBFS_TEST_TPU") and not _needs_reexec():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def pytest_configure(config):
+    if not _needs_reexec():
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        try:
+            capman.stop_global_capturing()
+        except Exception:
+            pass
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # sitecustomize skips the plugin register
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)

@@ -1,0 +1,68 @@
+"""Pure-NumPy/Python oracle reimplementing the reference semantics
+(independent of JAX), per the test strategy of SURVEY.md section 4(a).
+
+Oracle behaviors mirror /root/reference/main.cu exactly:
+* adjacency doubling with insertion order (main.cu:106-129);
+* source bounds check s in [0, n) (main.cu:46-51);
+* level-synchronous BFS from the multi-source frontier (main.cu:16-73);
+* F(U) skipping unreached vertices (main.cu:75-89);
+* argmin over valid entries, ties to lowest index (main.cu:379-397).
+"""
+
+from collections import deque
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def oracle_adjacency(n: int, edges: np.ndarray) -> List[List[int]]:
+    adj: List[List[int]] = [[] for _ in range(n)]
+    for u, v in np.asarray(edges):
+        adj[int(u)].append(int(v))
+        adj[int(v)].append(int(u))
+    return adj
+
+
+def oracle_csr(n: int, edges: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    adj = oracle_adjacency(n, edges)
+    row_offsets = np.zeros(n + 1, dtype=np.int64)
+    for i in range(n):
+        row_offsets[i + 1] = row_offsets[i] + len(adj[i])
+    col_indices = np.array(
+        [v for row in adj for v in row], dtype=np.int32
+    ) if row_offsets[-1] else np.zeros(0, dtype=np.int32)
+    return row_offsets, col_indices
+
+
+def oracle_bfs(n: int, edges: np.ndarray, sources: Sequence[int]) -> np.ndarray:
+    adj = oracle_adjacency(n, edges)
+    dist = np.full(n, -1, dtype=np.int64)
+    q = deque()
+    for s in sources:
+        s = int(s)
+        if 0 <= s < n and dist[s] != 0:
+            dist[s] = 0
+            q.append(s)
+    while q:
+        u = q.popleft()
+        for v in adj[u]:
+            if dist[v] == -1:
+                dist[v] = dist[u] + 1
+                q.append(v)
+    return dist
+
+
+def oracle_f(dist: np.ndarray) -> int:
+    return int(dist[dist >= 0].sum())
+
+
+def oracle_best(f_values: Sequence[int]) -> Tuple[int, int]:
+    min_f, min_k = -1, -1
+    for i, f in enumerate(f_values):
+        if f >= 0:
+            min_f, min_k = int(f), i
+            break
+    for i, f in enumerate(f_values):
+        if 0 <= f < min_f:
+            min_f, min_k = int(f), i
+    return min_f, min_k

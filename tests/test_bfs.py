@@ -1,0 +1,116 @@
+"""BFS core vs the NumPy oracle: property tests over graph families and the
+reference's edge-case semantics (main.cu:40-73)."""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+    CSRGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bfs import (
+    batched_multi_source_bfs,
+    multi_source_bfs,
+)
+
+from oracle import oracle_bfs
+
+
+def run_bfs(n, edges, sources):
+    g = CSRGraph.from_edges(n, edges).to_device()
+    sources = np.asarray(sources, dtype=np.int32)
+    if sources.size == 0:
+        sources = np.array([-1], dtype=np.int32)
+    return np.asarray(multi_source_bfs(g, sources))
+
+
+GRAPHS = {
+    "gnm_small": generators.gnm_edges(60, 150, seed=1),
+    "gnm_sparse_disconnected": generators.gnm_edges(200, 80, seed=2),
+    "grid_high_diameter": generators.grid_edges(17, 11),
+    "rmat_tiny": generators.rmat_edges(8, edge_factor=8, seed=4),
+    "star": (9, np.array([[0, i] for i in range(1, 9)], dtype=np.int32)),
+    "path": (12, np.array([[i, i + 1] for i in range(11)], dtype=np.int32)),
+    "self_loops_dups": (
+        5,
+        np.array([[0, 0], [0, 1], [0, 1], [3, 4], [4, 3]], dtype=np.int32),
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(GRAPHS))
+def test_single_source_matches_oracle(name):
+    n, edges = GRAPHS[name]
+    got = run_bfs(n, edges, [0])
+    want = oracle_bfs(n, edges, [0])
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("name", sorted(GRAPHS))
+def test_multi_source_matches_oracle(name):
+    n, edges = GRAPHS[name]
+    rng = np.random.default_rng(7)
+    sources = rng.integers(0, n, size=5)
+    got = run_bfs(n, edges, sources)
+    want = oracle_bfs(n, edges, sources)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_empty_source_set_all_unreached():
+    n, edges = GRAPHS["gnm_small"]
+    got = run_bfs(n, edges, [])
+    assert (got == -1).all()
+
+
+def test_out_of_range_sources_dropped():
+    # The reference bounds-checks sources (main.cu:46-51): -1 padding and
+    # ids >= n must be ignored, not crash or corrupt.
+    n, edges = GRAPHS["path"]
+    base = run_bfs(n, edges, [3])
+    padded = run_bfs(n, edges, [-1, 3, n, n + 100, -1])
+    np.testing.assert_array_equal(base, padded)
+
+
+def test_isolated_vertices_stay_unreached():
+    n, edges = GRAPHS["gnm_sparse_disconnected"]
+    dist = run_bfs(n, edges, [0])
+    want = oracle_bfs(n, edges, [0])
+    assert (dist == -1).sum() == (want == -1).sum() > 0
+
+
+def test_max_levels_caps_depth():
+    n, edges = GRAPHS["path"]
+    dist = np.asarray(
+        multi_source_bfs(
+            CSRGraph.from_edges(n, edges).to_device(),
+            np.array([0], dtype=np.int32),
+            max_levels=3,
+        )
+    )
+    assert dist.max() == 3 and (dist[4:] == -1).all()
+
+
+def test_batched_matches_sequential():
+    n, edges = GRAPHS["gnm_small"]
+    g = CSRGraph.from_edges(n, edges).to_device()
+    rng = np.random.default_rng(11)
+    queries = rng.integers(-1, n, size=(6, 4)).astype(np.int32)
+    batched = np.asarray(batched_multi_source_bfs(g, queries))
+    for i in range(queries.shape[0]):
+        seq = np.asarray(multi_source_bfs(g, queries[i]))
+        np.testing.assert_array_equal(batched[i], seq)
+
+
+def test_distance_is_metric_consistent():
+    # Triangle-ish property on an undirected graph: neighboring vertices'
+    # BFS levels differ by at most 1.
+    n, edges = generators.gnm_edges(80, 200, seed=13)
+    dist = run_bfs(n, edges, [0, 5])
+    for u, v in edges:
+        du, dv = dist[u], dist[v]
+        if du >= 0 and dv >= 0:
+            assert abs(int(du) - int(dv)) <= 1
+        else:
+            assert du == dv == -1

@@ -1,0 +1,91 @@
+"""End-to-end CLI test: exact report format diffing (reference main.cu:403-414),
+per SURVEY.md section 4(e)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+    main,
+    parse_args,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+    save_graph_bin,
+    save_query_bin,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+REPORT_RE = re.compile(
+    r"^Graph: (?P<g>.+)\n"
+    r"Query: (?P<q>.+)\n"
+    r"Query number \(k\) with minimum F value: (?P<mink>-?\d+)\n"
+    r"Minimum F value: (?P<minf>-?\d+)\n"
+    r"GPU # : (?P<gn>\d+) GPU\n"
+    r"Preprocessing time: (?P<pre>\d+\.\d{9}) s\n"
+    r"Computation time: (?P<comp>\d+\.\d{9}) s\n$"
+)
+
+
+@pytest.fixture(scope="module")
+def files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    n, edges = generators.gnm_edges(90, 300, seed=51)
+    queries = generators.random_queries(n, 9, max_group=4, seed=52)
+    gpath, qpath = str(d / "g.bin"), str(d / "q.bin")
+    save_graph_bin(gpath, n, edges)
+    save_query_bin(qpath, queries)
+    want = oracle_best([oracle_f(oracle_bfs(n, edges, q)) for q in queries])
+    return gpath, qpath, want
+
+
+def run_cli(argv, capsys):
+    rc = main(argv)
+    out = capsys.readouterr()
+    return rc, out.out, out.err
+
+
+def test_report_format_and_values(files, capsys):
+    gpath, qpath, (min_f, min_k) = files
+    rc, out, _ = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"], capsys)
+    assert rc == 0
+    m = REPORT_RE.match(out)
+    assert m, f"report format mismatch:\n{out!r}"
+    assert m["g"] == gpath and m["q"] == qpath
+    assert int(m["mink"]) == min_k + 1  # 1-based (main.cu:409)
+    assert int(m["minf"]) == min_f
+    assert int(m["gn"]) == 1
+
+
+def test_multichip_gn(files, capsys):
+    gpath, qpath, (min_f, min_k) = files
+    rc, out, _ = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "8"], capsys)
+    assert rc == 0
+    m = REPORT_RE.match(out)
+    assert m and int(m["mink"]) == min_k + 1 and int(m["minf"]) == min_f
+    assert int(m["gn"]) == 8  # reported as given (main.cu:411)
+
+
+def test_usage_on_missing_args(capsys):
+    rc, out, err = run_cli(["main.py", "-g", "x"], capsys)
+    assert rc == -1 and out == "" and "Usage:" in err
+
+
+def test_missing_graph_file(files, capsys):
+    _, qpath, _ = files
+    rc, _, err = run_cli(
+        ["main.py", "-g", "/nonexistent.bin", "-q", qpath, "-gn", "1"], capsys
+    )
+    assert rc == 1 and "Could not open graph file" in err
+
+
+def test_parse_args_reference_semantics():
+    # Unknown flags silently ignored; -gn default 1 (main.cu:214-224).
+    g, q, gn = parse_args(["prog", "-x", "1", "-g", "a", "-q", "b", "--foo"])
+    assert (g, q, gn) == ("a", "b", 1)
+    assert parse_args(["prog", "-g", "a", "-q", "b", "-gn", "3"])[2] == 3
+    assert parse_args(["prog", "-g", "a", "-q", "b", "-gn", "zzz"])[2] == 0

@@ -1,0 +1,102 @@
+"""Distributed layer on the 8-device virtual CPU mesh: cyclic assignment,
+pmax merge, parity with single-device results (SURVEY.md C8-C10)."""
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    Engine,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel import (
+    DistributedEngine,
+    cyclic_assignment,
+    make_mesh,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.scheduler import (
+    cyclic_grid,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def oracle_f_values(n, edges, queries):
+    return [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+
+
+def test_cyclic_assignment_matches_reference_loop():
+    # Reference: for(kidx = world_rank; kidx < K; kidx += world_size)
+    # (main.cu:303-307).
+    assert cyclic_assignment(10, 4) == [[0, 4, 8], [1, 5, 9], [2, 6], [3, 7]]
+    assert cyclic_assignment(3, 8)[5] == []
+
+
+def test_cyclic_grid_layout():
+    queries = np.arange(10, dtype=np.int32).reshape(10, 1)
+    grid, gids, k_pad = cyclic_grid(queries, 4)
+    assert grid.shape == (4, 3, 1) and k_pad == 12
+    # Slot [r, j] holds global query r + j*W.
+    for r in range(4):
+        for j in range(3):
+            gid = r + j * 4
+            assert gids[r, j] == gid
+            expected = gid if gid < 10 else -1
+            assert grid[r, j, 0] == expected
+
+
+@pytest.fixture(scope="module")
+def problem():
+    n, edges = generators.gnm_edges(150, 500, seed=41)
+    queries = generators.random_queries(n, 13, max_group=5, seed=42)
+    return n, edges, queries, pad_queries(queries)
+
+
+@pytest.mark.parametrize("w", [1, 2, 8])
+def test_distributed_matches_single_device(problem, w):
+    n, edges, queries, padded = problem
+    graph = CSRGraph.from_edges(n, edges)
+    mesh = make_mesh(num_query_shards=w, devices=jax.devices()[:w])
+    deng = DistributedEngine(mesh, graph)
+    got = np.asarray(deng.f_values(padded))
+    want = oracle_f_values(n, edges, queries)
+    np.testing.assert_array_equal(got, want)
+    assert deng.best(padded) == oracle_best(want)
+
+
+def test_fewer_queries_than_shards(problem):
+    n, edges, queries, _ = problem
+    graph = CSRGraph.from_edges(n, edges)
+    mesh = make_mesh(num_query_shards=8)
+    padded = pad_queries(queries[:3])
+    deng = DistributedEngine(mesh, graph)
+    got = np.asarray(deng.f_values(padded))
+    want = oracle_f_values(n, edges, queries[:3])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_query_chunked_distributed(problem):
+    n, edges, queries, padded = problem
+    graph = CSRGraph.from_edges(n, edges)
+    mesh = make_mesh(num_query_shards=4, devices=jax.devices()[:4])
+    deng = DistributedEngine(mesh, graph, query_chunk=2)
+    got = np.asarray(deng.f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_two_axis_mesh_query_sharding(problem):
+    # ('q','v') mesh with v=2: graph replicated, queries over q=4.
+    n, edges, queries, padded = problem
+    graph = CSRGraph.from_edges(n, edges)
+    mesh = make_mesh(num_query_shards=4, num_vertex_shards=2)
+    deng = DistributedEngine(mesh, graph)
+    got = np.asarray(deng.f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))

@@ -1,0 +1,89 @@
+"""Regression tests for review findings: K=0, corrupt inputs, alias shim."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    Engine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import main
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.objective import (
+    select_best,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+    load_graph_bin,
+    load_query_bin,
+    save_graph_bin,
+    save_query_bin,
+)
+
+import jax.numpy as jnp
+
+
+def test_select_best_empty():
+    min_f, min_k = select_best(jnp.zeros((0,), jnp.int64), jnp.zeros((0,), bool))
+    assert (int(min_f), int(min_k)) == (-1, -1)
+
+
+def test_engine_zero_queries():
+    n, edges = generators.gnm_edges(30, 60, seed=81)
+    eng = Engine(CSRGraph.from_edges(n, edges).to_device())
+    f = eng.f_values(jnp.zeros((0, 1), jnp.int32))
+    assert f.shape == (0,)
+    assert eng.best(np.zeros((0, 1), np.int32)) == (-1, -1)
+
+
+def test_cli_k_zero(tmp_path, capsys):
+    # Reference with K=0: scans never run, prints minK+1 = 0, minF = -1
+    # (main.cu:379-414).
+    n, edges = generators.gnm_edges(30, 60, seed=82)
+    g, q = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
+    save_graph_bin(g, n, edges)
+    save_query_bin(q, [])
+    rc = main(["main.py", "-g", g, "-q", q, "-gn", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Query number (k) with minimum F value: 0\n" in out
+    assert "Minimum F value: -1\n" in out
+
+
+def test_truncated_query_group_raises_ioerror(tmp_path):
+    path = tmp_path / "q.bin"
+    with open(path, "wb") as f:
+        f.write(bytes([1, 5]))  # K=1, group of 5 ids, but no payload
+        f.write(struct.pack("<i", 3))  # only 1 of 5
+    with pytest.raises(IOError):
+        load_query_bin(path)
+
+
+def test_corrupt_graph_vertex_ids(tmp_path, capsys):
+    path = tmp_path / "g.bin"
+    save_graph_bin(path, 3, np.array([[0, 9]], dtype=np.int32))
+    with pytest.raises(ValueError):
+        load_graph_bin(path, native=False)
+    # CLI converts it to the reference-style error + exit 1.
+    qpath = tmp_path / "q.bin"
+    save_query_bin(qpath, [[0]])
+    rc = main(["main.py", "-g", str(path), "-q", str(qpath), "-gn", "1"])
+    assert rc == 1
+    assert "Could not open graph file" in capsys.readouterr().err
+
+
+def test_alias_shim_shares_module_objects():
+    import msbfs_tpu  # noqa: F401
+    from msbfs_tpu.parallel.distributed import DistributedEngine as A
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
+        DistributedEngine as B,
+    )
+
+    assert A is B
+    import msbfs_tpu.ops.bfs as short_bfs
+    import parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bfs as long_bfs
+
+    assert short_bfs is long_bfs

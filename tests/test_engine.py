@@ -1,0 +1,52 @@
+"""Single-device Engine: end-to-end query pipeline vs oracle, chunking."""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    Engine,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+
+def oracle_f_values(n, edges, queries):
+    return [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    n, edges = generators.gnm_edges(120, 420, seed=31)
+    queries = generators.random_queries(n, 11, max_group=6, seed=32)
+    queries[3] = np.zeros(0, dtype=np.int32)  # empty group -> F = 0, wins
+    padded = pad_queries(queries)
+    return n, edges, queries, padded
+
+
+def test_f_values_match_oracle(setup):
+    n, edges, queries, padded = setup
+    eng = Engine(CSRGraph.from_edges(n, edges).to_device())
+    got = np.asarray(eng.f_values(padded))
+    want = oracle_f_values(n, edges, queries)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("chunk", [1, 2, 4, 16])
+def test_chunking_invariant(setup, chunk):
+    n, edges, queries, padded = setup
+    eng = Engine(CSRGraph.from_edges(n, edges).to_device(), query_chunk=chunk)
+    got = np.asarray(eng.f_values(padded))
+    want = oracle_f_values(n, edges, queries)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_best_matches_oracle(setup):
+    n, edges, queries, padded = setup
+    eng = Engine(CSRGraph.from_edges(n, edges).to_device())
+    min_f, min_k = eng.best(padded)
+    assert (min_f, min_k) == oracle_best(oracle_f_values(n, edges, queries))

@@ -1,0 +1,24 @@
+"""Driver entry points compile and run on the virtual platform."""
+
+import jax
+import numpy as np
+import pytest
+
+import __graft_entry__ as ge
+
+
+def test_entry_jits_and_runs():
+    fn, args = ge.entry()
+    min_f, min_k = jax.jit(fn)(*args)
+    assert int(min_f) >= 0
+    assert 0 <= int(min_k) < args[3].shape[0]
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 (virtual) devices")
+def test_dryrun_multichip():
+    ge.dryrun_multichip(8)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 (virtual) devices")
+def test_dryrun_multichip_odd_axes():
+    ge.dryrun_multichip(4)

@@ -1,0 +1,119 @@
+"""Binary format tests: byte-exact round trips and CSR build parity
+(reference formats: main.cu:92-130 graph, main.cu:134-164 queries)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+    CSRGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+    load_graph_bin,
+    load_query_bin,
+    pad_queries,
+    save_graph_bin,
+    save_query_bin,
+)
+
+from oracle import oracle_csr
+
+
+def test_graph_bytes_exact(tmp_path):
+    # Hand-build the exact byte layout: int32 n, int64 m, m x (int32, int32).
+    edges = [(0, 1), (1, 2), (2, 2), (0, 1)]  # self-loop + duplicate
+    path = tmp_path / "g.bin"
+    with open(path, "wb") as f:
+        f.write(struct.pack("<iq", 4, len(edges)))
+        for u, v in edges:
+            f.write(struct.pack("<ii", u, v))
+    g = load_graph_bin(path, native=False)
+    assert g.n == 4 and g.m == 4
+    ro, ci = oracle_csr(4, np.array(edges))
+    np.testing.assert_array_equal(g.row_offsets, ro)
+    np.testing.assert_array_equal(g.col_indices, ci)
+    # Self-loop stored twice (main.cu:114-115): vertex 2 has [2, 2, 1].
+    assert g.degrees[2] == 3
+
+
+def test_graph_roundtrip(tmp_path):
+    n, edges = generators.gnm_edges(100, 400, seed=3)
+    path = tmp_path / "g.bin"
+    save_graph_bin(path, n, edges)
+    g = load_graph_bin(path, native=False)
+    assert (g.n, g.m) == (n, 400)
+    ro, ci = oracle_csr(n, edges)
+    np.testing.assert_array_equal(g.row_offsets, ro)
+    np.testing.assert_array_equal(g.col_indices, ci)
+
+
+def test_graph_empty(tmp_path):
+    path = tmp_path / "g.bin"
+    save_graph_bin(path, 5, np.zeros((0, 2), dtype=np.int32))
+    g = load_graph_bin(path, native=False)
+    assert g.n == 5 and g.m == 0 and g.num_directed_edges == 0
+
+
+def test_graph_truncated(tmp_path):
+    path = tmp_path / "g.bin"
+    with open(path, "wb") as f:
+        f.write(struct.pack("<iq", 4, 10))  # header promises 10 edges, none given
+    with pytest.raises(IOError):
+        load_graph_bin(path, native=False)
+
+
+def test_query_bytes_exact(tmp_path):
+    path = tmp_path / "q.bin"
+    # uint8 K=3; groups: [5], [], [7, 8, 9]
+    with open(path, "wb") as f:
+        f.write(bytes([3]))
+        f.write(bytes([1]) + struct.pack("<i", 5))
+        f.write(bytes([0]))
+        f.write(bytes([3]) + struct.pack("<iii", 7, 8, 9))
+    qs = load_query_bin(path)
+    assert len(qs) == 3
+    np.testing.assert_array_equal(qs[0], [5])
+    assert qs[1].size == 0
+    np.testing.assert_array_equal(qs[2], [7, 8, 9])
+
+
+def test_query_roundtrip(tmp_path):
+    queries = generators.random_queries(1000, 17, max_group=128, seed=5)
+    queries.append(np.zeros(0, dtype=np.int32))  # empty group
+    path = tmp_path / "q.bin"
+    save_query_bin(path, queries)
+    back = load_query_bin(path)
+    assert len(back) == len(queries)
+    for a, b in zip(queries, back):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_query_limits(tmp_path):
+    with pytest.raises(ValueError):
+        save_query_bin(tmp_path / "q.bin", [[0]] * 256)  # K > uint8
+    with pytest.raises(ValueError):
+        save_query_bin(tmp_path / "q.bin", [list(range(256))])  # size > uint8
+
+
+def test_pad_queries():
+    qs = [np.array([1, 2]), np.array([], dtype=np.int32), np.array([3, 4, 5])]
+    p = pad_queries(qs)
+    assert p.shape == (3, 3) and p.dtype == np.int32
+    np.testing.assert_array_equal(p[0], [1, 2, -1])
+    np.testing.assert_array_equal(p[1], [-1, -1, -1])
+    np.testing.assert_array_equal(p[2], [3, 4, 5])
+    assert pad_queries([], pad_to=4).shape == (0, 4)
+    with pytest.raises(ValueError):
+        pad_queries(qs, pad_to=2)
+
+
+def test_from_edges_matches_oracle_insertion_order():
+    n, edges = generators.gnm_edges(50, 300, seed=9)
+    g = CSRGraph.from_edges(n, edges)
+    ro, ci = oracle_csr(n, edges)
+    np.testing.assert_array_equal(g.row_offsets, ro)
+    np.testing.assert_array_equal(g.col_indices, ci)

@@ -1,0 +1,66 @@
+"""Objective F(U) and selection semantics (reference main.cu:75-89, 379-397)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.objective import (
+    f_of_u,
+    select_best,
+)
+
+from oracle import oracle_best
+
+
+def test_f_skips_unreached():
+    dist = jnp.array([0, 3, -1, 2, -1], dtype=jnp.int32)
+    assert int(f_of_u(dist)) == 5
+
+
+def test_f_all_unreached_is_zero():
+    # Empty source set => all -1 => F = 0 (reference sums nothing, returns 0).
+    assert int(f_of_u(jnp.full(10, -1, dtype=jnp.int32))) == 0
+
+
+def test_f_int64_accumulator():
+    # n * dist overflows int32; the reference uses long long (main.cu:81).
+    dist = jnp.full(3_000_000, 1000, dtype=jnp.int32)
+    assert int(f_of_u(dist)) == 3_000_000_000
+
+
+def test_select_best_tie_breaks_lowest_index():
+    f = jnp.array([7, 3, 3, 9], dtype=jnp.int64)
+    valid = jnp.ones(4, dtype=bool)
+    min_f, min_k = select_best(f, valid)
+    assert (int(min_f), int(min_k)) == (3, 1)
+    assert oracle_best([7, 3, 3, 9]) == (3, 1)
+
+
+def test_select_best_skips_invalid():
+    f = jnp.array([-1, 5, 2, -1], dtype=jnp.int64)
+    min_f, min_k = select_best(f, f >= 0)
+    assert (int(min_f), int(min_k)) == (2, 2)
+    assert oracle_best([-1, 5, 2, -1]) == (2, 2)
+
+
+def test_select_best_none_valid():
+    f = jnp.full(4, -1, dtype=jnp.int64)
+    min_f, min_k = select_best(f, f >= 0)
+    assert (int(min_f), int(min_k)) == (-1, -1)
+    assert oracle_best([-1, -1, -1, -1]) == (-1, -1)
+
+
+def test_select_best_zero_is_valid():
+    # F = 0 (e.g. empty query group) is a VALID minimum in the reference
+    # (>= 0 test, main.cu:384).
+    f = jnp.array([4, 0, 1], dtype=jnp.int64)
+    min_f, min_k = select_best(f, jnp.ones(3, dtype=bool))
+    assert (int(min_f), int(min_k)) == (0, 1)
+
+
+def test_random_agreement_with_oracle():
+    rng = np.random.default_rng(21)
+    for _ in range(50):
+        k = int(rng.integers(1, 12))
+        f = rng.integers(-1, 20, size=k)
+        got = select_best(jnp.asarray(f, dtype=jnp.int64), jnp.asarray(f >= 0))
+        assert (int(got[0]), int(got[1])) == oracle_best(list(f))

@@ -1,0 +1,85 @@
+"""Vertex-sharded CSR BFS on the virtual mesh: partition correctness and
+parity with the replicated engine (the scale-out extension, SURVEY.md §5/§7)."""
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+    make_mesh,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_csr import (
+    ShardedCSR,
+    ShardedEngine,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def oracle_f_values(n, edges, queries):
+    return [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+
+
+def test_partition_covers_all_edges():
+    n, edges = generators.gnm_edges(101, 400, seed=71)  # n not divisible by 4
+    g = CSRGraph.from_edges(n, edges)
+    parts = ShardedCSR(g, 4)
+    assert parts.n_pad == parts.block * 4 >= n
+    # Every directed slot appears exactly once across shards, in row order.
+    total = 0
+    for b in range(4):
+        hi = int(parts.row_offsets[b, -1])
+        total += hi
+        # Padding slots are marked with edge_src == block (dropped).
+        assert (parts.edge_src[b, hi:] == parts.block).all()
+        assert (parts.edge_src[b, :hi] < parts.block).all()
+        assert (parts.edge_src[b, :hi] >= 0).all()
+    assert total == g.num_directed_edges
+
+
+@pytest.mark.parametrize("qv", [(1, 8), (2, 4), (4, 2), (8, 1)])
+def test_sharded_matches_oracle(qv):
+    w, p = qv
+    n, edges = generators.gnm_edges(150, 480, seed=72)
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 10, max_group=5, seed=73)
+    padded = pad_queries(queries)
+    mesh = make_mesh(num_query_shards=w, num_vertex_shards=p)
+    eng = ShardedEngine(mesh, g)
+    got = np.asarray(eng.f_values(padded))
+    want = oracle_f_values(n, edges, queries)
+    np.testing.assert_array_equal(got, want)
+    assert eng.best(padded) == oracle_best(want)
+
+
+def test_sharded_high_diameter_grid():
+    n, edges = generators.grid_edges(23, 9)  # diameter ~30, odd n
+    g = CSRGraph.from_edges(n, edges)
+    queries = [np.array([0], dtype=np.int32), np.array([n - 1, 3], dtype=np.int32)]
+    padded = pad_queries(queries)
+    mesh = make_mesh(num_query_shards=2, num_vertex_shards=4)
+    eng = ShardedEngine(mesh, g)
+    got = np.asarray(eng.f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_sharded_unreachable_and_empty():
+    n, edges = generators.gnm_edges(120, 60, seed=74)  # very sparse
+    g = CSRGraph.from_edges(n, edges)
+    queries = [np.array([], dtype=np.int32), np.array([0], dtype=np.int32)]
+    padded = pad_queries(queries)
+    mesh = make_mesh(num_query_shards=2, num_vertex_shards=4)
+    eng = ShardedEngine(mesh, g)
+    got = np.asarray(eng.f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))

@@ -23,6 +23,7 @@ for _sub in (
     "models.generators",
     "ops",
     "ops.bfs",
+    "ops.dense",
     "ops.engine",
     "ops.objective",
     "parallel",

@@ -94,7 +94,27 @@ def main(argv: Optional[List[str]] = None) -> int:
             mesh = default_mesh(max_devices=n_chips)
             engine = DistributedEngine(mesh, graph)
         else:
-            engine = Engine(graph.to_device())
+            # Backend selection (beyond-reference knob, env-controlled so the
+            # argv contract stays reference-exact): "dense" runs frontier
+            # expansion as a bf16 matmul on the MXU, worthwhile when the
+            # n^2 adjacency fits HBM; "auto" picks it for small graphs on
+            # MXU-bearing devices only.
+            import os
+
+            backend = os.environ.get("MSBFS_BACKEND", "auto")
+            use_dense = backend == "dense"
+            if backend == "auto" and jax.default_backend() in ("tpu", "axon"):
+                try:
+                    threshold = int(os.environ.get("MSBFS_DENSE_THRESHOLD", "8192"))
+                except ValueError:
+                    threshold = 8192
+                use_dense = graph.n <= threshold
+            if use_dense:
+                from .ops.dense import DenseGraph
+
+                engine = Engine(DenseGraph.from_host(graph))
+            else:
+                engine = Engine(graph.to_device())
         engine.compile(padded.shape)
 
     # ---- computation span: all BFS + objective + argmin (main.cu:301-400).

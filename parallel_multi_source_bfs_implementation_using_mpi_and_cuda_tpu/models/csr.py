@@ -134,6 +134,18 @@ class DeviceCSR:
             num_edges=E,
         )
 
+    @property
+    def n_pad(self) -> int:
+        """Distance-state length; the CSR engine's state is unpadded.
+        Part of the graph-container contract (the dense engine pads to
+        lane multiples), read uniformly by ops.bfs.multi_source_bfs."""
+        return self.n
+
+    def expand_frontier(self, dist, level):
+        """One BFS level via the CSR pull formulation (see ops.bfs)."""
+        from ..ops.bfs import frontier_expand  # lazy: models must not
+        return frontier_expand(dist, level, self)  # import ops at load time
+
     def tree_flatten(self):
         return (
             (self.row_offsets, self.col_indices, self.edge_src),

@@ -1,14 +1,25 @@
 """Device compute: BFS engines, objective, batched execution."""
 
-from .bfs import multi_source_bfs, batched_multi_source_bfs, init_distances
+from .bfs import (
+    multi_source_bfs,
+    batched_multi_source_bfs,
+    init_distances,
+    frontier_expand,
+    graph_expand,
+)
+from .dense import DenseGraph
 from .objective import f_of_u, select_best
-from .engine import Engine
+from .engine import Engine, QueryEngineBase
 
 __all__ = [
     "multi_source_bfs",
     "batched_multi_source_bfs",
     "init_distances",
+    "frontier_expand",
+    "graph_expand",
+    "DenseGraph",
     "f_of_u",
     "select_best",
     "Engine",
+    "QueryEngineBase",
 ]

@@ -38,17 +38,22 @@ from ..models.csr import DeviceCSR
 NOT_REACHED = jnp.int32(-1)
 
 
-def init_distances(n: int, sources: jax.Array) -> jax.Array:
+def init_distances(
+    n: int, sources: jax.Array, state_size: Optional[int] = None
+) -> jax.Array:
     """Distance init: -1 everywhere, 0 at in-range sources.
 
     Out-of-range entries (including the -1 padding used for ragged query
     groups) are dropped — exactly the reference's ``s >= 0 && s < n`` guard
     (main.cu:46-51), which is what makes -1 padding semantics-preserving.
+    ``state_size`` (>= n) sizes the array for engines whose state is padded
+    (the dense-MXU backend pads to lane multiples); bounds stay [0, n).
     """
+    size = n if state_size is None else state_size
     sources = sources.astype(jnp.int32)
-    dist = jnp.full((n,), NOT_REACHED, dtype=jnp.int32)
+    dist = jnp.full((size,), NOT_REACHED, dtype=jnp.int32)
     in_range = (sources >= 0) & (sources < n)
-    safe = jnp.where(in_range, sources, n)  # n is out of bounds -> dropped
+    safe = jnp.where(in_range, sources, size)  # out of bounds -> dropped
     return dist.at[safe].set(0, mode="drop")
 
 
@@ -72,11 +77,17 @@ def frontier_expand(dist: jax.Array, level: jax.Array, graph: DeviceCSR) -> jax.
     return (dist == NOT_REACHED) & (reached > 0)
 
 
+def graph_expand(dist: jax.Array, level: jax.Array, graph) -> jax.Array:
+    """Default expansion: dispatch to the graph container's own engine
+    (CSR pull for :class:`DeviceCSR`, MXU matmul for ``DenseGraph``)."""
+    return graph.expand_frontier(dist, level)
+
+
 def multi_source_bfs(
     graph: DeviceCSR,
     sources: jax.Array,
     max_levels: Optional[int] = None,
-    expand=frontier_expand,
+    expand=graph_expand,
 ) -> jax.Array:
     """BFS from a (possibly -1-padded) int32 source set; returns (n,) int32
     distances, -1 for unreached vertices (reference main.cu:40-73).
@@ -100,7 +111,7 @@ def multi_source_bfs(
         dist = jnp.where(new, level + 1, dist)
         return (dist, level + 1, jnp.any(new))
 
-    dist0 = init_distances(graph.n, sources)
+    dist0 = init_distances(graph.n, sources, state_size=graph.n_pad)
     # Initial "updated" flag: true iff any valid source exists.  (An empty
     # source set terminates immediately with all -1, like the reference's
     # single no-op kernel launch.)  Deriving it from dist0 — rather than a
@@ -115,7 +126,7 @@ def batched_multi_source_bfs(
     graph: DeviceCSR,
     sources: jax.Array,
     max_levels: Optional[int] = None,
-    expand=frontier_expand,
+    expand=graph_expand,
 ) -> jax.Array:
     """vmap of :func:`multi_source_bfs` over a (K, S) query batch -> (K, n).
 

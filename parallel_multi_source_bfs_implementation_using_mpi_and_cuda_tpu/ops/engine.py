@@ -18,7 +18,7 @@ import numpy as np
 from jax import lax
 
 from ..models.csr import DeviceCSR
-from .bfs import frontier_expand, multi_source_bfs
+from .bfs import graph_expand, multi_source_bfs
 from .objective import f_of_u, select_best_jit
 
 
@@ -65,7 +65,7 @@ class Engine(QueryEngineBase):
         graph: DeviceCSR,
         max_levels: Optional[int] = None,
         query_chunk: Optional[int] = None,
-        expand=frontier_expand,
+        expand=graph_expand,
     ):
         self.graph = graph
         self.max_levels = max_levels

@@ -25,7 +25,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.csr import CSRGraph, DeviceCSR
-from ..ops.bfs import frontier_expand, multi_source_bfs
+from ..ops.bfs import graph_expand, multi_source_bfs
 from ..ops.engine import QueryEngineBase
 from ..ops.objective import f_of_u
 from .mesh import QUERY_AXIS, VERTEX_AXIS
@@ -82,7 +82,7 @@ class DistributedEngine(QueryEngineBase):
         graph: CSRGraph | DeviceCSR,
         max_levels: Optional[int] = None,
         query_chunk: Optional[int] = None,
-        expand=frontier_expand,
+        expand=graph_expand,
     ):
         self.mesh = mesh
         self.w = mesh.shape[QUERY_AXIS]

@@ -16,7 +16,9 @@ vertex-parallel BFS with per-level host sync on power-law graphs lands at
 ~1-2 GTEPS on A100-class hardware; we use 1.5e9.
 
 Env knobs: BENCH_SCALE (default 20), BENCH_EDGE_FACTOR (16), BENCH_K (64),
-BENCH_CHUNK (8), BENCH_REPEATS (3), BENCH_MAX_S (64).
+BENCH_CHUNK (8), BENCH_REPEATS (3), BENCH_MAX_S (64),
+BENCH_ENGINE (packed|vmap|dense, default packed),
+BENCH_EDGE_CHUNKS (packed engine HBM knob, default 1).
 """
 
 import json
@@ -36,6 +38,8 @@ def main() -> None:
     chunk = int(os.environ.get("BENCH_CHUNK", "8"))
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
     max_s = int(os.environ.get("BENCH_MAX_S", "64"))
+    engine_kind = os.environ.get("BENCH_ENGINE", "packed")
+    edge_chunks = int(os.environ.get("BENCH_EDGE_CHUNKS", "1"))
 
     import jax
 
@@ -61,7 +65,25 @@ def main() -> None:
     gen_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    engine = Engine(g.to_device(), query_chunk=chunk)
+    if engine_kind == "dense":
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.dense import (
+            DenseGraph,
+        )
+
+        if n > 16384:  # n^2 adjacency: fail fast, not host-OOM mid-fill
+            sys.exit(
+                f"BENCH_ENGINE=dense infeasible for n={n} (n^2 adjacency); "
+                "use BENCH_SCALE<=14 or the packed engine"
+            )
+        engine = Engine(DenseGraph.from_host(g))
+    elif engine_kind == "vmap":
+        engine = Engine(g.to_device(), query_chunk=chunk)
+    else:
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+            PackedEngine,
+        )
+
+        engine = PackedEngine(g.to_device(), edge_chunks=edge_chunks)
     engine.compile(queries.shape)  # compile outside the timed span
     compile_s = time.perf_counter() - t0
 
@@ -88,7 +110,9 @@ def main() -> None:
             "minF": int(min_f),
             "minK_1based": int(min_k) + 1,
             "device": str(jax.devices()[0]),
+            "engine": engine_kind,
             "query_chunk": chunk,
+            "edge_chunks": edge_chunks,
             "baseline_note": "reference publishes no numbers; vs est. "
             "1.5 GTEPS naive A100 kernel (see module docstring)",
         },

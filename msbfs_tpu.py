@@ -26,6 +26,7 @@ for _sub in (
     "ops.dense",
     "ops.engine",
     "ops.objective",
+    "ops.packed",
     "parallel",
     "parallel.mesh",
     "parallel.scheduler",

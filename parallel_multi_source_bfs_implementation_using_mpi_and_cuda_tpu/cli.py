@@ -113,8 +113,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                 from .ops.dense import DenseGraph
 
                 engine = Engine(DenseGraph.from_host(graph))
-            else:
+            elif backend == "vmap":
                 engine = Engine(graph.to_device())
+            else:
+                # Default CSR path: the coalesced query-major engine.
+                # MSBFS_EDGE_CHUNKS bounds the per-level (E/chunks, K)
+                # gather intermediate on HBM-constrained chips.
+                from .ops.packed import PackedEngine
+
+                try:
+                    edge_chunks = int(os.environ.get("MSBFS_EDGE_CHUNKS", "1"))
+                except ValueError:
+                    edge_chunks = 1
+                engine = PackedEngine(graph.to_device(), edge_chunks=edge_chunks)
         engine.compile(padded.shape)
 
     # ---- computation span: all BFS + objective + argmin (main.cu:301-400).

@@ -89,8 +89,11 @@ def multi_source_bfs(
     max_levels: Optional[int] = None,
     expand=graph_expand,
 ) -> jax.Array:
-    """BFS from a (possibly -1-padded) int32 source set; returns (n,) int32
-    distances, -1 for unreached vertices (reference main.cu:40-73).
+    """BFS from a (possibly -1-padded) int32 source set; returns
+    (graph.n_pad,) int32 distances, -1 for unreached vertices (reference
+    main.cu:40-73).  n_pad == n for CSR graphs; padded engines (dense-MXU)
+    return extra trailing slots that are always -1 — slice ``dist[:graph.n]``
+    for the logical vertex set.
 
     ``max_levels`` optionally bounds the level loop (diameter cap); ``None``
     iterates to convergence like the reference's ``while(h_updated)``.

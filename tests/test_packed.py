@@ -1,0 +1,75 @@
+"""Packed query-major engine: oracle parity, chunking invariance, K padding."""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+    PackedEngine,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+
+def oracle_f_values(n, edges, queries):
+    return [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+
+
+GRAPHS = {
+    "gnm": generators.gnm_edges(140, 460, seed=101),
+    "grid": generators.grid_edges(19, 7),
+    "rmat": generators.rmat_edges(8, edge_factor=8, seed=102),
+    "sparse_disconnected": generators.gnm_edges(180, 70, seed=103),
+}
+
+
+@pytest.mark.parametrize("name", sorted(GRAPHS))
+def test_packed_matches_oracle(name):
+    n, edges = GRAPHS[name]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 11, max_group=5, seed=104)
+    queries[2] = np.zeros(0, dtype=np.int32)
+    padded = pad_queries(queries)
+    eng = PackedEngine(g.to_device())
+    got = np.asarray(eng.f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+@pytest.mark.parametrize("chunks", [1, 2, 3, 7])
+def test_edge_chunking_invariant(chunks):
+    n, edges = GRAPHS["rmat"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 6, max_group=4, seed=105)
+    padded = pad_queries(queries)
+    eng = PackedEngine(g.to_device(), edge_chunks=chunks)
+    got = np.asarray(eng.f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_k_not_aligned():
+    n, edges = GRAPHS["gnm"]
+    g = CSRGraph.from_edges(n, edges)
+    for k in (1, 3, 8, 13):
+        queries = generators.random_queries(n, k, max_group=3, seed=106 + k)
+        padded = pad_queries(queries)
+        eng = PackedEngine(g.to_device())
+        got = np.asarray(eng.f_values(padded))
+        np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+        assert got.shape == (k,)
+
+
+def test_packed_best_and_out_of_range_sources():
+    n, edges = GRAPHS["grid"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = [np.array([0, -1, n + 5], dtype=np.int32), np.array([n - 1])]
+    padded = pad_queries(queries)
+    eng = PackedEngine(g.to_device())
+    want = oracle_f_values(n, edges, queries)
+    np.testing.assert_array_equal(np.asarray(eng.f_values(padded)), want)
+    assert eng.best(padded) == oracle_best(want)

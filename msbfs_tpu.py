@@ -38,6 +38,7 @@ for _sub in (
     "utils.io",
     "utils.report",
     "utils.timing",
+    "utils.trace",
 ):
     importlib.import_module(f"{_LONG}.{_sub}")
 

@@ -20,6 +20,7 @@ flag value (main.cu:411).
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import List, Optional
 
@@ -99,8 +100,6 @@ def main(argv: Optional[List[str]] = None) -> int:
             # expansion as a bf16 matmul on the MXU, worthwhile when the
             # n^2 adjacency fits HBM; "auto" picks it for small graphs on
             # MXU-bearing devices only.
-            import os
-
             backend = os.environ.get("MSBFS_BACKEND", "auto")
             use_dense = backend == "dense"
             if backend == "auto" and jax.default_backend() in ("tpu", "axon"):
@@ -129,8 +128,37 @@ def main(argv: Optional[List[str]] = None) -> int:
         engine.compile(padded.shape)
 
     # ---- computation span: all BFS + objective + argmin (main.cu:301-400).
+    # MSBFS_PROFILE_DIR captures a jax.profiler trace of the span (tracing
+    # subsystem — new capability, the reference has none; SURVEY.md §5).
+    from .utils.trace import profiler_trace
+
+    stats_mode = os.environ.get("MSBFS_STATS") == "1"
+    stats = None
     with Span() as comp:
-        min_f, min_k = engine.best(np.asarray(padded))
+        with profiler_trace():
+            if stats_mode and padded.shape[0]:
+                # One BFS pass serves both the report and the stats table:
+                # stats include the F values, so selection derives from them.
+                stats = engine.query_stats(np.asarray(padded))
+            if stats is not None:
+                from .ops.objective import select_best_jit
+                import jax.numpy as jnp
+
+                f = jnp.asarray(stats[2])
+                min_f, min_k = (int(x) for x in select_best_jit(f, f >= 0))
+            else:
+                min_f, min_k = engine.best(np.asarray(padded))
+
+    if stats is not None:
+        # Per-query diagnostics to stderr (stdout stays reference-exact).
+        from .utils.trace import format_query_stats
+
+        sys.stderr.write(format_query_stats(*stats))
+    elif stats_mode:
+        sys.stderr.write(
+            "MSBFS_STATS: per-query stats are available on single-chip "
+            "engines only; ignored for this run\n"
+        )
 
     sys.stdout.write(
         format_report(

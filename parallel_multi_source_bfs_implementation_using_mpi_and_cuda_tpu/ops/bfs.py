@@ -125,6 +125,23 @@ def multi_source_bfs(
     return dist
 
 
+def stats_from_distances(dist: jax.Array):
+    """Per-query stats from a final (n,) distance vector.
+
+    Returns (levels, reached, f): ``levels`` = while-loop iterations the
+    query took = max distance + 1 (the last iteration discovers nothing and
+    flips the convergence flag — matching the reference's kernel-launch
+    count, ecc(U)+1, main.cu:61-71); 0 when no source was valid.
+    """
+    from .objective import f_of_u  # lazy: avoid import cycle at load
+
+    reached_mask = dist >= 0
+    any_reached = jnp.any(reached_mask)
+    levels = jnp.where(any_reached, jnp.max(dist) + 1, 0).astype(jnp.int32)
+    reached = jnp.sum(reached_mask.astype(jnp.int32))
+    return levels, reached, f_of_u(dist)
+
+
 def batched_multi_source_bfs(
     graph: DeviceCSR,
     sources: jax.Array,

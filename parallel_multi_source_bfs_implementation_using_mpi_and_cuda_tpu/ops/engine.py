@@ -33,6 +33,18 @@ def _f_values_chunked(graph, queries, max_levels, expand):
     return lax.map(jax.vmap(one), queries)
 
 
+@partial(jax.jit, static_argnames=("max_levels", "expand"))
+def _stats_chunked(graph, queries, max_levels, expand):
+    """(C, J, S) queries -> per-query (levels, reached, F), each (C, J)."""
+    from .bfs import stats_from_distances
+
+    def one(q):
+        dist = multi_source_bfs(graph, q, max_levels=max_levels, expand=expand)
+        return stats_from_distances(dist)
+
+    return lax.map(jax.vmap(one), queries)
+
+
 class QueryEngineBase:
     """Shared selection/compile surface over any ``f_values`` implementation
     (single-device, replicated-distributed, vertex-sharded)."""
@@ -51,6 +63,11 @@ class QueryEngineBase:
         lands in the preprocessing span (the CUDA reference's kernels are
         compiled offline by nvcc; see utils.timing)."""
         self.best(np.full(queries_shape, -1, dtype=np.int32))
+
+    def query_stats(self, queries):
+        """Optional diagnostic: per-query (levels, reached, F) arrays.
+        Engines that don't expose distances return None."""
+        return None
 
 
 class Engine(QueryEngineBase):
@@ -84,3 +101,26 @@ class Engine(QueryEngineBase):
         grid = queries.reshape((K + pad) // chunk, chunk, S)
         out = _f_values_chunked(self.graph, grid, self.max_levels, self.expand)
         return out.reshape(-1)[:K]
+
+    def query_stats(self, queries):
+        """Per-query (levels, reached, F) — the tracing subsystem's data
+        source (SURVEY.md section 5: new capability, reference has none).
+        Respects query_chunk: the same O(chunk * E) per-level memory bound
+        as f_values."""
+        queries = jnp.asarray(queries, dtype=jnp.int32)
+        K, S = queries.shape
+        chunk = self.query_chunk or max(K, 1)
+        pad = (-K) % chunk
+        if pad:
+            queries = jnp.concatenate(
+                [queries, jnp.full((pad, S), -1, dtype=jnp.int32)], axis=0
+            )
+        grid = queries.reshape((K + pad) // chunk, chunk, S)
+        levels, reached, f = _stats_chunked(
+            self.graph, grid, self.max_levels, self.expand
+        )
+        return (
+            np.asarray(levels).reshape(-1)[:K],
+            np.asarray(reached).reshape(-1)[:K],
+            np.asarray(f).reshape(-1)[:K],
+        )

@@ -87,13 +87,13 @@ def _packed_expand(
 
 
 @partial(jax.jit, static_argnames=("max_levels", "edge_chunks"))
-def packed_f_values(
+def packed_distances(
     graph: DeviceCSR,
     queries: jax.Array,
     max_levels: Optional[int] = None,
     edge_chunks: int = 1,
 ) -> jax.Array:
-    """(K, S) queries -> (K,) int64 F values, one fused level loop for all K."""
+    """(K, S) queries -> (n, K) int32 distances, one fused level loop."""
 
     def cond(carry):
         _, level, updated = carry
@@ -112,7 +112,21 @@ def packed_f_values(
     dist, _, _ = lax.while_loop(
         cond, body, (dist0, jnp.int32(0), jnp.any(dist0 == 0))
     )
-    # Per-column F(U) via the canonical objective (main.cu:75-89).
+    return dist
+
+
+@partial(jax.jit, static_argnames=("max_levels", "edge_chunks"))
+def packed_f_values(
+    graph: DeviceCSR,
+    queries: jax.Array,
+    max_levels: Optional[int] = None,
+    edge_chunks: int = 1,
+) -> jax.Array:
+    """(K, S) queries -> (K,) int64 F values, one fused level loop for all K.
+
+    Per-column F(U) via the canonical objective (main.cu:75-89).
+    """
+    dist = packed_distances(graph, queries, max_levels, edge_chunks)
     return jax.vmap(f_of_u)(dist.T)
 
 
@@ -135,7 +149,7 @@ class PackedEngine(QueryEngineBase):
         self.edge_chunks = edge_chunks
         self.k_align = k_align
 
-    def f_values(self, queries) -> jax.Array:
+    def _pad_queries(self, queries) -> Tuple[jax.Array, int]:
         queries = jnp.asarray(queries, dtype=jnp.int32)
         k, s = queries.shape
         pad = (-k) % self.k_align if k else 1
@@ -143,7 +157,28 @@ class PackedEngine(QueryEngineBase):
             queries = jnp.concatenate(
                 [queries, jnp.full((pad, s), -1, dtype=jnp.int32)], axis=0
             )
+        return queries, k
+
+    def f_values(self, queries) -> jax.Array:
+        queries, k = self._pad_queries(queries)
         f = packed_f_values(
             self.graph, queries, self.max_levels, self.edge_chunks
         )
         return f[:k]
+
+    def query_stats(self, queries):
+        """Per-query (levels, reached, F) from the packed distance matrix.
+        Uses the same k_align padding as f_values so the level loop is
+        compiled for one K shape only."""
+        from .bfs import stats_from_distances
+
+        queries, k = self._pad_queries(queries)
+        dist = packed_distances(
+            self.graph, queries, self.max_levels, self.edge_chunks
+        )
+        levels, reached, f = jax.vmap(stats_from_distances)(dist.T)
+        return (
+            np.asarray(levels)[:k],
+            np.asarray(reached)[:k],
+            np.asarray(f)[:k],
+        )

@@ -1,0 +1,49 @@
+"""Tracing / profiling beyond the reference's two wall-clock spans.
+
+The reference's only observability is the preprocessing/computation report
+(SURVEY.md C11, main.cu:235-298/301-400).  This module adds, as opt-in
+capability (stdout contract untouched — everything goes to stderr or files):
+
+* :func:`profiler_trace` — a context manager around ``jax.profiler`` trace
+  collection (view in TensorBoard / xprof), enabled by a directory path or
+  the ``MSBFS_PROFILE_DIR`` env var;
+* :func:`format_query_stats` — per-query lines (levels run, vertices
+  reached, F) from the stats variants in :mod:`..ops.bfs`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional, Sequence
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: Optional[str] = None) -> Iterator[bool]:
+    """Collect a device profile into ``log_dir`` (or $MSBFS_PROFILE_DIR).
+
+    Yields True when tracing is active.  No-op (yields False) when no
+    directory is configured, so callers can wrap unconditionally.
+    """
+    log_dir = log_dir or os.environ.get("MSBFS_PROFILE_DIR")
+    if not log_dir:
+        yield False
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield True
+    finally:
+        jax.profiler.stop_trace()
+
+
+def format_query_stats(
+    levels: Sequence[int], reached: Sequence[int], f_values: Sequence[int]
+) -> str:
+    """Per-query stats table (stderr-destined; one line per query, 1-based
+    ids to match the report's convention, main.cu:409)."""
+    lines = ["query  levels  reached  F"]
+    for i, (lv, rc, fv) in enumerate(zip(levels, reached, f_values)):
+        lines.append(f"{i + 1:5d}  {int(lv):6d}  {int(rc):7d}  {int(fv)}")
+    return "\n".join(lines) + "\n"

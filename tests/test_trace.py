@@ -1,0 +1,88 @@
+"""Tracing subsystem: per-query stats correctness and CLI stderr output."""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    Engine,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+    PackedEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.trace import (
+    format_query_stats,
+    profiler_trace,
+)
+
+from oracle import oracle_bfs, oracle_f
+
+
+@pytest.fixture(scope="module")
+def problem():
+    n, edges = generators.grid_edges(11, 13)  # known diameters
+    queries = [np.array([0]), np.array([0, n - 1]), np.zeros(0, dtype=np.int32)]
+    return n, edges, queries, pad_queries(queries)
+
+
+@pytest.mark.parametrize("engine_cls", [Engine, PackedEngine])
+def test_query_stats_match_oracle(problem, engine_cls):
+    n, edges, queries, padded = problem
+    eng = engine_cls(CSRGraph.from_edges(n, edges).to_device())
+    levels, reached, f = eng.query_stats(padded)
+    for i, q in enumerate(queries):
+        dist = oracle_bfs(n, edges, q)
+        want_levels = int(dist.max()) + 1 if (dist >= 0).any() else 0
+        assert levels[i] == want_levels
+        assert reached[i] == int((dist >= 0).sum())
+        assert f[i] == oracle_f(dist)
+
+
+def test_format_query_stats():
+    out = format_query_stats([3, 0], [10, 0], [42, 0])
+    lines = out.strip().split("\n")
+    assert lines[0].split() == ["query", "levels", "reached", "F"]
+    assert lines[1].split() == ["1", "3", "10", "42"]
+    assert lines[2].split() == ["2", "0", "0", "0"]
+
+
+def test_profiler_trace_noop_without_dir(monkeypatch):
+    monkeypatch.delenv("MSBFS_PROFILE_DIR", raising=False)
+    with profiler_trace() as active:
+        assert active is False
+
+
+def test_profiler_trace_collects(tmp_path):
+    import jax.numpy as jnp
+
+    with profiler_trace(str(tmp_path)) as active:
+        assert active is True
+        jnp.arange(4).sum().block_until_ready()
+    assert any(tmp_path.rglob("*"))  # trace files written
+
+
+def test_cli_stats_stderr(tmp_path, capsys, monkeypatch):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+        main,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_graph_bin,
+        save_query_bin,
+    )
+
+    n, edges = generators.gnm_edges(40, 120, seed=111)
+    g, q = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
+    save_graph_bin(g, n, edges)
+    save_query_bin(q, [[0], [1, 2]])
+    monkeypatch.setenv("MSBFS_STATS", "1")
+    rc = main(["main.py", "-g", g, "-q", q, "-gn", "1"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "Query number" in captured.out
+    assert "levels" in captured.err and captured.err.count("\n") >= 3
+    # stdout stays reference-exact: no stats leak into it.
+    assert "levels" not in captured.out

@@ -78,6 +78,12 @@ def main() -> None:
         engine = Engine(DenseGraph.from_host(g))
     elif engine_kind == "vmap":
         engine = Engine(g.to_device(), query_chunk=chunk)
+    elif engine_kind == "pallas":
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.ell import (
+            EllGraph,
+        )
+
+        engine = Engine(EllGraph.from_host(g), query_chunk=chunk)
     else:
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
             PackedEngine,

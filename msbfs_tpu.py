@@ -20,6 +20,7 @@ for _sub in (
     "cli",
     "models",
     "models.csr",
+    "models.ell",
     "models.generators",
     "ops",
     "ops.bfs",
@@ -27,6 +28,7 @@ for _sub in (
     "ops.engine",
     "ops.objective",
     "ops.packed",
+    "ops.pallas_bfs",
     "parallel",
     "parallel.mesh",
     "parallel.scheduler",

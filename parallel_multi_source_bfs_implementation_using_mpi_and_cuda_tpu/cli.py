@@ -114,6 +114,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 engine = Engine(DenseGraph.from_host(graph))
             elif backend == "vmap":
                 engine = Engine(graph.to_device())
+            elif backend == "pallas":
+                # ELL-slab layout + Pallas VMEM-resident-frontier kernel.
+                from .models.ell import EllGraph
+
+                engine = Engine(EllGraph.from_host(graph))
             else:
                 # Default CSR path: the coalesced query-major engine.
                 # MSBFS_EDGE_CHUNKS bounds the per-level (E/chunks, K)
@@ -125,14 +130,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 except ValueError:
                     edge_chunks = 1
                 engine = PackedEngine(graph.to_device(), edge_chunks=edge_chunks)
-        engine.compile(padded.shape)
+        stats_mode = os.environ.get("MSBFS_STATS") == "1"
+        engine.compile(padded.shape, warm_stats=stats_mode)
 
     # ---- computation span: all BFS + objective + argmin (main.cu:301-400).
     # MSBFS_PROFILE_DIR captures a jax.profiler trace of the span (tracing
     # subsystem — new capability, the reference has none; SURVEY.md §5).
     from .utils.trace import profiler_trace
 
-    stats_mode = os.environ.get("MSBFS_STATS") == "1"
     stats = None
     with Span() as comp:
         with profiler_trace():
@@ -155,10 +160,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         sys.stderr.write(format_query_stats(*stats))
     elif stats_mode:
-        sys.stderr.write(
-            "MSBFS_STATS: per-query stats are available on single-chip "
-            "engines only; ignored for this run\n"
-        )
+        if padded.shape[0] == 0:
+            sys.stderr.write("MSBFS_STATS: no queries\n")
+        else:
+            sys.stderr.write(
+                "MSBFS_STATS: per-query stats are available on single-chip "
+                "engines only; ignored for this run\n"
+            )
 
     sys.stdout.write(
         format_report(

@@ -1,0 +1,101 @@
+"""ELL-slab graph layout for the Pallas frontier kernel.
+
+CSR rows are split into fixed-width *virtual rows* ("slabs") of ``width``
+neighbor slots: a vertex of degree d occupies ceil(d / width) virtual rows.
+This bounds per-row work (the reference kernel's thread-divergence problem
+on power-law degrees, main.cu:26-35, solved by layout instead of by
+scheduling) and gives the kernel a rectangular (width, R) tile structure
+that matches TPU tiling.
+
+Arrays (R virtual rows, padded up to a tile multiple):
+
+* ``cols``        (width, R) int32  — neighbor ids, column-major so the
+  lane (last) dimension runs over virtual rows; padding slots hold ``n``
+  (a frontier index that is always 0);
+* ``vrow_vertex`` (R,) int32       — owning vertex per virtual row, sorted
+  ascending; padding rows hold ``n`` (dropped by the segment reduce).
+
+The per-level reduce over virtual rows is ``width`` times smaller than the
+per-edge-slot reduce of the flat CSR path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .csr import CSRGraph
+
+LANE = 128
+
+
+@jax.tree_util.register_pytree_node_class
+class EllGraph:
+    """Device-resident ELL-slab layout (see module docstring)."""
+
+    def __init__(self, cols, vrow_vertex, n: int, num_vrows: int, width: int):
+        self.cols = cols  # (width, R) int32
+        self.vrow_vertex = vrow_vertex  # (R,) int32
+        self.n = int(n)
+        self.num_vrows = int(num_vrows)
+        self.width = int(width)
+
+    @property
+    def n_pad(self) -> int:
+        return self.n
+
+    @staticmethod
+    def from_host(g: CSRGraph, width: int = 16, tile_rows: int = 512) -> "EllGraph":
+        if width < 1:
+            raise ValueError("width must be >= 1")
+        deg = g.degrees.astype(np.int64)
+        vrows_per_vertex = -(-deg // width)  # ceil; 0 for isolated vertices
+        r_used = int(vrows_per_vertex.sum())
+        r = max(tile_rows, -(-max(r_used, 1) // tile_rows) * tile_rows)
+
+        cols = np.full((r, width), g.n, dtype=np.int32)  # sentinel n
+        vrow_vertex = np.full(r, g.n, dtype=np.int32)  # sentinel n (dropped)
+
+        # Vertex of each virtual row, in vertex order (so vrow_vertex is
+        # sorted and the segment reduce can use indices_are_sorted).
+        owners = np.repeat(
+            np.arange(g.n, dtype=np.int32), vrows_per_vertex.astype(np.int64)
+        )
+        vrow_vertex[:r_used] = owners
+        # Slot (i, j) of virtual row i holds the j-th neighbor of that row's
+        # chunk: flat position = row_offsets(vertex) + chunk_index*width + j.
+        first_vrow = np.zeros(g.n + 1, dtype=np.int64)
+        np.cumsum(vrows_per_vertex, out=first_vrow[1:])
+        chunk_idx = np.arange(r_used, dtype=np.int64) - first_vrow[owners]
+        flat_start = g.row_offsets[owners] + chunk_idx * width
+        take = np.minimum(deg[owners] - chunk_idx * width, width)
+        for j in range(width):
+            mask = take > j
+            cols[:r_used][mask, j] = g.col_indices[flat_start[mask] + j]
+
+        return EllGraph(
+            cols=jnp.asarray(np.ascontiguousarray(cols.T)),
+            vrow_vertex=jnp.asarray(vrow_vertex),
+            n=g.n,
+            num_vrows=r,
+            width=width,
+        )
+
+    def expand_frontier(self, dist, level):
+        from ..ops.pallas_bfs import ell_expand  # lazy: models stays op-free
+
+        return ell_expand(dist, level, self)
+
+    def tree_flatten(self):
+        return (self.cols, self.vrow_vertex), (self.n, self.num_vrows, self.width)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        cols, vrow_vertex = children
+        return cls(cols, vrow_vertex, *aux)
+
+    def __repr__(self):
+        return (
+            f"EllGraph(n={self.n}, vrows={self.num_vrows}, width={self.width})"
+        )

@@ -58,11 +58,16 @@ class QueryEngineBase:
         min_f, min_k = select_best_jit(f, f >= 0)
         return int(min_f), int(min_k)
 
-    def compile(self, queries_shape: Tuple[int, int]) -> None:
+    def compile(self, queries_shape: Tuple[int, int], warm_stats: bool = False) -> None:
         """Pre-trace/compile for a given (K, S) query shape so compile time
         lands in the preprocessing span (the CUDA reference's kernels are
-        compiled offline by nvcc; see utils.timing)."""
-        self.best(np.full(queries_shape, -1, dtype=np.int32))
+        compiled offline by nvcc; see utils.timing).  ``warm_stats`` also
+        compiles the query_stats program (used when the caller will take the
+        stats path in the timed span)."""
+        dummy = np.full(queries_shape, -1, dtype=np.int32)
+        self.best(dummy)
+        if warm_stats and queries_shape[0]:
+            self.query_stats(dummy)
 
     def query_stats(self, queries):
         """Optional diagnostic: per-query (levels, reached, F) arrays.
@@ -89,24 +94,8 @@ class Engine(QueryEngineBase):
         self.query_chunk = query_chunk
         self.expand = expand
 
-    def f_values(self, queries: jax.Array) -> jax.Array:
-        """(K, S) int32 -1-padded queries -> (K,) int64 F values."""
-        K, S = queries.shape
-        chunk = self.query_chunk or max(K, 1)
-        pad = (-K) % chunk
-        if pad:
-            queries = jnp.concatenate(
-                [queries, jnp.full((pad, S), -1, dtype=jnp.int32)], axis=0
-            )
-        grid = queries.reshape((K + pad) // chunk, chunk, S)
-        out = _f_values_chunked(self.graph, grid, self.max_levels, self.expand)
-        return out.reshape(-1)[:K]
-
-    def query_stats(self, queries):
-        """Per-query (levels, reached, F) — the tracing subsystem's data
-        source (SURVEY.md section 5: new capability, reference has none).
-        Respects query_chunk: the same O(chunk * E) per-level memory bound
-        as f_values."""
+    def _chunk_grid(self, queries) -> Tuple[jax.Array, int]:
+        """Pad K to the chunk multiple and reshape to (C, chunk, S)."""
         queries = jnp.asarray(queries, dtype=jnp.int32)
         K, S = queries.shape
         chunk = self.query_chunk or max(K, 1)
@@ -115,7 +104,20 @@ class Engine(QueryEngineBase):
             queries = jnp.concatenate(
                 [queries, jnp.full((pad, S), -1, dtype=jnp.int32)], axis=0
             )
-        grid = queries.reshape((K + pad) // chunk, chunk, S)
+        return queries.reshape((K + pad) // chunk, chunk, S), K
+
+    def f_values(self, queries: jax.Array) -> jax.Array:
+        """(K, S) int32 -1-padded queries -> (K,) int64 F values."""
+        grid, K = self._chunk_grid(queries)
+        out = _f_values_chunked(self.graph, grid, self.max_levels, self.expand)
+        return out.reshape(-1)[:K]
+
+    def query_stats(self, queries):
+        """Per-query (levels, reached, F) — the tracing subsystem's data
+        source (SURVEY.md section 5: new capability, reference has none).
+        Respects query_chunk: the same O(chunk * E) per-level memory bound
+        as f_values."""
+        grid, K = self._chunk_grid(queries)
         levels, reached, f = _stats_chunked(
             self.graph, grid, self.max_levels, self.expand
         )

@@ -1,0 +1,82 @@
+"""Pallas TPU frontier-expansion kernel over the ELL-slab layout.
+
+The hot op of every BFS level is "is any neighbor of row r in the
+frontier?".  This kernel keeps the whole frontier indicator resident in
+VMEM (n bytes — fits for graphs up to ~10M vertices) and streams the
+(width, R) neighbor-id slab from HBM tile by tile, doing the random frontier
+lookups against on-chip memory instead of HBM — the memory-system inverse
+of the reference's kernel, which streams the frontier check but random-reads
+the CSR from device memory (main.cu:24-35).
+
+Per grid step i:
+    cols  = slab tile (width, TILE_R) int32          [HBM -> VMEM via spec]
+    vals  = frontier[cols]                           [VMEM random gather]
+    out_i = max over width                           [(TILE_R,) int8]
+
+The (R,) hit vector is then merged per owning vertex with a sorted
+segment-max that is ``width``-times smaller than the flat-CSR reduce.
+
+On non-TPU backends the kernel runs in interpreter mode (bit-identical
+semantics), so the full test suite exercises it on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_R = 512
+
+
+def _ell_hits_kernel(frontier_ref, cols_ref, out_ref):
+    cols = cols_ref[:]  # (width, TILE_R) int32
+    frontier = frontier_ref[:]  # (n_vmem,) int8, whole array in VMEM
+    vals = jnp.take(frontier, cols, axis=0)  # random VMEM gather
+    out_ref[:] = jnp.max(vals, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("num_vrows", "width"))
+def ell_hits(frontier: jax.Array, cols: jax.Array, num_vrows: int, width: int):
+    """frontier (n_vmem,) int8, cols (width, R) -> (R,) int8 hit flags."""
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    # Round the virtual-row axis up to the kernel tile; padding slots index
+    # frontier[0], which is harmless because their vrow_vertex sentinel is
+    # dropped by the downstream segment reduce.
+    r_pad = -(-num_vrows // TILE_R) * TILE_R
+    if r_pad != num_vrows:
+        cols = jnp.pad(cols, ((0, 0), (0, r_pad - num_vrows)))
+    hits = pl.pallas_call(
+        _ell_hits_kernel,
+        out_shape=jax.ShapeDtypeStruct((r_pad,), jnp.int8),
+        grid=(r_pad // TILE_R,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY if interpret else pltpu.VMEM),
+            pl.BlockSpec((width, TILE_R), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((TILE_R,), lambda i: (i,)),
+        interpret=interpret,
+    )(frontier, cols)
+    return hits[:num_vrows]
+
+
+def ell_expand(dist: jax.Array, level: jax.Array, graph) -> jax.Array:
+    """Frontier-expansion hook (ops.bfs contract) over an EllGraph."""
+    n = graph.n
+    # Frontier indicator with one trailing sentinel region: index n (the
+    # padding value in graph.cols / vrow_vertex) must read 0.  Pad to a
+    # lane multiple for VMEM residency.
+    pad_to = max(128, -(-(n + 1) // 128) * 128)
+    frontier = jnp.zeros((pad_to,), dtype=jnp.int8)
+    frontier = frontier.at[:n].set((dist[:n] == level).astype(jnp.int8))
+    hits = ell_hits(frontier, graph.cols, graph.num_vrows, graph.width)
+    reached = jax.ops.segment_max(
+        hits,
+        graph.vrow_vertex,  # sentinel n is out of range -> dropped
+        num_segments=n,
+        indices_are_sorted=True,
+    )
+    return (dist == -1) & (reached > 0)

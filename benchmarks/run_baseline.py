@@ -1,0 +1,226 @@
+#!/usr/bin/env python3
+"""BASELINE.md measurement harness: runs the five BASELINE.json configs.
+
+The reference publishes no numbers (BASELINE.md); this harness produces the
+framework-side column of the measurement table.  Each config prints one JSON
+line; ``--all`` runs every config feasible on the current host and writes
+``benchmarks/results.json``.
+
+Configs (BASELINE.md "Measurement plan"):
+  1. Single-source BFS, RMAT-16, 1 query group          (latency-dominated)
+  2. Multi-source BFS, 64 groups, RMAT-20, single chip  (the headline TEPS)
+  3. Round-robin query sharding across 8 chips, RMAT-24 (runs on a virtual
+     8-device CPU mesh when only one chip is present; scale capped by RAM)
+  4. Grid road-network (USA-road-d stand-in), high diameter
+  5. Vertex-sharded CSR (RMAT-27-class; scaled-down shape on one host)
+
+Usage: python benchmarks/run_baseline.py [--config N] [--all] [--scale-cap S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _engine_for(graph, kind: str, edge_chunks: int = 8):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+        PackedEngine,
+    )
+
+    if kind != "packed":
+        raise ValueError(kind)
+    return PackedEngine(graph.to_device(), edge_chunks=edge_chunks)
+
+
+def _run(engine, queries, e_directed: int, repeats: int = 3):
+    import jax
+
+    engine.compile(queries.shape)
+    times = []
+    out = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = engine.best(queries)
+        times.append(time.perf_counter() - t0)
+    best_s = min(times)
+    k = queries.shape[0]
+    return {
+        "computation_s": round(best_s, 6),
+        "teps": round(k * e_directed / best_s),
+        "p50_query_latency_s": round(float(np.median(times)) / max(k, 1), 6),
+        "minF": int(out[0]),
+        "minK_1based": int(out[1]) + 1,
+        "device": str(jax.devices()[0]),
+        "runs_s": [round(t, 6) for t in times],
+    }
+
+
+def config1():
+    """Single-source BFS on RMAT-16."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+
+    n, edges = generators.rmat_edges(16, edge_factor=16, seed=42)
+    g = CSRGraph.from_edges(n, edges)
+    queries = np.array([[0]], dtype=np.int32)
+    r = _run(_engine_for(g, "packed", edge_chunks=1), queries, g.num_directed_edges)
+    return {"config": 1, "workload": "RMAT-16, 1 query, 1 source", **r}
+
+
+def config2(scale=20):
+    """The headline: 64 query groups on RMAT-scale-20, single chip."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        pad_queries,
+    )
+
+    n, edges = generators.rmat_edges(scale, edge_factor=16, seed=42)
+    g = CSRGraph.from_edges(n, edges)
+    queries = pad_queries(
+        generators.random_queries(n, 64, max_group=64, seed=43), pad_to=64
+    )
+    r = _run(_engine_for(g, "packed"), queries, g.num_directed_edges)
+    return {"config": 2, "workload": f"RMAT-{scale}, 64 query groups", **r}
+
+
+def config3(scale=22):
+    """Query sharding over 8 devices (virtual CPU mesh if 1 chip)."""
+    import jax
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
+        DistributedEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        pad_queries,
+    )
+
+    ndev = len(jax.devices())
+    w = min(8, ndev)
+    n, edges = generators.rmat_edges(scale, edge_factor=16, seed=42)
+    g = CSRGraph.from_edges(n, edges)
+    queries = pad_queries(
+        generators.random_queries(n, 64, max_group=64, seed=43), pad_to=64
+    )
+    mesh = make_mesh(num_query_shards=w)
+    engine = DistributedEngine(mesh, g)
+    r = _run(engine, queries, g.num_directed_edges)
+    return {
+        "config": 3,
+        "workload": f"RMAT-{scale}, 64 groups, {w}-way query sharding",
+        "devices": w,
+        **r,
+    }
+
+
+def config4():
+    """High-diameter road-network stand-in: 2k x 2k grid."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        pad_queries,
+    )
+
+    n, edges = generators.grid_edges(2048, 2048)
+    g = CSRGraph.from_edges(n, edges)
+    queries = pad_queries(
+        generators.random_queries(n, 16, max_group=8, seed=44), pad_to=8
+    )
+    r = _run(_engine_for(g, "packed"), queries, g.num_directed_edges)
+    return {"config": 4, "workload": "2048x2048 grid (diam ~4096), 16 groups", **r}
+
+
+def config5(scale=20):
+    """Vertex-sharded CSR over the full ('q','v') mesh."""
+    import jax
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_csr import (
+        ShardedEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        pad_queries,
+    )
+
+    ndev = len(jax.devices())
+    n_v = 2 if ndev >= 2 else 1
+    n_q = max(1, min(4, ndev // n_v))
+    n, edges = generators.rmat_edges(scale, edge_factor=16, seed=42)
+    g = CSRGraph.from_edges(n, edges)
+    queries = pad_queries(
+        generators.random_queries(n, 16, max_group=16, seed=45), pad_to=16
+    )
+    mesh = make_mesh(num_query_shards=n_q, num_vertex_shards=n_v)
+    engine = ShardedEngine(mesh, g)
+    r = _run(engine, queries, g.num_directed_edges)
+    return {
+        "config": 5,
+        "workload": f"RMAT-{scale}, CSR sharded ({n_q}q x {n_v}v mesh)",
+        **r,
+    }
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    todo = sorted(CONFIGS) if args.all or args.config is None else [args.config]
+    results = []
+    for c in todo:
+        try:
+            r = CONFIGS[c]()
+        except Exception as exc:  # keep going: one infeasible config
+            r = {"config": c, "error": f"{type(exc).__name__}: {exc}"}
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

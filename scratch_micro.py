@@ -1,0 +1,66 @@
+"""Microbenchmark: where does the packed-engine level time go?"""
+import time, os, sys
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import generators
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import CSRGraph
+
+scale = int(os.environ.get("S", "18"))
+K = int(os.environ.get("K", "64"))
+n, edges = generators.rmat_edges(scale, edge_factor=16, seed=42)
+g = CSRGraph.from_edges(n, edges).to_device()
+E = g.num_edges
+print(f"n={n} E={E} K={K}", flush=True)
+
+frontier = jnp.asarray((np.random.default_rng(0).random((n, K)) < 0.1).astype(np.uint8))
+fron1 = jnp.asarray((np.random.default_rng(0).random(n) < 0.1).astype(np.uint8))
+
+def bench(name, fn, *args):
+    r = fn(*args); jax.block_until_ready(r)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = fn(*args); jax.block_until_ready(r)
+        ts.append(time.perf_counter() - t0)
+    t = min(ts)
+    print(f"{name:40s} {t*1e3:9.2f} ms  ({E/t/1e9:7.2f} Gedge/s)", flush=True)
+    return t
+
+# 1. row gather (E, K) uint8
+f_take = jax.jit(lambda f: jnp.take(f, g.col_indices, axis=0))
+bench("take rows (E,K) u8", f_take, frontier)
+
+# 2. segment_max (E,K)->(n,K)
+hits = f_take(frontier)
+f_seg = jax.jit(lambda h: jax.ops.segment_max(h, g.edge_src, num_segments=n, indices_are_sorted=True))
+bench("segment_max (E,K)->(n,K)", f_seg, hits)
+
+# 3. fused take+segment_max
+f_fused = jax.jit(lambda f: jax.ops.segment_max(jnp.take(f, g.col_indices, axis=0), g.edge_src, num_segments=n, indices_are_sorted=True))
+bench("fused take+segmax", f_fused, frontier)
+
+# 4. scalar (E,) gather + segment_max (per query cost x K)
+f_1 = jax.jit(lambda f: jax.ops.segment_max(jnp.take(f, g.col_indices, axis=0), g.edge_src, num_segments=n, indices_are_sorted=True))
+t1 = bench("1-query fused (x K would be)", f_1, fron1)
+print(f"  -> xK = {t1*K*1e3:9.2f} ms", flush=True)
+
+# 5. sort-free alternative: one-hot matmul? skip. bitpacked gather:
+W = K // 8
+fp = jnp.asarray(np.random.default_rng(0).integers(0, 255, size=(n, W), dtype=np.uint8))
+f_takep = jax.jit(lambda f: jnp.take(f, g.col_indices, axis=0))
+bench("take rows (E,K/8) u8 bitpacked", f_takep, fp)
+
+# 6. pure streaming read of (E,K) u8 (reduce) as bandwidth roofline probe
+f_red = jax.jit(lambda h: jnp.sum(h, axis=0))
+bench("sum (E,K) u8 -> (K,) [BW probe]", f_red, hits)
+
+# 7. reduce by reshape trick: segment boundaries ignored; max over fixed window
+f_win = jax.jit(lambda h: jnp.max(h.reshape(E // 64, 64, K), axis=1))
+bench("fixed-window max64 (E,K) [probe]", f_win, hits)
+
+# 8. cumulative-max approach to sorted-segment reduce:
+#    seg-max(sorted) == cummax gather trick; probe cummax cost
+f_cum = jax.jit(lambda h: lax.cummax(h.astype(jnp.uint8), axis=0))
+bench("cummax (E,K) u8 [probe]", f_cum, hits)

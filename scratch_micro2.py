@@ -1,0 +1,116 @@
+"""Precise op-cost probes: loop-in-jit timing; data device-generated, passed as args."""
+import time
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+
+E = 4 * 1024 * 1024
+n = 256 * 1024
+K = 64
+
+cols = jax.jit(lambda: (lax.iota(jnp.uint32, E) * np.uint32(2654435761) % np.uint32(n)).astype(jnp.int32))()
+edge_src = jax.jit(lambda: (lax.iota(jnp.int32, E) // (E // n)))()
+jax.block_until_ready((cols, edge_src))
+
+from functools import partial
+
+@partial(jax.jit, static_argnums=0)
+def _gen(total):
+    v = (lax.iota(jnp.uint32, total) * np.uint32(1103515245) + np.uint32(12345)) >> 8
+    return v % np.uint32(97)
+
+def dev_arr(shape, dtype):
+    x = _gen(int(np.prod(shape))).reshape(shape).astype(dtype)
+    jax.block_until_ready(x)
+    return x
+
+def bench_loop(name, make_fn, args, iters=8, bytes_per_iter=None):
+    f1 = make_fn(1); fN = make_fn(iters)
+    float(f1(*args)); float(fN(*args))
+    def t(f):
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter(); float(f(*args)); ts.append(time.perf_counter() - t0)
+        return min(ts)
+    per = (t(fN) - t(f1)) / (iters - 1)
+    bw = f"  {bytes_per_iter/per/1e9:8.1f} GB/s" if bytes_per_iter else ""
+    print(f"{name:44s} {per*1e3:9.3f} ms/iter{bw}", flush=True)
+
+def probe_sum(dtype, shape, label):
+    x = dev_arr(shape, dtype)
+    def mk(k):
+        @jax.jit
+        def f(x):
+            def body(i, acc):
+                return acc + (x + i.astype(x.dtype)).astype(jnp.float32).sum()
+            return lax.fori_loop(0, k, body, 0.0)
+        return f
+    bench_loop(label, mk, (x,), bytes_per_iter=x.size * x.dtype.itemsize)
+
+probe_sum(np.uint8,  (E, K), "sum (E,64) u8")
+probe_sum(np.float32,(E, K), "sum (E,64) f32")
+probe_sum(np.int32,  (E, K // 8), "sum (E,8) i32")
+
+def probe_winmax(dtype, label):
+    x = dev_arr((E, K), dtype)
+    def mk(k):
+        @jax.jit
+        def f(x):
+            def body(i, acc):
+                w = jnp.max((x + i.astype(x.dtype)).reshape(E // 8, 8, K), axis=1)
+                return acc + w.astype(jnp.float32).sum()
+            return lax.fori_loop(0, k, body, 0.0)
+        return f
+    bench_loop(label, mk, (x,), bytes_per_iter=x.size * x.dtype.itemsize)
+
+probe_winmax(np.uint8, "winmax8 (E,64) u8")
+probe_winmax(np.float32, "winmax8 (E,64) f32")
+
+xw = dev_arr((E, K // 32), np.int32)
+def mk_orwin(k):
+    @jax.jit
+    def f(xw):
+        def body(i, acc):
+            vv = xw ^ i
+            w = vv.reshape(E // 8, 8, K // 32)
+            r = w[:, 0]
+            for j in range(1, 8):
+                r = r | w[:, j]
+            return acc + r.astype(jnp.float32).sum()
+        return lax.fori_loop(0, k, body, 0.0)
+    return f
+bench_loop("orwin8 (E,2) i32 bitpacked", mk_orwin, (xw,), bytes_per_iter=E * 8)
+
+def probe_gather(dtype, C, label):
+    f0 = dev_arr((n, C), dtype)
+    def mk(k):
+        @jax.jit
+        def g(f0, cols):
+            def body(i, acc):
+                h = jnp.take(f0 + i.astype(f0.dtype), cols, axis=0)
+                return acc + h.astype(jnp.float32).sum()
+            return lax.fori_loop(0, k, body, 0.0)
+        return g
+    bench_loop(label, mk, (f0, cols), bytes_per_iter=E * C * f0.dtype.itemsize)
+
+probe_gather(np.uint8, K, "gather rows (n,64)u8 -> (E,64)")
+probe_gather(np.float32, K, "gather rows (n,64)f32 -> (E,64)")
+probe_gather(np.int32, K // 32, "gather rows (n,2)i32 -> (E,2) packed")
+
+def probe_segmax(dtype, C, label):
+    h0 = dev_arr((E, C) if C > 1 else (E,), dtype)
+    def mk(k):
+        @jax.jit
+        def g(h0, edge_src):
+            def body(i, acc):
+                r = jax.ops.segment_max(h0 + i.astype(h0.dtype), edge_src,
+                                        num_segments=n, indices_are_sorted=True)
+                return acc + r.astype(jnp.float32).sum()
+            return lax.fori_loop(0, k, body, 0.0)
+        return g
+    bench_loop(label, mk, (h0, edge_src), iters=4,
+               bytes_per_iter=h0.size * h0.dtype.itemsize)
+
+probe_segmax(np.uint8, K, "segmax (E,64)u8 -> (n,64)")
+probe_segmax(np.float32, K, "segmax (E,64)f32 -> (n,64)")
+probe_segmax(np.uint8, 1, "segmax (E,)u8 -> (n,)")
+print("done", flush=True)

@@ -84,6 +84,15 @@ def main() -> None:
         )
 
         engine = Engine(EllGraph.from_host(g), query_chunk=chunk)
+    elif engine_kind == "bell":
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+            BellGraph,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bell import (
+            BellEngine,
+        )
+
+        engine = BellEngine(BellGraph.from_host(g))
     else:
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
             PackedEngine,

@@ -119,6 +119,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 from .models.ell import EllGraph
 
                 engine = Engine(EllGraph.from_host(graph))
+            elif backend == "bell":
+                # Scatter-free bucketed-ELL reduction forest (ops.bell).
+                from .models.bell import BellGraph
+                from .ops.bell import BellEngine
+
+                engine = BellEngine(BellGraph.from_host(graph))
             else:
                 # Default CSR path: the coalesced query-major engine.
                 # MSBFS_EDGE_CHUNKS bounds the per-level (E/chunks, K)

@@ -124,7 +124,7 @@ class BellGraph:
     ) -> "BellGraph":
         widths = tuple(sorted(widths))
         n = g.n
-        e = int(g.num_edges)
+        e = int(g.num_directed_edges)
 
         # ---- level 0: owners = vertices, items = CSR slots -> frontier ids.
         # Gathering from the frontier: item value array = frontier (n rows)
@@ -142,11 +142,13 @@ class BellGraph:
 
         first_row = None
         rows_per_owner = None
+        walk: List[Tuple[np.ndarray, np.ndarray]] = []  # (rpo, fr) per level
         while True:
             sentinel_items = item_vals.shape[0]
             cols_b, rows_per_owner, first_row = _bucket_rows(
                 item_start, item_count, widths, sentinel_items
             )
+            walk.append((rows_per_owner, first_row))
             # Map item indices -> value-array row ids (level 0: frontier ids;
             # deeper: previous-level output rows).  Sentinel item maps to the
             # value array's zero row.
@@ -183,20 +185,11 @@ class BellGraph:
         # rows -> zero row.  Otherwise its terminal level is the first level
         # where its row count == 1.
         final_slot = np.full(n, -1, dtype=np.int64)
-        # Recompute per-level (rows_per_owner, first_row) chains.
-        item_count = np.asarray(g.degrees, dtype=np.int64)
-        item_start = np.asarray(g.row_offsets[:-1], dtype=np.int64)
-        done = item_count == 0  # deg-0 -> global zero row (set below)
-        for li in range(len(levels)):
-            sentinel_items = -1  # unused here
-            _, rpo, fr = _bucket_rows(
-                item_start, item_count, widths, 0
-            )
+        done = np.asarray(g.degrees) == 0  # deg-0 -> global zero row (below)
+        for li, (rpo, fr) in enumerate(walk):
             newly = (~done) & (rpo == 1)
             final_slot[newly] = out_offset[li] + fr[newly]
             done |= newly
-            item_start = fr
-            item_count = np.where(rpo == 1, 0, rpo)  # mirror the main walk
         total_rows = sum(level_sizes)
         final_slot[final_slot < 0] = total_rows  # zero sentinel row
 

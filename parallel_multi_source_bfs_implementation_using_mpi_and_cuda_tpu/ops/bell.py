@@ -19,17 +19,15 @@ to unvisited (-1) vertices until a level discovers nothing.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 from ..models.bell import BellGraph
-from .engine import QueryEngineBase
 from .objective import f_of_u
-from .packed import K_ALIGN, _packed_init
+from .packed import K_ALIGN, PackedEngineBase, packed_init
 
 HIT = jnp.uint8
 
@@ -95,21 +93,11 @@ def bell_distances(
         dist = jnp.where(new, level + 1, dist)
         return (dist, level + 1, jnp.any(new))
 
-    dist0 = _packed_init_bell(graph, queries)
+    dist0 = packed_init(graph.n, queries)
     dist, _, _ = lax.while_loop(
         cond, body, (dist0, jnp.int32(0), jnp.any(dist0 == 0))
     )
     return dist
-
-
-def _packed_init_bell(graph: BellGraph, queries: jax.Array) -> jax.Array:
-    """(K, S) queries -> (n, K) distances; reference source-bounds semantics
-    (main.cu:46-51) via the shared packed init."""
-
-    class _N:  # minimal duck type: _packed_init only needs .n
-        n = graph.n
-
-    return _packed_init(_N, queries)
 
 
 @partial(jax.jit, static_argnames=("max_levels",))
@@ -123,7 +111,7 @@ def bell_f_values(
     return jax.vmap(f_of_u)(dist.T)
 
 
-class BellEngine(QueryEngineBase):
+class BellEngine(PackedEngineBase):
     """All-queries-at-once scatter-free engine over a BellGraph."""
 
     def __init__(
@@ -136,28 +124,9 @@ class BellEngine(QueryEngineBase):
         self.max_levels = max_levels
         self.k_align = k_align
 
-    def _pad_queries(self, queries) -> Tuple[jax.Array, int]:
-        queries = jnp.asarray(queries, dtype=jnp.int32)
-        k, s = queries.shape
-        pad = (-k) % self.k_align if k else 1
-        if pad:
-            queries = jnp.concatenate(
-                [queries, jnp.full((pad, s), -1, dtype=jnp.int32)], axis=0
-            )
-        return queries, k
+    def _distances(self, queries) -> jax.Array:
+        return bell_distances(self.graph, queries, self.max_levels)
 
     def f_values(self, queries) -> jax.Array:
         queries, k = self._pad_queries(queries)
         return bell_f_values(self.graph, queries, self.max_levels)[:k]
-
-    def query_stats(self, queries):
-        from .bfs import stats_from_distances
-
-        queries, k = self._pad_queries(queries)
-        dist = bell_distances(self.graph, queries, self.max_levels)
-        levels, reached, f = jax.vmap(stats_from_distances)(dist.T)
-        return (
-            np.asarray(levels)[:k],
-            np.asarray(reached)[:k],
-            np.asarray(f)[:k],
-        )

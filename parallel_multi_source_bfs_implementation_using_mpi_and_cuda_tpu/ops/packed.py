@@ -40,13 +40,49 @@ from .objective import f_of_u
 K_ALIGN = 8
 
 
-def _packed_init(graph: DeviceCSR, queries: jax.Array) -> jax.Array:
+def packed_init(n: int, queries: jax.Array) -> jax.Array:
     """(K, S) -1-padded queries -> (n, K) int32 distances (-1 / 0).
 
     Reuses the canonical per-query init (and its reference bounds-check
     semantics, main.cu:46-51); the transpose to query-minor layout fuses.
     """
-    return jax.vmap(partial(init_distances, graph.n))(queries).T
+    return jax.vmap(partial(init_distances, n))(queries).T
+
+
+class PackedEngineBase(QueryEngineBase):
+    """Shared surface of the query-minor (n, K) engines (packed CSR, BELL):
+    K-alignment padding and the distances->stats plumbing.  Subclasses
+    provide ``_distances(padded_queries) -> (n, K)`` and ``f_values``."""
+
+    k_align: int = K_ALIGN
+
+    def _pad_queries(self, queries) -> Tuple[jax.Array, int]:
+        queries = jnp.asarray(queries, dtype=jnp.int32)
+        k, s = queries.shape
+        pad = (-k) % self.k_align if k else 1
+        if pad:
+            queries = jnp.concatenate(
+                [queries, jnp.full((pad, s), -1, dtype=jnp.int32)], axis=0
+            )
+        return queries, k
+
+    def _distances(self, queries) -> jax.Array:  # pragma: no cover - iface
+        raise NotImplementedError
+
+    def query_stats(self, queries):
+        """Per-query (levels, reached, F) from the packed distance matrix.
+        Uses the same k_align padding as f_values so the level loop is
+        compiled for one K shape only."""
+        from .bfs import stats_from_distances
+
+        queries, k = self._pad_queries(queries)
+        dist = self._distances(queries)
+        levels, reached, f = jax.vmap(stats_from_distances)(dist.T)
+        return (
+            np.asarray(levels)[:k],
+            np.asarray(reached)[:k],
+            np.asarray(f)[:k],
+        )
 
 
 def _packed_expand(
@@ -108,7 +144,7 @@ def packed_distances(
         dist = jnp.where(new, level + 1, dist)
         return (dist, level + 1, jnp.any(new))
 
-    dist0 = _packed_init(graph, queries)
+    dist0 = packed_init(graph.n, queries)
     dist, _, _ = lax.while_loop(
         cond, body, (dist0, jnp.int32(0), jnp.any(dist0 == 0))
     )
@@ -130,7 +166,7 @@ def packed_f_values(
     return jax.vmap(f_of_u)(dist.T)
 
 
-class PackedEngine(QueryEngineBase):
+class PackedEngine(PackedEngineBase):
     """Coalesced all-queries-at-once engine over a device CSR.
 
     ``edge_chunks`` bounds the (E/chunks, K) gather intermediate (HBM knob);
@@ -149,15 +185,10 @@ class PackedEngine(QueryEngineBase):
         self.edge_chunks = edge_chunks
         self.k_align = k_align
 
-    def _pad_queries(self, queries) -> Tuple[jax.Array, int]:
-        queries = jnp.asarray(queries, dtype=jnp.int32)
-        k, s = queries.shape
-        pad = (-k) % self.k_align if k else 1
-        if pad:
-            queries = jnp.concatenate(
-                [queries, jnp.full((pad, s), -1, dtype=jnp.int32)], axis=0
-            )
-        return queries, k
+    def _distances(self, queries) -> jax.Array:
+        return packed_distances(
+            self.graph, queries, self.max_levels, self.edge_chunks
+        )
 
     def f_values(self, queries) -> jax.Array:
         queries, k = self._pad_queries(queries)
@@ -165,20 +196,3 @@ class PackedEngine(QueryEngineBase):
             self.graph, queries, self.max_levels, self.edge_chunks
         )
         return f[:k]
-
-    def query_stats(self, queries):
-        """Per-query (levels, reached, F) from the packed distance matrix.
-        Uses the same k_align padding as f_values so the level loop is
-        compiled for one K shape only."""
-        from .bfs import stats_from_distances
-
-        queries, k = self._pad_queries(queries)
-        dist = packed_distances(
-            self.graph, queries, self.max_levels, self.edge_chunks
-        )
-        levels, reached, f = jax.vmap(stats_from_distances)(dist.T)
-        return (
-            np.asarray(levels)[:k],
-            np.asarray(reached)[:k],
-            np.asarray(f)[:k],
-        )

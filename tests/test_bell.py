@@ -1,0 +1,130 @@
+"""BELL scatter-free engine: oracle parity, hub recursion, width invariance."""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bell import (
+    BellEngine,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+
+def oracle_f_values(n, edges, queries):
+    return [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+
+
+GRAPHS = {
+    "gnm": generators.gnm_edges(140, 460, seed=201),
+    "grid": generators.grid_edges(19, 7),
+    "rmat": generators.rmat_edges(8, edge_factor=8, seed=202),
+    "sparse_disconnected": generators.gnm_edges(180, 70, seed=203),
+}
+
+
+def star_edges(n_leaves: int):
+    """Star: hub 0 with n_leaves neighbors — forces the chunked hub path
+    (deg > max width -> multi-row + deeper reduce levels)."""
+    n = n_leaves + 1
+    edges = np.stack(
+        [np.zeros(n_leaves, dtype=np.int64), np.arange(1, n, dtype=np.int64)],
+        axis=1,
+    )
+    return n, edges
+
+
+@pytest.mark.parametrize("name", sorted(GRAPHS))
+def test_bell_matches_oracle(name):
+    n, edges = GRAPHS[name]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 11, max_group=5, seed=204)
+    queries[2] = np.zeros(0, dtype=np.int32)
+    padded = pad_queries(queries)
+    eng = BellEngine(BellGraph.from_host(g))
+    got = np.asarray(eng.f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+@pytest.mark.parametrize("widths", [(2,), (2, 4), (2, 8, 32), (4, 16, 64, 128)])
+def test_bell_width_invariance(widths):
+    """Any width ladder must give identical results — the layout is an
+    implementation detail, not semantics."""
+    n, edges = GRAPHS["rmat"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 6, max_group=4, seed=205)
+    padded = pad_queries(queries)
+    eng = BellEngine(BellGraph.from_host(g, widths=widths))
+    got = np.asarray(eng.f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+@pytest.mark.parametrize("n_leaves", [1, 2, 129, 1000])
+def test_bell_hub_recursion(n_leaves):
+    """Hubs beyond max width exercise the multi-level reduction forest
+    (1000 leaves with widths (2,8) -> ceil(log_8) = several levels)."""
+    n, edges = star_edges(n_leaves)
+    g = CSRGraph.from_edges(n, edges)
+    queries = [
+        np.array([0], dtype=np.int32),  # from the hub
+        np.array([1], dtype=np.int32),  # from one leaf (dist 2 to others)
+        np.array([0, n - 1], dtype=np.int32),
+    ]
+    padded = pad_queries(queries)
+    for widths in ((2, 8), (2, 8, 32, 128)):
+        eng = BellEngine(BellGraph.from_host(g, widths=widths))
+        got = np.asarray(eng.f_values(padded))
+        np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_bell_deg0_and_out_of_range():
+    """Isolated vertices get the zero-sentinel final slot; -1/oob sources
+    are dropped per the reference bounds check (main.cu:49)."""
+    n, edges = GRAPHS["sparse_disconnected"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = [
+        np.array([0, -1, n + 5], dtype=np.int32),
+        np.array([n - 1], dtype=np.int32),
+        np.zeros(0, dtype=np.int32),
+    ]
+    padded = pad_queries(queries)
+    eng = BellEngine(BellGraph.from_host(g))
+    want = oracle_f_values(n, edges, queries)
+    np.testing.assert_array_equal(np.asarray(eng.f_values(padded)), want)
+    assert eng.best(padded) == oracle_best(want)
+
+
+def test_bell_k_not_aligned():
+    n, edges = GRAPHS["gnm"]
+    g = CSRGraph.from_edges(n, edges)
+    bg = BellGraph.from_host(g)
+    for k in (1, 3, 8, 13):
+        queries = generators.random_queries(n, k, max_group=3, seed=206 + k)
+        padded = pad_queries(queries)
+        got = np.asarray(BellEngine(bg).f_values(padded))
+        np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+        assert got.shape == (k,)
+
+
+def test_bell_query_stats_matches_packed():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+        PackedEngine,
+    )
+
+    n, edges = GRAPHS["grid"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 5, max_group=3, seed=207)
+    padded = pad_queries(queries)
+    a = BellEngine(BellGraph.from_host(g)).query_stats(padded)
+    b = PackedEngine(g.to_device()).query_stats(padded)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)

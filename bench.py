@@ -17,7 +17,7 @@ vertex-parallel BFS with per-level host sync on power-law graphs lands at
 
 Env knobs: BENCH_SCALE (default 20), BENCH_EDGE_FACTOR (16), BENCH_K (64),
 BENCH_CHUNK (8), BENCH_REPEATS (3), BENCH_MAX_S (64),
-BENCH_ENGINE (packed|vmap|dense, default packed),
+BENCH_ENGINE (bitbell|bell|packed|vmap|dense|pallas, default bitbell),
 BENCH_EDGE_CHUNKS (packed engine HBM knob, default 1).
 """
 
@@ -38,7 +38,7 @@ def main() -> None:
     chunk = int(os.environ.get("BENCH_CHUNK", "8"))
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
     max_s = int(os.environ.get("BENCH_MAX_S", "64"))
-    engine_kind = os.environ.get("BENCH_ENGINE", "packed")
+    engine_kind = os.environ.get("BENCH_ENGINE", "bitbell")
     edge_chunks = int(os.environ.get("BENCH_EDGE_CHUNKS", "1"))
 
     import jax
@@ -93,6 +93,15 @@ def main() -> None:
         )
 
         engine = BellEngine(BellGraph.from_host(g))
+    elif engine_kind == "bitbell":
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+            BellGraph,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+            BitBellEngine,
+        )
+
+        engine = BitBellEngine(BellGraph.from_host(g))
     else:
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
             PackedEngine,

@@ -1,0 +1,90 @@
+"""Where does a bitbell level go?  Times the forest OR-gather vs the
+per-query count unpack on a real RMAT graph (run on the TPU host)."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+    CSRGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+    bell_hits_or,
+    unpack_counts,
+)
+
+scale = int(os.environ.get("S", "20"))
+K = int(os.environ.get("K", "64"))
+W = K // 32
+
+n, edges = generators.rmat_edges(scale, edge_factor=16, seed=42)
+g = CSRGraph.from_edges(n, edges)
+bg = BellGraph.from_host(g)
+print(f"n={n} E={g.num_directed_edges} {bg}", flush=True)
+
+rng = np.random.default_rng(0)
+frontier = jnp.asarray(
+    rng.integers(0, 2**32, size=(n, W), dtype=np.uint32)
+    & rng.integers(0, 2**32, size=(n, W), dtype=np.uint32)
+    & rng.integers(0, 2**32, size=(n, W), dtype=np.uint32)
+)
+
+
+def bench(name, fn, *args):
+    f = jax.jit(fn)
+    r = f(*args)
+    jax.block_until_ready(r)
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(*args))
+        ts.append(time.perf_counter() - t0)
+    t = min(ts)
+    e = g.num_directed_edges
+    print(f"{name:28s} {t*1e3:9.2f} ms ({e/t/1e9:6.2f} Gslot/s)", flush=True)
+    return t
+
+
+bench("hits_or (forest gather)", lambda fr: bell_hits_or(fr, bg), frontier)
+bench("unpack_counts", unpack_counts, frontier)
+bench("new&~vis + counts + or", lambda fr: (
+    unpack_counts(fr & ~(fr >> 1)), fr | (fr >> 1)
+), frontier)
+bench(
+    "full level (hits+counts)",
+    lambda fr: unpack_counts(bell_hits_or(fr, bg) & ~fr),
+    frontier,
+)
+
+
+# --- Pallas VMEM-gather probe: existing ELL kernel, single uint8 frontier.
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.ell import (
+    EllGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.pallas_bfs import (
+    ell_hits,
+)
+
+eg = EllGraph.from_host(g, width=16)
+print(repr(eg), flush=True)
+pad_to = max(128, -(-(n + 1) // 128) * 128)
+fr1 = jnp.zeros((pad_to,), dtype=jnp.int8).at[: n].set(
+    jnp.asarray((rng.random(n) < 0.1).astype(np.int8))
+)
+bench(
+    "pallas ell_hits (1 query)",
+    lambda fr: ell_hits(fr, eg.cols, eg.num_vrows, eg.width),
+    fr1,
+)

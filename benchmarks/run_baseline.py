@@ -17,7 +17,7 @@ Configs (BASELINE.md "Measurement plan"):
      needs >= 2 devices, same virtual-mesh fallback as config 3)
 
 Usage: python benchmarks/run_baseline.py [--config N] [--all] [--scale-cap S]
-                                         [--engine packed|bell] [--out F]
+                                         [--engine bitbell|bell|packed] [--out F]
 """
 
 from __future__ import annotations
@@ -33,20 +33,28 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-ENGINE = "packed"  # set by --engine; "bell" = scatter-free reduction forest
+ENGINE = "bitbell"  # set by --engine
 
 
 def _engine_for(graph, kind: str = None, edge_chunks: int = 8):
     kind = kind or ENGINE
-    if kind == "bell":
+    if kind in ("bell", "bitbell"):
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
             BellGraph,
         )
+
+        bg = BellGraph.from_host(graph)
+        if kind == "bitbell":
+            from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+                BitBellEngine,
+            )
+
+            return BitBellEngine(bg)
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bell import (
             BellEngine,
         )
 
-        return BellEngine(BellGraph.from_host(graph))
+        return BellEngine(bg)
     if kind != "packed":
         raise ValueError(kind)
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
@@ -236,6 +244,10 @@ CPU_MESH_ENV = {
     "PALLAS_AXON_POOL_IPS": "",
     "JAX_PLATFORMS": "cpu",
     "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    # Sentinel so the child doesn't recurse into another fallback; a user's
+    # own JAX_PLATFORMS=cpu must NOT suppress the fallback (their plain CPU
+    # run has one device and still needs the virtual mesh).
+    "MSBFS_BASELINE_CPU_MESH": "1",
 }
 
 
@@ -290,7 +302,9 @@ def main() -> int:
         default=None,
         help="cap RMAT scales (configs 2/3/5) for RAM-limited hosts",
     )
-    ap.add_argument("--engine", choices=("packed", "bell"), default="packed")
+    ap.add_argument(
+        "--engine", choices=("bitbell", "bell", "packed"), default="bitbell"
+    )
     ap.add_argument(
         "--out",
         default=None,
@@ -308,7 +322,7 @@ def main() -> int:
         try:
             r = _call(c, args)
         except NeedsDevices:
-            if os.environ.get("JAX_PLATFORMS") == "cpu":
+            if os.environ.get("MSBFS_BASELINE_CPU_MESH"):
                 r = {"config": c, "error": "needs more devices (already on CPU mesh)"}
             else:
                 r = _run_in_cpu_mesh(c, args)

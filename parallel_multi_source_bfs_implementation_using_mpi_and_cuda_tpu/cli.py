@@ -125,8 +125,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 from .ops.bell import BellEngine
 
                 engine = BellEngine(BellGraph.from_host(graph))
-            else:
-                # Default CSR path: the coalesced query-major engine.
+            elif backend == "packed":
+                # Coalesced query-major (n, K) engine over the flat CSR.
                 # MSBFS_EDGE_CHUNKS bounds the per-level (E/chunks, K)
                 # gather intermediate on HBM-constrained chips.
                 from .ops.packed import PackedEngine
@@ -136,6 +136,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                 except ValueError:
                     edge_chunks = 1
                 engine = PackedEngine(graph.to_device(), edge_chunks=edge_chunks)
+            else:
+                # Default CSR path: bit-packed BELL reduction forest — the
+                # fastest measured engine (RMAT-20/64q on v5e: 2x the packed
+                # CSR path; see BASELINE.md).
+                from .models.bell import BellGraph
+                from .ops.bitbell import BitBellEngine
+
+                engine = BitBellEngine(BellGraph.from_host(graph))
         stats_mode = os.environ.get("MSBFS_STATS") == "1"
         engine.compile(padded.shape, warm_stats=stats_mode)
 

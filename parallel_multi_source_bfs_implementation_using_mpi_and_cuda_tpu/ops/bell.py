@@ -32,10 +32,16 @@ from .packed import K_ALIGN, PackedEngineBase, packed_init
 HIT = jnp.uint8
 
 
-def bell_hits_packed(frontier: jax.Array, graph: BellGraph) -> jax.Array:
-    """(n, K) uint8 frontier indicator -> (n, K) uint8 per-vertex hit flags."""
-    k = frontier.shape[1]
-    zero_row = jnp.zeros((1, k), dtype=frontier.dtype)
+def forest_hits(frontier: jax.Array, graph: BellGraph, reduce_fn) -> jax.Array:
+    """Shared BELL reduction-forest traversal.
+
+    ``frontier`` is (n, C) of any dtype whose zero value means "not in
+    frontier"; ``reduce_fn(vals (R, W, C)) -> (R, C)`` collapses the width
+    axis (max for flag columns, bitwise-OR for packed bit planes).  Returns
+    the (n, C) per-vertex hit array via the final per-vertex slot gather.
+    """
+    c = frontier.shape[1]
+    zero_row = jnp.zeros((1, c), dtype=frontier.dtype)
     v_prev = jnp.concatenate([frontier, zero_row], axis=0)  # sentinel row n
     outs = []
     for cols_per_bucket in graph.levels:
@@ -45,16 +51,21 @@ def bell_hits_packed(frontier: jax.Array, graph: BellGraph) -> jax.Array:
             if r_b == 0:
                 continue
             g = jnp.take(v_prev, cols.reshape(-1), axis=0)
-            parts.append(jnp.max(g.reshape(r_b, w_b, k), axis=1))
+            parts.append(reduce_fn(g.reshape(r_b, w_b, c)))
         out = (
             jnp.concatenate(parts, axis=0)
             if len(parts) != 1
             else parts[0]
-        ) if parts else jnp.zeros((0, k), dtype=frontier.dtype)
+        ) if parts else jnp.zeros((0, c), dtype=frontier.dtype)
         outs.append(out)
         v_prev = jnp.concatenate([out, zero_row], axis=0)
     v_cat = jnp.concatenate(outs + [zero_row], axis=0)
     return jnp.take(v_cat, graph.final_slot, axis=0)
+
+
+def bell_hits_packed(frontier: jax.Array, graph: BellGraph) -> jax.Array:
+    """(n, K) uint8 frontier indicator -> (n, K) uint8 per-vertex hit flags."""
+    return forest_hits(frontier, graph, lambda g: jnp.max(g, axis=1))
 
 
 def bell_expand_packed(

@@ -1,0 +1,177 @@
+"""Bit-packed BELL BFS: 32 queries per uint32 word, OR-fold frontier.
+
+The BELL engine (ops.bell) already removed the scatter from the per-level
+neighbor reduce; its remaining HBM cost is the (slots, K) uint8 frontier
+gather — one byte per query per padded slot.  This engine packs the query
+axis into uint32 words (query k lives in word k>>5, bit k&31), so the same
+reduction forest moves 8x fewer bytes, and the fixed-width ``max`` becomes a
+bitwise OR-fold (the boolean-semiring sum), which the VPU executes at the
+same rate.
+
+The (n, K) int32 distance matrix disappears from the loop entirely: the
+objective F(U) = sum dist(v) (reference main.cu:75-89) is accumulated
+incrementally — when a level discovers c_k new vertices for query k at
+distance l, F_k += l * c_k — and the per-query stats (levels, reached) fall
+out of the same counters, so nothing per-vertex-per-query wider than one bit
+is ever materialized.  Loop state per query: two (n, K/32) bit planes
+(visited, frontier) + three (K,) counters.
+
+Semantics are the reference's exactly (main.cu:16-89): -1/out-of-range
+sources dropped (main.cu:49), level-synchronous expansion until a level
+discovers nothing (main.cu:61-71), unreached vertices excluded from F.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.bell import BellGraph
+from .bell import forest_hits
+from .packed import PackedEngineBase
+
+WORD_BITS = 32
+_SHIFTS = tuple(range(WORD_BITS))
+
+
+def _or_fold(x: jax.Array, axis: int) -> jax.Array:
+    """Bitwise-OR reduction along one axis (the boolean-semiring 'max')."""
+    return lax.reduce(x, x.dtype.type(0), lax.bitwise_or, (axis,))
+
+
+def pack_queries(n: int, queries: jax.Array) -> jax.Array:
+    """(K, S) -1-padded queries -> (n, K/32) uint32 source bit planes.
+
+    K must be a multiple of 32.  Out-of-range sources (including -1 padding)
+    are dropped — the reference's bounds check (main.cu:46-51).
+
+    One scatter per query, each writing that query's single constant bit
+    (so scatter-max IS bitwise-OR within the scatter), OR-accumulated into
+    the word plane: peak memory stays O(n * K/32) — no (n, K) membership
+    matrix is ever built (init runs once per batch; scatter cost of K
+    small index vectors is irrelevant next to the level loop).
+    """
+    k, _ = queries.shape
+    assert k % WORD_BITS == 0, k
+    sources = queries.astype(jnp.int32)
+    in_range = (sources >= 0) & (sources < n)
+    safe = jnp.where(in_range, sources, n)  # row n dropped via mode="drop"
+    planes = []
+    for w in range(k // WORD_BITS):
+        plane = jnp.zeros((n,), dtype=jnp.uint32)
+        for b in range(WORD_BITS):
+            plane = plane | (
+                jnp.zeros((n,), dtype=jnp.uint32)
+                .at[safe[w * WORD_BITS + b]]
+                .max(jnp.uint32(1 << b), mode="drop")
+            )
+        planes.append(plane)
+    return jnp.stack(planes, axis=1)
+
+
+def unpack_counts(words: jax.Array) -> jax.Array:
+    """(n, W) uint32 bit planes -> (W*32,) int32 per-query set-bit counts."""
+    n, w = words.shape
+    shifts = jnp.asarray(_SHIFTS, dtype=jnp.uint32)
+    bits = (words[:, :, None] >> shifts[None, None, :]) & jnp.uint32(1)
+    return bits.sum(axis=0, dtype=jnp.int32).reshape(w * WORD_BITS)
+
+
+def bell_hits_or(frontier: jax.Array, graph: BellGraph) -> jax.Array:
+    """(n, W) uint32 frontier planes -> (n, W) per-vertex hit planes.
+
+    The shared reduction-forest traversal (ops.bell.forest_hits) with the
+    fixed-width max replaced by OR over the packed word lanes.
+    """
+    return forest_hits(frontier, graph, lambda g: _or_fold(g, 1))
+
+
+@partial(jax.jit, static_argnames=("max_levels",))
+def bitbell_run(
+    graph: BellGraph,
+    queries: jax.Array,
+    max_levels: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(K, S) queries (K % 32 == 0) -> per-query (f, levels, reached).
+
+    ``f`` is int64 (reference accumulates in long long, main.cu:77);
+    ``levels`` = while-iterations the query needed (= max distance + 1, the
+    reference's kernel-launch count, main.cu:61-71); ``reached`` = number of
+    reached vertices including sources.
+    """
+    n = graph.n
+    k = queries.shape[0]
+    frontier0 = pack_queries(n, queries)
+    counts0 = unpack_counts(frontier0)
+
+    def cond(carry):
+        _, _, _, _, _, level, updated = carry
+        go = updated
+        if max_levels is not None:
+            go = jnp.logical_and(go, level < max_levels)
+        return go
+
+    def body(carry):
+        visited, frontier, f, levels, reached, level, _ = carry
+        hits = bell_hits_or(frontier, graph)
+        new = hits & ~visited
+        counts = unpack_counts(new)
+        found = counts > 0
+        dist = level + 1  # newly discovered vertices are at this distance
+        return (
+            visited | new,
+            new,
+            f + counts.astype(jnp.int64) * (dist).astype(jnp.int64),
+            jnp.where(found, dist + 1, levels),
+            reached + counts,
+            level + 1,
+            jnp.any(found),
+        )
+
+    carry = (
+        frontier0,  # visited = sources
+        frontier0,
+        # Sources contribute distance 0; deriving the zero init from counts0
+        # (rather than a literal) gives it counts0's varying-axes type, so
+        # the same loop works unchanged inside shard_map shards.
+        counts0.astype(jnp.int64) * 0,
+        jnp.where(counts0 > 0, 1, 0).astype(jnp.int32),
+        counts0,
+        jnp.int32(0),
+        jnp.any(counts0 > 0),
+    )
+    _, _, f, levels, reached, _, _ = lax.while_loop(cond, body, carry)
+    return f, levels, reached
+
+
+class BitBellEngine(PackedEngineBase):
+    """Bit-plane all-queries-at-once engine over a BellGraph.
+
+    Inherits the K-alignment padding from PackedEngineBase (k_align = 32
+    here) but overrides query_stats: stats come from the loop's counters,
+    not from a distance matrix (none exists in this engine)."""
+
+    k_align = WORD_BITS
+
+    def __init__(self, graph: BellGraph, max_levels: Optional[int] = None):
+        self.graph = graph
+        self.max_levels = max_levels
+
+    def f_values(self, queries) -> jax.Array:
+        queries, k = self._pad_queries(queries)
+        f, _, _ = bitbell_run(self.graph, queries, self.max_levels)
+        return f[:k]
+
+    def query_stats(self, queries):
+        queries, k = self._pad_queries(queries)
+        f, levels, reached = bitbell_run(self.graph, queries, self.max_levels)
+        return (
+            np.asarray(levels)[:k],
+            np.asarray(reached)[:k],
+            np.asarray(f)[:k],
+        )

@@ -59,7 +59,9 @@ class PackedEngineBase(QueryEngineBase):
     def _pad_queries(self, queries) -> Tuple[jax.Array, int]:
         queries = jnp.asarray(queries, dtype=jnp.int32)
         k, s = queries.shape
-        pad = (-k) % self.k_align if k else 1
+        # K = 0 still pads to one full alignment group so the engine runs a
+        # fixed-shape program (results are sliced back to length 0).
+        pad = (-k) % self.k_align if k else self.k_align
         if pad:
             queries = jnp.concatenate(
                 [queries, jnp.full((pad, s), -1, dtype=jnp.int32)], axis=0

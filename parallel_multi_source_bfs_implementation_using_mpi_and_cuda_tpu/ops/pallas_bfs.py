@@ -18,6 +18,16 @@ segment-max that is ``width``-times smaller than the flat-CSR reduce.
 
 On non-TPU backends the kernel runs in interpreter mode (bit-identical
 semantics), so the full test suite exercises it on the virtual CPU mesh.
+
+STATUS on real TPUs: Mosaic's gather lowering currently supports only
+lane-batched ``take_along_axis``-shaped dynamic gathers (indices shaped like
+the 2D operand, same-lane lookups) — the arbitrary-index VMEM gather at the
+heart of this kernel is not yet expressible, so :func:`ell_hits` transparently
+runs the identical slab computation as plain XLA ops there.  The kernel is
+kept (and CI-tested in interpreter mode) as the drop-in implementation for
+when Mosaic grows arbitrary vector gathers; the production TPU path is the
+bit-packed BELL engine (ops.bitbell), which needs no scatter or arbitrary
+gather inside a kernel.
 """
 
 from __future__ import annotations
@@ -27,7 +37,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 TILE_R = 512
 
@@ -42,7 +51,16 @@ def _ell_hits_kernel(frontier_ref, cols_ref, out_ref):
 @functools.partial(jax.jit, static_argnames=("num_vrows", "width"))
 def ell_hits(frontier: jax.Array, cols: jax.Array, num_vrows: int, width: int):
     """frontier (n_vmem,) int8, cols (width, R) -> (R,) int8 hit flags."""
-    interpret = jax.default_backend() not in ("tpu", "axon")
+    if jax.default_backend() in ("tpu", "axon"):
+        # Mosaic currently lowers only lane-batched 2D dynamic gathers
+        # (take_along_axis with indices shaped like the operand); the
+        # arbitrary-index VMEM gather this kernel wants is not expressible,
+        # so on real TPUs the same slab computation runs as plain XLA ops
+        # (identical semantics, HBM-resident frontier).  The pallas_call
+        # path below executes in interpreter mode on CPU, where the test
+        # suite verifies bit-identical behavior.
+        vals = jnp.take(frontier, cols, axis=0)  # (width, R)
+        return jnp.max(vals, axis=0)
     # Round the virtual-row axis up to the kernel tile; padding slots index
     # frontier[0], which is harmless because their vrow_vertex sentinel is
     # dropped by the downstream segment reduce.
@@ -54,11 +72,11 @@ def ell_hits(frontier: jax.Array, cols: jax.Array, num_vrows: int, width: int):
         out_shape=jax.ShapeDtypeStruct((r_pad,), jnp.int8),
         grid=(r_pad // TILE_R,),
         in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY if interpret else pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((width, TILE_R), lambda i: (0, i)),
         ],
         out_specs=pl.BlockSpec((TILE_R,), lambda i: (i,)),
-        interpret=interpret,
+        interpret=True,
     )(frontier, cols)
     return hits[:num_vrows]
 

@@ -32,6 +32,38 @@ from .mesh import QUERY_AXIS, VERTEX_AXIS
 from .scheduler import merge_local_f, shard_queries
 
 
+@partial(jax.jit, static_argnames=("mesh", "k", "k_pad", "w", "max_levels"))
+def _distributed_bitbell_f_values(
+    mesh: Mesh,
+    graph,  # BellGraph, replicated on every device
+    query_grid: jax.Array,  # (W, J, S) cyclic layout
+    k: int,
+    k_pad: int,
+    w: int,
+    max_levels,
+) -> jax.Array:
+    """Merged (k_pad,) int64 F via the bit-packed BELL engine per shard."""
+    from ..ops.bitbell import WORD_BITS, bitbell_run
+
+    def shard_body(graph, qblock):
+        qblock = qblock[0]  # local leading extent 1 on 'q'
+        j, s = qblock.shape
+        pad = (-j) % WORD_BITS
+        if pad:
+            qblock = jnp.concatenate(
+                [qblock, jnp.full((pad, s), -1, dtype=qblock.dtype)], axis=0
+            )
+        f, _, _ = bitbell_run(graph, qblock, max_levels)
+        return merge_local_f(f[:j], j, w, k, k_pad, (QUERY_AXIS, VERTEX_AXIS))
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(QUERY_AXIS)),
+        out_specs=P(),
+    )(graph, query_grid)
+
+
 @partial(
     jax.jit,
     static_argnames=("mesh", "k", "k_pad", "w", "query_chunk", "max_levels", "expand"),
@@ -74,7 +106,12 @@ def _distributed_f_values(
 
 class DistributedEngine(QueryEngineBase):
     """Query-sharded execution over a mesh, graph replicated per device
-    (the reference's full-graph-per-rank model, SURVEY.md C8)."""
+    (the reference's full-graph-per-rank model, SURVEY.md C8).
+
+    ``backend`` picks the per-shard engine: ``"bitbell"`` (default) runs the
+    bit-packed BELL reduction forest — the fastest single-chip engine — on
+    each shard's query slice; ``"csr"`` runs the per-query vmap CSR pull
+    (accepts a custom ``expand`` hook, e.g. the dense-MXU frontier)."""
 
     def __init__(
         self,
@@ -83,13 +120,37 @@ class DistributedEngine(QueryEngineBase):
         max_levels: Optional[int] = None,
         query_chunk: Optional[int] = None,
         expand=graph_expand,
+        backend: str = "bitbell",
     ):
         self.mesh = mesh
         self.w = mesh.shape[QUERY_AXIS]
         replicated = NamedSharding(mesh, P())
-        if isinstance(graph, CSRGraph):
-            graph = DeviceCSR.from_host(graph, sharding=replicated)
-        self.graph = graph
+        if backend == "bitbell":
+            if expand is not graph_expand or query_chunk is not None:
+                # These knobs only exist on the per-query CSR path; accepting
+                # them here would silently not apply them.
+                raise ValueError(
+                    "expand/query_chunk require backend='csr' "
+                    "(the bitbell path has no per-query expansion hook)"
+                )
+            if isinstance(graph, DeviceCSR):
+                raise ValueError(
+                    "backend='bitbell' builds its own layout; pass the host "
+                    "CSRGraph"
+                )
+            from ..models.bell import BellGraph
+
+            self.bell = jax.device_put(
+                BellGraph.from_host(graph), replicated
+            )
+        elif backend == "csr":
+            self.bell = None
+            if isinstance(graph, CSRGraph):
+                graph = DeviceCSR.from_host(graph, sharding=replicated)
+            self.graph = graph
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
         self.max_levels = max_levels
         self.query_chunk = query_chunk
         self.expand = expand
@@ -99,15 +160,26 @@ class DistributedEngine(QueryEngineBase):
         sharded, k, k_pad, chunk = shard_queries(
             self.mesh, np.asarray(queries), self.query_chunk
         )
-        merged = _distributed_f_values(
-            self.mesh,
-            self.graph,
-            sharded,
-            k,
-            k_pad,
-            self.w,
-            chunk,
-            self.max_levels,
-            self.expand,
-        )
+        if self.backend == "bitbell":
+            merged = _distributed_bitbell_f_values(
+                self.mesh,
+                self.bell,
+                sharded,
+                k,
+                k_pad,
+                self.w,
+                self.max_levels,
+            )
+        else:
+            merged = _distributed_f_values(
+                self.mesh,
+                self.graph,
+                sharded,
+                k,
+                k_pad,
+                self.w,
+                chunk,
+                self.max_levels,
+                self.expand,
+            )
         return merged[:k]

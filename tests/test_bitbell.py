@@ -1,0 +1,123 @@
+"""Bit-packed BELL engine: oracle parity, packing helpers, stats parity."""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+    BitBellEngine,
+    pack_queries,
+    unpack_counts,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+
+def oracle_f_values(n, edges, queries):
+    return [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+
+
+GRAPHS = {
+    "gnm": generators.gnm_edges(140, 460, seed=301),
+    "grid": generators.grid_edges(19, 7),
+    "rmat": generators.rmat_edges(8, edge_factor=8, seed=302),
+    "sparse_disconnected": generators.gnm_edges(180, 70, seed=303),
+}
+
+
+def test_pack_unpack_roundtrip():
+    n, k = 50, 64
+    rng = np.random.default_rng(304)
+    queries = np.full((k, 4), -1, dtype=np.int32)
+    for i in range(k):
+        g = rng.choice(n, size=rng.integers(0, 5), replace=False)
+        queries[i, : len(g)] = g
+    planes = np.asarray(pack_queries(n, queries))
+    assert planes.shape == (n, k // 32) and planes.dtype == np.uint32
+    counts = np.asarray(unpack_counts(planes))
+    want = [len({s for s in q if 0 <= s < n}) for q in queries]
+    np.testing.assert_array_equal(counts, want)
+    # bit identity: query i's bit set exactly at its source rows
+    for i in range(k):
+        rows = np.nonzero((planes[:, i // 32] >> (i % 32)) & 1)[0]
+        assert set(rows) == {s for s in queries[i] if 0 <= s < n}
+
+
+@pytest.mark.parametrize("name", sorted(GRAPHS))
+def test_bitbell_matches_oracle(name):
+    n, edges = GRAPHS[name]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 11, max_group=5, seed=305)
+    queries[2] = np.zeros(0, dtype=np.int32)
+    padded = pad_queries(queries)
+    eng = BitBellEngine(BellGraph.from_host(g))
+    got = np.asarray(eng.f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_bitbell_k_not_multiple_of_32():
+    n, edges = GRAPHS["gnm"]
+    g = CSRGraph.from_edges(n, edges)
+    bg = BellGraph.from_host(g)
+    for k in (1, 31, 32, 33, 64):
+        queries = generators.random_queries(n, k, max_group=3, seed=306 + k)
+        padded = pad_queries(queries)
+        got = np.asarray(BitBellEngine(bg).f_values(padded))
+        np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+        assert got.shape == (k,)
+
+
+def test_bitbell_best_and_out_of_range():
+    n, edges = GRAPHS["sparse_disconnected"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = [
+        np.array([0, -1, n + 5], dtype=np.int32),
+        np.array([n - 1], dtype=np.int32),
+        np.zeros(0, dtype=np.int32),
+    ]
+    padded = pad_queries(queries)
+    eng = BitBellEngine(BellGraph.from_host(g))
+    want = oracle_f_values(n, edges, queries)
+    np.testing.assert_array_equal(np.asarray(eng.f_values(padded)), want)
+    assert eng.best(padded) == oracle_best(want)
+
+
+def test_bitbell_stats_match_packed():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+        PackedEngine,
+    )
+
+    n, edges = GRAPHS["grid"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 7, max_group=3, seed=307)
+    queries[3] = np.zeros(0, dtype=np.int32)  # levels=0 lane
+    padded = pad_queries(queries)
+    a = BitBellEngine(BellGraph.from_host(g)).query_stats(padded)
+    b = PackedEngine(g.to_device()).query_stats(padded)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_bitbell_hub_star():
+    n_leaves = 500
+    n = n_leaves + 1
+    edges = np.stack(
+        [np.zeros(n_leaves, dtype=np.int64), np.arange(1, n, dtype=np.int64)],
+        axis=1,
+    )
+    g = CSRGraph.from_edges(n, edges)
+    queries = [np.array([0], dtype=np.int32), np.array([5], dtype=np.int32)]
+    padded = pad_queries(queries)
+    for widths in ((2, 8), (2, 8, 32, 128)):
+        eng = BitBellEngine(BellGraph.from_host(g, widths=widths))
+        got = np.asarray(eng.f_values(padded))
+        np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))

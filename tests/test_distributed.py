@@ -87,9 +87,26 @@ def test_query_chunked_distributed(problem):
     n, edges, queries, padded = problem
     graph = CSRGraph.from_edges(n, edges)
     mesh = make_mesh(num_query_shards=4, devices=jax.devices()[:4])
-    deng = DistributedEngine(mesh, graph, query_chunk=2)
+    deng = DistributedEngine(mesh, graph, query_chunk=2, backend="csr")
     got = np.asarray(deng.f_values(padded))
     np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_distributed_csr_backend_matches(problem):
+    n, edges, queries, padded = problem
+    graph = CSRGraph.from_edges(n, edges)
+    mesh = make_mesh(num_query_shards=2, devices=jax.devices()[:2])
+    a = np.asarray(DistributedEngine(mesh, graph, backend="csr").f_values(padded))
+    b = np.asarray(DistributedEngine(mesh, graph).f_values(padded))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_distributed_bitbell_rejects_csr_knobs(problem):
+    n, edges, _, _ = problem
+    graph = CSRGraph.from_edges(n, edges)
+    mesh = make_mesh(num_query_shards=2, devices=jax.devices()[:2])
+    with pytest.raises(ValueError):
+        DistributedEngine(mesh, graph, query_chunk=2)
 
 
 def test_two_axis_mesh_query_sharding(problem):

@@ -227,7 +227,14 @@ def config5(scale=20):
         generators.random_queries(n, 16, max_group=16, seed=45), pad_to=16
     )
     mesh = make_mesh(num_query_shards=n_q, num_vertex_shards=n_v)
-    engine = ShardedEngine(mesh, g)
+    if ENGINE == "bitbell":
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (
+            ShardedBellEngine,
+        )
+
+        engine = ShardedBellEngine(mesh, g)
+    else:  # bell/packed: the boolean-halo sharded CSR path
+        engine = ShardedEngine(mesh, g)
     r = _run(engine, queries, g.num_directed_edges)
     return {
         "config": 5,

@@ -92,8 +92,34 @@ def main(argv: Optional[List[str]] = None) -> int:
         padded = pad_queries(queries)
         n_chips = max(1, min(num_gpu, len(jax.devices())))
         if n_chips > 1:
-            mesh = default_mesh(max_devices=n_chips)
-            engine = DistributedEngine(mesh, graph)
+            # MSBFS_VSHARD=v splits the CSR over a 'v' mesh axis of that
+            # size (vertex sharding for graphs beyond one chip's HBM —
+            # beyond-reference capability, parallel/sharded_bell.py);
+            # remaining chips shard queries.  Default: all chips on 'q',
+            # graph replicated (the reference's model, main.cu:242-255).
+            try:
+                vshard = int(os.environ.get("MSBFS_VSHARD", "1"))
+            except ValueError:
+                vshard = 1
+            if vshard > 1 and n_chips % vshard != 0:
+                print(
+                    f"MSBFS_VSHARD={vshard} does not divide {n_chips} chips;"
+                    " falling back to replicated-graph query sharding",
+                    file=sys.stderr,
+                )
+            if vshard > 1 and n_chips % vshard == 0:
+                from .parallel.mesh import make_mesh
+                from .parallel.sharded_bell import ShardedBellEngine
+
+                mesh = make_mesh(
+                    num_query_shards=n_chips // vshard,
+                    num_vertex_shards=vshard,
+                    devices=jax.devices()[:n_chips],
+                )
+                engine = ShardedBellEngine(mesh, graph)
+            else:
+                mesh = default_mesh(max_devices=n_chips)
+                engine = DistributedEngine(mesh, graph)
         else:
             # Backend selection (beyond-reference knob, env-controlled so the
             # argv contract stays reference-exact): "dense" runs frontier

@@ -143,6 +143,7 @@ class DistributedEngine(QueryEngineBase):
             self.bell = jax.device_put(
                 BellGraph.from_host(graph), replicated
             )
+            self.graph = None  # keep the attribute set backend-uniform
         elif backend == "csr":
             self.bell = None
             if isinstance(graph, CSRGraph):

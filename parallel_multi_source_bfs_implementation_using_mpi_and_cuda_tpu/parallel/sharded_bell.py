@@ -1,0 +1,292 @@
+"""Vertex-sharded bit-plane BFS: the bitbell engine over a partitioned CSR.
+
+parallel.sharded_csr scales graphs beyond one chip's HBM with a per-level
+``all_gather`` halo exchange of a *boolean* frontier per query (SURVEY.md
+section 5's "scale the big dimension" axis).  This module is its
+high-throughput sibling: all K queries advance together as (n_pad, K/32)
+uint32 bit planes, so one level costs
+
+  * one scatter-free forest pass over the shard's LOCAL rows (ops.bitbell),
+  * one (L, K/32)-word ``all_gather`` over the 'v' axis — 32x less ICI
+    traffic than the boolean halo, and one collective for all K queries
+    instead of one per vmapped query.
+
+Layout.  Each 'v' shard owns the vertex rows [p*L, (p+1)*L) and builds a
+BELL reduction forest over the *global* owner space in which only its own
+rows have neighbors; every other row is degree-0 and maps to the zero
+sentinel.  Shard forests are then "harmonized" — every level/bucket padded
+to the cross-shard maximum with sentinel rows — so all shards execute one
+SPMD program over identically-shaped arrays (shard_map requirement), while
+each shard's pads gather only the always-zero sentinel row.
+
+F(U) accumulates replicated (each shard sees the same gathered frontier),
+so the only per-level collective is the halo all_gather itself; the final
+(K,) values merge over 'q' exactly like every other engine
+(scheduler.merge_local_f — the reference's Gatherv+argmin contract,
+main.cu:324-397).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.bell import DEFAULT_WIDTHS, BellGraph
+from ..models.csr import CSRGraph
+from ..ops.bitbell import WORD_BITS, bell_hits_or, pack_queries, unpack_counts
+from ..ops.engine import QueryEngineBase
+from .mesh import QUERY_AXIS, VERTEX_AXIS
+from .scheduler import merge_local_f, shard_queries
+
+
+def _block_csr(g: CSRGraph, lo: int, hi: int, n_pad: int) -> CSRGraph:
+    """CSR over the global owner space [0, n_pad) in which only rows
+    [lo, hi) keep their neighbors (the shard's partition)."""
+    degrees = np.zeros(n_pad, dtype=np.int64)
+    degrees[lo:hi] = np.diff(g.row_offsets[lo : hi + 1])
+    row_offsets = np.zeros(n_pad + 1, dtype=np.int64)
+    np.cumsum(degrees, out=row_offsets[1:])
+    s, e = int(g.row_offsets[lo]), int(g.row_offsets[hi])
+    return CSRGraph(
+        n=n_pad,
+        m=0,  # undirected record count is meaningless for a row block;
+        # BellGraph.from_host reads only offsets/cols/degrees
+        row_offsets=row_offsets,
+        col_indices=np.asarray(g.col_indices[s:e], dtype=np.int32),
+    )
+
+
+def build_sharded_forest(
+    g: CSRGraph, p: int, widths: Sequence[int] = DEFAULT_WIDTHS
+) -> Tuple[BellGraph, int, int]:
+    """Partition ``g`` into ``p`` vertex blocks and build one harmonized,
+    shard-stacked BELL forest.
+
+    Returns (stacked BellGraph whose every leaf has a leading shard axis,
+    block length L, padded vertex count n_pad = p * L).
+    """
+    L = -(-max(g.n, 1) // p)
+    n_pad = p * L
+    shards: List[BellGraph] = [
+        BellGraph.from_host(
+            _block_csr(g, min(b * L, g.n), min((b + 1) * L, g.n), n_pad),
+            widths=widths,
+        )
+        for b in range(p)
+    ]
+
+    num_levels = max(len(s.levels) for s in shards)
+    n_buckets = len(widths)
+    sorted_w = sorted(widths)
+
+    def bucket_rows(s: BellGraph, li: int, bi: int) -> int:
+        return s.levels[li][bi].shape[0] if li < len(s.levels) else 0
+
+    # Padded rows per (level, bucket) and the resulting uniform level sizes.
+    pad_rows = [
+        [max(bucket_rows(s, li, bi) for s in shards) for bi in range(n_buckets)]
+        for li in range(num_levels)
+    ]
+    pad_level_sizes = [sum(r) for r in pad_rows]
+    pad_level_off = np.concatenate([[0], np.cumsum(pad_level_sizes)])
+    total_pad = int(pad_level_off[-1])
+
+    # A level's output rows are the concatenation of its buckets, so padding
+    # any bucket shifts the positions of every later bucket's rows.  For each
+    # shard, row_map[li] maps a level-li local output row to its padded
+    # position *within the level*; every reference into level li's outputs
+    # (the next level's cols, and final_slot) goes through it.
+    row_maps: List[List[np.ndarray]] = []
+    for s in shards:
+        maps = []
+        for li in range(num_levels):
+            pad_b_off = np.concatenate([[0], np.cumsum(pad_rows[li])])
+            pieces = [
+                int(pad_b_off[bi]) + np.arange(bucket_rows(s, li, bi), dtype=np.int64)
+                for bi in range(n_buckets)
+            ]
+            maps.append(
+                np.concatenate(pieces)
+                if pieces
+                else np.zeros(0, dtype=np.int64)
+            )
+        row_maps.append(maps)
+
+    stacked_levels = []
+    for li in range(num_levels):
+        # Index of the always-zero row in the previous value array (the
+        # frontier for level 0): sentinel target for padding rows and for
+        # each shard's own local sentinel.
+        prev_zero = n_pad if li == 0 else pad_level_sizes[li - 1]
+        per_bucket = []
+        for bi in range(n_buckets):
+            w_b = sorted_w[bi]
+            rows = pad_rows[li][bi]
+            if rows == 0:
+                per_bucket.append(jnp.zeros((p, 0, w_b), dtype=jnp.int32))
+                continue
+            mats = []
+            for si, s in enumerate(shards):
+                m = np.full((rows, w_b), prev_zero, dtype=np.int64)
+                have = bucket_rows(s, li, bi)
+                if have:
+                    vals = np.asarray(s.levels[li][bi], dtype=np.int64)
+                    if li > 0:
+                        # Remap previous-level row references to padded
+                        # positions; the shard's local sentinel (== its
+                        # local level size) becomes the padded zero row.
+                        local_prev = sum(
+                            bucket_rows(s, li - 1, b) for b in range(n_buckets)
+                        )
+                        sentinel = vals == local_prev
+                        vals = np.where(
+                            sentinel, prev_zero, row_maps[si][li - 1][
+                                np.minimum(vals, max(local_prev - 1, 0))
+                            ]
+                        )
+                    m[:have] = vals
+                mats.append(m)
+            per_bucket.append(jnp.asarray(np.stack(mats).astype(np.int32)))
+        stacked_levels.append(per_bucket)
+
+    # final_slot: local level-concat position -> padded one, via the same
+    # per-level row maps; the local zero sentinel -> padded zero sentinel.
+    slots = []
+    for si, s in enumerate(shards):
+        # Global map over the shard's local concat of all level outputs:
+        # local position -> padded global position, sentinel appended last.
+        g_map = np.concatenate(
+            [row_maps[si][li] + pad_level_off[li] for li in range(num_levels)]
+            + [np.asarray([total_pad], dtype=np.int64)]
+        )
+        fs = np.asarray(s.final_slot, dtype=np.int64)  # local total == sentinel
+        slots.append(g_map[fs].astype(np.int32))
+    final_slot = jnp.asarray(np.stack(slots))
+
+    stacked = BellGraph(
+        levels=stacked_levels,
+        final_slot=final_slot,
+        n=n_pad,
+        n_pad=n_pad,
+        level_sizes=pad_level_sizes,
+        fill=float(np.mean([s.fill for s in shards])),
+    )
+    return stacked, L, n_pad
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "k_pad", "w", "block", "max_levels"))
+def _sharded_bitbell_f_values(
+    mesh: Mesh,
+    forest,  # shard-stacked BellGraph, leaves sharded over 'v'
+    query_grid: jax.Array,  # (W, J, S) cyclic layout, sharded over 'q'
+    k: int,
+    k_pad: int,
+    w: int,
+    block: int,
+    max_levels,
+) -> jax.Array:
+    def shard_body(forest, qblock):
+        local = jax.tree.map(lambda x: x[0], forest)  # drop 'v' stack axis
+        qblock = qblock[0]  # local leading extent 1 on 'q'
+        j, s = qblock.shape
+        pad = (-j) % WORD_BITS
+        if pad:
+            qblock = jnp.concatenate(
+                [qblock, jnp.full((pad, s), -1, dtype=qblock.dtype)], axis=0
+            )
+        n_pad = local.n
+
+        frontier0 = pack_queries(n_pad, qblock)
+        counts0 = unpack_counts(frontier0)
+        # The body's frontier comes out of an all_gather over 'v'; give the
+        # initial carry the same ('q','v')-varying type.
+        frontier0 = lax.pcast(frontier0, (VERTEX_AXIS,), to="varying")
+        me = lax.axis_index(VERTEX_AXIS)
+
+        def cond(carry):
+            _, _, _, level, updated = carry
+            go = updated
+            if max_levels is not None:
+                go = jnp.logical_and(go, level < max_levels)
+            return go
+
+        def body(carry):
+            visited, frontier, f, level, _ = carry
+            hits = bell_hits_or(frontier, local)  # zero outside owned rows
+            new = hits & ~visited
+            # Halo exchange: shards own disjoint row blocks, so gathering
+            # each shard's own (L, W) slice reconstructs the global planes.
+            mine = lax.dynamic_slice_in_dim(new, me * block, block, axis=0)
+            new_global = lax.all_gather(mine, VERTEX_AXIS, tiled=True)
+            counts = unpack_counts(new_global)
+            dist = level + 1
+            return (
+                visited | new_global,
+                new_global,
+                f + counts.astype(jnp.int64) * dist.astype(jnp.int64),
+                level + 1,
+                jnp.any(counts > 0),
+            )
+
+        carry = (
+            frontier0,
+            frontier0,
+            lax.pcast(
+                counts0.astype(jnp.int64) * 0, (VERTEX_AXIS,), to="varying"
+            ),
+            jnp.int32(0),
+            lax.pcast(jnp.any(counts0 > 0), (VERTEX_AXIS,), to="varying"),
+        )
+        _, _, f, _, _ = lax.while_loop(cond, body, carry)
+        return merge_local_f(f[:j], j, w, k, k_pad, (QUERY_AXIS, VERTEX_AXIS))
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(VERTEX_AXIS), P(QUERY_AXIS)),
+        out_specs=P(),
+    )(forest, query_grid)
+
+
+class ShardedBellEngine(QueryEngineBase):
+    """Queries round-robin over 'q', CSR vertex-sharded over 'v', all-K
+    bit-plane level loop with one word-packed halo all_gather per level."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        graph: CSRGraph,
+        max_levels: Optional[int] = None,
+        widths: Sequence[int] = DEFAULT_WIDTHS,
+    ):
+        self.mesh = mesh
+        self.w = mesh.shape[QUERY_AXIS]
+        p = mesh.shape[VERTEX_AXIS]
+        stacked, self.block, self.n_pad = build_sharded_forest(
+            graph, p, widths
+        )
+        vspec = NamedSharding(mesh, P(VERTEX_AXIS))
+        self.forest = jax.device_put(stacked, vspec)
+        self.max_levels = max_levels
+
+    def f_values(self, queries: np.ndarray) -> jax.Array:
+        sharded, k, k_pad, _ = shard_queries(
+            self.mesh, np.asarray(queries), None
+        )
+        merged = _sharded_bitbell_f_values(
+            self.mesh,
+            self.forest,
+            sharded,
+            k,
+            k_pad,
+            self.w,
+            self.block,
+            self.max_levels,
+        )
+        return merged[:k]

@@ -93,6 +93,13 @@ def main() -> None:
         )
 
         engine = BellEngine(BellGraph.from_host(g))
+    elif engine_kind == "push":
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push import (
+            PaddedAdjacency,
+            PushEngine,
+        )
+
+        engine = PushEngine(PaddedAdjacency.from_host(g))
     elif engine_kind == "bitbell":
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
             BellGraph,

@@ -19,11 +19,14 @@ _real = importlib.import_module(_LONG)
 for _sub in (
     "cli",
     "models",
+    "models.bell",
     "models.csr",
     "models.ell",
     "models.generators",
     "ops",
+    "ops.bell",
     "ops.bfs",
+    "ops.bitbell",
     "ops.dense",
     "ops.engine",
     "ops.objective",
@@ -33,6 +36,7 @@ for _sub in (
     "parallel.mesh",
     "parallel.scheduler",
     "parallel.distributed",
+    "parallel.sharded_bell",
     "parallel.sharded_csr",
     "runtime",
     "runtime.native_loader",

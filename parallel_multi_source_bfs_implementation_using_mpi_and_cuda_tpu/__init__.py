@@ -39,9 +39,11 @@ from jax import config as _jax_config
 _jax_config.update("jax_enable_x64", True)
 
 from .models.csr import CSRGraph, DeviceCSR  # noqa: E402
+from .models.bell import BellGraph  # noqa: E402
 from .ops.bfs import multi_source_bfs, batched_multi_source_bfs  # noqa: E402
 from .ops.objective import f_of_u, select_best  # noqa: E402
 from .ops.engine import Engine  # noqa: E402
+from .ops.bitbell import BitBellEngine  # noqa: E402
 from .utils.io import (  # noqa: E402
     load_graph_bin,
     load_query_bin,
@@ -53,6 +55,8 @@ from .utils.io import (  # noqa: E402
 __all__ = [
     "CSRGraph",
     "DeviceCSR",
+    "BellGraph",
+    "BitBellEngine",
     "multi_source_bfs",
     "batched_multi_source_bfs",
     "f_of_u",

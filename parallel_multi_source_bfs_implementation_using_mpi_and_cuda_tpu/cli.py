@@ -151,6 +151,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 from .ops.bell import BellEngine
 
                 engine = BellEngine(BellGraph.from_host(graph))
+            elif backend == "push":
+                # Frontier-compacted queue BFS: work-optimal on
+                # high-diameter, low-degree graphs (road networks, grids).
+                from .ops.push import PaddedAdjacency, PushEngine
+
+                engine = PushEngine(PaddedAdjacency.from_host(graph))
             elif backend == "packed":
                 # Coalesced query-major (n, K) engine over the flat CSR.
                 # MSBFS_EDGE_CHUNKS bounds the per-level (E/chunks, K)

@@ -144,15 +144,7 @@ class BellGraph:
         # Gathering from the frontier: item value array = frontier (n rows)
         # + sentinel zero row at index n.
         if dedup and e:
-            src = np.repeat(
-                np.arange(n, dtype=np.int64), g.degrees.astype(np.int64)
-            )
-            dst = np.asarray(g.col_indices, dtype=np.int64)
-            keep = src != dst  # self-loops can never newly reach anyone
-            pairs = np.unique(src[keep] * n + dst[keep])
-            item_vals = pairs % n
-            new_src = pairs // n
-            item_count = np.bincount(new_src, minlength=n)
+            _, item_vals, item_count = g.deduped_pairs()
             item_start = np.zeros(n, dtype=np.int64)
             np.cumsum(item_count[:-1], out=item_start[1:])
         else:

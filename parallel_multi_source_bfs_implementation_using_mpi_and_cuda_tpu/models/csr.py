@@ -85,6 +85,30 @@ class CSRGraph:
         col_indices = dst[order]
         return CSRGraph(n=n, m=m, row_offsets=row_offsets, col_indices=col_indices)
 
+    def deduped_pairs(self):
+        """Directed slots with duplicate neighbors and self-loops removed:
+        (src, dst, per-vertex counts), each sorted by (src, dst).
+
+        Set semantics per row — safe for any engine whose per-level step is
+        an "is any neighbor in the frontier" predicate (the hit cannot
+        change, only the redundant reads disappear); self-loops can never
+        newly reach their own already-visited vertex (main.cu:30-32).
+        """
+        n = self.n
+        src = np.repeat(
+            np.arange(n, dtype=np.int64), self.degrees.astype(np.int64)
+        )
+        dst = np.asarray(self.col_indices, dtype=np.int64)
+        keep = src != dst
+        pairs = (
+            np.unique(src[keep] * n + dst[keep])
+            if n
+            else np.zeros(0, dtype=np.int64)
+        )
+        u = pairs // n
+        v = pairs % n
+        return u, v, np.bincount(u, minlength=n)
+
     def to_device(self, sharding=None) -> "DeviceCSR":
         return DeviceCSR.from_host(self, sharding=sharding)
 

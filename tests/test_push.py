@@ -1,0 +1,114 @@
+"""Frontier-compacted push engine: oracle parity, capacity semantics."""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push import (
+    FrontierOverflow,
+    PaddedAdjacency,
+    PushEngine,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+
+def oracle_f_values(n, edges, queries):
+    return [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+
+
+GRAPHS = {
+    "grid": generators.grid_edges(19, 7),
+    "gnm_sparse": generators.gnm_edges(200, 320, seed=501),
+    "path": (
+        50,
+        np.stack(
+            [np.arange(49, dtype=np.int64), np.arange(1, 50, dtype=np.int64)],
+            axis=1,
+        ),
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(GRAPHS))
+def test_push_matches_oracle(name):
+    n, edges = GRAPHS[name]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 7, max_group=4, seed=502)
+    queries[3] = np.zeros(0, dtype=np.int32)
+    padded = pad_queries(queries)
+    eng = PushEngine(PaddedAdjacency.from_host(g))
+    got = np.asarray(eng.f_values(padded))
+    want = oracle_f_values(n, edges, queries)
+    np.testing.assert_array_equal(got, want)
+    assert eng.best(padded) == oracle_best(want)
+
+
+def test_push_duplicate_edges_and_self_loops():
+    n = 30
+    base = generators.gnm_edges(n, 60, seed=503)[1]
+    edges = np.concatenate([base, base[:20], np.stack([np.arange(5)] * 2, 1)])
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 5, max_group=3, seed=504)
+    padded = pad_queries(queries)
+    got = np.asarray(PushEngine(PaddedAdjacency.from_host(g)).f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_push_out_of_range_sources():
+    n, edges = GRAPHS["grid"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = [np.array([0, -1, n + 5], dtype=np.int32), np.array([n - 1])]
+    padded = pad_queries(queries)
+    got = np.asarray(PushEngine(PaddedAdjacency.from_host(g)).f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_push_width_cap_rejects_hubs():
+    n, edges = generators.rmat_edges(8, edge_factor=8, seed=505)
+    g = CSRGraph.from_edges(n, edges)
+    with pytest.raises(ValueError, match="width cap"):
+        PaddedAdjacency.from_host(g, max_width=4)
+
+
+def test_push_capacity_overflow_raises():
+    n, edges = GRAPHS["grid"]  # 19x7 grid: frontier quickly exceeds 2
+    g = CSRGraph.from_edges(n, edges)
+    eng = PushEngine(PaddedAdjacency.from_host(g), capacity=2)
+    padded = pad_queries([np.array([0], dtype=np.int32)])
+    with pytest.raises(FrontierOverflow):
+        eng.f_values(padded)
+
+
+def test_push_stats_match_bitbell():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        BitBellEngine,
+    )
+
+    n, edges = GRAPHS["grid"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 6, max_group=3, seed=506)
+    queries[2] = np.zeros(0, dtype=np.int32)
+    padded = pad_queries(queries)
+    a = PushEngine(PaddedAdjacency.from_host(g)).query_stats(padded)
+    b = BitBellEngine(BellGraph.from_host(g)).query_stats(padded)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_push_k0():
+    n, edges = GRAPHS["path"]
+    g = CSRGraph.from_edges(n, edges)
+    eng = PushEngine(PaddedAdjacency.from_host(g))
+    out = np.asarray(eng.f_values(np.zeros((0, 4), dtype=np.int32)))
+    assert out.shape == (0,)
+    assert eng.best(np.zeros((0, 4), dtype=np.int32)) == (-1, -1)

@@ -32,6 +32,7 @@ for _sub in (
     "ops.objective",
     "ops.packed",
     "ops.pallas_bfs",
+    "ops.push",
     "parallel",
     "parallel.mesh",
     "parallel.scheduler",

@@ -95,6 +95,13 @@ class CSRGraph:
         newly reach their own already-visited vertex (main.cu:30-32).
         """
         n = self.n
+        from ..runtime import native_loader  # lazy: avoid import cycle
+
+        native = native_loader.dedup_rows(self.row_offsets, self.col_indices)
+        if native is not None:
+            v, deg = native
+            u = np.repeat(np.arange(n, dtype=np.int64), deg)
+            return u, v.astype(np.int64), deg
         src = np.repeat(
             np.arange(n, dtype=np.int64), self.degrees.astype(np.int64)
         )

@@ -13,9 +13,11 @@
 //
 // C ABI, bound from Python via ctypes (runtime/native_loader.py).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <vector>
 
 #include <fcntl.h>
 #include <sys/mman.h>
@@ -112,6 +114,41 @@ int msbfs_load_graph_csr(const char* path, int64_t n, int64_t m,
   }
   delete[] cursor;
   return 0;
+}
+
+// Per-row neighbor dedup for the set-semantics engine layouts (BELL, padded
+// adjacency): sorts each CSR row, drops duplicates and self-loops.  Fills
+// caller-allocated out_dst (>= row_offsets[n] int32, only the first
+// <return value> entries are meaningful, sorted by (row, neighbor)) and
+// out_deg (n int64 deduped degrees).  Returns the deduped directed slot
+// count, or -1 on bad input.  The Python fallback (CSRGraph.deduped_pairs)
+// does the same with a global np.unique over src*n+dst encodings; this
+// native pass avoids materializing the 8-byte pair encoding entirely.
+int64_t msbfs_dedup_rows(int64_t n, int64_t num_slots,
+                         const int64_t* row_offsets,
+                         const int32_t* col_indices, int32_t* out_dst,
+                         int64_t* out_deg) {
+  if (n < 0 || num_slots < 0) return -1;
+  int64_t w = 0;
+  std::vector<int32_t> scratch;
+  for (int64_t u = 0; u < n; ++u) {
+    const int64_t s = row_offsets[u];
+    const int64_t e = row_offsets[u + 1];
+    if (s < 0 || e < s || e > num_slots) return -1;
+    scratch.assign(col_indices + s, col_indices + e);
+    std::sort(scratch.begin(), scratch.end());
+    int64_t cnt = 0;
+    int32_t prev = 0;
+    for (int32_t v : scratch) {
+      if (v == static_cast<int32_t>(u)) continue;  // self-loop
+      if (cnt && v == prev) continue;              // duplicate
+      out_dst[w++] = v;
+      prev = v;
+      ++cnt;
+    }
+    out_deg[u] = cnt;
+  }
+  return w;
 }
 
 }  // extern "C"

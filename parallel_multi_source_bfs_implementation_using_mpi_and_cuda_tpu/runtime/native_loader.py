@@ -54,8 +54,19 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(dtype=np.int32, ndim=1, flags="C_CONTIGUOUS"),
         ]
         lib.msbfs_load_graph_csr.restype = ctypes.c_int
+        lib.msbfs_dedup_rows.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(dtype=np.int64, ndim=1, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, ndim=1, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, ndim=1, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int64, ndim=1, flags="C_CONTIGUOUS"),
+        ]
+        lib.msbfs_dedup_rows.restype = ctypes.c_int64
         _lib = lib
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: a stale .so built before a newer symbol existed —
+        # fall back to the NumPy paths rather than crash ("make native").
         _load_failed = True
     return _lib
 
@@ -81,3 +92,26 @@ def load_graph_csr(path: str) -> CSRGraph:
     return CSRGraph(
         n=int(n.value), m=int(m.value), row_offsets=row_offsets, col_indices=col_indices
     )
+
+
+def dedup_rows(row_offsets: np.ndarray, col_indices: np.ndarray):
+    """Native per-row neighbor dedup (sorted, self-loops dropped).
+
+    Returns (dst, deg) with ``dst`` already sliced to the deduped slot
+    count, or None when the native library is unavailable (caller falls
+    back to the NumPy path).
+    """
+    lib = _get_lib()
+    if lib is None:
+        return None
+    n = row_offsets.shape[0] - 1
+    row_offsets = np.ascontiguousarray(row_offsets, dtype=np.int64)
+    col_indices = np.ascontiguousarray(col_indices, dtype=np.int32)
+    out_dst = np.empty(col_indices.shape[0], dtype=np.int32)
+    out_deg = np.empty(max(n, 1), dtype=np.int64)
+    w = lib.msbfs_dedup_rows(
+        n, col_indices.shape[0], row_offsets, col_indices, out_dst, out_deg
+    )
+    if w < 0:
+        raise ValueError("native dedup_rows: corrupt CSR input")
+    return out_dst[:w], out_deg[:n]

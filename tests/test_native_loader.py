@@ -74,3 +74,27 @@ def test_native_truncated_file(built, tmp_path):
         f.write(struct.pack("<iq", 4, 100))
     with pytest.raises(IOError):
         native_loader.load_graph_csr(str(path))
+
+
+def test_native_dedup_rows_matches_numpy():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+
+    if not native_loader.available():
+        pytest.skip("native library not built")
+    n = 40
+    rng = np.random.default_rng(601)
+    base = rng.integers(0, n, size=(120, 2)).astype(np.int64)
+    edges = np.concatenate([base, base[:30], np.stack([np.arange(6)] * 2, 1)])
+    g = CSRGraph.from_edges(n, edges)
+    got = native_loader.dedup_rows(g.row_offsets, g.col_indices)
+    assert got is not None
+    v, deg = got
+    # NumPy reference (the fallback path, forced)
+    src = np.repeat(np.arange(n, dtype=np.int64), g.degrees.astype(np.int64))
+    dst = g.col_indices.astype(np.int64)
+    keep = src != dst
+    pairs = np.unique(src[keep] * n + dst[keep])
+    np.testing.assert_array_equal(v, pairs % n)
+    np.testing.assert_array_equal(deg, np.bincount(pairs // n, minlength=n))

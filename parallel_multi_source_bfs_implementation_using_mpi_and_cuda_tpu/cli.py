@@ -27,6 +27,15 @@ from typing import List, Optional
 import numpy as np
 
 
+def _env_int(name: str, default: int) -> int:
+    """Integer env knob with the file-wide atoi-ish convention: malformed
+    values fall back to the default rather than crash."""
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
 def parse_args(argv: List[str]):
     """Linear argv scan, reference-exact (main.cu:216-224)."""
     graph_file: Optional[str] = None
@@ -97,10 +106,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # beyond-reference capability, parallel/sharded_bell.py);
             # remaining chips shard queries.  Default: all chips on 'q',
             # graph replicated (the reference's model, main.cu:242-255).
-            try:
-                vshard = int(os.environ.get("MSBFS_VSHARD", "1"))
-            except ValueError:
-                vshard = 1
+            vshard = _env_int("MSBFS_VSHARD", 1)
             if vshard > 1 and n_chips % vshard != 0:
                 print(
                     f"MSBFS_VSHARD={vshard} does not divide {n_chips} chips;"
@@ -129,10 +135,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             backend = os.environ.get("MSBFS_BACKEND", "auto")
             use_dense = backend == "dense"
             if backend == "auto" and jax.default_backend() in ("tpu", "axon"):
-                try:
-                    threshold = int(os.environ.get("MSBFS_DENSE_THRESHOLD", "8192"))
-                except ValueError:
-                    threshold = 8192
+                threshold = _env_int("MSBFS_DENSE_THRESHOLD", 8192)
                 use_dense = graph.n <= threshold
             if use_dense:
                 from .ops.dense import DenseGraph
@@ -163,10 +166,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # gather intermediate on HBM-constrained chips.
                 from .ops.packed import PackedEngine
 
-                try:
-                    edge_chunks = int(os.environ.get("MSBFS_EDGE_CHUNKS", "1"))
-                except ValueError:
-                    edge_chunks = 1
+                edge_chunks = _env_int("MSBFS_EDGE_CHUNKS", 1)
                 engine = PackedEngine(graph.to_device(), edge_chunks=edge_chunks)
             else:
                 # Default CSR path: bit-packed BELL reduction forest — the
@@ -177,17 +177,48 @@ def main(argv: Optional[List[str]] = None) -> int:
 
                 engine = BitBellEngine(BellGraph.from_host(graph))
         stats_mode = os.environ.get("MSBFS_STATS") == "1"
-        engine.compile(padded.shape, warm_stats=stats_mode)
+        ckpt_path = os.environ.get("MSBFS_CHECKPOINT")
+        ckpt_chunk = _env_int("MSBFS_CHECKPOINT_CHUNK", 64)
+        if ckpt_path:
+            if stats_mode:
+                sys.stderr.write(
+                    "MSBFS_STATS: ignored when MSBFS_CHECKPOINT is set\n"
+                )
+            # The checkpoint path calls f_values on (chunk, S) slices, not
+            # best() on the full (K, S) batch — warm exactly those shapes so
+            # XLA compiles land in the preprocessing span.
+            k, s = padded.shape
+            for shape_k in {min(max(1, ckpt_chunk), max(k, 1)), *(
+                [k % ckpt_chunk] if k % ckpt_chunk else []
+            )}:
+                dummy = np.full((shape_k, s), -1, dtype=np.int32)
+                engine.f_values(dummy)
+        else:
+            engine.compile(padded.shape, warm_stats=stats_mode)
 
     # ---- computation span: all BFS + objective + argmin (main.cu:301-400).
     # MSBFS_PROFILE_DIR captures a jax.profiler trace of the span (tracing
     # subsystem — new capability, the reference has none; SURVEY.md §5).
     from .utils.trace import profiler_trace
 
+    # MSBFS_CHECKPOINT=<path>: chunk-wise resumable execution (utils.
+    # checkpoint — beyond-reference; the reference recomputes everything on
+    # failure).  Works with any engine; chunk via MSBFS_CHECKPOINT_CHUNK.
     stats = None
     with Span() as comp:
         with profiler_trace():
-            if stats_mode and padded.shape[0]:
+            if ckpt_path:
+                from .utils.checkpoint import CheckpointedRunner
+
+                runner = CheckpointedRunner(engine, ckpt_path, chunk=ckpt_chunk)
+                try:
+                    min_f, min_k = runner.best(
+                        graph.n, graph.num_directed_edges, np.asarray(padded)
+                    )
+                except ValueError as exc:  # stale/foreign journal: fail loud
+                    print(f"Checkpoint error: {exc}", file=sys.stderr)
+                    return 1
+            elif stats_mode and padded.shape[0]:
                 # One BFS pass serves both the report and the stats table:
                 # stats include the F values, so selection derives from them.
                 stats = engine.query_stats(np.asarray(padded))
@@ -197,7 +228,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
                 f = jnp.asarray(stats[2])
                 min_f, min_k = (int(x) for x in select_best_jit(f, f >= 0))
-            else:
+            elif not ckpt_path:
                 min_f, min_k = engine.best(np.asarray(padded))
 
     if stats is not None:
@@ -205,7 +236,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .utils.trace import format_query_stats
 
         sys.stderr.write(format_query_stats(*stats))
-    elif stats_mode:
+    elif stats_mode and not ckpt_path:
         if padded.shape[0] == 0:
             sys.stderr.write("MSBFS_STATS: no queries\n")
         else:

@@ -130,11 +130,15 @@ int64_t msbfs_dedup_rows(int64_t n, int64_t num_slots,
                          int64_t* out_deg) {
   if (n < 0 || num_slots < 0) return -1;
   int64_t w = 0;
+  int64_t prev_end = 0;
   std::vector<int32_t> scratch;
   for (int64_t u = 0; u < n; ++u) {
     const int64_t s = row_offsets[u];
     const int64_t e = row_offsets[u + 1];
-    if (s < 0 || e < s || e > num_slots) return -1;
+    // Monotone non-overlapping rows, in bounds: otherwise w could exceed
+    // num_slots and overflow the caller's out_dst buffer.
+    if (s < prev_end || e < s || e > num_slots) return -1;
+    prev_end = e;
     scratch.assign(col_indices + s, col_indices + e);
     std::sort(scratch.begin(), scratch.end());
     int64_t cnt = 0;

@@ -1,0 +1,97 @@
+"""Checkpoint/resume: journal replay, crash-interrupted runs, mismatches."""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+    BitBellEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.checkpoint import (
+    CheckpointedRunner,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+
+@pytest.fixture(scope="module")
+def problem():
+    n, edges = generators.gnm_edges(120, 380, seed=701)
+    queries = generators.random_queries(n, 13, max_group=4, seed=702)
+    queries[5] = np.zeros(0, dtype=np.int32)
+    g = CSRGraph.from_edges(n, edges)
+    eng = BitBellEngine(BellGraph.from_host(g))
+    want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    return n, g, eng, pad_queries(queries), want
+
+
+def test_checkpoint_fresh_run(problem, tmp_path):
+    n, g, eng, padded, want = problem
+    r = CheckpointedRunner(eng, tmp_path / "j.ckpt", chunk=4)
+    f, computed = r.run(n, g.num_directed_edges, padded)
+    np.testing.assert_array_equal(f, want)
+    assert computed == padded.shape[0]
+    assert r.best(n, g.num_directed_edges, padded) == oracle_best(want)
+
+
+def test_checkpoint_resume_skips_done(problem, tmp_path):
+    n, g, eng, padded, want = problem
+    path = tmp_path / "j.ckpt"
+    r1 = CheckpointedRunner(eng, path, chunk=4)
+    r1.run(n, g.num_directed_edges, padded)
+
+    class Boom:
+        def f_values(self, q):  # pragma: no cover - must not be called
+            raise AssertionError("resume recomputed a completed chunk")
+
+    r2 = CheckpointedRunner(Boom(), path, chunk=4)
+    f, computed = r2.run(n, g.num_directed_edges, padded)
+    np.testing.assert_array_equal(f, want)
+    assert computed == 0
+
+
+def test_checkpoint_partial_journal_completes(problem, tmp_path):
+    """Simulate a crash after 2 chunks: a new runner finishes the rest."""
+    n, g, eng, padded, want = problem
+    path = tmp_path / "j.ckpt"
+
+    class CrashAfter:
+        def __init__(self, inner, chunks):
+            self.inner, self.left = inner, chunks
+
+        def f_values(self, q):
+            if self.left == 0:
+                raise KeyboardInterrupt  # mid-run "crash"
+            self.left -= 1
+            return self.inner.f_values(q)
+
+    r1 = CheckpointedRunner(CrashAfter(eng, 2), path, chunk=4)
+    with pytest.raises(KeyboardInterrupt):
+        r1.run(n, g.num_directed_edges, padded)
+
+    r2 = CheckpointedRunner(eng, path, chunk=4)
+    f, computed = r2.run(n, g.num_directed_edges, padded)
+    np.testing.assert_array_equal(f, want)
+    assert 0 < computed <= padded.shape[0] - 8  # first 8 were journaled
+
+
+def test_checkpoint_workload_mismatch_raises(problem, tmp_path):
+    n, g, eng, padded, _ = problem
+    path = tmp_path / "j.ckpt"
+    CheckpointedRunner(eng, path, chunk=4).run(n, g.num_directed_edges, padded)
+    other = pad_queries(
+        generators.random_queries(n, 13, max_group=4, seed=703)
+    )
+    with pytest.raises(ValueError, match="different"):
+        CheckpointedRunner(eng, path, chunk=4).run(
+            n, g.num_directed_edges, other
+        )

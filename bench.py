@@ -43,6 +43,12 @@ def main() -> None:
 
     import jax
 
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.xla_cache import (
+        configure_compilation_cache,
+    )
+
+    configure_compilation_cache()
+
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
         generators,
     )

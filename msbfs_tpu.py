@@ -42,10 +42,12 @@ for _sub in (
     "runtime",
     "runtime.native_loader",
     "utils",
+    "utils.checkpoint",
     "utils.io",
     "utils.report",
     "utils.timing",
     "utils.trace",
+    "utils.xla_cache",
 ):
     importlib.import_module(f"{_LONG}.{_sub}")
 

@@ -76,6 +76,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     import jax
 
+    from .utils.xla_cache import configure_compilation_cache
+
+    configure_compilation_cache()
+
     from .ops.engine import Engine
     from .parallel.distributed import DistributedEngine
     from .parallel.mesh import default_mesh

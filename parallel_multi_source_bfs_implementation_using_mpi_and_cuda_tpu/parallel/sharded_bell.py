@@ -63,7 +63,10 @@ def _block_csr(g: CSRGraph, lo: int, hi: int, n_pad: int) -> CSRGraph:
 
 
 def build_sharded_forest(
-    g: CSRGraph, p: int, widths: Sequence[int] = DEFAULT_WIDTHS
+    g: CSRGraph,
+    p: int,
+    widths: Sequence[int] = DEFAULT_WIDTHS,
+    min_bucket_rows: Optional[int] = None,
 ) -> Tuple[BellGraph, int, int]:
     """Partition ``g`` into ``p`` vertex blocks and build one harmonized,
     shard-stacked BELL forest.
@@ -73,10 +76,26 @@ def build_sharded_forest(
     """
     L = -(-max(g.n, 1) // p)
     n_pad = p * L
+    # One width ladder for ALL shards: per-shard adaptive pruning would
+    # give each shard a different bucket structure and break harmonization
+    # below.  Same defaulting rule as BellGraph.from_host (prune only the
+    # default ladder, e-scaled threshold); the pre-dedup degree histogram
+    # is close enough for a pruning heuristic — no extra O(E) dedup pass.
+    if min_bucket_rows is None:
+        min_bucket_rows = (
+            BellGraph.default_min_bucket_rows(g.n, g.num_directed_edges)
+            if tuple(widths) == tuple(sorted(DEFAULT_WIDTHS))
+            else 0
+        )
+    if min_bucket_rows:
+        widths = BellGraph.adaptive_widths(
+            np.asarray(g.degrees), widths, min_bucket_rows
+        )
     shards: List[BellGraph] = [
         BellGraph.from_host(
             _block_csr(g, min(b * L, g.n), min((b + 1) * L, g.n), n_pad),
             widths=widths,
+            min_bucket_rows=0,
         )
         for b in range(p)
     ]
@@ -264,12 +283,13 @@ class ShardedBellEngine(QueryEngineBase):
         graph: CSRGraph,
         max_levels: Optional[int] = None,
         widths: Sequence[int] = DEFAULT_WIDTHS,
+        min_bucket_rows: Optional[int] = None,
     ):
         self.mesh = mesh
         self.w = mesh.shape[QUERY_AXIS]
         p = mesh.shape[VERTEX_AXIS]
         stacked, self.block, self.n_pad = build_sharded_forest(
-            graph, p, widths
+            graph, p, widths, min_bucket_rows
         )
         vspec = NamedSharding(mesh, P(VERTEX_AXIS))
         self.forest = jax.device_put(stacked, vspec)

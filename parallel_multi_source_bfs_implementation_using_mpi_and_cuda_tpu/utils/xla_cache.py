@@ -1,0 +1,28 @@
+"""Persistent XLA compilation cache setup (shared by cli.py and bench.py).
+
+Repeat runs skip the tens-of-seconds BFS program compile — the analog of
+the reference's nvcc-precompiled kernels.  ``MSBFS_CACHE_DIR=`` (empty)
+disables; unset uses ``~/.cache/msbfs_tpu/xla``.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def configure_compilation_cache() -> None:
+    import jax
+
+    cache_dir = os.environ.get(
+        "MSBFS_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "msbfs_tpu", "xla"),
+    )
+    if not cache_dir:
+        return
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except (OSError, AttributeError):
+        pass  # unwritable cache dir or older jax: compile every run

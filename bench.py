@@ -141,6 +141,12 @@ def main() -> None:
         "vs_baseline": round(teps / ESTIMATED_REFERENCE_TEPS, 4),
         "detail": {
             "computation_s": round(best_s, 6),
+            # median batch wall-time / K: queries run concurrently in one
+            # dispatch, so this is per-query throughput time, not a latency
+            # percentile.
+            "mean_per_query_s": round(
+                float(np.median(times)) / max(k, 1), 6
+            ),
             "all_runs_s": [round(t, 6) for t in times],
             "gen_s": round(gen_s, 3),
             "compile_s": round(compile_s, 3),

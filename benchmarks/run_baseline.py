@@ -79,7 +79,7 @@ def _run(engine, queries, e_directed: int, repeats: int = 3):
     return {
         "computation_s": round(best_s, 6),
         "teps": round(k * e_directed / best_s),
-        "p50_query_latency_s": round(float(np.median(times)) / max(k, 1), 6),
+        "mean_per_query_s": round(float(np.median(times)) / max(k, 1), 6),
         "minF": int(out[0]),
         "minK_1based": int(out[1]) + 1,
         "device": str(jax.devices()[0]),

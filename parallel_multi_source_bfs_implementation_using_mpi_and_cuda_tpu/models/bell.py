@@ -134,6 +134,29 @@ class BellGraph:
         return min(16384 if e < (1 << 24) else 2048, max(1, n // 4))
 
     @staticmethod
+    def resolve_widths(
+        widths: Sequence[int],
+        degrees: np.ndarray,
+        n: int,
+        e: int,
+        min_bucket_rows: Optional[int],
+    ) -> Tuple[int, ...]:
+        """Shared ladder policy for the single-chip and sharded builders:
+        auto-prune (e-scaled threshold) only when ``widths`` is the default
+        ladder — an explicitly chosen ladder is an API contract — unless the
+        caller passes ``min_bucket_rows`` explicitly."""
+        widths = tuple(sorted(widths))
+        if min_bucket_rows is None:
+            min_bucket_rows = (
+                BellGraph.default_min_bucket_rows(n, e)
+                if widths == tuple(sorted(DEFAULT_WIDTHS))
+                else 0
+            )
+        if min_bucket_rows:
+            widths = BellGraph.adaptive_widths(degrees, widths, min_bucket_rows)
+        return widths
+
+    @staticmethod
     def adaptive_widths(
         degrees: np.ndarray,
         widths: Sequence[int] = DEFAULT_WIDTHS,
@@ -190,18 +213,9 @@ class BellGraph:
             item_vals = np.asarray(g.col_indices, dtype=np.int64)
             item_start = np.asarray(g.row_offsets[:-1], dtype=np.int64)
             item_count = np.asarray(g.degrees, dtype=np.int64)
-        if min_bucket_rows is None:
-            # Auto-prune only for the default ladder: an explicitly chosen
-            # widths ladder is an API contract the builder must honor.
-            min_bucket_rows = (
-                BellGraph.default_min_bucket_rows(n, e)
-                if tuple(widths) == tuple(sorted(DEFAULT_WIDTHS))
-                else 0
-            )
-        if min_bucket_rows:
-            widths = BellGraph.adaptive_widths(
-                item_count, widths, min_bucket_rows
-            )
+        widths = BellGraph.resolve_widths(
+            widths, item_count, n, e, min_bucket_rows
+        )
 
         item_count_0 = item_count
         levels: List[List[np.ndarray]] = []

@@ -78,19 +78,13 @@ def build_sharded_forest(
     n_pad = p * L
     # One width ladder for ALL shards: per-shard adaptive pruning would
     # give each shard a different bucket structure and break harmonization
-    # below.  Same defaulting rule as BellGraph.from_host (prune only the
-    # default ladder, e-scaled threshold); the pre-dedup degree histogram
-    # is close enough for a pruning heuristic — no extra O(E) dedup pass.
-    if min_bucket_rows is None:
-        min_bucket_rows = (
-            BellGraph.default_min_bucket_rows(g.n, g.num_directed_edges)
-            if tuple(widths) == tuple(sorted(DEFAULT_WIDTHS))
-            else 0
-        )
-    if min_bucket_rows:
-        widths = BellGraph.adaptive_widths(
-            np.asarray(g.degrees), widths, min_bucket_rows
-        )
+    # below.  Same policy as BellGraph.from_host; the pre-dedup degree
+    # histogram is close enough for a pruning heuristic — no extra O(E)
+    # dedup pass.
+    widths = BellGraph.resolve_widths(
+        widths, np.asarray(g.degrees), g.n, g.num_directed_edges,
+        min_bucket_rows,
+    )
     shards: List[BellGraph] = [
         BellGraph.from_host(
             _block_csr(g, min(b * L, g.n), min((b + 1) * L, g.n), n_pad),

@@ -198,7 +198,6 @@ class BellGraph:
         likewise just wastes the repeated reads, main.cu:26-35).  Self-loop
         removal is safe because a frontier vertex is already visited and
         can never be newly reached by its own loop (main.cu:30-32)."""
-        widths = tuple(sorted(widths))
         n = g.n
         e = int(g.num_directed_edges)
 

@@ -1,0 +1,172 @@
+"""Every engine must produce identical F values on the same workload.
+
+The per-engine suites already check oracle parity on their own fixtures;
+this is the single cross-cutting guarantee: one graph, one query batch,
+every execution engine (single-chip and mesh-sharded), byte-identical
+results.  A new engine added to the registry below gets the guarantee for
+free.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+
+
+def _vmap(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+        Engine,
+    )
+
+    return Engine(g.to_device(), query_chunk=4)
+
+
+def _packed(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+        PackedEngine,
+    )
+
+    return PackedEngine(g.to_device(), edge_chunks=2)
+
+
+def _dense(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.dense import (
+        DenseGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+        Engine,
+    )
+
+    return Engine(DenseGraph.from_host(g))
+
+
+def _pallas_ell(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.ell import (
+        EllGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+        Engine,
+    )
+
+    return Engine(EllGraph.from_host(g, width=8))
+
+
+def _bell(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bell import (
+        BellEngine,
+    )
+
+    return BellEngine(BellGraph.from_host(g))
+
+
+def _bitbell(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        BitBellEngine,
+    )
+
+    return BitBellEngine(BellGraph.from_host(g))
+
+
+def _push(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push import (
+        PaddedAdjacency,
+        PushEngine,
+    )
+
+    return PushEngine(PaddedAdjacency.from_host(g, max_width=512))
+
+
+def _distributed(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
+        DistributedEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    return DistributedEngine(make_mesh(num_query_shards=4), g)
+
+
+def _sharded_csr(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_csr import (
+        ShardedEngine,
+    )
+
+    return ShardedEngine(make_mesh(num_query_shards=2, num_vertex_shards=2), g)
+
+
+def _sharded_bell(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (
+        ShardedBellEngine,
+    )
+
+    return ShardedBellEngine(
+        make_mesh(num_query_shards=2, num_vertex_shards=4), g
+    )
+
+
+ENGINES = {
+    "vmap": _vmap,
+    "packed": _packed,
+    "dense": _dense,
+    "pallas_ell": _pallas_ell,
+    "bell": _bell,
+    "bitbell": _bitbell,
+    "push": _push,
+    "distributed": _distributed,
+    "sharded_csr": _sharded_csr,
+    "sharded_bell": _sharded_bell,
+}
+
+
+@pytest.fixture(scope="module")
+def workload():
+    from oracle import oracle_bfs, oracle_f
+
+    n, edges = generators.rmat_edges(8, edge_factor=8, seed=801)
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 10, max_group=6, seed=802)
+    queries[3] = np.zeros(0, dtype=np.int32)
+    queries[7] = np.array([-1, n + 9], dtype=np.int32)  # all out of range
+    padded = pad_queries(queries)
+    # Engine-independent reference: the host deque-BFS oracle.
+    reference = np.asarray(
+        [oracle_f(oracle_bfs(n, edges, q)) for q in queries], dtype=np.int64
+    )
+    return g, padded, reference
+
+
+@pytest.mark.parametrize("name", sorted(ENGINES))
+def test_engine_agrees(workload, name):
+    g, padded, reference = workload
+    if name.startswith(("distributed", "sharded")) and len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    eng = ENGINES[name](g)
+    np.testing.assert_array_equal(np.asarray(eng.f_values(padded)), reference)
+    f = reference
+    valid = f >= 0
+    want = (
+        (int(f[valid].min()), int(np.flatnonzero(f == f[valid].min())[0]))
+        if valid.any()
+        else (-1, -1)
+    )
+    assert eng.best(padded) == want

@@ -245,8 +245,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             sys.stderr.write("MSBFS_STATS: no queries\n")
         else:
             sys.stderr.write(
-                "MSBFS_STATS: per-query stats are available on single-chip "
-                "engines only; ignored for this run\n"
+                "MSBFS_STATS: per-query stats are not available on this "
+                "engine; ignored for this run\n"
             )
 
     sys.stdout.write(

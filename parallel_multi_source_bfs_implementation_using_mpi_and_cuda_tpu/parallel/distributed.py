@@ -33,7 +33,7 @@ from .scheduler import merge_local_f, shard_queries
 
 
 @partial(jax.jit, static_argnames=("mesh", "k", "k_pad", "w", "max_levels"))
-def _distributed_bitbell_f_values(
+def _distributed_bitbell_run(
     mesh: Mesh,
     graph,  # BellGraph, replicated on every device
     query_grid: jax.Array,  # (W, J, S) cyclic layout
@@ -41,8 +41,10 @@ def _distributed_bitbell_f_values(
     k_pad: int,
     w: int,
     max_levels,
-) -> jax.Array:
-    """Merged (k_pad,) int64 F via the bit-packed BELL engine per shard."""
+):
+    """Merged per-query (f, levels, reached), each (k_pad,), via the
+    bit-packed BELL engine per shard (padding slots stay -1, like the
+    reference's never-computed all_F_values entries, main.cu:325)."""
     from ..ops.bitbell import WORD_BITS, bitbell_run
 
     def shard_body(graph, qblock):
@@ -53,14 +55,19 @@ def _distributed_bitbell_f_values(
             qblock = jnp.concatenate(
                 [qblock, jnp.full((pad, s), -1, dtype=qblock.dtype)], axis=0
             )
-        f, _, _ = bitbell_run(graph, qblock, max_levels)
-        return merge_local_f(f[:j], j, w, k, k_pad, (QUERY_AXIS, VERTEX_AXIS))
+        f, levels, reached = bitbell_run(graph, qblock, max_levels)
+        axes = (QUERY_AXIS, VERTEX_AXIS)
+        return (
+            merge_local_f(f[:j], j, w, k, k_pad, axes),
+            merge_local_f(levels[:j].astype(jnp.int64), j, w, k, k_pad, axes),
+            merge_local_f(reached[:j].astype(jnp.int64), j, w, k, k_pad, axes),
+        )
 
     return jax.shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(), P(QUERY_AXIS)),
-        out_specs=P(),
+        out_specs=(P(), P(), P()),
     )(graph, query_grid)
 
 
@@ -162,7 +169,7 @@ class DistributedEngine(QueryEngineBase):
             self.mesh, np.asarray(queries), self.query_chunk
         )
         if self.backend == "bitbell":
-            merged = _distributed_bitbell_f_values(
+            merged, _, _ = _distributed_bitbell_run(
                 self.mesh,
                 self.bell,
                 sharded,
@@ -184,3 +191,20 @@ class DistributedEngine(QueryEngineBase):
                 self.expand,
             )
         return merged[:k]
+
+    def query_stats(self, queries):
+        """Per-query (levels, reached, F) — multi-chip stats (bitbell
+        backend; the per-shard counters merge exactly like F values)."""
+        if self.backend != "bitbell":
+            return None
+        sharded, k, k_pad, _ = shard_queries(
+            self.mesh, np.asarray(queries), self.query_chunk
+        )
+        f, levels, reached = _distributed_bitbell_run(
+            self.mesh, self.bell, sharded, k, k_pad, self.w, self.max_levels
+        )
+        return (
+            np.asarray(levels[:k]).astype(np.int32),
+            np.asarray(reached[:k]).astype(np.int32),
+            np.asarray(f[:k]),
+        )

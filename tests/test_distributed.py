@@ -101,6 +101,24 @@ def test_distributed_csr_backend_matches(problem):
     np.testing.assert_array_equal(a, b)
 
 
+def test_distributed_query_stats_match_single_chip(problem):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        BitBellEngine,
+    )
+
+    n, edges, _, padded = problem
+    graph = CSRGraph.from_edges(n, edges)
+    mesh = make_mesh(num_query_shards=4, devices=jax.devices()[:4])
+    a = DistributedEngine(mesh, graph).query_stats(padded)
+    b = BitBellEngine(BellGraph.from_host(graph)).query_stats(padded)
+    assert a is not None
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
 def test_distributed_bitbell_rejects_csr_knobs(problem):
     n, edges, _, _ = problem
     graph = CSRGraph.from_edges(n, edges)

@@ -18,6 +18,7 @@ _real = importlib.import_module(_LONG)
 # re-execute a module under the short name.
 for _sub in (
     "cli",
+    "gen_cli",
     "models",
     "models.bell",
     "models.csr",

@@ -1,0 +1,82 @@
+"""Fixture generator CLI: fabricate reference-format graph/query binaries.
+
+The reference consumes opaque ``graph.bin``/``query.bin`` files (formats at
+main.cu:92-130 and 134-164) but ships no tool to create them; this generator
+fills that gap so a user can produce workloads end to end:
+
+    python -m parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.gen_cli \
+        --kind rmat --scale 16 --edge-factor 16 --graph g.bin \
+        --queries 64 --max-group 64 --query-file q.bin --seed 42
+
+Kinds: ``rmat`` (power-law, Graph500-style), ``grid`` (side x side
+road-network stand-in), ``gnm`` (uniform random).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--kind", choices=("rmat", "grid", "gnm"), default="rmat")
+    ap.add_argument("--scale", type=int, default=16, help="log2(n) for rmat; grid side = 2^(scale/2)")
+    ap.add_argument("--edge-factor", type=int, default=16, help="edges per vertex (rmat/gnm)")
+    ap.add_argument("--graph", required=True, help="output graph .bin path")
+    ap.add_argument("--queries", type=int, default=0, help="number of query groups (0: no query file)")
+    ap.add_argument("--max-group", type=int, default=64, help="max sources per group (<= 128)")
+    ap.add_argument("--query-file", default=None)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args(argv)
+
+    # Validate the query flags BEFORE the (potentially minutes-long) graph
+    # generation, so bad flags fail instantly and side-effect-free.
+    if args.queries and not args.query_file:
+        print("--queries given without --query-file", file=sys.stderr)
+        return 2
+    if args.query_file and not args.queries:
+        print("--query-file given without --queries", file=sys.stderr)
+        return 2
+    if args.queries and (
+        not 0 < args.queries <= 255 or not 0 < args.max_group <= 128
+    ):
+        # uint8 K / uint8 set_size wire format (main.cu:143-152)
+        print("--queries must be 1..255, --max-group 1..128", file=sys.stderr)
+        return 2
+
+    from .models import generators
+    from .utils.io import save_graph_bin, save_query_bin
+
+    if args.kind == "rmat":
+        n, edges = generators.rmat_edges(
+            args.scale, edge_factor=args.edge_factor, seed=args.seed
+        )
+    elif args.kind == "grid":
+        side = 1 << (args.scale // 2)
+        n, edges = generators.grid_edges(side, side)
+    else:
+        n = 1 << args.scale
+        n, edges = generators.gnm_edges(
+            n, args.edge_factor * n, seed=args.seed
+        )
+    save_graph_bin(args.graph, n, edges)
+    print(f"wrote {args.graph}: n={n} m={len(edges)}", file=sys.stderr)
+
+    if args.queries:
+        qs = generators.random_queries(
+            n, args.queries, max_group=args.max_group, seed=args.seed + 1
+        )
+        save_query_bin(args.query_file, qs)
+        print(
+            f"wrote {args.query_file}: K={len(qs)} sizes="
+            f"{[len(q) for q in qs[:8]]}{'...' if len(qs) > 8 else ''}",
+            file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,3 +89,64 @@ def test_parse_args_reference_semantics():
     assert (g, q, gn) == ("a", "b", 1)
     assert parse_args(["prog", "-g", "a", "-q", "b", "-gn", "3"])[2] == 3
     assert parse_args(["prog", "-g", "a", "-q", "b", "-gn", "zzz"])[2] == 0
+
+
+def test_gen_cli_roundtrip(tmp_path):
+    """Fixture generator output loads back byte-exactly through the normal
+    loaders and runs end to end through the CLI driver."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+        gen_cli,
+        load_graph_bin,
+        load_query_bin,
+    )
+
+    g_path = str(tmp_path / "g.bin")
+    q_path = str(tmp_path / "q.bin")
+    rc = gen_cli.main(
+        [
+            "--kind", "gnm", "--scale", "6", "--edge-factor", "3",
+            "--graph", g_path,
+            "--queries", "4", "--max-group", "3", "--query-file", q_path,
+            "--seed", "9",
+        ]
+    )
+    assert rc == 0
+    g = load_graph_bin(g_path)
+    assert g.n == 64 and g.m == 192
+    qs = load_query_bin(q_path)
+    assert len(qs) == 4 and all(len(q) <= 3 for q in qs)
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+        main as cli_main,
+    )
+
+    rc = cli_main(["main.py", "-g", g_path, "-q", q_path, "-gn", "1"])
+    assert rc == 0
+
+
+def test_gen_cli_rejects_wire_format_limits(tmp_path):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+        gen_cli,
+    )
+
+    rc = gen_cli.main(
+        [
+            "--kind", "gnm", "--scale", "5", "--graph",
+            str(tmp_path / "g.bin"), "--queries", "300",
+            "--query-file", str(tmp_path / "q.bin"),
+        ]
+    )
+    assert rc == 2  # K > 255 cannot be encoded in the uint8 header
+
+
+def test_gen_cli_validates_before_generating(tmp_path):
+    """Bad query flags fail instantly, before any graph file is written."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+        gen_cli,
+    )
+
+    g_path = tmp_path / "g.bin"
+    rc = gen_cli.main(
+        ["--kind", "gnm", "--scale", "5", "--graph", str(g_path),
+         "--query-file", str(tmp_path / "q.bin")]  # --query-file, no --queries
+    )
+    assert rc == 2 and not g_path.exists()

@@ -46,6 +46,7 @@ for _sub in (
     "utils.checkpoint",
     "utils.io",
     "utils.report",
+    "utils.platform",
     "utils.timing",
     "utils.trace",
     "utils.xla_cache",

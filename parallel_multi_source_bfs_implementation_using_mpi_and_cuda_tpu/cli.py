@@ -76,6 +76,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     import jax
 
+    from .utils.platform import is_tpu_backend
     from .utils.xla_cache import configure_compilation_cache
 
     configure_compilation_cache()
@@ -138,7 +139,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # MXU-bearing devices only.
             backend = os.environ.get("MSBFS_BACKEND", "auto")
             use_dense = backend == "dense"
-            if backend == "auto" and jax.default_backend() in ("tpu", "axon"):
+            if backend == "auto" and is_tpu_backend():
                 threshold = _env_int("MSBFS_DENSE_THRESHOLD", 8192)
                 use_dense = graph.n <= threshold
             if use_dense:
@@ -163,7 +164,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # high-diameter, low-degree graphs (road networks, grids).
                 from .ops.push import PaddedAdjacency, PushEngine
 
-                engine = PushEngine(PaddedAdjacency.from_host(graph))
+                try:
+                    engine = PushEngine(PaddedAdjacency.from_host(graph))
+                except (NotImplementedError, ValueError) as exc:
+                    # TPU XLA-nonzero bug / degree beyond the width cap:
+                    # both are user-facing engine-choice errors.
+                    print(str(exc), file=sys.stderr)
+                    return 1
             elif backend == "packed":
                 # Coalesced query-major (n, K) engine over the flat CSR.
                 # MSBFS_EDGE_CHUNKS bounds the per-level (E/chunks, K)

@@ -51,7 +51,9 @@ def _ell_hits_kernel(frontier_ref, cols_ref, out_ref):
 @functools.partial(jax.jit, static_argnames=("num_vrows", "width"))
 def ell_hits(frontier: jax.Array, cols: jax.Array, num_vrows: int, width: int):
     """frontier (n_vmem,) int8, cols (width, R) -> (R,) int8 hit flags."""
-    if jax.default_backend() in ("tpu", "axon"):
+    from ..utils.platform import is_tpu_backend
+
+    if is_tpu_backend():
         # Mosaic currently lowers only lane-batched 2D dynamic gathers
         # (take_along_axis with indices shaped like the operand); the
         # arbitrary-index VMEM gather this kernel wants is not expressible,

@@ -41,9 +41,26 @@ import numpy as np
 from jax import lax
 
 from ..models.csr import CSRGraph
+from ..utils.platform import is_tpu_backend
 from .engine import QueryEngineBase
 
 DEFAULT_MAX_WIDTH = 64
+
+
+def _reject_tpu_backend() -> None:
+    """The fixed-size jnp.nonzero compaction inside the level loop hits an
+    XLA scoped-VMEM lowering failure on current TPU stacks at ANY problem
+    size ("It should not be possible to run out of scoped vmem - please
+    file a bug against XLA"); larger shapes crash the worker outright.
+    Fail fast with the workaround instead of a mid-run compiler error.
+    Details: docs/PERF_NOTES.md "XLA lowering hazards"."""
+    if is_tpu_backend():
+        raise NotImplementedError(
+            "PushEngine cannot compile on current TPU backends (XLA "
+            "scoped-VMEM bug in fixed-size nonzero lowering); run it on "
+            "the CPU platform (JAX_PLATFORMS=cpu) or use the bitbell "
+            "engine on TPU"
+        )
 
 
 @jax.tree_util.register_pytree_node_class
@@ -66,6 +83,7 @@ class PaddedAdjacency:
         """Build from a CSR; duplicate neighbors and self-loops are dropped
         (set semantics — cannot change BFS distances or F(U); see
         CSRGraph.deduped_pairs)."""
+        _reject_tpu_backend()  # before the O(n*w) build + device placement
         n = g.n
         u, v, deg = g.deduped_pairs()
         w = int(deg.max()) if n and deg.size else 0
@@ -192,6 +210,7 @@ class PushEngine(QueryEngineBase):
         capacity: Optional[int] = None,
         max_levels: Optional[int] = None,
     ):
+        _reject_tpu_backend()  # direct-constructed graphs hit it here
         self.graph = graph
         self.capacity = int(capacity) if capacity else max(graph.n, 1)
         self.max_levels = max_levels

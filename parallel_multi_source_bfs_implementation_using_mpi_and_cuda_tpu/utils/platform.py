@@ -1,0 +1,16 @@
+"""Backend identity helpers.
+
+One definition of "running on TPU hardware" for the whole package: the
+axon tunnel platform reports itself as ``axon`` rather than ``tpu``, and a
+missed site means a guard or test-skip silently stops firing there.
+"""
+
+from __future__ import annotations
+
+TPU_BACKENDS = ("tpu", "axon")
+
+
+def is_tpu_backend() -> bool:
+    import jax
+
+    return jax.default_backend() in TPU_BACKENDS

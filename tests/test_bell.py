@@ -128,3 +128,28 @@ def test_bell_query_stats_matches_packed():
     b = PackedEngine(g.to_device()).query_stats(padded)
     for x, y in zip(a, b):
         np.testing.assert_array_equal(x, y)
+
+
+def test_adaptive_widths_pruning_properties():
+    """Rungs below the row threshold merge upward; hub width survives."""
+    # 100 deg-1, 5 deg-2, 100 deg-3 vertices, one hub
+    degrees = np.array([1] * 100 + [2] * 5 + [3] * 100 + [500])
+    widths = (1, 2, 3, 4, 128)
+    kept = BellGraph.adaptive_widths(degrees, widths, min_bucket_rows=50)
+    assert kept[-1] == 128  # hub width always kept
+    assert 1 in kept and 3 in kept  # populous rungs survive
+    assert 2 not in kept  # 5-owner rung merges into 3
+    # threshold 1: every POPULATED rung kept (deg-4 rung has no owners
+    # and is dropped even at the minimum threshold)
+    assert BellGraph.adaptive_widths(degrees, widths, 1) == (1, 2, 3, 128)
+    # empty graph: only the hub width remains
+    assert BellGraph.adaptive_widths(np.zeros(0, int), widths, 10) == (128,)
+
+
+def test_explicit_widths_not_pruned():
+    """An explicitly passed ladder is honored verbatim (API contract)."""
+    n, edges = generators.gnm_edges(60, 150, seed=208)
+    g = CSRGraph.from_edges(n, edges)
+    bg = BellGraph.from_host(g, widths=(2, 4, 8, 16))
+    # 4 buckets exist per level (some possibly 0-row, but present)
+    assert all(len(lvl) == 4 for lvl in bg.levels)

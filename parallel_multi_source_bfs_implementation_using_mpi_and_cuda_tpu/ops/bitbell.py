@@ -91,23 +91,23 @@ def bell_hits_or(frontier: jax.Array, graph: BellGraph) -> jax.Array:
     return forest_hits(frontier, graph, lambda g: _or_fold(g, 1))
 
 
-@partial(jax.jit, static_argnames=("max_levels",))
-def bitbell_run(
-    graph: BellGraph,
-    queries: jax.Array,
-    max_levels: Optional[int] = None,
+def bit_level_loop(
+    frontier0: jax.Array,  # (n, W) uint32 source planes
+    counts0: jax.Array,  # (K,) per-query source counts
+    expand,  # (visited, frontier) -> newly-reached global planes
+    max_levels,
+    cast=lambda x: x,  # varying-axes cast for shard_map callers
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """(K, S) queries (K % 32 == 0) -> per-query (f, levels, reached).
+    """The shared bit-plane level loop: returns (f, levels, reached).
 
     ``f`` is int64 (reference accumulates in long long, main.cu:77);
     ``levels`` = while-iterations the query needed (= max distance + 1, the
     reference's kernel-launch count, main.cu:61-71); ``reached`` = number of
-    reached vertices including sources.
+    reached vertices including sources.  ``expand`` is the only piece that
+    differs between the single-chip engine (forest pass) and the
+    vertex-sharded one (forest pass + halo all_gather); ``cast`` lets the
+    sharded caller give the initial carry its collective-output axis types.
     """
-    n = graph.n
-    k = queries.shape[0]
-    frontier0 = pack_queries(n, queries)
-    counts0 = unpack_counts(frontier0)
 
     def cond(carry):
         _, _, _, _, _, level, updated = carry
@@ -118,15 +118,14 @@ def bitbell_run(
 
     def body(carry):
         visited, frontier, f, levels, reached, level, _ = carry
-        hits = bell_hits_or(frontier, graph)
-        new = hits & ~visited
+        new = expand(visited, frontier)
         counts = unpack_counts(new)
         found = counts > 0
         dist = level + 1  # newly discovered vertices are at this distance
         return (
             visited | new,
             new,
-            f + counts.astype(jnp.int64) * (dist).astype(jnp.int64),
+            f + counts.astype(jnp.int64) * dist.astype(jnp.int64),
             jnp.where(found, dist + 1, levels),
             reached + counts,
             level + 1,
@@ -139,14 +138,30 @@ def bitbell_run(
         # Sources contribute distance 0; deriving the zero init from counts0
         # (rather than a literal) gives it counts0's varying-axes type, so
         # the same loop works unchanged inside shard_map shards.
-        counts0.astype(jnp.int64) * 0,
-        jnp.where(counts0 > 0, 1, 0).astype(jnp.int32),
-        counts0,
+        cast(counts0.astype(jnp.int64) * 0),
+        cast(jnp.where(counts0 > 0, 1, 0).astype(jnp.int32)),
+        cast(counts0),
         jnp.int32(0),
-        jnp.any(counts0 > 0),
+        cast(jnp.any(counts0 > 0)),
     )
     _, _, f, levels, reached, _, _ = lax.while_loop(cond, body, carry)
     return f, levels, reached
+
+
+@partial(jax.jit, static_argnames=("max_levels",))
+def bitbell_run(
+    graph: BellGraph,
+    queries: jax.Array,
+    max_levels: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(K, S) queries (K % 32 == 0) -> per-query (f, levels, reached)."""
+    frontier0 = pack_queries(graph.n, queries)
+    return bit_level_loop(
+        frontier0,
+        unpack_counts(frontier0),
+        lambda visited, frontier: bell_hits_or(frontier, graph) & ~visited,
+        max_levels,
+    )
 
 
 class BitBellEngine(PackedEngineBase):

@@ -39,7 +39,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.bell import DEFAULT_WIDTHS, BellGraph
 from ..models.csr import CSRGraph
-from ..ops.bitbell import WORD_BITS, bell_hits_or, pack_queries, unpack_counts
+from ..ops.bitbell import (
+    WORD_BITS,
+    bell_hits_or,
+    bit_level_loop,
+    pack_queries,
+    unpack_counts,
+)
 from ..ops.engine import QueryEngineBase
 from .mesh import QUERY_AXIS, VERTEX_AXIS
 from .scheduler import merge_local_f, shard_queries
@@ -194,7 +200,7 @@ def build_sharded_forest(
 
 
 @partial(jax.jit, static_argnames=("mesh", "k", "k_pad", "w", "block", "max_levels"))
-def _sharded_bitbell_f_values(
+def _sharded_bitbell_run(
     mesh: Mesh,
     forest,  # shard-stacked BellGraph, leaves sharded over 'v'
     query_grid: jax.Array,  # (W, J, S) cyclic layout, sharded over 'q'
@@ -203,7 +209,9 @@ def _sharded_bitbell_f_values(
     w: int,
     block: int,
     max_levels,
-) -> jax.Array:
+):
+    """Merged per-query (f, levels, reached), each (k_pad,) replicated."""
+
     def shard_body(forest, qblock):
         local = jax.tree.map(lambda x: x[0], forest)  # drop 'v' stack axis
         qblock = qblock[0]  # local leading extent 1 on 'q'
@@ -215,55 +223,38 @@ def _sharded_bitbell_f_values(
             )
         n_pad = local.n
 
+        def vvary(x):
+            # Collective outputs carry a ('q','v')-varying type; give the
+            # initial loop carry the same one.
+            return lax.pcast(x, (VERTEX_AXIS,), to="varying")
+
         frontier0 = pack_queries(n_pad, qblock)
         counts0 = unpack_counts(frontier0)
-        # The body's frontier comes out of an all_gather over 'v'; give the
-        # initial carry the same ('q','v')-varying type.
-        frontier0 = lax.pcast(frontier0, (VERTEX_AXIS,), to="varying")
         me = lax.axis_index(VERTEX_AXIS)
 
-        def cond(carry):
-            _, _, _, level, updated = carry
-            go = updated
-            if max_levels is not None:
-                go = jnp.logical_and(go, level < max_levels)
-            return go
-
-        def body(carry):
-            visited, frontier, f, level, _ = carry
+        def expand(visited, frontier):
             hits = bell_hits_or(frontier, local)  # zero outside owned rows
             new = hits & ~visited
             # Halo exchange: shards own disjoint row blocks, so gathering
             # each shard's own (L, W) slice reconstructs the global planes.
             mine = lax.dynamic_slice_in_dim(new, me * block, block, axis=0)
-            new_global = lax.all_gather(mine, VERTEX_AXIS, tiled=True)
-            counts = unpack_counts(new_global)
-            dist = level + 1
-            return (
-                visited | new_global,
-                new_global,
-                f + counts.astype(jnp.int64) * dist.astype(jnp.int64),
-                level + 1,
-                jnp.any(counts > 0),
-            )
+            return lax.all_gather(mine, VERTEX_AXIS, tiled=True)
 
-        carry = (
-            frontier0,
-            frontier0,
-            lax.pcast(
-                counts0.astype(jnp.int64) * 0, (VERTEX_AXIS,), to="varying"
-            ),
-            jnp.int32(0),
-            lax.pcast(jnp.any(counts0 > 0), (VERTEX_AXIS,), to="varying"),
+        f, levels, reached = bit_level_loop(
+            vvary(frontier0), counts0, expand, max_levels, cast=vvary
         )
-        _, _, f, _, _ = lax.while_loop(cond, body, carry)
-        return merge_local_f(f[:j], j, w, k, k_pad, (QUERY_AXIS, VERTEX_AXIS))
+        axes = (QUERY_AXIS, VERTEX_AXIS)
+        return (
+            merge_local_f(f[:j], j, w, k, k_pad, axes),
+            merge_local_f(levels[:j].astype(jnp.int64), j, w, k, k_pad, axes),
+            merge_local_f(reached[:j].astype(jnp.int64), j, w, k, k_pad, axes),
+        )
 
     return jax.shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(VERTEX_AXIS), P(QUERY_AXIS)),
-        out_specs=P(),
+        out_specs=(P(), P(), P()),
     )(forest, query_grid)
 
 
@@ -289,11 +280,11 @@ class ShardedBellEngine(QueryEngineBase):
         self.forest = jax.device_put(stacked, vspec)
         self.max_levels = max_levels
 
-    def f_values(self, queries: np.ndarray) -> jax.Array:
+    def _run(self, queries: np.ndarray):
         sharded, k, k_pad, _ = shard_queries(
             self.mesh, np.asarray(queries), None
         )
-        merged = _sharded_bitbell_f_values(
+        f, levels, reached = _sharded_bitbell_run(
             self.mesh,
             self.forest,
             sharded,
@@ -303,4 +294,19 @@ class ShardedBellEngine(QueryEngineBase):
             self.block,
             self.max_levels,
         )
-        return merged[:k]
+        return f, levels, reached, k
+
+    def f_values(self, queries: np.ndarray) -> jax.Array:
+        f, _, _, k = self._run(queries)
+        return f[:k]
+
+    def query_stats(self, queries):
+        """Per-query (levels, reached, F): the loop counters are replicated
+        across 'v' (computed from the gathered global planes), so they merge
+        exactly like F values."""
+        f, levels, reached, k = self._run(queries)
+        return (
+            np.asarray(levels[:k]).astype(np.int32),
+            np.asarray(reached[:k]).astype(np.int32),
+            np.asarray(f[:k]),
+        )

@@ -96,3 +96,21 @@ def test_build_sharded_forest_shapes():
     for per_bucket in stacked.levels:
         lead = {c.shape[0] for c in per_bucket}
         assert lead == {4}  # every bucket stacked over all shards
+
+
+def test_sharded_bell_query_stats_match_single_chip(problem):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        BitBellEngine,
+    )
+
+    n, edges, _, padded = problem
+    graph = CSRGraph.from_edges(n, edges)
+    mesh = make_mesh(num_query_shards=2, num_vertex_shards=4)
+    a = ShardedBellEngine(mesh, graph).query_stats(padded)
+    b = BitBellEngine(BellGraph.from_host(graph)).query_stats(padded)
+    assert a is not None
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)

@@ -32,6 +32,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from virtual_cpu import virtual_cpu_env  # noqa: E402
+
 
 ENGINE = "bitbell"  # set by --engine
 
@@ -274,15 +276,6 @@ CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
 # Default RMAT scale per config, cappable with --scale-cap (RAM-limited hosts).
 SCALES = {2: 20, 3: 22, 4: 18, 5: 20}
 
-CPU_MESH_ENV = {
-    "PALLAS_AXON_POOL_IPS": "",
-    "JAX_PLATFORMS": "cpu",
-    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-    # Sentinel so the child doesn't recurse into another fallback; a user's
-    # own JAX_PLATFORMS=cpu must NOT suppress the fallback (their plain CPU
-    # run has one device and still needs the virtual mesh).
-    "MSBFS_BASELINE_CPU_MESH": "1",
-}
 
 
 def _call(c: int, args):
@@ -309,11 +302,11 @@ def _run_in_cpu_mesh(c: int, args):
     ]
     if args.scale_cap:
         cmd += ["--scale-cap", str(args.scale_cap)]
-    env = {**os.environ, **CPU_MESH_ENV}
-    if os.environ.get("XLA_FLAGS"):  # append, don't clobber, caller's flags
-        env["XLA_FLAGS"] = (
-            os.environ["XLA_FLAGS"] + " " + CPU_MESH_ENV["XLA_FLAGS"]
-        )
+    env = virtual_cpu_env(8)
+    # Sentinel so the child doesn't recurse into another fallback; a user's
+    # own JAX_PLATFORMS=cpu must NOT suppress the fallback (their plain CPU
+    # run has one device and still needs the virtual mesh).
+    env["MSBFS_BASELINE_CPU_MESH"] = "1"
     proc = subprocess.run(cmd, env=env, capture_output=True, text=True)
     for line in proc.stdout.splitlines():
         try:

@@ -16,6 +16,12 @@ would otherwise inherit as its stdout).
 import os
 import sys
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from virtual_cpu import forced_device_count, virtual_cpu_env  # noqa: E402
+
 
 def _needs_reexec() -> bool:
     return bool(
@@ -26,10 +32,10 @@ def _needs_reexec() -> bool:
 
 if not os.environ.get("MSBFS_TEST_TPU") and not _needs_reexec():
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
+    if forced_device_count() is None:  # respect a caller's own count flag
         os.environ["XLA_FLAGS"] = (
-            _flags + " --xla_force_host_platform_device_count=8"
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
         ).strip()
 
 
@@ -42,15 +48,5 @@ def pytest_configure(config):
             capman.stop_global_capturing()
         except Exception:
             pass
-    env = dict(os.environ)
-    env["PALLAS_AXON_POOL_IPS"] = ""  # sitecustomize skips the plugin register
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env = virtual_cpu_env(forced_device_count() or 8)
     os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
-
-
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if REPO_ROOT not in sys.path:
-    sys.path.insert(0, REPO_ROOT)

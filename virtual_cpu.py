@@ -1,0 +1,54 @@
+"""Single source of truth for virtual CPU-mesh process environments.
+
+This machine's ``sitecustomize`` registers an ``axon`` TPU PJRT plugin in
+every interpreter when ``PALLAS_AXON_POOL_IPS`` is truthy; once registered,
+initializing the CPU backend deadlocks.  Any process that must come up on
+the virtual CPU platform therefore needs (a) the plugin env scrubbed and
+(b) the host-platform device-count flag — BEFORE interpreter start, i.e.
+via subprocess/re-exec with the env this module builds.  Used by
+``tests/conftest.py``, ``__graft_entry__.dryrun_multichip`` and
+``benchmarks/run_baseline.py``; keep the invariant here only.
+
+Deliberately jax-free and package-free: the package ``__init__`` imports
+jax, which is exactly what callers of this module must avoid doing before
+the environment is fixed.
+"""
+
+import os
+import re
+
+_COUNT_FLAG = "xla_force_host_platform_device_count"
+
+
+def forced_device_count(environ=None):
+    """The virtual device count the CPU platform will actually use, parsed
+    from XLA_FLAGS (XLA's flag parser honors the LAST occurrence), or None
+    if the flag is absent."""
+    env = os.environ if environ is None else environ
+    hits = re.findall(rf"{_COUNT_FLAG}=(\d+)", env.get("XLA_FLAGS", ""))
+    return int(hits[-1]) if hits else None
+
+
+def is_virtual_cpu(n_devices, environ=None):
+    """True iff an interpreter started under ``environ`` comes up on the
+    CPU backend with at least ``n_devices`` virtual devices."""
+    env = os.environ if environ is None else environ
+    if env.get("PALLAS_AXON_POOL_IPS"):
+        return False
+    if env.get("JAX_PLATFORMS", "") != "cpu":
+        return False
+    count = forced_device_count(env)
+    return count is not None and count >= n_devices
+
+
+def virtual_cpu_env(n_devices=8, base=None):
+    """A copy of ``base`` (default ``os.environ``) adjusted so a fresh
+    interpreter comes up on the CPU backend with exactly ``n_devices``
+    virtual devices.  Any pre-existing device-count flags are stripped
+    (never duplicated) so the resulting count is unambiguous."""
+    env = dict(os.environ if base is None else base)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # sitecustomize skips the TPU plugin
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(rf"--?{_COUNT_FLAG}=\d+", "", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = " ".join((flags + f" --{_COUNT_FLAG}={n_devices}").split())
+    return env

@@ -17,7 +17,7 @@ vertex-parallel BFS with per-level host sync on power-law graphs lands at
 
 Env knobs: BENCH_SCALE (default 20), BENCH_EDGE_FACTOR (16), BENCH_K (64),
 BENCH_CHUNK (8), BENCH_REPEATS (3), BENCH_MAX_S (64),
-BENCH_ENGINE (bitbell|bell|packed|vmap|dense|pallas, default bitbell),
+BENCH_ENGINE (bitbell|bell|packed|vmap|dense|pallas|push, default bitbell),
 BENCH_EDGE_CHUNKS (packed engine HBM knob, default 1).
 """
 
@@ -105,7 +105,10 @@ def main() -> None:
             PushEngine,
         )
 
-        engine = PushEngine(PaddedAdjacency.from_host(g))
+        try:
+            engine = PushEngine(PaddedAdjacency.from_host(g))
+        except NotImplementedError as e:
+            sys.exit(f"BENCH_ENGINE=push: {e}")
     elif engine_kind == "bitbell":
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
             BellGraph,

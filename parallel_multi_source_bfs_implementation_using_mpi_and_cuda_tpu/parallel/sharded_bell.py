@@ -272,6 +272,7 @@ class ShardedBellEngine(QueryEngineBase):
     ):
         self.mesh = mesh
         self.w = mesh.shape[QUERY_AXIS]
+        self.n = graph.n
         p = mesh.shape[VERTEX_AXIS]
         stacked, self.block, self.n_pad = build_sharded_forest(
             graph, p, widths, min_bucket_rows
@@ -281,9 +282,14 @@ class ShardedBellEngine(QueryEngineBase):
         self.max_levels = max_levels
 
     def _run(self, queries: np.ndarray):
-        sharded, k, k_pad, _ = shard_queries(
-            self.mesh, np.asarray(queries), None
-        )
+        # Reference bounds check (main.cu:48-50): sources outside [0, n) are
+        # dropped.  The forest is padded to n_pad >= n, so an id in
+        # [n, n_pad) would otherwise hit a phantom padding vertex and
+        # inflate the reached/levels stats; remap to the -1 drop sentinel
+        # against the TRUE vertex count before packing.
+        queries = np.asarray(queries)
+        queries = np.where((queries >= 0) & (queries < self.n), queries, -1)
+        sharded, k, k_pad, _ = shard_queries(self.mesh, queries, None)
         f, levels, reached = _sharded_bitbell_run(
             self.mesh,
             self.forest,

@@ -61,6 +61,8 @@ class CheckpointedRunner:
             header = f.readline().strip().split(",")
             if header[:1] != [_MAGIC]:
                 raise ValueError(f"{self.path}: not a checkpoint journal")
+            if len(header) < 2:  # truncated: magic present, fingerprint lost
+                raise ValueError(f"{self.path}: malformed checkpoint header")
             if header[1] != fingerprint:
                 raise ValueError(
                     f"{self.path}: checkpoint belongs to a different "

@@ -84,6 +84,19 @@ def test_checkpoint_partial_journal_completes(problem, tmp_path):
     assert 0 < computed <= padded.shape[0] - 8  # first 8 were journaled
 
 
+def test_checkpoint_truncated_header_raises_valueerror(problem, tmp_path):
+    """A journal cut off mid-header (magic line only, no fingerprint) must
+    raise ValueError — the type cli.py maps to the clean 'Checkpoint error'
+    message — not IndexError."""
+    n, g, eng, padded, _ = problem
+    path = tmp_path / "j.ckpt"
+    path.write_text("msbfs-ckpt-v1")
+    with pytest.raises(ValueError, match="malformed"):
+        CheckpointedRunner(eng, path, chunk=4).run(
+            n, g.num_directed_edges, padded
+        )
+
+
 def test_checkpoint_workload_mismatch_raises(problem, tmp_path):
     n, g, eng, padded, _ = problem
     path = tmp_path / "j.ckpt"

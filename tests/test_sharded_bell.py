@@ -87,6 +87,37 @@ def test_sharded_bell_hub_imbalance():
     np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
 
 
+def test_sharded_bell_out_of_range_source_dropped():
+    """Reference bounds check (main.cu:48-50): a source id >= n is dropped.
+    The forest pads n to n_pad = shards * block; an id in [n, n_pad) must
+    not become a phantom source that inflates reached/levels stats."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        BitBellEngine,
+    )
+
+    n, edges = generators.gnm_edges(101, 350, seed=405)  # n_pad = 104 on v=4
+    graph = CSRGraph.from_edges(n, edges)
+    queries = [
+        np.array([0, 102], dtype=np.int32),  # 102 in [n, n_pad): phantom
+        np.array([3, 4], dtype=np.int32),
+    ]
+    padded = pad_queries(queries)
+    mesh = make_mesh(num_query_shards=2, num_vertex_shards=4)
+    eng = ShardedBellEngine(mesh, graph)
+    np.testing.assert_array_equal(
+        np.asarray(eng.f_values(padded)),
+        oracle_f_values(n, edges, [q[q < n] for q in queries]),
+    )
+    a = eng.query_stats(padded)
+    b = BitBellEngine(BellGraph.from_host(graph)).query_stats(padded)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    assert int(a[1][0]) <= n  # reached count cannot exceed true n
+
+
 def test_build_sharded_forest_shapes():
     n, edges = generators.rmat_edges(7, edge_factor=6, seed=405)
     g = CSRGraph.from_edges(n, edges)

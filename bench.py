@@ -107,7 +107,7 @@ def main() -> None:
 
         try:
             engine = PushEngine(PaddedAdjacency.from_host(g))
-        except NotImplementedError as e:
+        except ValueError as e:
             sys.exit(f"BENCH_ENGINE=push: {e}")
     elif engine_kind == "bitbell":
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (

@@ -177,23 +177,14 @@ def config3(scale=22):
     }
 
 
-class NeedsCpuHost(RuntimeError):
-    """Config must run on the host CPU platform; main() retries it in a
-    JAX_PLATFORMS=cpu subprocess."""
-
-
 def config4(scale=18):
     """High-diameter road-network stand-in: a 2^(scale/2) square grid.
 
     Runs the frontier-compacted push engine (level-synchronous pull engines
-    are O(D*E) with D in the thousands here).  On current TPU backends the
-    fixed-size ``jnp.nonzero`` compaction inside the loop hits an XLA
-    scoped-VMEM lowering failure on big planes, so this config executes on
-    the host CPU platform — where the queue BFS is genuinely fast — and the
-    result records that device honestly.
+    are O(D*E) with D in the thousands here).  The prefix-sum frontier
+    compaction (ops/push.py compact_indices) compiles on every backend, so
+    this config runs wherever the harness does — TPU included.
     """
-    import jax
-
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
         generators,
     )
@@ -208,8 +199,6 @@ def config4(scale=18):
         pad_queries,
     )
 
-    if jax.default_backend() not in ("cpu",):
-        raise NeedsCpuHost()
     side = 1 << (scale // 2)
     n, edges = generators.grid_edges(side, side)
     g = CSRGraph.from_edges(n, edges)
@@ -348,7 +337,7 @@ def main() -> int:
     for c in todo:
         try:
             r = _call(c, args)
-        except (NeedsDevices, NeedsCpuHost) as exc:
+        except NeedsDevices as exc:
             if os.environ.get("MSBFS_BASELINE_CPU_MESH"):
                 r = {"config": c, "error": f"{type(exc).__name__} on CPU mesh"}
             else:

@@ -166,9 +166,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
                 try:
                     engine = PushEngine(PaddedAdjacency.from_host(graph))
-                except (NotImplementedError, ValueError) as exc:
-                    # TPU XLA-nonzero bug / degree beyond the width cap:
-                    # both are user-facing engine-choice errors.
+                except ValueError as exc:
+                    # Degree beyond the width cap: a user-facing
+                    # engine-choice error.
                     print(str(exc), file=sys.stderr)
                     return 1
             elif backend == "packed":
@@ -187,7 +187,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 from .ops.bitbell import BitBellEngine
 
                 engine = BitBellEngine(BellGraph.from_host(graph))
-        stats_mode = os.environ.get("MSBFS_STATS") == "1"
+        stats_env = os.environ.get("MSBFS_STATS", "")
+        stats_mode = stats_env in ("1", "2")
+        # MSBFS_STATS=2: additionally trace each BFS level (frontier size,
+        # wall time) via the engine's stepped loop, when it has one.
+        stats_level = stats_env == "2" and hasattr(engine, "level_stats")
         ckpt_path = os.environ.get("MSBFS_CHECKPOINT")
         ckpt_chunk = _env_int("MSBFS_CHECKPOINT_CHUNK", 64)
         if ckpt_path:
@@ -205,7 +209,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 dummy = np.full((shape_k, s), -1, dtype=np.int32)
                 engine.f_values(dummy)
         else:
-            engine.compile(padded.shape, warm_stats=stats_mode)
+            engine.compile(
+                padded.shape,
+                warm_stats=stats_mode and not stats_level,
+                warm_levels=stats_level,
+            )
 
     # ---- computation span: all BFS + objective + argmin (main.cu:301-400).
     # MSBFS_PROFILE_DIR captures a jax.profiler trace of the span (tracing
@@ -216,6 +224,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     # checkpoint — beyond-reference; the reference recomputes everything on
     # failure).  Works with any engine; chunk via MSBFS_CHECKPOINT_CHUNK.
     stats = None
+    level_rows = None
     with Span() as comp:
         with profiler_trace():
             if ckpt_path:
@@ -232,7 +241,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             elif stats_mode and padded.shape[0]:
                 # One BFS pass serves both the report and the stats table:
                 # stats include the F values, so selection derives from them.
-                stats = engine.query_stats(np.asarray(padded))
+                if stats_level:
+                    levels, reached, f, lvl_counts, lvl_secs = (
+                        engine.level_stats(np.asarray(padded))
+                    )
+                    stats = (levels, reached, f)
+                    level_rows = (lvl_counts, lvl_secs)
+                else:
+                    stats = engine.query_stats(np.asarray(padded))
             if stats is not None:
                 from .ops.objective import select_best_jit
                 import jax.numpy as jnp
@@ -244,8 +260,15 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if stats is not None:
         # Per-query diagnostics to stderr (stdout stays reference-exact).
-        from .utils.trace import format_query_stats
+        from .utils.trace import format_level_stats, format_query_stats
 
+        if level_rows is not None:
+            sys.stderr.write(format_level_stats(*level_rows))
+        elif stats_env == "2":
+            sys.stderr.write(
+                "MSBFS_STATS=2: per-level trace not available on this "
+                "engine; per-query stats only\n"
+            )
         sys.stderr.write(format_query_stats(*stats))
     elif stats_mode and not ckpt_path:
         if padded.shape[0] == 0:

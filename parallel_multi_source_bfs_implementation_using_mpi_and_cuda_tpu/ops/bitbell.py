@@ -148,6 +148,23 @@ def bit_level_loop(
     return f, levels, reached
 
 
+# Standalone-jitted pack for the stepped tracing mode (inside bitbell_run it
+# is fused into the main program); static n, cached across calls.
+_pack_queries_jit = jax.jit(pack_queries, static_argnums=0)
+
+
+@jax.jit
+def bitbell_step(
+    graph: BellGraph, visited: jax.Array, frontier: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One BFS level for all packed queries; returns (visited', frontier',
+    per-query newly-discovered counts).  The stepped form of the while-loop
+    body, used by the per-level tracing mode (MSBFS_STATS=2) where the host
+    drives the loop so each level can be timed individually."""
+    new = bell_hits_or(frontier, graph) & ~visited
+    return visited | new, new, unpack_counts(new)
+
+
 @partial(jax.jit, static_argnames=("max_levels",))
 def bitbell_run(
     graph: BellGraph,
@@ -189,4 +206,65 @@ class BitBellEngine(PackedEngineBase):
             np.asarray(levels)[:k],
             np.asarray(reached)[:k],
             np.asarray(f)[:k],
+        )
+
+    def level_stats(self, queries):
+        """Per-level trace (MSBFS_STATS=2): host-driven stepped BFS so each
+        level is individually timed.  Returns (levels, reached, f,
+        level_counts, level_seconds) where ``level_counts`` is (L, K) — row
+        d = vertices discovered at distance d per query (row 0 = sources) —
+        and ``level_seconds`` is (L,) wall time per executed level (row 0 =
+        source packing).  The first three match :meth:`query_stats` exactly
+        (they are the same counters, accumulated on host); the stepped loop
+        pays one dispatch per level, so this is a diagnostic mode, not the
+        performance path.
+        """
+        import time
+
+        queries, k = self._pad_queries(queries)
+        pack = partial(_pack_queries_jit, self.graph.n)
+        # Warm both programs first so the timed rows measure execution, not
+        # XLA compilation.  compile(warm_levels=True) routes here, putting
+        # these compiles in the CLI's preprocessing span; a direct caller
+        # pays them before its first timed row either way.  (An empty dummy
+        # can't warm the step program — the loop would never execute one.)
+        warm_frontier = pack(queries)
+        jax.block_until_ready(
+            bitbell_step(self.graph, warm_frontier, warm_frontier)
+        )
+        t0 = time.perf_counter()
+        frontier = pack(queries)
+        counts = np.asarray(unpack_counts(frontier))
+        dt = time.perf_counter() - t0
+        visited = frontier
+        level_counts = [counts]
+        level_seconds = [dt]
+        while counts.any():
+            if (
+                self.max_levels is not None
+                and len(level_counts) > self.max_levels
+            ):
+                break
+            t0 = time.perf_counter()
+            visited, frontier, c = bitbell_step(self.graph, visited, frontier)
+            counts = np.asarray(c)
+            level_seconds.append(time.perf_counter() - t0)
+            level_counts.append(counts)
+        lc = np.stack(level_counts)  # (L, Kpad)
+        dists = np.arange(lc.shape[0], dtype=np.int64)
+        f = (lc.astype(np.int64) * dists[:, None]).sum(axis=0)
+        reached = lc.sum(axis=0, dtype=np.int32)
+        any_at = lc > 0
+        # levels = while-iterations the query needed = max distance + 1
+        # (reference's kernel-launch count, main.cu:61-71); 0 for empty.
+        maxdist = np.where(
+            any_at.any(axis=0), any_at.shape[0] - 1 - any_at[::-1].argmax(axis=0), -1
+        )
+        levels = (maxdist + 1).astype(np.int32)
+        return (
+            levels[:k],
+            reached[:k],
+            f[:k],
+            lc[:, :k],
+            np.asarray(level_seconds),
         )

@@ -58,16 +58,25 @@ class QueryEngineBase:
         min_f, min_k = select_best_jit(f, f >= 0)
         return int(min_f), int(min_k)
 
-    def compile(self, queries_shape: Tuple[int, int], warm_stats: bool = False) -> None:
+    def compile(
+        self,
+        queries_shape: Tuple[int, int],
+        warm_stats: bool = False,
+        warm_levels: bool = False,
+    ) -> None:
         """Pre-trace/compile for a given (K, S) query shape so compile time
         lands in the preprocessing span (the CUDA reference's kernels are
         compiled offline by nvcc; see utils.timing).  ``warm_stats`` also
-        compiles the query_stats program (used when the caller will take the
-        stats path in the timed span)."""
+        compiles the query_stats program, ``warm_levels`` the stepped
+        per-level program (each used when the caller will take that path in
+        the timed span; ``warm_levels`` is a no-op on engines without
+        :meth:`level_stats`)."""
         dummy = np.full(queries_shape, -1, dtype=np.int32)
         self.best(dummy)
         if warm_stats and queries_shape[0]:
             self.query_stats(dummy)
+        if warm_levels and queries_shape[0] and hasattr(self, "level_stats"):
+            self.level_stats(dummy)
 
     def query_stats(self, queries):
         """Optional diagnostic: per-query (levels, reached, F) arrays.

@@ -15,7 +15,11 @@ main.cu:21-23):
   a constant 1 into the hit plane.  A constant-valued scatter-max IS the
   bitwise-OR that a multi-writer push needs, so the reference's benign
   write race (main.cu:30-33) maps to a well-defined XLA op;
-* the next frontier is rebuilt with a fixed-size ``jnp.nonzero``.
+* the next frontier is rebuilt with a prefix-sum compaction (exclusive
+  ``cumsum`` of the hit plane + one bounded scatter) — NOT fixed-size
+  ``jnp.nonzero``, whose lowering hits an XLA scoped-VMEM bug on current
+  TPU stacks (docs/PERF_NOTES.md "XLA lowering hazards"); the cumsum form
+  compiles and runs on every backend.
 
 Work per query: O(sum of frontier sizes) = O(n) gathered rows and O(E)
 scattered slots across the WHOLE BFS (vs per level for the pull engines),
@@ -41,26 +45,35 @@ import numpy as np
 from jax import lax
 
 from ..models.csr import CSRGraph
-from ..utils.platform import is_tpu_backend
 from .engine import QueryEngineBase
 
 DEFAULT_MAX_WIDTH = 64
 
 
-def _reject_tpu_backend() -> None:
-    """The fixed-size jnp.nonzero compaction inside the level loop hits an
-    XLA scoped-VMEM lowering failure on current TPU stacks at ANY problem
-    size ("It should not be possible to run out of scoped vmem - please
-    file a bug against XLA"); larger shapes crash the worker outright.
-    Fail fast with the workaround instead of a mid-run compiler error.
-    Details: docs/PERF_NOTES.md "XLA lowering hazards"."""
-    if is_tpu_backend():
-        raise NotImplementedError(
-            "PushEngine cannot compile on current TPU backends (XLA "
-            "scoped-VMEM bug in fixed-size nonzero lowering); run it on "
-            "the CPU platform (JAX_PLATFORMS=cpu) or use the bitbell "
-            "engine on TPU"
-        )
+def compact_indices(
+    mask: jax.Array, capacity: int, fill_value: Optional[int] = None
+) -> jax.Array:
+    """(m,) 0/1 plane -> (capacity,) int32 indices of the set entries,
+    ascending, padded with ``fill_value`` (default m).
+
+    Prefix-sum compaction: slot of entry i = number of set entries before i
+    (exclusive cumsum); one bounded ``.at[].set(mode="drop")`` scatter
+    places the indices.  Entries beyond ``capacity`` drop — callers detect
+    that via their own count (never silently truncate).  This is the
+    TPU-safe replacement for ``jnp.nonzero(size=...)``, whose reduce-window
+    lowering exceeds scoped VMEM on current TPU stacks (docs/PERF_NOTES.md
+    "XLA lowering hazards")."""
+    m = mask.shape[0]
+    if fill_value is None:
+        fill_value = m
+    on = (mask > 0).astype(jnp.int32)
+    pos = jnp.cumsum(on) - on  # exclusive prefix sum
+    target = jnp.where(on > 0, pos, capacity)  # masked-off -> dropped
+    return (
+        jnp.full((capacity,), fill_value, dtype=jnp.int32)
+        .at[target]
+        .set(jnp.arange(m, dtype=jnp.int32), mode="drop")
+    )
 
 
 @jax.tree_util.register_pytree_node_class
@@ -83,7 +96,6 @@ class PaddedAdjacency:
         """Build from a CSR; duplicate neighbors and self-loops are dropped
         (set semantics — cannot change BFS distances or F(U); see
         CSRGraph.deduped_pairs)."""
-        _reject_tpu_backend()  # before the O(n*w) build + device placement
         n = g.n
         u, v, deg = g.deduped_pairs()
         w = int(deg.max()) if n and deg.size else 0
@@ -131,9 +143,12 @@ def _push_one(
     )
     visited = visited.at[n].set(0)
     count0 = jnp.sum(visited, dtype=jnp.int32)
-    frontier = jnp.nonzero(
-        visited, size=capacity, fill_value=n
-    )[0].astype(jnp.int32)
+    # Padding slots point at row n — the all-sentinel landing pad of the
+    # (n+1, w) adjacency table — so padded frontier entries gather only
+    # sentinel neighbors (which in turn land on hit-plane row n, cleared
+    # below).  The mask itself is (n+1,) with row n forced 0, so n never
+    # appears as a REAL frontier entry.
+    frontier = compact_indices(visited, capacity, fill_value=n)
     overflow0 = count0 > capacity
 
     def cond(carry):
@@ -156,7 +171,7 @@ def _push_one(
         dist = level + 1
         return (
             visited | new,
-            jnp.nonzero(new, size=capacity, fill_value=n)[0].astype(jnp.int32),
+            compact_indices(new, capacity, fill_value=n),
             f + count.astype(jnp.int64) * dist.astype(jnp.int64),
             jnp.where(count > 0, dist + 1, levels),
             reached + count,
@@ -210,7 +225,6 @@ class PushEngine(QueryEngineBase):
         capacity: Optional[int] = None,
         max_levels: Optional[int] = None,
     ):
-        _reject_tpu_backend()  # direct-constructed graphs hit it here
         self.graph = graph
         self.capacity = int(capacity) if capacity else max(graph.n, 1)
         self.max_levels = max_levels

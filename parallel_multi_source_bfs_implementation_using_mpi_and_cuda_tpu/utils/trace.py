@@ -38,6 +38,19 @@ def profiler_trace(log_dir: Optional[str] = None) -> Iterator[bool]:
         jax.profiler.stop_trace()
 
 
+def format_level_stats(level_counts, level_seconds) -> str:
+    """Per-level trace table (MSBFS_STATS=2): one line per executed BFS
+    level with the total vertices discovered at that distance (summed over
+    queries), how many queries were still active, and the level's wall
+    time.  Row 0 is the source-packing step (distance-0 vertices)."""
+    lines = ["level  discovered  active_queries  seconds"]
+    for d, (counts, sec) in enumerate(zip(level_counts, level_seconds)):
+        total = int(sum(int(c) for c in counts))
+        active = int(sum(1 for c in counts if int(c) > 0))
+        lines.append(f"{d:5d}  {total:10d}  {active:14d}  {float(sec):.6f}")
+    return "\n".join(lines) + "\n"
+
+
 def format_query_stats(
     levels: Sequence[int], reached: Sequence[int], f_values: Sequence[int]
 ) -> str:

@@ -160,9 +160,6 @@ def test_engine_agrees(workload, name):
     g, padded, reference = workload
     if name.startswith(("distributed", "sharded")) and len(jax.devices()) < 8:
         pytest.skip("needs the 8-device test mesh")
-    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.platform import is_tpu_backend
-    if name == "push" and is_tpu_backend():
-        pytest.skip("PushEngine blocked on TPU (XLA nonzero lowering bug)")
     eng = ENGINES[name](g)
     np.testing.assert_array_equal(np.asarray(eng.f_values(padded)), reference)
     f = reference

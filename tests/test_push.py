@@ -18,18 +18,6 @@ from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push im
 
 from oracle import oracle_best, oracle_bfs, oracle_f
 
-from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.platform import (
-    is_tpu_backend,
-)
-
-# String condition: pytest evaluates it lazily when applying the marker —
-# after conftest has settled the platform env — instead of at module import.
-pytestmark = pytest.mark.skipif(
-    "is_tpu_backend()",
-    reason="PushEngine blocked on TPU by the XLA scoped-VMEM nonzero "
-    "lowering bug (docs/PERF_NOTES.md); engine raises NotImplementedError",
-)
-
 
 def oracle_f_values(n, edges, queries):
     return [oracle_f(oracle_bfs(n, edges, q)) for q in queries]

@@ -86,3 +86,89 @@ def test_cli_stats_stderr(tmp_path, capsys, monkeypatch):
     assert "levels" in captured.err and captured.err.count("\n") >= 3
     # stdout stays reference-exact: no stats leak into it.
     assert "levels" not in captured.out
+
+
+def test_level_stats_match_query_stats(problem):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        BitBellEngine,
+    )
+
+    n, edges, queries, padded = problem
+    eng = BitBellEngine(BellGraph.from_host(CSRGraph.from_edges(n, edges)))
+    levels, reached, f, lvl_counts, lvl_secs = eng.level_stats(padded)
+    w_levels, w_reached, w_f = eng.query_stats(padded)
+    np.testing.assert_array_equal(levels, w_levels)
+    np.testing.assert_array_equal(reached, w_reached)
+    np.testing.assert_array_equal(f, w_f)
+    # Per-level counts are per-distance discovery histograms: row 0 is the
+    # source count, every row sums into reached, and the trailing executed
+    # level discovered nothing (the loop's termination probe).
+    assert lvl_counts.shape[1] == len(queries)
+    assert lvl_counts.shape[0] == len(lvl_secs)
+    np.testing.assert_array_equal(lvl_counts.sum(axis=0), reached)
+    assert (lvl_counts[-1] == 0).all()
+    assert (lvl_secs >= 0).all()
+    for i, q in enumerate(queries):
+        dist = oracle_bfs(n, edges, q)
+        for d in range(lvl_counts.shape[0]):
+            assert lvl_counts[d, i] == int((dist == d).sum())
+
+
+def test_level_stats_respects_max_levels(problem):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        BitBellEngine,
+    )
+
+    n, edges, queries, padded = problem
+    eng = BitBellEngine(
+        BellGraph.from_host(CSRGraph.from_edges(n, edges)), max_levels=3
+    )
+    levels, reached, f, lvl_counts, _ = eng.level_stats(padded)
+    w = eng.query_stats(padded)
+    np.testing.assert_array_equal(levels, w[0])
+    np.testing.assert_array_equal(reached, w[1])
+    np.testing.assert_array_equal(f, w[2])
+    assert lvl_counts.shape[0] <= 4  # sources row + max_levels steps
+
+
+def test_format_level_stats():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.trace import (
+        format_level_stats,
+    )
+
+    counts = np.array([[2, 1], [5, 0], [0, 0]])
+    out = format_level_stats(counts, [0.001, 0.002, 0.003])
+    lines = out.strip().split("\n")
+    assert lines[0].split() == ["level", "discovered", "active_queries", "seconds"]
+    assert lines[1].split() == ["0", "3", "2", "0.001000"]
+    assert lines[2].split() == ["1", "5", "1", "0.002000"]
+    assert lines[3].split() == ["2", "0", "0", "0.003000"]
+
+
+def test_cli_level_stats_stderr(tmp_path, capsys, monkeypatch):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+        main,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_graph_bin,
+        save_query_bin,
+    )
+
+    n, edges = generators.gnm_edges(40, 120, seed=111)
+    g, q = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
+    save_graph_bin(g, n, edges)
+    save_query_bin(q, [[0], [1, 2]])
+    monkeypatch.setenv("MSBFS_STATS", "2")
+    rc = main(["main.py", "-g", g, "-q", q, "-gn", "1"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "Query number" in captured.out
+    assert "active_queries" in captured.err  # per-level table
+    assert "reached" in captured.err  # per-query table still present
+    assert "active_queries" not in captured.out  # stdout stays reference-exact

@@ -1,0 +1,182 @@
+"""Microbenchmark the primitives for a frontier-sparse bitbell level.
+
+Costs that decide between the two candidate scatter-OR formulations:
+(a) byte-lane scatter-max of (M, K) uint8 rows (max on 0/1 bytes == OR,
+    collision-safe with no preprocessing);
+(b) sort edges by target + segmented OR-scan + collision-free row scatter
+    of (M, W) uint32 words.
+
+Amortization: every op repeats R times inside one jit (fori_loop) with a
+varying input scalar (docs/PERF_NOTES.md "Measurement traps").  Each op's
+output is consumed by a FULL reduction (a single-element read lets XLA
+dead-code-eliminate most of the op); the reduction cost is measured
+separately ("probe" rows) and should be subtracted mentally.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(os.environ.get("N", str(1 << 20)))
+K = int(os.environ.get("K", "64"))
+R = int(os.environ.get("R", "20"))
+W = K // 32
+
+
+def bench(name, fn, *args, elems=1):
+    """Time fn(seed, *args); seed varies per call so the tunnel's
+    identical-execution result cache can never serve a repeat."""
+    import jax
+    import jax.numpy as jnp
+
+    # int() forces a device->host transfer: through the axon tunnel,
+    # block_until_ready alone does not reliably wait for remote execution.
+    int(fn(jnp.int32(99), *args))
+    ts = []
+    for trial in range(3):
+        t0 = time.perf_counter()
+        int(fn(jnp.int32(trial), *args))
+        ts.append(time.perf_counter() - t0)
+    t = min(ts) / R
+    print(f"{name:44s} {t * 1e3:9.3f} ms  ({elems / t / 1e6:10.1f} M/s)", flush=True)
+    return t
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.xla_cache import (
+        configure_compilation_cache,
+    )
+
+    configure_compilation_cache()
+    print(f"N={N} K={K} R={R} dev={jax.devices()[0]}", flush=True)
+    rng = np.random.default_rng(0)
+
+    def rep(body):
+        """Repeat body R times, consuming each output with a full sum; the
+        per-call seed keys every iteration so neither XLA nor the tunnel's
+        result cache can reuse work across timed calls."""
+
+        def run(seed, *args):
+            def one(i, acc):
+                out = body(i + seed, *args)
+                return acc + out.sum(dtype=jnp.uint32)
+
+            return lax.fori_loop(0, R, one, jnp.uint32(0))
+
+        return jax.jit(run)
+
+    # Reduction-cost probes (subtract from same-shaped op rows).
+    big_u8 = jnp.ones((N + 1, K), jnp.uint8)
+    big_u32 = jnp.ones((N + 1, W), jnp.uint32)
+    f = rep(lambda i, x: x + i.astype(jnp.uint8))
+    bench(f"probe: sum (N+1,{K}) u8", f, big_u8, elems=(N + 1) * K)
+    f = rep(lambda i, x: x + i)
+    bench(f"probe: sum (N+1,{W}) u32", f, big_u32, elems=(N + 1) * W)
+
+    for m_log in (18, 20, 21):
+        m = 1 << m_log
+        idx = jnp.asarray(rng.integers(0, N, size=m, dtype=np.int32))
+        bytes_vals = jnp.asarray(
+            rng.integers(0, 2, size=(m, K), dtype=np.uint8)
+        )
+        word_vals = jnp.asarray(
+            rng.integers(0, 1 << 31, size=(m, W), dtype=np.uint32)
+        )
+
+        f = rep(lambda i, x: x + i.astype(jnp.uint8))
+        bench(f"probe: sum (M={m},{K}) u8", f, bytes_vals, elems=m * K)
+
+        # (a) byte-lane scatter-max rows (M, K) u8 into (N+1, K)
+        f = rep(
+            lambda i, idx, v: jnp.zeros((N + 1, K), jnp.uint8)
+            .at[(idx + i) % N]
+            .max(v)
+        )
+        bench(f"scatter-max rows u8 (M={m}, {K}B)", f, idx, bytes_vals, elems=m)
+
+        # (b1) sort M by key with 2 u32 payloads
+        f = rep(
+            lambda i, idx, v: lax.sort(
+                ((idx + i) % N, v[:, 0], v[:, 1]), num_keys=1
+            )[1]
+        )
+        bench(f"sort M={m} key+2xu32 payload", f, idx, word_vals, elems=m)
+
+        # (b2) segmented OR scan on (M, W) words (flags from sorted keys)
+        def segscan(i, idx, v):
+            keys = (idx + i) % N
+
+            def comb(a, b):
+                ka, va = a
+                kb, vb = b
+                same = (ka == kb)[:, None]
+                return kb, jnp.where(same, va | vb, vb)
+
+            _, out = lax.associative_scan(comb, (keys, v))
+            return out
+
+        f = rep(segscan)
+        bench(f"assoc-scan seg-OR M={m} (W={W})", f, idx, word_vals, elems=m)
+
+        # (b3) collision-free row scatter-set (M, W) u32 into (N+1, W)
+        f = rep(
+            lambda i, idx, v: jnp.zeros((N + 1, W), jnp.uint32)
+            .at[(idx + i) % N]
+            .set(v, mode="drop")
+        )
+        bench(f"scatter-set rows u32 (M={m}, {4 * W}B)", f, idx, word_vals, elems=m)
+
+        # word scatter-max (WRONG for OR, cost probe only)
+        f = rep(
+            lambda i, idx, v: jnp.zeros((N + 1, W), jnp.uint32)
+            .at[(idx + i) % N]
+            .max(v)
+        )
+        bench(f"scatter-max rows u32 probe (M={m})", f, idx, word_vals, elems=m)
+
+        # gather M rows from (N, W) u32 (the frontier-word gather)
+        plane = jnp.asarray(
+            rng.integers(0, 1 << 31, size=(N, W), dtype=np.uint32)
+        )
+        f = rep(lambda i, idx, p: jnp.take(p, (idx + i) % N, axis=0))
+        bench(f"gather rows u32 (M={m})", f, idx, plane, elems=m)
+
+        # searchsorted M into B=65536 (edge-slot -> owner mapping)
+        offs = jnp.asarray(np.sort(rng.integers(0, m, size=1 << 16)).astype(np.int32))
+        f = rep(
+            lambda i, idx, o: jnp.searchsorted(
+                o, (idx + i) % m, side="right"
+            ).astype(jnp.uint32)
+        )
+        bench(f"searchsorted M={m} into 64k", f, idx, offs, elems=m)
+
+    # bookkeeping at N: any-bit + degree-sum + cumsum-compact
+    deg = jnp.asarray(rng.integers(1, 64, size=N + 1, dtype=np.int32))
+    plane = jnp.asarray(rng.integers(0, 2, size=(N, W), dtype=np.uint32))
+
+    def bookkeeping(i, p, d):
+        active = (p != 0).any(axis=1)
+        edges = jnp.where(active, d[:N], 0).sum()
+        on = active.astype(jnp.int32)
+        pos = jnp.cumsum(on) - on
+        ids = (
+            jnp.full((1 << 16,), N, jnp.int32)
+            .at[jnp.where(active, pos, 1 << 16)]
+            .set(jnp.arange(N, dtype=jnp.int32), mode="drop")
+        )
+        return ids.astype(jnp.uint32) + edges.astype(jnp.uint32) + i
+
+    f = rep(bookkeeping)
+    bench(f"bookkeeping at N={N} (any+sum+compact)", f, plane, deg, elems=N)
+
+
+if __name__ == "__main__":
+    main()

@@ -177,13 +177,18 @@ def config3(scale=22):
     }
 
 
-def config4(scale=18):
-    """High-diameter road-network stand-in: a 2^(scale/2) square grid.
+def config4(scale=20, kind="road"):
+    """High-diameter road-network distance-to-set (BASELINE config 4).
+
+    ``kind="road"``: the USA-road-d-calibrated synthetic road network
+    (models.generators.road_edges — the real dataset is unreachable from
+    this sandbox; `gen_cli --convert` ingests it on hosts that have it).
+    ``kind="grid"`` keeps the round-1 512x512 plain-grid workload for
+    comparability with earlier rounds.
 
     Runs the frontier-compacted push engine (level-synchronous pull engines
-    are O(D*E) with D in the thousands here).  The prefix-sum frontier
-    compaction (ops/push.py compact_indices) compiles on every backend, so
-    this config runs wherever the harness does — TPU included.
+    are O(D*E) with D in the thousands here) with auto-sized capacity; the
+    prefix-sum compaction compiles on every backend, TPU included.
     """
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
         generators,
@@ -200,16 +205,21 @@ def config4(scale=18):
     )
 
     side = 1 << (scale // 2)
-    n, edges = generators.grid_edges(side, side)
+    if kind == "road":
+        n, edges = generators.road_edges(side, side, seed=46)
+        name = f"synthetic-road {side}x{side} (USA-road-d calibrated)"
+    else:
+        n, edges = generators.grid_edges(side, side)
+        name = f"{side}x{side} grid (diam ~{2 * side})"
     g = CSRGraph.from_edges(n, edges)
     queries = pad_queries(
         generators.random_queries(n, 16, max_group=8, seed=44), pad_to=8
     )
-    engine = PushEngine(PaddedAdjacency.from_host(g), capacity=1 << 16)
+    engine = PushEngine(PaddedAdjacency.from_host(g))  # auto capacity
     r = _run(engine, queries, g.num_directed_edges)
     return {
         "config": 4,
-        "workload": f"{side}x{side} grid (diam ~{2 * side}), 16 groups, push engine",
+        "workload": f"{name}, 16 groups, push engine",
         **r,
     }
 
@@ -263,7 +273,7 @@ def config5(scale=20):
 
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
 # Default RMAT scale per config, cappable with --scale-cap (RAM-limited hosts).
-SCALES = {2: 20, 3: 22, 4: 18, 5: 20}
+SCALES = {2: 20, 3: 22, 4: 20, 5: 20}
 
 
 

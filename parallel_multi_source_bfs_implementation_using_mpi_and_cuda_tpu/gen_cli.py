@@ -9,7 +9,14 @@ fills that gap so a user can produce workloads end to end:
         --queries 64 --max-group 64 --query-file q.bin --seed 42
 
 Kinds: ``rmat`` (power-law, Graph500-style), ``grid`` (side x side
-road-network stand-in), ``gnm`` (uniform random).
+4-neighbor lattice), ``road`` (calibrated road-network stand-in: sparse
+irregular grid + diagonals + regional shortcuts, see
+models.generators.road_edges), ``gnm`` (uniform random).
+
+Real datasets: ``--convert <file>`` ingests a public graph instead of
+generating one — DIMACS ``.gr`` (USA-road-d family, ``--informat dimacs``)
+or SNAP whitespace edge lists (``--informat snap``), .gz transparently —
+and writes it in the reference binary format.
 """
 
 from __future__ import annotations
@@ -20,8 +27,20 @@ import sys
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--kind", choices=("rmat", "grid", "gnm"), default="rmat")
-    ap.add_argument("--scale", type=int, default=16, help="log2(n) for rmat; grid side = 2^(scale/2)")
+    ap.add_argument("--kind", choices=("rmat", "grid", "road", "gnm"), default="rmat")
+    ap.add_argument(
+        "--convert",
+        default=None,
+        metavar="FILE",
+        help="convert a real dataset instead of generating (--informat)",
+    )
+    ap.add_argument(
+        "--informat",
+        choices=("dimacs", "snap"),
+        default="dimacs",
+        help="--convert input format: DIMACS .gr or SNAP edge list",
+    )
+    ap.add_argument("--scale", type=int, default=16, help="log2(n) for rmat; grid/road side = 2^(scale/2)")
     ap.add_argument("--edge-factor", type=int, default=16, help="edges per vertex (rmat/gnm)")
     ap.add_argument("--graph", required=True, help="output graph .bin path")
     ap.add_argument("--queries", type=int, default=0, help="number of query groups (0: no query file)")
@@ -46,15 +65,44 @@ def main(argv=None) -> int:
         return 2
 
     from .models import generators
-    from .utils.io import save_graph_bin, save_query_bin
+    from .utils.io import (
+        load_dimacs_gr,
+        load_edgelist,
+        save_graph_bin,
+        save_query_bin,
+    )
 
-    if args.kind == "rmat":
+    if args.convert:
+        defaults = {"kind": "rmat", "scale": 16, "edge_factor": 16}
+        ignored = [
+            f"--{k.replace('_', '-')}"
+            for k, d in defaults.items()
+            if getattr(args, k) != d
+        ]
+        if ignored:
+            print(
+                f"--convert takes the graph from {args.convert}; "
+                f"ignoring generation flags: {', '.join(ignored)}",
+                file=sys.stderr,
+            )
+        try:
+            if args.informat == "dimacs":
+                n, edges = load_dimacs_gr(args.convert)
+            else:
+                n, edges = load_edgelist(args.convert)
+        except (IOError, OSError, ValueError) as exc:
+            print(f"convert failed: {exc}", file=sys.stderr)
+            return 1
+    elif args.kind == "rmat":
         n, edges = generators.rmat_edges(
             args.scale, edge_factor=args.edge_factor, seed=args.seed
         )
     elif args.kind == "grid":
         side = 1 << (args.scale // 2)
         n, edges = generators.grid_edges(side, side)
+    elif args.kind == "road":
+        side = 1 << (args.scale // 2)
+        n, edges = generators.road_edges(side, side, seed=args.seed)
     else:
         n = 1 << args.scale
         n, edges = generators.gnm_edges(

@@ -62,6 +62,62 @@ def grid_edges(rows: int, cols: int) -> Tuple[int, np.ndarray]:
     return rows * cols, edges
 
 
+def road_edges(
+    rows: int,
+    cols: int,
+    seed: int = 0,
+    keep: float = 0.55,
+    diag: float = 0.06,
+    shortcut_frac: float = 0.0005,
+    shortcut_reach: int = 0,
+) -> Tuple[int, np.ndarray]:
+    """Synthetic road network calibrated to the DIMACS USA-road-d family
+    (the real dataset is unavailable in this sandbox — zero egress; this is
+    the documented stand-in BASELINE.md config 4 uses).
+
+    Construction and calibration targets:
+
+    * 4-neighbor grid with each edge kept with probability ``keep`` —
+      irregular connectivity and dead ends like a real street network;
+    * diagonal (down-right / down-left) links with probability ``diag`` —
+      non-gridlike junctions;
+    * ``shortcut_frac * n`` medium-range links (highway segments), each
+      connecting a node to one <= ``shortcut_reach`` (default side/8) grid
+      steps away in each axis: shortens paths regionally WITHOUT the
+      global small-world collapse uniform random pairs would cause;
+    * defaults give mean undirected degree 2 * (2*keep + 2*diag) ~ 2.44 —
+      USA-road-d's 58.3M arcs / 23.9M nodes — and diameter Theta(rows+cols)
+      like the real network's ~8000-hop diameter at its scale.
+
+    Returns (n, edges) in the reference loader's convention (each line one
+    undirected edge, doubled by the CSR build, main.cu:106-116).
+    """
+    rng = np.random.default_rng(seed)
+    n = rows * cols
+    idx = np.arange(n, dtype=np.int32).reshape(rows, cols)
+    parts = []
+    right = np.stack([idx[:, :-1].ravel(), idx[:, 1:].ravel()], axis=1)
+    parts.append(right[rng.random(len(right)) < keep])
+    down = np.stack([idx[:-1, :].ravel(), idx[1:, :].ravel()], axis=1)
+    parts.append(down[rng.random(len(down)) < keep])
+    dr = np.stack([idx[:-1, :-1].ravel(), idx[1:, 1:].ravel()], axis=1)
+    parts.append(dr[rng.random(len(dr)) < diag])
+    dl = np.stack([idx[:-1, 1:].ravel(), idx[1:, :-1].ravel()], axis=1)
+    parts.append(dl[rng.random(len(dl)) < diag])
+    k = int(n * shortcut_frac)
+    if k:
+        reach = shortcut_reach or max(2, min(rows, cols) // 8)
+        r0 = rng.integers(0, rows, size=k)
+        c0 = rng.integers(0, cols, size=k)
+        r1 = np.clip(r0 + rng.integers(-reach, reach + 1, size=k), 0, rows - 1)
+        c1 = np.clip(c0 + rng.integers(-reach, reach + 1, size=k), 0, cols - 1)
+        parts.append(
+            np.stack([idx[r0, c0], idx[r1, c1]], axis=1).astype(np.int32)
+        )
+    edges = np.concatenate(parts, axis=0).astype(np.int32)
+    return n, edges
+
+
 def gnm_edges(n: int, m: int, seed: int = 0) -> Tuple[int, np.ndarray]:
     """Uniform G(n, m) multigraph (duplicates and self-loops possible)."""
     rng = np.random.default_rng(seed)

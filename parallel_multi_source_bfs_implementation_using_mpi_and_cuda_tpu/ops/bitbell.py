@@ -193,6 +193,7 @@ class BitBellEngine(PackedEngineBase):
     def __init__(self, graph: BellGraph, max_levels: Optional[int] = None):
         self.graph = graph
         self.max_levels = max_levels
+        self._level_warm_shapes = set()  # level_stats warms once per shape
 
     def f_values(self, queries) -> jax.Array:
         queries, k = self._pad_queries(queries)
@@ -223,15 +224,17 @@ class BitBellEngine(PackedEngineBase):
 
         queries, k = self._pad_queries(queries)
         pack = partial(_pack_queries_jit, self.graph.n)
-        # Warm both programs first so the timed rows measure execution, not
-        # XLA compilation.  compile(warm_levels=True) routes here, putting
-        # these compiles in the CLI's preprocessing span; a direct caller
-        # pays them before its first timed row either way.  (An empty dummy
-        # can't warm the step program — the loop would never execute one.)
-        warm_frontier = pack(queries)
-        jax.block_until_ready(
-            bitbell_step(self.graph, warm_frontier, warm_frontier)
-        )
+        # Warm both programs ONCE PER SHAPE so the timed rows measure
+        # execution, not XLA compilation.  compile(warm_levels=True) routes
+        # here, putting these compiles in the CLI's preprocessing span; a
+        # direct caller pays them before its first timed row either way.
+        # (An empty dummy can't warm the step program — the loop would
+        # never execute one.)  The warm executes one real level, so repeat
+        # calls at a warmed shape skip it entirely.
+        if queries.shape not in self._level_warm_shapes:
+            warm_frontier = pack(queries)
+            np.asarray(bitbell_step(self.graph, warm_frontier, warm_frontier)[2])
+            self._level_warm_shapes.add(queries.shape)
         t0 = time.perf_counter()
         frontier = pack(queries)
         counts = np.asarray(unpack_counts(frontier))

@@ -19,17 +19,25 @@ VERTEX_AXIS = "v"
 
 
 def initialize_distributed(**kwargs) -> None:
-    """Multi-host bring-up (the analog of MPI_Init, main.cu:197).
+    """Multi-host bring-up (the analog of MPI_Init, main.cu:197-201).
 
-    On a single host this is a no-op; on a multi-host TPU slice pass
-    coordinator_address/num_processes/process_id or rely on the TPU
-    environment's auto-detection.
+    With explicit arguments (coordinator_address/num_processes/process_id)
+    the caller is asking for a cluster: genuine bring-up failures (bad
+    address, coordinator unreachable, rank mismatch) PROPAGATE — the
+    reference's MPI_Init would abort there too.  Only double initialization
+    is forgiven, so the call is idempotent.
+
+    With no arguments this is best-effort auto-detection: absence of a
+    cluster environment is the normal single-process case, not an error.
     """
+    if jax.distributed.is_initialized():
+        return  # idempotent: second init is a no-op, not a failure
     try:
         jax.distributed.initialize(**kwargs)
     except (RuntimeError, ValueError):
-        # Already initialized or single-process environment.
-        pass
+        if not kwargs:
+            return  # auto-detect found no cluster: single-process mode
+        raise
 
 
 def make_mesh(

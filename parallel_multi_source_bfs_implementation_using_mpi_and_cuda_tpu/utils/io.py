@@ -104,6 +104,103 @@ def save_query_bin(path: str | os.PathLike, queries: Sequence[Sequence[int]]) ->
             q.tofile(f)
 
 
+def _open_text(path: str | os.PathLike):
+    """Open a text dataset, transparently decompressing .gz files."""
+    if os.fspath(path).endswith(".gz"):
+        import gzip
+
+        return gzip.open(path, "rt")
+    return open(path, "r")
+
+
+def _canonical_undirected(edges: np.ndarray) -> np.ndarray:
+    """Arc list -> unique undirected edge list (u <= v).
+
+    Public datasets list both directions of every road segment (DIMACS
+    .gr) or mix conventions (SNAP); the reference format stores each
+    undirected edge ONCE and doubles it at load (main.cu:106-116), so
+    converting arcs verbatim would double every adjacency.  Dropping
+    duplicate arcs cannot change BFS distances or F(U) — the per-level hit
+    is a set predicate (see BellGraph.from_host on dedup).
+    """
+    lo = np.minimum(edges[:, 0], edges[:, 1])
+    hi = np.maximum(edges[:, 0], edges[:, 1])
+    return np.unique(np.stack([lo, hi], axis=1), axis=0)
+
+
+def load_dimacs_gr(path: str | os.PathLike):
+    """Parse a DIMACS shortest-path ``.gr`` file (USA-road-d family) into
+    (n, edges) for :func:`save_graph_bin`.
+
+    Format: comment lines ``c ...``, one ``p sp <n> <m>`` header, and arc
+    lines ``a <u> <v> <w>`` with 1-based endpoints; weights are dropped
+    (the objective is hop-distance, reference main.cu:30-32).  Arcs are
+    canonicalized to unique undirected edges.
+    """
+    n = None
+    us: List[np.ndarray] = []
+    vs: List[np.ndarray] = []
+    chunk_u: List[int] = []
+    chunk_v: List[int] = []
+    with _open_text(path) as f:
+        for line in f:
+            if line.startswith("a "):
+                _, u, v, *_ = line.split()
+                chunk_u.append(int(u))
+                chunk_v.append(int(v))
+                if len(chunk_u) >= 1 << 20:
+                    # int32 buffers: ids fit (the reference format is
+                    # int32, main.cu:102), and USA-road-d's 58M arcs would
+                    # double peak RAM in int64; out-of-range python ints
+                    # raise OverflowError here (fail loud, never wrap).
+                    us.append(np.asarray(chunk_u, dtype=np.int32))
+                    vs.append(np.asarray(chunk_v, dtype=np.int32))
+                    chunk_u, chunk_v = [], []
+            elif line.startswith("p "):
+                parts = line.split()
+                n = int(parts[2])
+    if n is None:
+        raise ValueError(f"{path}: no 'p sp <n> <m>' header line")
+    us.append(np.asarray(chunk_u, dtype=np.int32))
+    vs.append(np.asarray(chunk_v, dtype=np.int32))
+    arcs = np.stack([np.concatenate(us), np.concatenate(vs)], axis=1) - 1
+    if arcs.size and (arcs.min() < 0 or arcs.max() >= n):
+        raise ValueError(f"{path}: arc endpoint outside 1..{n}")
+    return n, _canonical_undirected(arcs)
+
+
+def load_edgelist(path: str | os.PathLike):
+    """Parse a SNAP-style whitespace edge list (``# comments``, one
+    ``u v`` pair per line, 0-based ids) into (n, edges).
+
+    n = max id + 1; pairs are canonicalized to unique undirected edges
+    (SNAP files mix one-per-edge and both-directions conventions).
+    """
+    us: List[np.ndarray] = []
+    chunk: List[int] = []
+    with _open_text(path) as f:
+        for line in f:
+            if line.startswith(("#", "%")) or not line.strip():
+                continue
+            u, v, *_ = line.split()
+            chunk.append(int(u))
+            chunk.append(int(v))
+            if len(chunk) >= 1 << 21:
+                # int32 (see load_dimacs_gr): halves peak RAM on the big
+                # public datasets; ids beyond int32 raise OverflowError.
+                us.append(np.asarray(chunk, dtype=np.int32))
+                chunk = []
+    us.append(np.asarray(chunk, dtype=np.int32))
+    flat = np.concatenate(us)
+    if flat.size == 0:
+        raise ValueError(f"{path}: no edges found")
+    pairs = flat.reshape(-1, 2)
+    if pairs.min() < 0:
+        raise ValueError(f"{path}: negative vertex id")
+    n = int(pairs.max()) + 1
+    return n, _canonical_undirected(pairs)
+
+
 def pad_queries(
     queries: Sequence[Sequence[int]], pad_to: Optional[int] = None
 ) -> np.ndarray:

@@ -1,0 +1,81 @@
+"""Multi-process worker for test_multiprocess.py.
+
+Each OS process contributes 2 virtual CPU devices to a 2-process / 4-device
+cluster (the TPU-native analog of one `mpirun -np 2` rank, reference
+main.cu:197-201), builds the same graph/queries from shared seeds, runs
+DistributedEngine over the GLOBAL mesh, and prints the (minF, minK) result
+as JSON.  The parent asserts both processes print the single-process
+answer.
+
+Usage: python mp_worker.py <coordinator_address> <num_processes> <process_id>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    import jax
+
+    # Bring the cluster up BEFORE importing the package: package imports may
+    # touch the backend, and jax.distributed.initialize must come first.
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nproc, process_id=pid
+    )
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
+        DistributedEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        initialize_distributed,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        pad_queries,
+    )
+
+    # Idempotence of the library entry point (second init must be a no-op).
+    initialize_distributed(
+        coordinator_address=coord, num_processes=nproc, process_id=pid
+    )
+    assert jax.process_count() == nproc, jax.process_count()
+    devices = jax.devices()  # global: nproc * local_device_count
+
+    n, edges = generators.gnm_edges(120, 400, seed=821)
+    g = CSRGraph.from_edges(n, edges)
+    queries = pad_queries(generators.random_queries(n, 10, max_group=5, seed=822))
+
+    mesh = make_mesh(num_query_shards=len(devices), devices=devices)
+    engine = DistributedEngine(mesh, g)
+    min_f, min_k = engine.best(queries)
+    print(
+        json.dumps(
+            {
+                "process_id": pid,
+                "process_count": jax.process_count(),
+                "global_devices": len(devices),
+                "local_devices": jax.local_device_count(),
+                "min_f": int(min_f),
+                "min_k": int(min_k),
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

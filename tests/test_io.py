@@ -117,3 +117,139 @@ def test_from_edges_matches_oracle_insertion_order():
     ro, ci = oracle_csr(n, edges)
     np.testing.assert_array_equal(g.row_offsets, ro)
     np.testing.assert_array_equal(g.col_indices, ci)
+
+
+def test_load_dimacs_gr(tmp_path):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_dimacs_gr,
+    )
+
+    p = tmp_path / "tiny.gr"
+    p.write_text(
+        "c USA-road-d style fixture\n"
+        "p sp 5 8\n"
+        "a 1 2 40\n"
+        "a 2 1 40\n"   # reverse arc: must collapse with the forward one
+        "a 2 3 9\n"
+        "a 3 2 9\n"
+        "a 4 5 1\n"
+        "a 5 4 1\n"
+        "a 1 5 7\n"
+        "a 5 1 7\n"
+    )
+    n, edges = load_dimacs_gr(p)
+    assert n == 5
+    # 0-based, canonical (u <= v), unique
+    assert edges.tolist() == [[0, 1], [0, 4], [1, 2], [3, 4]]
+
+
+def test_load_dimacs_gr_gz_roundtrip(tmp_path):
+    import gzip
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_dimacs_gr,
+        load_graph_bin,
+        save_graph_bin,
+    )
+
+    p = tmp_path / "tiny.gr.gz"
+    with gzip.open(p, "wt") as f:
+        f.write("p sp 3 2\na 1 2 5\na 2 3 5\n")
+    n, edges = load_dimacs_gr(p)
+    out = tmp_path / "g.bin"
+    save_graph_bin(out, n, edges)
+    g = load_graph_bin(out)
+    assert g.n == 3 and g.num_directed_edges == 4  # 2 undirected, doubled
+
+
+def test_load_dimacs_gr_errors(tmp_path):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_dimacs_gr,
+    )
+
+    p = tmp_path / "bad.gr"
+    p.write_text("a 1 2 3\n")  # no p header
+    with pytest.raises(ValueError, match="header"):
+        load_dimacs_gr(p)
+    p.write_text("p sp 2 1\na 1 9 4\n")  # endpoint out of range
+    with pytest.raises(ValueError, match="outside"):
+        load_dimacs_gr(p)
+
+
+def test_load_edgelist(tmp_path):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_edgelist,
+    )
+
+    p = tmp_path / "snap.txt"
+    p.write_text(
+        "# Directed graph: fixture\n"
+        "# FromNodeId ToNodeId\n"
+        "0\t3\n"
+        "3 0\n"       # mixed separators + reverse duplicate
+        "\n"
+        "2 2\n"       # self loop survives (stored once)
+        "1 3\n"
+    )
+    n, edges = load_edgelist(p)
+    assert n == 4
+    assert edges.tolist() == [[0, 3], [1, 3], [2, 2]]
+
+
+def test_convert_cli_end_to_end(tmp_path, capsys):
+    """DIMACS file -> gen_cli --convert -> main.py CLI answer == oracle."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+        main as cli_main,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.gen_cli import (
+        main as gen_main,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_query_bin,
+    )
+
+    from oracle import oracle_best, oracle_bfs, oracle_f
+
+    gr = tmp_path / "road.gr"
+    lines = ["p sp 6 10\n"]
+    arcs = [(1, 2), (2, 3), (3, 4), (4, 5), (5, 6)]
+    for u, v in arcs:
+        lines.append(f"a {u} {v} 1\n")
+        lines.append(f"a {v} {u} 1\n")
+    gr.write_text("".join(lines))
+    gbin, qbin = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
+    rc = gen_main(["--convert", str(gr), "--informat", "dimacs", "--graph", gbin])
+    assert rc == 0
+    queries = [[0], [2, 5], [1]]
+    save_query_bin(qbin, queries)
+    rc = cli_main(["main.py", "-g", gbin, "-q", qbin, "-gn", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    edges = np.asarray([(u - 1, v - 1) for u, v in arcs], dtype=np.int64)
+    want_f, want_k = oracle_best(
+        [oracle_f(oracle_bfs(6, edges, np.asarray(q))) for q in queries]
+    )
+    assert f"Query number (k) with minimum F value: {want_k + 1}" in out
+    assert f"Minimum F value: {want_f}" in out
+
+
+def test_road_edges_statistics():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+
+    n, edges = generators.road_edges(64, 64, seed=7)
+    assert n == 64 * 64
+    assert edges.min() >= 0 and edges.max() < n
+    # Calibration: mean undirected degree ~2.44 (USA-road-d), high diameter.
+    mean_deg = 2 * len(edges) / n
+    assert 2.0 < mean_deg < 3.0, mean_deg
+    # Determinism
+    n2, edges2 = generators.road_edges(64, 64, seed=7)
+    np.testing.assert_array_equal(edges, edges2)
+    # High diameter: BFS from corner on the giant component must need far
+    # more levels than an RMAT graph of this size would (~6).
+    from oracle import oracle_bfs
+
+    dist = oracle_bfs(n, edges.astype(np.int64), np.asarray([0]))
+    assert dist.max() > 40

@@ -1,0 +1,107 @@
+"""Real multi-process bring-up: the mpirun analog, actually executed.
+
+The reference runs W OS processes joined by MPI_Init over a network
+(main.cu:197-201); its collectives then move graph/query/result data
+between them (main.cu:242-368).  The TPU-native analog is
+``jax.distributed.initialize`` + a global mesh whose devices span
+processes, with XLA inserting the collectives.  This test launches TWO
+actual OS processes (each holding 2 virtual CPU devices), runs
+DistributedEngine over the resulting 4-device global mesh, and asserts
+both processes independently report the single-process answer — the
+replicated result array IS the broadcast the reference does by hand.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from virtual_cpu import virtual_cpu_env  # noqa: E402
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (  # noqa: E402
+    generators,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f  # noqa: E402
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cluster_matches_single_process():
+    nproc, local_devices = 2, 2
+    port = _free_port()
+    env = virtual_cpu_env(local_devices)
+    worker = os.path.join(REPO, "tests", "mp_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, f"127.0.0.1:{port}", str(nproc), str(pid)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=REPO,
+        )
+        for pid in range(nproc)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process worker timed out")
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    # Same seeds as mp_worker.py: independent single-process oracle answer.
+    n, edges = generators.gnm_edges(120, 400, seed=821)
+    queries = generators.random_queries(n, 10, max_group=5, seed=822)
+    want_f, want_k = oracle_best(
+        [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    )
+
+    for r in outs:
+        assert r["process_count"] == nproc
+        assert r["global_devices"] == nproc * local_devices
+        assert r["local_devices"] == local_devices
+        assert (r["min_f"], r["min_k"]) == (want_f, want_k), r
+    assert outs[0]["process_id"] != outs[1]["process_id"]
+
+
+def test_initialize_distributed_propagates_bad_cluster():
+    """Explicit-arg bring-up failures must NOT be swallowed (VERDICT: the
+    old try/except hid genuine errors).  Run in a subprocess: a failed
+    jax.distributed.initialize must not poison this test process."""
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu."
+        "parallel.mesh import initialize_distributed\n"
+        "try:\n"
+        "    initialize_distributed(coordinator_address='127.0.0.1:1',"
+        " num_processes=2, process_id=1, initialization_timeout=5)\n"
+        "except Exception as e:\n"
+        "    print('RAISED', type(e).__name__); sys.exit(0)\n"
+        "sys.exit(1)  # swallowed a genuine bring-up failure\n" % REPO
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=virtual_cpu_env(2),
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "RAISED" in proc.stdout

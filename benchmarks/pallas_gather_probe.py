@@ -1,0 +1,189 @@
+"""Probe: which frontier-gather formulations does Mosaic actually lower?
+
+VERDICT item 4 asks for either a working Pallas frontier kernel or a
+committed experiment log of what Mosaic rejects.  This script attempts
+each candidate formulation in a REAL (non-interpreted) pallas_call on the
+TPU and records lower/execute/reject per formulation, plus throughput for
+the ones that run.  Output is committed to docs/PALLAS_LOG.md.
+
+Formulations:
+  A. arbitrary-index VMEM gather: jnp.take(frontier (n,), cols (w, t)) —
+     the op the ELL kernel wants (ops/pallas_bfs.py).
+  B. lane-batched take_along_axis: vals[s, l] = plane[idx[s, l], l] —
+     the gather PERF_NOTES says Mosaic supports (same-lane lookups).
+  C. B at uint32 (bit-plane words instead of bytes).
+  D. one-hot dot-product gather (MXU): onehot(idx) @ plane — always
+     lowers (it is a matmul) but costs O(rows * n/128) FLOPs.
+"""
+
+import os
+import sys
+import time
+import traceback
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+M_ROWS = 1 << 13  # operand sublane extent (n/128 for n=1M)
+S_ROWS = 1 << 12  # gather rows per call
+
+
+def probe(name, build):
+    import jax
+
+    print(f"--- {name}")
+    try:
+        fn, args = build()
+        out = fn(*args)
+        np.asarray(out)  # force execution through the tunnel
+    except Exception as exc:  # noqa: BLE001 - we are cataloguing failures
+        msg = "".join(
+            traceback.format_exception_only(type(exc), exc)
+        ).strip()
+        print(f"REJECTED: {msg[:600]}")
+        return None
+    ts = []
+    for i in range(3):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    t = min(ts)
+    print(f"OK: {t*1e3:.3f} ms/call")
+    return t
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.xla_cache import (
+        configure_compilation_cache,
+    )
+
+    configure_compilation_cache()
+    print(f"device={jax.devices()[0]} jax={jax.__version__}")
+    rng = np.random.default_rng(0)
+
+    plane8 = jnp.asarray(
+        rng.integers(0, 2, size=(M_ROWS, 128), dtype=np.uint8)
+    )
+    plane32 = jnp.asarray(
+        rng.integers(0, 1 << 31, size=(M_ROWS, 128), dtype=np.uint32)
+    )
+    idx = jnp.asarray(
+        rng.integers(0, M_ROWS, size=(S_ROWS, 128), dtype=np.int32)
+    )
+    flat = jnp.asarray(
+        rng.integers(0, 2, size=(M_ROWS * 128,), dtype=np.uint8)
+    )
+    cols = jnp.asarray(
+        rng.integers(0, M_ROWS * 128, size=(8, S_ROWS), dtype=np.int32)
+    )
+
+    # A: arbitrary-index gather from a flat VMEM frontier
+    def build_a():
+        def kernel(f_ref, c_ref, o_ref):
+            o_ref[:] = jnp.max(jnp.take(f_ref[:], c_ref[:], axis=0), axis=0)
+
+        fn = jax.jit(
+            lambda f, c: pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((S_ROWS,), jnp.uint8),
+            )(f, c)
+        )
+        return fn, (flat, cols)
+
+    probe("A: arbitrary jnp.take (flat frontier)", build_a)
+
+    # B: lane-batched take_along_axis, uint8
+    def build_b():
+        def kernel(p_ref, i_ref, o_ref):
+            o_ref[:] = jnp.take_along_axis(p_ref[:], i_ref[:], axis=0)
+
+        fn = jax.jit(
+            lambda p, i: pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((S_ROWS, 128), jnp.uint8),
+            )(p, i)
+        )
+        return fn, (plane8, idx)
+
+    t_b = probe("B: lane-batched take_along_axis u8", build_b)
+    if t_b:
+        print(f"   = {S_ROWS*128/t_b/1e6:.0f} M lookups/s")
+
+    # C: lane-batched take_along_axis, uint32 words
+    def build_c():
+        def kernel(p_ref, i_ref, o_ref):
+            o_ref[:] = jnp.take_along_axis(p_ref[:], i_ref[:], axis=0)
+
+        fn = jax.jit(
+            lambda p, i: pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((S_ROWS, 128), jnp.uint32),
+            )(p, i)
+        )
+        return fn, (plane32, idx)
+
+    t_c = probe("C: lane-batched take_along_axis u32", build_c)
+    if t_c:
+        print(f"   = {S_ROWS*128/t_c/1e6:.0f} M lookups/s")
+
+    # D: one-hot MXU gather (rows of plane32 selected by idx[:, 0])
+    def build_d():
+        def kernel(p_ref, i_ref, o_ref):
+            rows = i_ref[:]  # (S_ROWS, 128) int32; use lane 0's index per row
+            onehot = (
+                jax.lax.broadcasted_iota(jnp.int32, (S_ROWS, M_ROWS), 1)
+                == rows[:, 0:1]
+            ).astype(jnp.bfloat16)
+            o_ref[:] = jax.lax.dot_general(
+                onehot,
+                p_ref[:].astype(jnp.bfloat16),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.uint32)
+
+        fn = jax.jit(
+            lambda p, i: pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((S_ROWS, 128), jnp.uint32),
+            )(p, i)
+        )
+        return fn, (plane32, idx)
+
+    t_d = probe("D: one-hot MXU row gather", build_d)
+    if t_d:
+        print(f"   = {S_ROWS/t_d/1e6:.2f} M rows/s (FLOP-bound)")
+
+    # XLA reference: the same lane-batched gather outside Pallas
+    fn = jax.jit(lambda p, i: jnp.take_along_axis(p, i, axis=0))
+    np.asarray(fn(plane32, idx))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(fn(plane32, idx))
+        ts.append(time.perf_counter() - t0)
+    print(
+        f"--- XLA take_along_axis u32 (no pallas): {min(ts)*1e3:.3f} ms "
+        f"= {S_ROWS*128/min(ts)/1e6:.0f} M lookups/s"
+    )
+
+    # XLA reference: arbitrary row gather at the same volume
+    fn = jax.jit(lambda f, c: jnp.max(jnp.take(f, c, axis=0), axis=0))
+    np.asarray(fn(flat, cols))
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(fn(flat, cols))
+        ts.append(time.perf_counter() - t0)
+    print(
+        f"--- XLA arbitrary take (no pallas): {min(ts)*1e3:.3f} ms "
+        f"= {8*S_ROWS/min(ts)/1e6:.0f} M lookups/s"
+    )
+
+
+if __name__ == "__main__":
+    main()

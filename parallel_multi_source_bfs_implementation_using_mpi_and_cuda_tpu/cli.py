@@ -105,13 +105,48 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 1
         padded = pad_queries(queries)
         n_chips = max(1, min(num_gpu, len(jax.devices())))
+        # HBM routing: estimate the default engine's footprint and compare
+        # to the per-chip budget.  A graph beyond one chip auto-routes to
+        # the vertex-sharded engine (multi-chip) or warns (single chip) —
+        # the int32/HBM guard is a routing decision, not an error.
+        from .models.bell import BellGraph
+        from .utils.platform import device_hbm_bytes
+
+        hbm_need = BellGraph.estimate_hbm_bytes(
+            graph.n, graph.num_directed_edges, max(32, padded.shape[0])
+        )
+        hbm_have = device_hbm_bytes()
         if n_chips > 1:
             # MSBFS_VSHARD=v splits the CSR over a 'v' mesh axis of that
             # size (vertex sharding for graphs beyond one chip's HBM —
             # beyond-reference capability, parallel/sharded_bell.py);
             # remaining chips shard queries.  Default: all chips on 'q',
-            # graph replicated (the reference's model, main.cu:242-255).
-            vshard = _env_int("MSBFS_VSHARD", 1)
+            # graph replicated (the reference's model, main.cu:242-255) —
+            # unless the replicated footprint exceeds the chip budget, in
+            # which case the smallest sufficient vertex-shard count that
+            # divides the chips is chosen automatically.
+            vshard = _env_int("MSBFS_VSHARD", 0)
+            if vshard == 0:
+                vshard = 1
+                if hbm_need > hbm_have:
+                    k_est = max(32, padded.shape[0])
+                    for v in range(2, n_chips + 1):
+                        # Re-estimate per shard count: only edge-
+                        # proportional terms shrink (planes stay global).
+                        if n_chips % v == 0 and BellGraph.estimate_hbm_bytes(
+                            graph.n, graph.num_directed_edges, k_est, v
+                        ) <= hbm_have:
+                            vshard = v
+                            break
+                    else:
+                        vshard = n_chips
+                    print(
+                        f"graph needs ~{hbm_need >> 20} MiB"
+                        f" > {hbm_have >> 20} MiB/chip: auto-sharding the"
+                        f" CSR over {vshard} of {n_chips} chips"
+                        " (MSBFS_VSHARD overrides)",
+                        file=sys.stderr,
+                    )
             if vshard > 1 and n_chips % vshard != 0:
                 print(
                     f"MSBFS_VSHARD={vshard} does not divide {n_chips} chips;"
@@ -132,6 +167,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 mesh = default_mesh(max_devices=n_chips)
                 engine = DistributedEngine(mesh, graph)
         else:
+            if hbm_need > hbm_have:
+                print(
+                    f"warning: graph needs ~{hbm_need >> 20} MiB but one "
+                    f"chip has {hbm_have >> 20} MiB; run with -gn > 1 to "
+                    "auto-shard the CSR (this run may exhaust memory)",
+                    file=sys.stderr,
+                )
             # Backend selection (beyond-reference knob, env-controlled so the
             # argv contract stays reference-exact): "dense" runs frontier
             # expansion as a bf16 matmul on the MXU, worthwhile when the

@@ -90,7 +90,8 @@ def main(argv=None) -> int:
                 n, edges = load_dimacs_gr(args.convert)
             else:
                 n, edges = load_edgelist(args.convert)
-        except (IOError, OSError, ValueError) as exc:
+        except (IOError, OSError, ValueError, OverflowError) as exc:
+            # OverflowError: vertex id beyond int32 (loaders fail loud).
             print(f"convert failed: {exc}", file=sys.stderr)
             return 1
     elif args.kind == "rmat":

@@ -114,13 +114,50 @@ class BellGraph:
     all level outputs (+ trailing zero row) to yield per-vertex hits.
     """
 
-    def __init__(self, levels, final_slot, n, n_pad, level_sizes, fill):
+    def __init__(
+        self, levels, final_slot, n, n_pad, level_sizes, fill, sparse=None
+    ):
         self.levels = levels  # list[list[jax.Array (R_b, W_b) int32]]
         self.final_slot = final_slot  # (n,) int32 into concat of outputs
         self.n = int(n)
         self.n_pad = int(n_pad)
         self.level_sizes = tuple(level_sizes)  # rows per level (pre-concat)
         self.fill = float(fill)  # E / padded slot count (diagnostic)
+        # Optional dedup CSR (item_start (n,), item_count (n,), item_vals
+        # (E,), all int32): the push-side structure the hybrid engine's
+        # frontier-sparse levels scatter through (ops.bitbell.sparse
+        # expand).  None when not kept (e.g. sharded sub-layouts).
+        self.sparse = sparse
+
+    @staticmethod
+    def estimate_hbm_bytes(
+        n: int, e: int, k: int = 64, vertex_shards: int = 1
+    ) -> int:
+        """Worst-case PER-CHIP device-memory footprint of a bit-plane run
+        over this layout (measured structure on v5e; docs/PERF_NOTES.md
+        "HBM ceiling"):
+
+        * forest cols arrays: ~e/fill slots x 4 B (fill >= 0.7 floor);
+        * per-level gather intermediate: slots x ceil(k/32) words x 4 B
+          (XLA materializes the take before the OR-fold);
+        * hybrid dedup CSR: (e + 2n) x 4 B (single chip only — the
+          sharded engine is pull-only and skips it);
+        * bit planes (+ the hybrid's byte-lane scratch on one chip):
+          n x words x 16 B (+ n x k_pad B) — NOT divided by vertex
+          shards: every shard holds full global planes (the halo
+          all_gather reconstructs them each level, parallel/sharded_bell).
+
+        ``k`` is padded to the engine's word multiple.  Only the
+        edge-proportional terms shrink with ``vertex_shards``; used by the
+        CLI to route graphs that exceed one chip onto the vertex-sharded
+        engine instead of dying in an allocator error."""
+        k_pad = max(32, -(-k // 32) * 32)
+        w = k_pad // 32
+        slots = int(e / 0.7) + 1
+        per_shard_edges = (4 * slots + 4 * w * slots) // max(1, vertex_shards)
+        if vertex_shards > 1:
+            return per_shard_edges + 16 * w * n
+        return per_shard_edges + 4 * (e + 2 * n) + n * (16 * w + k_pad)
 
     @staticmethod
     def default_min_bucket_rows(n: int, e: int) -> int:
@@ -189,6 +226,7 @@ class BellGraph:
         widths: Sequence[int] = DEFAULT_WIDTHS,
         dedup: bool = True,
         min_bucket_rows: Optional[int] = None,
+        keep_sparse: bool = True,
     ) -> "BellGraph":
         """Build the layout.  ``dedup`` drops duplicate neighbors and
         self-loops per vertex: the per-level hit is a *set* predicate ("is
@@ -197,7 +235,11 @@ class BellGraph:
         stores duplicates verbatim, main.cu:114-115, and its kernel
         likewise just wastes the repeated reads, main.cu:26-35).  Self-loop
         removal is safe because a frontier vertex is already visited and
-        can never be newly reached by its own loop (main.cu:30-32)."""
+        can never be newly reached by its own loop (main.cu:30-32).
+
+        ``keep_sparse`` also uploads the dedup CSR itself (int32; skipped
+        when E >= 2^31), enabling the hybrid engine's frontier-sparse
+        levels; pass False to save the extra E+2n ints of HBM."""
         n = g.n
         e = int(g.num_directed_edges)
 
@@ -217,6 +259,13 @@ class BellGraph:
         )
 
         item_count_0 = item_count
+        sparse = None
+        if keep_sparse and n and item_vals.shape[0] < (1 << 31):
+            sparse = (
+                jnp.asarray(item_start.astype(np.int32)),
+                jnp.asarray(item_count.astype(np.int32)),
+                jnp.asarray(item_vals.astype(np.int32)),
+            )
         levels: List[List[np.ndarray]] = []
         level_sizes: List[int] = []
         padded_slots = 0
@@ -299,6 +348,7 @@ class BellGraph:
             # fill counts level-0 slots only in the numerator (items actually
             # gathered from the frontier, post-dedup) over all padded slots.
             fill=int(np.sum(item_count_0)) / max(padded_slots, 1),
+            sparse=sparse,
         )
 
     def expand_frontier(self, dist, level):
@@ -314,20 +364,26 @@ class BellGraph:
             self.n_pad,
             self.level_sizes,
             self.fill,
+            self.sparse is not None,
         )
-        return tuple(flat) + (self.final_slot,), aux
+        sparse = tuple(self.sparse) if self.sparse is not None else ()
+        return tuple(flat) + (self.final_slot,) + sparse, aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        counts, n, n_pad, level_sizes, fill = aux
+        counts, n, n_pad, level_sizes, fill, has_sparse = aux
         children = list(children)
+        sparse = None
+        if has_sparse:
+            sparse = tuple(children[-3:])
+            children = children[:-3]
         final_slot = children.pop()
         levels = []
         i = 0
         for c in counts:
             levels.append(children[i : i + c])
             i += c
-        return cls(levels, final_slot, n, n_pad, level_sizes, fill)
+        return cls(levels, final_slot, n, n_pad, level_sizes, fill, sparse)
 
     def __repr__(self):
         return (

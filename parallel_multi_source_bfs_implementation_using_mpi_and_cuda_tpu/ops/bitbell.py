@@ -34,6 +34,7 @@ from jax import lax
 from ..models.bell import BellGraph
 from .bell import forest_hits
 from .packed import PackedEngineBase
+from .push import compact_indices
 
 WORD_BITS = 32
 _SHIFTS = tuple(range(WORD_BITS))
@@ -89,6 +90,105 @@ def bell_hits_or(frontier: jax.Array, graph: BellGraph) -> jax.Array:
     fixed-width max replaced by OR over the packed word lanes.
     """
     return forest_hits(frontier, graph, lambda g: _or_fold(g, 1))
+
+
+def unpack_byte_planes(words: jax.Array) -> jax.Array:
+    """(m, W) uint32 bit planes -> (m, W*32) uint8 0/1 byte planes."""
+    m, w = words.shape
+    shifts = jnp.asarray(_SHIFTS, dtype=jnp.uint32)
+    bits = (words[:, :, None] >> shifts[None, None, :]) & jnp.uint32(1)
+    return bits.astype(jnp.uint8).reshape(m, w * WORD_BITS)
+
+
+def pack_byte_planes(bytes_: jax.Array) -> jax.Array:
+    """(m, K) uint8 0/1 byte planes -> (m, K/32) uint32 bit planes.
+
+    Sum over shifted disjoint bits == OR (no carries possible)."""
+    m, k = bytes_.shape
+    b = bytes_.reshape(m, k // WORD_BITS, WORD_BITS).astype(jnp.uint32)
+    shifts = jnp.asarray(_SHIFTS, dtype=jnp.uint32)
+    return (b << shifts[None, None, :]).sum(axis=2, dtype=jnp.uint32)
+
+
+def sparse_hits_or(
+    frontier: jax.Array, graph: BellGraph, budget: int
+) -> jax.Array:
+    """Frontier-sparse dual of :func:`bell_hits_or`: same (n, W) hit planes,
+    but via PUSH — enumerate the <= ``budget`` edges leaving the frontier
+    and scatter each source's query bits into its neighbors — instead of
+    gathering every padded slot of the reduction forest.
+
+    Correct only when the frontier has <= budget active vertices AND
+    <= budget outgoing dedup edges (the hybrid's `lax.cond` predicate);
+    cost is budget-proportional and independent of |E|, which is the whole
+    point: tail/head BFS levels with thin frontiers stop paying the full
+    O(slots) forest gather (measured v5e: a 2^17 budget step is ~10 ms vs
+    ~220 ms for the RMAT-20 forest pass; docs/PERF_NOTES.md).
+
+    The collision-safe scatter-OR: expand words to 0/1 BYTE lanes and use
+    ``.at[].max`` — elementwise max on bytes IS bitwise OR, and XLA's
+    scatter-max handles colliding rows (multiple frontier vertices sharing
+    a neighbor) exactly like the reference kernel's benign write race
+    (main.cu:30-33).  Word-level max would be WRONG (max(0b01,0b10) loses
+    bits); byte lanes make OR and max coincide.
+    """
+    n = graph.n
+    start, count, vals = graph.sparse
+    active = (frontier != jnp.uint32(0)).any(axis=1)  # (n,)
+    ids = compact_indices(active, budget, fill_value=n)  # (B,) ascending
+    valid_id = ids < n
+    safe_ids = jnp.minimum(ids, n - 1)
+    deg = jnp.where(valid_id, jnp.take(count, safe_ids), 0)
+    st = jnp.where(valid_id, jnp.take(start, safe_ids), 0)
+    pos = jnp.cumsum(deg) - deg  # exclusive: edge range start per owner
+    total = pos[-1] + deg[-1]
+    # Owner of edge slot j: scatter owner index i at pos[i] (distinct for
+    # deg>0 owners), then running max fills each owner's range.
+    own = (
+        jnp.zeros((budget,), jnp.int32)
+        .at[jnp.where(deg > 0, pos, budget)]
+        .max(jnp.arange(budget, dtype=jnp.int32), mode="drop")
+    )
+    own = lax.cummax(own)
+    j = jnp.arange(budget, dtype=jnp.int32)
+    within = j - jnp.take(pos, own)
+    valid_e = j < total
+    eidx = jnp.clip(jnp.take(st, own) + within, 0, vals.shape[0] - 1)
+    nbr = jnp.where(valid_e, jnp.take(vals, eidx), n)  # sentinel row n
+    src_words = jnp.where(
+        valid_id[:, None], jnp.take(frontier, safe_ids, axis=0), jnp.uint32(0)
+    )
+    src_bytes = unpack_byte_planes(src_words)  # (B, K) 0/1 bytes
+    rows = jnp.take(src_bytes, own, axis=0)  # (budget, K)
+    hit_bytes = (
+        jnp.zeros((n + 1, rows.shape[1]), jnp.uint8).at[nbr].max(rows)
+    )
+    return pack_byte_planes(hit_bytes[:n])
+
+
+def hybrid_expand(graph: BellGraph, budget: int):
+    """The hybrid pull/push expansion hook for :func:`bit_level_loop`:
+    per level, route thin frontiers (<= ``budget`` active vertices and
+    outgoing edges) through the push scatter and everything else through
+    the reduction-forest gather.  Exact same ``new`` planes either way —
+    only the cost model differs (the direction-optimization idea of
+    Beamer's BFS, recast for bit-plane multi-query TPU execution)."""
+    _, count, _ = graph.sparse
+
+    def expand(visited, frontier):
+        active = (frontier != jnp.uint32(0)).any(axis=1)
+        cnt = jnp.sum(active, dtype=jnp.int32)
+        edges = jnp.sum(jnp.where(active, count, 0), dtype=jnp.int32)
+        pred = (cnt <= budget) & (edges <= budget)
+        new = lax.cond(
+            pred,
+            lambda vf: sparse_hits_or(vf[1], graph, budget),
+            lambda vf: bell_hits_or(vf[1], graph),
+            (visited, frontier),
+        )
+        return new & ~visited
+
+    return expand
 
 
 def bit_level_loop(
@@ -165,18 +265,35 @@ def bitbell_step(
     return visited | new, new, unpack_counts(new)
 
 
-@partial(jax.jit, static_argnames=("max_levels",))
+def default_sparse_budget(e: int) -> int:
+    """Auto hybrid budget: ~E/256 edge slots (a sparse step then costs
+    <1/10 of a forest pass), floored so head/tail levels of small graphs
+    still qualify, capped so the fixed per-sparse-step cost stays far
+    below a forest pass even at RMAT-24 scale."""
+    return int(min(max(e // 256, 1 << 14), 1 << 20))
+
+
+@partial(jax.jit, static_argnames=("max_levels", "sparse_budget"))
 def bitbell_run(
     graph: BellGraph,
     queries: jax.Array,
     max_levels: Optional[int] = None,
+    sparse_budget: int = 0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """(K, S) queries (K % 32 == 0) -> per-query (f, levels, reached)."""
+    """(K, S) queries (K % 32 == 0) -> per-query (f, levels, reached).
+
+    ``sparse_budget`` > 0 (and a graph built with ``keep_sparse``) enables
+    the hybrid pull/push level loop (:func:`hybrid_expand`)."""
     frontier0 = pack_queries(graph.n, queries)
+    if sparse_budget and graph.sparse is not None:
+        expand_hits = hybrid_expand(graph, sparse_budget)
+    else:
+        def expand_hits(visited, frontier):
+            return bell_hits_or(frontier, graph) & ~visited
     return bit_level_loop(
         frontier0,
         unpack_counts(frontier0),
-        lambda visited, frontier: bell_hits_or(frontier, graph) & ~visited,
+        expand_hits,
         max_levels,
     )
 
@@ -186,23 +303,41 @@ class BitBellEngine(PackedEngineBase):
 
     Inherits the K-alignment padding from PackedEngineBase (k_align = 32
     here) but overrides query_stats: stats come from the loop's counters,
-    not from a distance matrix (none exists in this engine)."""
+    not from a distance matrix (none exists in this engine).
+
+    ``sparse_budget``: hybrid pull/push threshold (edge slots).  None
+    auto-sizes from the graph (:func:`default_sparse_budget`) when the
+    graph retains its dedup CSR; 0 disables the hybrid (pure forest
+    pulls, the round-1 behavior)."""
 
     k_align = WORD_BITS
 
-    def __init__(self, graph: BellGraph, max_levels: Optional[int] = None):
+    def __init__(
+        self,
+        graph: BellGraph,
+        max_levels: Optional[int] = None,
+        sparse_budget: Optional[int] = None,
+    ):
         self.graph = graph
         self.max_levels = max_levels
+        if sparse_budget is None:
+            e = graph.sparse[2].shape[0] if graph.sparse is not None else 0
+            sparse_budget = default_sparse_budget(e) if e else 0
+        self.sparse_budget = int(sparse_budget)
         self._level_warm_shapes = set()  # level_stats warms once per shape
 
     def f_values(self, queries) -> jax.Array:
         queries, k = self._pad_queries(queries)
-        f, _, _ = bitbell_run(self.graph, queries, self.max_levels)
+        f, _, _ = bitbell_run(
+            self.graph, queries, self.max_levels, self.sparse_budget
+        )
         return f[:k]
 
     def query_stats(self, queries):
         queries, k = self._pad_queries(queries)
-        f, levels, reached = bitbell_run(self.graph, queries, self.max_levels)
+        f, levels, reached = bitbell_run(
+            self.graph, queries, self.max_levels, self.sparse_budget
+        )
         return (
             np.asarray(levels)[:k],
             np.asarray(reached)[:k],

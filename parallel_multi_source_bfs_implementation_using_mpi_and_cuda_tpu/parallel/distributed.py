@@ -32,7 +32,10 @@ from .mesh import QUERY_AXIS, VERTEX_AXIS
 from .scheduler import merge_local_f, shard_queries
 
 
-@partial(jax.jit, static_argnames=("mesh", "k", "k_pad", "w", "max_levels"))
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "k", "k_pad", "w", "max_levels", "sparse_budget"),
+)
 def _distributed_bitbell_run(
     mesh: Mesh,
     graph,  # BellGraph, replicated on every device
@@ -41,6 +44,7 @@ def _distributed_bitbell_run(
     k_pad: int,
     w: int,
     max_levels,
+    sparse_budget: int = 0,
 ):
     """Merged per-query (f, levels, reached), each (k_pad,), via the
     bit-packed BELL engine per shard (padding slots stay -1, like the
@@ -55,7 +59,7 @@ def _distributed_bitbell_run(
             qblock = jnp.concatenate(
                 [qblock, jnp.full((pad, s), -1, dtype=qblock.dtype)], axis=0
             )
-        f, levels, reached = bitbell_run(graph, qblock, max_levels)
+        f, levels, reached = bitbell_run(graph, qblock, max_levels, sparse_budget)
         axes = (QUERY_AXIS, VERTEX_AXIS)
         return (
             merge_local_f(f[:j], j, w, k, k_pad, axes),
@@ -146,9 +150,16 @@ class DistributedEngine(QueryEngineBase):
                     "CSRGraph"
                 )
             from ..models.bell import BellGraph
+            from ..ops.bitbell import default_sparse_budget
 
-            self.bell = jax.device_put(
-                BellGraph.from_host(graph), replicated
+            bell = BellGraph.from_host(graph)
+            self.bell = jax.device_put(bell, replicated)
+            # Per-shard hybrid pull/push (same speedup as the single-chip
+            # engine — the sparse scatter is shard-local, no collectives).
+            self.sparse_budget = (
+                default_sparse_budget(bell.sparse[2].shape[0])
+                if bell.sparse is not None
+                else 0
             )
             self.graph = None  # keep the attribute set backend-uniform
         elif backend == "csr":
@@ -177,6 +188,7 @@ class DistributedEngine(QueryEngineBase):
                 k_pad,
                 self.w,
                 self.max_levels,
+                self.sparse_budget,
             )
         else:
             merged = _distributed_f_values(
@@ -201,7 +213,14 @@ class DistributedEngine(QueryEngineBase):
             self.mesh, np.asarray(queries), self.query_chunk
         )
         f, levels, reached = _distributed_bitbell_run(
-            self.mesh, self.bell, sharded, k, k_pad, self.w, self.max_levels
+            self.mesh,
+            self.bell,
+            sharded,
+            k,
+            k_pad,
+            self.w,
+            self.max_levels,
+            self.sparse_budget,
         )
         return (
             np.asarray(levels[:k]).astype(np.int32),

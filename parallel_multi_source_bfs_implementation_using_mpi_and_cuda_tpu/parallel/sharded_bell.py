@@ -96,6 +96,7 @@ def build_sharded_forest(
             _block_csr(g, min(b * L, g.n), min((b + 1) * L, g.n), n_pad),
             widths=widths,
             min_bucket_rows=0,
+            keep_sparse=False,  # the sharded loop is pull-only
         )
         for b in range(p)
     ]

@@ -14,3 +14,26 @@ def is_tpu_backend() -> bool:
     import jax
 
     return jax.default_backend() in TPU_BACKENDS
+
+
+def device_hbm_bytes(default: int = 16 * 1024**3) -> int:
+    """Per-device memory budget for engine routing decisions.
+
+    ``MSBFS_HBM_BYTES`` overrides; otherwise the device's reported
+    bytes_limit, falling back to ``default`` (v5e's 16 GB) when the
+    backend exposes no memory stats (CPU, some plugins)."""
+    import os
+
+    env = os.environ.get("MSBFS_HBM_BYTES")
+    if env:
+        return int(env)
+    import jax
+
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return limit
+    except Exception:
+        pass
+    return default

@@ -121,3 +121,110 @@ def test_bitbell_hub_star():
         eng = BitBellEngine(BellGraph.from_host(g, widths=widths))
         got = np.asarray(eng.f_values(padded))
         np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+class TestHybridSparse:
+    """Hybrid pull/push levels (sparse_hits_or / hybrid_expand) must be
+    bit-exact with the pure forest path on every graph shape."""
+
+    def _graphs(self):
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+            generators,
+        )
+
+        yield "rmat_hubs", generators.rmat_edges(9, edge_factor=12, seed=901)
+        yield "grid", generators.grid_edges(17, 13)
+        yield "road", generators.road_edges(24, 24, seed=902)
+        yield "gnm", generators.gnm_edges(150, 450, seed=903)
+        n = 40  # star: one hub adjacent to everything (max-degree stress)
+        hub = np.stack(
+            [np.zeros(n - 1, dtype=np.int64), np.arange(1, n, dtype=np.int64)],
+            axis=1,
+        )
+        yield "star", (n, hub)
+
+    @pytest.mark.parametrize("budget", [1 << 14, 64, 7])
+    def test_hybrid_matches_dense(self, budget):
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+            generators,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+            BellGraph,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+            BitBellEngine,
+        )
+
+        for name, (n, edges) in self._graphs():
+            g = CSRGraph.from_edges(n, edges)
+            queries = generators.random_queries(n, 5, max_group=4, seed=904)
+            queries[1] = np.zeros(0, dtype=np.int32)
+            padded = pad_queries(queries)
+            bg = BellGraph.from_host(g)
+            assert bg.sparse is not None
+            dense = BitBellEngine(bg, sparse_budget=0)
+            hybrid = BitBellEngine(bg, sparse_budget=budget)
+            for a, b in zip(
+                dense.query_stats(padded), hybrid.query_stats(padded)
+            ):
+                np.testing.assert_array_equal(a, b, err_msg=f"{name}/{budget}")
+
+    def test_auto_budget_and_keep_sparse_flag(self):
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+            generators,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+            BellGraph,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+            BitBellEngine,
+            default_sparse_budget,
+        )
+
+        n, edges = generators.gnm_edges(100, 300, seed=905)
+        g = CSRGraph.from_edges(n, edges)
+        bg = BellGraph.from_host(g)
+        eng = BitBellEngine(bg)
+        assert eng.sparse_budget == default_sparse_budget(bg.sparse[2].shape[0])
+        lean = BellGraph.from_host(g, keep_sparse=False)
+        assert lean.sparse is None
+        assert BitBellEngine(lean).sparse_budget == 0  # silently dense
+
+    def test_byte_plane_roundtrip(self):
+        import jax.numpy as jnp
+
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+            pack_byte_planes,
+            unpack_byte_planes,
+        )
+
+        rng = np.random.default_rng(906)
+        words = jnp.asarray(
+            rng.integers(0, 1 << 32, size=(13, 2), dtype=np.uint32)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(pack_byte_planes(unpack_byte_planes(words))),
+            np.asarray(words),
+        )
+
+    def test_hybrid_matches_oracle_on_road(self):
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+            generators,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+            BellGraph,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+            BitBellEngine,
+        )
+
+        from oracle import oracle_bfs, oracle_f
+
+        n, edges = generators.road_edges(20, 20, seed=907)
+        g = CSRGraph.from_edges(n, edges)
+        queries = generators.random_queries(n, 6, max_group=3, seed=908)
+        padded = pad_queries(queries)
+        eng = BitBellEngine(BellGraph.from_host(g), sparse_budget=256)
+        got = np.asarray(eng.f_values(padded))
+        want = [oracle_f(oracle_bfs(n, edges.astype(np.int64), q)) for q in queries]
+        np.testing.assert_array_equal(got, want)

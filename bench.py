@@ -99,7 +99,7 @@ def main() -> None:
             BellEngine,
         )
 
-        engine = BellEngine(BellGraph.from_host(g))
+        engine = BellEngine(BellGraph.from_host(g, keep_sparse=False))
     elif engine_kind == "push":
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push import (
             PaddedAdjacency,

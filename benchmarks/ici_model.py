@@ -62,13 +62,18 @@ def measure():
                         acc[:L] + i, VERTEX_AXIS, tiled=True
                     )
                     return g
-                return lax.fori_loop(0, REPEAT, one, mine + seed)
+
+                init = lax.pcast(
+                    mine + seed, (VERTEX_AXIS,), to="varying"
+                )  # match the collective output's varying-axes type
+                return lax.fori_loop(0, REPEAT, one, init)
 
             return jax.shard_map(
                 body,
                 mesh=mesh,
                 in_specs=P(),
                 out_specs=P(),
+                check_vma=False,  # output is replicated by construction
             )(plane)
 
         int(np.asarray(run(jnp.uint32(9), plane))[0, 0])  # compile + force
@@ -120,22 +125,31 @@ def main():
     rows = [json.loads(l) for l in proc.stdout.splitlines() if l.startswith("{")]
     if not rows:
         sys.exit("no measurements")
-    # Fit BW (+ a fixed per-collective latency) from two points; predict all.
-    a, b = rows[0], rows[-1]
-    inv_bw = (a["halo_s"] - b["halo_s"]) / (a["bytes"] - b["bytes"])
-    lat = a["halo_s"] - a["bytes"] * inv_bw
+    # On the shared-memory CPU mesh an all_gather is p parallel plane
+    # copies, so the validated model here is BYTE-LINEAR per plane:
+    # C_halo ~ n_pad * w * 4 / BW_eff, with p only a small secondary
+    # effect (all shards copy concurrently).  Fit BW_eff from the two
+    # p=4, w=2 points; predict the other p=4 rows; report p rows as the
+    # observed p-(in)sensitivity.  On real ICI the standard ring model
+    # multiplies plane bytes by (p-1)/p — see docs/PERF_NOTES.md.
+    fit = [r for r in rows if r["p"] == 4 and r["w"] == 2]
+    if len(fit) < 2 or fit[0]["n_pad"] == fit[-1]["n_pad"]:
+        sys.exit("need both p=4, w=2 points for the fit; child died early?")
+    a, b = fit[0], fit[-1]
+    pa, pb = a["n_pad"] * a["w"] * 4, b["n_pad"] * b["w"] * 4
+    inv_bw = (a["halo_s"] - b["halo_s"]) / (pa - pb)
     bw = 1.0 / inv_bw
     print(
-        f"# fit from (p={a['p']},w={a['w']},n={a['n_pad']}) and "
-        f"(p={b['p']},w={b['w']},n={b['n_pad']}): "
-        f"BW_eff={bw/1e9:.2f} GB/s, latency={lat*1e6:.0f} us"
+        f"# fit (p=4, w=2, n={a['n_pad']} vs {b['n_pad']}): plane-copy "
+        f"BW_eff={bw/1e9:.2f} GB/s per shard"
     )
     for r in rows:
-        pred = lat + r["bytes"] * inv_bw
+        pred = r["n_pad"] * r["w"] * 4 * inv_bw
+        tag = "" if r["p"] == 4 else "  [p-scaling: observed only]"
         print(
             f"p={r['p']} w={r['w']} n_pad={r['n_pad']}: measured "
-            f"{r['halo_s']*1e3:7.3f} ms/level, model {pred*1e3:7.3f} "
-            f"({(pred/r['halo_s']-1)*100:+.0f}%)"
+            f"{r['halo_s']*1e3:7.3f} ms/level, byte-linear model "
+            f"{pred*1e3:7.3f} ({(pred/r['halo_s']-1)*100:+.0f}%){tag}"
         )
 
 

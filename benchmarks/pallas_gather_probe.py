@@ -29,8 +29,13 @@ M_ROWS = 1 << 13  # operand sublane extent (n/128 for n=1M)
 S_ROWS = 1 << 12  # gather rows per call
 
 
-def probe(name, build):
+def probe(name, build, n_index=None):
+    """build() -> (fn, args); args[-1] must be the integer index operand.
+    Timed calls perturb that operand (mod ``n_index``) per trial — the
+    tunnel serves repeated identical executions from a result cache, so
+    identical-args timing would record a cache hit as kernel throughput."""
     import jax
+    import jax.numpy as jnp
 
     print(f"--- {name}")
     try:
@@ -43,10 +48,12 @@ def probe(name, build):
         ).strip()
         print(f"REJECTED: {msg[:600]}")
         return None
+    bound = n_index if n_index is not None else M_ROWS
     ts = []
-    for i in range(3):
+    for trial in range(3):
+        trial_args = args[:-1] + ((args[-1] + trial + 1) % bound,)
         t0 = time.perf_counter()
-        np.asarray(fn(*args))
+        np.asarray(fn(*trial_args))
         ts.append(time.perf_counter() - t0)
     t = min(ts)
     print(f"OK: {t*1e3:.3f} ms/call")
@@ -95,7 +102,7 @@ def main():
         )
         return fn, (flat, cols)
 
-    probe("A: arbitrary jnp.take (flat frontier)", build_a)
+    probe("A: arbitrary jnp.take (flat frontier)", build_a, n_index=M_ROWS * 128)
 
     # B: lane-batched take_along_axis, uint8
     def build_b():
@@ -131,6 +138,26 @@ def main():
     if t_c:
         print(f"   = {S_ROWS*128/t_c/1e6:.0f} M lookups/s")
 
+    # C2: same, promising in-bounds indices (the plain form's rejection
+    # message names 64-bit types — likely the OOB-clamp index arithmetic).
+    def build_c2():
+        def kernel(p_ref, i_ref, o_ref):
+            o_ref[:] = jnp.take_along_axis(
+                p_ref[:], i_ref[:], axis=0, mode="promise_in_bounds"
+            )
+
+        fn = jax.jit(
+            lambda p, i: pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((S_ROWS, 128), jnp.uint32),
+            )(p, i)
+        )
+        return fn, (plane32, idx)
+
+    t_c2 = probe("C2: take_along_axis u32 promise_in_bounds", build_c2)
+    if t_c2:
+        print(f"   = {S_ROWS*128/t_c2/1e6:.0f} M lookups/s")
+
     # D: one-hot MXU gather (rows of plane32 selected by idx[:, 0])
     def build_d():
         def kernel(p_ref, i_ref, o_ref):
@@ -158,26 +185,28 @@ def main():
     if t_d:
         print(f"   = {S_ROWS/t_d/1e6:.2f} M rows/s (FLOP-bound)")
 
-    # XLA reference: the same lane-batched gather outside Pallas
-    fn = jax.jit(lambda p, i: jnp.take_along_axis(p, i, axis=0))
-    np.asarray(fn(plane32, idx))
+    # XLA references outside Pallas (seed-varied per call: the tunnel
+    # serves repeated identical executions from a result cache).
+    fn = jax.jit(lambda p, i, s: jnp.take_along_axis(p, (i + s) % M_ROWS, axis=0))
+    np.asarray(fn(plane32, idx, jnp.int32(9)))
     ts = []
-    for _ in range(3):
+    for t in range(3):
         t0 = time.perf_counter()
-        np.asarray(fn(plane32, idx))
+        np.asarray(fn(plane32, idx, jnp.int32(t)))
         ts.append(time.perf_counter() - t0)
     print(
         f"--- XLA take_along_axis u32 (no pallas): {min(ts)*1e3:.3f} ms "
         f"= {S_ROWS*128/min(ts)/1e6:.0f} M lookups/s"
     )
 
-    # XLA reference: arbitrary row gather at the same volume
-    fn = jax.jit(lambda f, c: jnp.max(jnp.take(f, c, axis=0), axis=0))
-    np.asarray(fn(flat, cols))
+    fn = jax.jit(
+        lambda f, c, s: jnp.max(jnp.take(f, (c + s) % (M_ROWS * 128), axis=0), axis=0)
+    )
+    np.asarray(fn(flat, cols, jnp.int32(9)))
     ts = []
-    for _ in range(3):
+    for t in range(3):
         t0 = time.perf_counter()
-        np.asarray(fn(flat, cols))
+        np.asarray(fn(flat, cols, jnp.int32(t)))
         ts.append(time.perf_counter() - t0)
     print(
         f"--- XLA arbitrary take (no pallas): {min(ts)*1e3:.3f} ms "

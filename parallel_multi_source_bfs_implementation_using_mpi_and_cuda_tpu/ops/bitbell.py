@@ -253,24 +253,36 @@ def bit_level_loop(
 _pack_queries_jit = jax.jit(pack_queries, static_argnums=0)
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("sparse_budget",))
 def bitbell_step(
-    graph: BellGraph, visited: jax.Array, frontier: jax.Array
+    graph: BellGraph,
+    visited: jax.Array,
+    frontier: jax.Array,
+    sparse_budget: int = 0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One BFS level for all packed queries; returns (visited', frontier',
     per-query newly-discovered counts).  The stepped form of the while-loop
     body, used by the per-level tracing mode (MSBFS_STATS=2) where the host
-    drives the loop so each level can be timed individually."""
-    new = bell_hits_or(frontier, graph) & ~visited
+    drives the loop so each level can be timed individually; honors the
+    hybrid budget so traced levels run the same pull/push routing as the
+    production loop."""
+    if sparse_budget and graph.sparse is not None:
+        new = hybrid_expand(graph, sparse_budget)(visited, frontier)
+    else:
+        new = bell_hits_or(frontier, graph) & ~visited
     return visited | new, new, unpack_counts(new)
 
 
 def default_sparse_budget(e: int) -> int:
-    """Auto hybrid budget: ~E/256 edge slots (a sparse step then costs
-    <1/10 of a forest pass), floored so head/tail levels of small graphs
-    still qualify, capped so the fixed per-sparse-step cost stays far
-    below a forest pass even at RMAT-24 scale."""
-    return int(min(max(e // 256, 1 << 14), 1 << 20))
+    """Auto hybrid budget: ~E/64 edge slots.  A sparse step costs
+    ~budget x 40 ns (scatter + gathers + scans, v5e) vs ~e x 7 ns for a
+    forest pass, so E/64 keeps every sparse step under ~10% of a dense
+    level at any graph scale while catching the fat-but-leafy tail levels
+    (measured RMAT-20: the 201k-vertex / 413k-edge step 5 qualifies at
+    E/64 but not E/256 — worth ~0.2 s of the headline).  Floored so small
+    graphs' levels qualify at all; capped so the (budget, K) uint8
+    scatter transients stay within HBM headroom at RMAT-24+ scale."""
+    return int(min(max(e // 64, 1 << 14), 1 << 23))
 
 
 @partial(jax.jit, static_argnames=("max_levels", "sparse_budget"))
@@ -368,7 +380,11 @@ class BitBellEngine(PackedEngineBase):
         # calls at a warmed shape skip it entirely.
         if queries.shape not in self._level_warm_shapes:
             warm_frontier = pack(queries)
-            np.asarray(bitbell_step(self.graph, warm_frontier, warm_frontier)[2])
+            np.asarray(
+                bitbell_step(
+                    self.graph, warm_frontier, warm_frontier, self.sparse_budget
+                )[2]
+            )
             self._level_warm_shapes.add(queries.shape)
         t0 = time.perf_counter()
         frontier = pack(queries)
@@ -384,7 +400,9 @@ class BitBellEngine(PackedEngineBase):
             ):
                 break
             t0 = time.perf_counter()
-            visited, frontier, c = bitbell_step(self.graph, visited, frontier)
+            visited, frontier, c = bitbell_step(
+                self.graph, visited, frontier, self.sparse_budget
+            )
             counts = np.asarray(c)
             level_seconds.append(time.perf_counter() - t0)
             level_counts.append(counts)

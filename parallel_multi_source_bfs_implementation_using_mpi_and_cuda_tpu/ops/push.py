@@ -127,18 +127,8 @@ class PaddedAdjacency:
         return f"PaddedAdjacency(n={self.n}, width={self.width})"
 
 
-def _push_one(
-    adj: PaddedAdjacency,
-    sources: jax.Array,  # (S,) int32, -1 padded
-    capacity: int,
-    max_levels,
-):
-    """One query's BFS; returns (f, levels, reached, max_count).
-
-    ``max_count`` is the largest per-level frontier the run saw; a value
-    above ``capacity`` means some level was truncated (overflow) AND tells
-    the caller how big a retry capacity provably suffices for the levels
-    this run reached."""
+def _push_init(adj: PaddedAdjacency, sources: jax.Array, capacity: int):
+    """One query's initial loop carry from its (S,) -1-padded sources."""
     n = adj.n
     sources = sources.astype(jnp.int32)
     in_range = (sources >= 0) & (sources < n)
@@ -154,16 +144,36 @@ def _push_one(
     # below).  The mask itself is (n+1,) with row n forced 0, so n never
     # appears as a REAL frontier entry.
     frontier = compact_indices(visited, capacity, fill_value=n)
+    return (
+        visited,
+        frontier,
+        count0.astype(jnp.int64) * 0,  # sources are at distance 0
+        jnp.where(count0 > 0, 1, 0).astype(jnp.int32),
+        count0,
+        jnp.int32(0),
+        count0 > 0,
+        count0,
+    )
 
-    def cond(carry):
-        _, _, _, _, _, level, updated, _ = carry
-        go = updated
+
+def _push_chunk(adj: PaddedAdjacency, carry, capacity: int, chunk, max_levels):
+    """Advance one query's BFS by at most ``chunk`` levels (or to
+    ``max_levels``/convergence).  Carry: (visited, frontier, f, levels,
+    reached, level, updated, max_count); ``max_count`` is the largest
+    per-level frontier seen — above ``capacity`` means truncation AND
+    tells the caller what retry capacity provably suffices so far."""
+    n = adj.n
+    start = carry[5]
+
+    def cond(c):
+        _, _, _, _, _, level, updated, _ = c
+        go = jnp.logical_and(updated, level < start + chunk)
         if max_levels is not None:
             go = jnp.logical_and(go, level < max_levels)
         return go
 
-    def body(carry):
-        visited, frontier, f, levels, reached, level, _, max_count = carry
+    def body(c):
+        visited, frontier, f, levels, reached, level, _, max_count = c
         nbrs = jnp.take(adj.rows, frontier, axis=0)  # (C, w) frontier rows
         hit = (
             jnp.zeros((n + 1,), dtype=jnp.uint8)
@@ -184,32 +194,65 @@ def _push_one(
             jnp.maximum(max_count, count),
         )
 
-    carry = (
-        visited,
-        frontier,
-        count0.astype(jnp.int64) * 0,  # sources are at distance 0
-        jnp.where(count0 > 0, 1, 0).astype(jnp.int32),
-        count0,
-        jnp.int32(0),
-        count0 > 0,
-        count0,
-    )
-    _, _, f, levels, reached, _, _, max_count = lax.while_loop(cond, body, carry)
-    return f, levels, reached, max_count
+    return lax.while_loop(cond, body, carry)
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def _push_init_batch(adj, queries, capacity):
+    return jax.vmap(partial(_push_init, adj, capacity=capacity))(queries)
 
 
 @partial(jax.jit, static_argnames=("capacity", "max_levels"))
+def _push_chunk_batch(adj, carry, capacity, chunk, max_levels):
+    return jax.vmap(
+        lambda c: _push_chunk(adj, c, capacity, chunk, max_levels)
+    )(carry)
+
+
+def default_push_chunk() -> int:
+    """Levels per dispatch.  Unbounded single-dispatch runs of the level
+    loop crash the TPU worker on this platform once per-dispatch work
+    grows large (k=16 x n=1M road BFS dies mid-run while k=8 completes;
+    every constituent op passes in isolation — docs/PERF_NOTES.md
+    "Push-engine TPU status").  Chunking bounds per-dispatch work and
+    costs one ~100 ms dispatch per ``chunk`` levels — noise for the
+    thousands-of-levels graphs this engine targets.  Env override:
+    MSBFS_PUSH_CHUNK."""
+    import os
+
+    try:
+        return max(1, int(os.environ.get("MSBFS_PUSH_CHUNK", "64")))
+    except ValueError:
+        return 64
+
+
 def push_run(
     adj: PaddedAdjacency,
     queries: jax.Array,  # (K, S)
     capacity: int,
     max_levels=None,
+    chunk: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """(K, S) queries -> per-query (f, levels, reached, max_count);
-    max_count > capacity means that query's run overflowed (truncated)."""
-    return jax.vmap(partial(_push_one, adj, capacity=capacity, max_levels=max_levels))(
-        queries
-    )
+    max_count > capacity means that query's run overflowed (truncated).
+
+    Host-chunked orchestrator: each dispatch advances every query by at
+    most ``chunk`` levels (see :func:`default_push_chunk`), with a cheap
+    (K,)-bool host sync between dispatches."""
+    if chunk is None:
+        chunk = default_push_chunk()
+    carry = _push_init_batch(adj, queries, capacity)
+    while True:
+        carry = _push_chunk_batch(
+            adj, carry, capacity, jnp.int32(chunk), max_levels
+        )
+        updated = np.asarray(carry[6])
+        if not updated.any():
+            break
+        if max_levels is not None and int(np.asarray(carry[5]).max()) >= max_levels:
+            break
+    _, _, f, levels, reached, _, _, max_count = carry
+    return f, levels, reached, max_count
 
 
 class FrontierOverflow(RuntimeError):
@@ -243,7 +286,11 @@ class PushEngine(QueryEngineBase):
         self.auto_capacity = capacity is None
         n = max(graph.n, 1)
         if self.auto_capacity:
-            self.capacity = min(n, max(1024, 2 * int(n**0.5)))
+            # 8*sqrt(n): road-class wavefronts from multi-source groups run
+            # several disc perimeters wide (measured: a 512x512 road with
+            # 8-source groups peaks at ~4.6*sqrt(n)); starting low costs a
+            # full discarded run per growth step.
+            self.capacity = min(n, max(2048, 8 * int(n**0.5)))
         else:
             self.capacity = int(capacity)
         self.max_levels = max_levels
@@ -270,9 +317,12 @@ class PushEngine(QueryEngineBase):
                     f"needed >= {need}); construct PushEngine with a larger "
                     "capacity"
                 )
-            # A truncated run can under-count later levels, so pad the
-            # measured need; the cap at n is always sufficient.
-            grown = min(self.graph.n, max(2 * self.capacity, 2 * need))
+            # A truncated run under-counts later levels (measured: a road
+            # graph's true peak was ~2x the first truncated run's
+            # observation), so pad the measured need generously — an
+            # oversized capacity costs linearly, another discarded full
+            # run costs more; the cap at n is always sufficient.
+            grown = min(self.graph.n, max(2 * self.capacity, 4 * need))
             print(
                 f"PushEngine: frontier overflowed capacity={self.capacity} "
                 f"(level needed >= {need}); re-running at {grown}",

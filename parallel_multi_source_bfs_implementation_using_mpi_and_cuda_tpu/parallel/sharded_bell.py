@@ -101,12 +101,15 @@ def build_sharded_forest(
         for b in range(p)
     ]
 
-    num_levels = max(len(s.levels) for s in shards)
+    num_levels = max(len(s.level_shapes) for s in shards)
     n_buckets = len(widths)
     sorted_w = sorted(widths)
+    # One reconstruction of the per-bucket views per shard (the levels
+    # property slices the flat arrays; don't re-slice per access).
+    shard_views = [s.levels for s in shards]
 
     def bucket_rows(s: BellGraph, li: int, bi: int) -> int:
-        return s.levels[li][bi].shape[0] if li < len(s.levels) else 0
+        return s.level_shapes[li][bi][0] if li < len(s.level_shapes) else 0
 
     # Padded rows per (level, bucket) and the resulting uniform level sizes.
     pad_rows = [
@@ -138,25 +141,29 @@ def build_sharded_forest(
             )
         row_maps.append(maps)
 
-    stacked_levels = []
+    stacked_cols = []
+    stacked_shapes = []
     for li in range(num_levels):
         # Index of the always-zero row in the previous value array (the
         # frontier for level 0): sentinel target for padding rows and for
         # each shard's own local sentinel.
         prev_zero = n_pad if li == 0 else pad_level_sizes[li - 1]
         per_bucket = []
+        shard_levels = [
+            v[li] if li < len(v) else None for v in shard_views
+        ]
         for bi in range(n_buckets):
             w_b = sorted_w[bi]
             rows = pad_rows[li][bi]
             if rows == 0:
-                per_bucket.append(jnp.zeros((p, 0, w_b), dtype=jnp.int32))
+                per_bucket.append(np.zeros((p, 0, w_b), dtype=np.int32))
                 continue
             mats = []
             for si, s in enumerate(shards):
                 m = np.full((rows, w_b), prev_zero, dtype=np.int64)
                 have = bucket_rows(s, li, bi)
                 if have:
-                    vals = np.asarray(s.levels[li][bi], dtype=np.int64)
+                    vals = np.asarray(shard_levels[si][bi], dtype=np.int64)
                     if li > 0:
                         # Remap previous-level row references to padded
                         # positions; the shard's local sentinel (== its
@@ -172,8 +179,10 @@ def build_sharded_forest(
                         )
                     m[:have] = vals
                 mats.append(m)
-            per_bucket.append(jnp.asarray(np.stack(mats).astype(np.int32)))
-        stacked_levels.append(per_bucket)
+            per_bucket.append(np.stack(mats).astype(np.int32))
+        flat, shapes = BellGraph.pack_level(per_bucket)
+        stacked_cols.append(jnp.asarray(flat))
+        stacked_shapes.append(shapes)
 
     # final_slot: local level-concat position -> padded one, via the same
     # per-level row maps; the local zero sentinel -> padded zero sentinel.
@@ -190,7 +199,8 @@ def build_sharded_forest(
     final_slot = jnp.asarray(np.stack(slots))
 
     stacked = BellGraph(
-        levels=stacked_levels,
+        level_cols=stacked_cols,
+        level_shapes=stacked_shapes,
         final_slot=final_slot,
         n=n_pad,
         n_pad=n_pad,

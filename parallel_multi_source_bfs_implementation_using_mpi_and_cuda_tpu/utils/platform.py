@@ -26,7 +26,10 @@ def device_hbm_bytes(default: int = 16 * 1024**3) -> int:
 
     env = os.environ.get("MSBFS_HBM_BYTES")
     if env:
-        return int(env)
+        try:
+            return int(env)
+        except ValueError:
+            pass  # malformed knob falls back, like every other env knob
     import jax
 
     try:

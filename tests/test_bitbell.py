@@ -228,3 +228,29 @@ class TestHybridSparse:
         got = np.asarray(eng.f_values(padded))
         want = [oracle_f(oracle_bfs(n, edges.astype(np.int64), q)) for q in queries]
         np.testing.assert_array_equal(got, want)
+
+
+def test_estimate_hbm_bytes_routing_properties():
+    """The CLI's HBM routing relies on: K padding to word multiples, only
+    edge-proportional terms shrinking with vertex shards, and
+    monotonicity in n/e."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+
+    est = BellGraph.estimate_hbm_bytes
+    # K in (32, 64] pads to 64: estimates must match K=64, not K=32.
+    assert est(1 << 20, 1 << 25, 40) == est(1 << 20, 1 << 25, 64)
+    assert est(1 << 20, 1 << 25, 40) > est(1 << 20, 1 << 25, 32)
+    # Sharding divides the edge terms (and drops the single-chip hybrid
+    # CSR + byte scratch: the sharded loop is pull-only) but NOT the
+    # plane terms.
+    one = est(1 << 20, 1 << 25, 64)
+    two = est(1 << 20, 1 << 25, 64, vertex_shards=2)
+    assert two < one
+    # More shards keep shrinking toward the unsharded plane floor.
+    eight = est(1 << 20, 1 << 25, 64, vertex_shards=8)
+    assert eight < two
+    assert eight > 16 * 2 * (1 << 20)  # plane floor: 16 B * words * n
+    assert est(1 << 21, 1 << 25, 64) > one  # monotone in n
+    assert est(1 << 20, 1 << 26, 64) > one  # monotone in e

@@ -319,6 +319,13 @@ def _run_in_cpu_mesh(c: int, args):
 
 
 def main() -> int:
+    # Persistent XLA cache: compiles through the tunnel run minutes-long
+    # (docs/PERF_NOTES.md), and every config otherwise pays its own.
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.xla_cache import (
+        configure_compilation_cache,
+    )
+
+    configure_compilation_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
     ap.add_argument("--all", action="store_true")

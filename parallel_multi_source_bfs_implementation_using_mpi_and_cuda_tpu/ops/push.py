@@ -310,6 +310,14 @@ class PushEngine(QueryEngineBase):
             )
             need = int(jnp.max(max_count[:k])) if k else 0
             if need <= self.capacity:
+                if self.auto_capacity and 2 * need < self.capacity // 2:
+                    # Growth overshoots deliberately (a retry costs a full
+                    # run); once the true peak is known, shrink so later
+                    # runs stop paying capacity-proportional cost for
+                    # headroom they don't need.
+                    self.capacity = min(
+                        max(self.graph.n, 1), max(1024, 2 * need)
+                    )
                 return f[:k], levels[:k], reached[:k]
             if not self.auto_capacity:
                 raise FrontierOverflow(

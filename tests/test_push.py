@@ -112,3 +112,23 @@ def test_push_k0():
     out = np.asarray(eng.f_values(np.zeros((0, 4), dtype=np.int32)))
     assert out.shape == (0,)
     assert eng.best(np.zeros((0, 4), dtype=np.int32)) == (-1, -1)
+
+
+def test_auto_capacity_grows_and_shrinks():
+    """Auto mode: an overflow re-runs at the measured need (padded), and a
+    comfortably oversized capacity shrinks after a successful run so
+    steady-state cost tracks the true wavefront."""
+    n, edges = generators.grid_edges(40, 40)  # n=1600 > the 1024 floor
+    g = CSRGraph.from_edges(n, edges)
+    eng = PushEngine(PaddedAdjacency.from_host(g))
+    assert eng.auto_capacity
+    eng.capacity = 2  # force the growth path
+    padded = pad_queries([np.array([0], dtype=np.int32)])
+    f1 = np.asarray(eng.f_values(padded))
+    assert eng.capacity > 2  # grew to cover the measured need
+    eng.capacity = n  # force the shrink path (peak wavefront ~ side)
+    f2 = np.asarray(eng.f_values(padded))
+    np.testing.assert_array_equal(f1, f2)
+    assert eng.capacity < n  # shrunk toward max(1024, 2*peak)
+    f3 = np.asarray(eng.f_values(padded))  # still correct at shrunk size
+    np.testing.assert_array_equal(f1, f3)

@@ -70,6 +70,14 @@ class CSRGraph:
                 row_offsets=np.zeros(n + 1, dtype=np.int64),
                 col_indices=np.zeros(0, dtype=np.int32),
             )
+        from ..runtime import native_loader  # lazy: avoid import cycle
+
+        native = native_loader.csr_from_edges(n, edges)
+        if native is not None:
+            row_offsets, col_indices = native
+            return CSRGraph(
+                n=n, m=m, row_offsets=row_offsets, col_indices=col_indices
+            )
         # Interleave (u,v) and (v,u) so directed slot order matches the
         # reference's per-record double push_back.
         src = np.empty(2 * m, dtype=np.int64)

@@ -294,6 +294,7 @@ class PushEngine(QueryEngineBase):
         else:
             self.capacity = int(capacity)
         self.max_levels = max_levels
+        self._max_need = 0  # historical peak frontier across runs
 
     def _run(self, queries):
         import sys
@@ -310,13 +311,22 @@ class PushEngine(QueryEngineBase):
             )
             need = int(jnp.max(max_count[:k])) if k else 0
             if need <= self.capacity:
-                if self.auto_capacity and 2 * need < self.capacity // 2:
+                self._max_need = max(self._max_need, need)
+                if (
+                    self.auto_capacity
+                    and k
+                    and 2 * self._max_need < self.capacity // 2
+                ):
                     # Growth overshoots deliberately (a retry costs a full
                     # run); once the true peak is known, shrink so later
                     # runs stop paying capacity-proportional cost for
-                    # headroom they don't need.
+                    # headroom they don't need.  The HISTORICAL peak (not
+                    # this batch's) is the bound: alternating thin/fat
+                    # batches must not thrash grow/shrink cycles, and an
+                    # empty batch (k=0, need=0) must not collapse a tuned
+                    # capacity.
                     self.capacity = min(
-                        max(self.graph.n, 1), max(1024, 2 * need)
+                        max(self.graph.n, 1), max(1024, 2 * self._max_need)
                     )
                 return f[:k], levels[:k], reached[:k]
             if not self.auto_capacity:

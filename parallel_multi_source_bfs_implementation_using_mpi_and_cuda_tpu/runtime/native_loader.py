@@ -54,6 +54,14 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(dtype=np.int32, ndim=1, flags="C_CONTIGUOUS"),
         ]
         lib.msbfs_load_graph_csr.restype = ctypes.c_int
+        lib.msbfs_csr_from_edges.argtypes = [
+            ctypes.c_int64,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(dtype=np.int32, ndim=2, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int64, ndim=1, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, ndim=1, flags="C_CONTIGUOUS"),
+        ]
+        lib.msbfs_csr_from_edges.restype = ctypes.c_int
         lib.msbfs_dedup_rows.argtypes = [
             ctypes.c_int64,
             ctypes.c_int64,
@@ -92,6 +100,36 @@ def load_graph_csr(path: str) -> CSRGraph:
     return CSRGraph(
         n=int(n.value), m=int(m.value), row_offsets=row_offsets, col_indices=col_indices
     )
+
+
+def csr_from_edges(n: int, edges: np.ndarray):
+    """Native in-memory CSR build from an (m, 2) int32 edge array.
+
+    Returns (row_offsets, col_indices) or None when the library is
+    unavailable (caller falls back to the NumPy argsort path).  Raises
+    ValueError on an out-of-range endpoint — the same contract as the
+    NumPy path's explicit bounds check.
+    """
+    lib = _get_lib()
+    if lib is None or not hasattr(lib, "msbfs_csr_from_edges"):
+        return None
+    edges = np.asarray(edges)
+    if edges.size and edges.dtype != np.int32 and (
+        edges.min() < -(2**31) or edges.max() >= 2**31
+    ):
+        # int32 conversion would wrap (possibly onto a VALID id) before
+        # the native bounds check could see it — fail loud instead.
+        raise ValueError("edge endpoint exceeds int32")
+    edges = np.ascontiguousarray(edges, dtype=np.int32)
+    m = edges.shape[0]
+    row_offsets = np.zeros(n + 1, dtype=np.int64)
+    col_indices = np.empty(2 * m, dtype=np.int32)
+    rc = lib.msbfs_csr_from_edges(n, m, edges, row_offsets, col_indices)
+    if rc == 4:
+        raise ValueError(f"edge endpoint out of range [0, {n})")
+    if rc != 0:
+        raise ValueError(f"native csr_from_edges failed (rc={rc})")
+    return row_offsets, col_indices
 
 
 def dedup_rows(row_offsets: np.ndarray, col_indices: np.ndarray):

@@ -98,3 +98,47 @@ def test_native_dedup_rows_matches_numpy():
     pairs = np.unique(src[keep] * n + dst[keep])
     np.testing.assert_array_equal(v, pairs % n)
     np.testing.assert_array_equal(deg, np.bincount(pairs // n, minlength=n))
+
+
+def test_csr_from_edges_matches_numpy_path():
+    """The native in-memory CSR build must reproduce the NumPy argsort
+    path bit-for-bit (same insertion-order adjacency, same offsets)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime import (
+        native_loader,
+    )
+
+    if not native_loader.available():
+        pytest.skip("librt_loader.so not built")
+    rng = np.random.default_rng(77)
+    for n, m in ((1, 0), (5, 9), (200, 1000), (64, 64)):
+        edges = rng.integers(0, n, size=(m, 2), dtype=np.int64)
+        if m:
+            edges[0] = (0, 0)  # self-loop record
+            edges[-1] = edges[m // 2]  # duplicate record
+        got = native_loader.csr_from_edges(n, edges)
+        assert got is not None
+        row_offsets, col_indices = got
+        # Independent NumPy construction (the fallback path's algorithm).
+        src = np.empty(2 * m, dtype=np.int64)
+        dst = np.empty(2 * m, dtype=np.int32)
+        src[0::2] = edges[:, 0]
+        src[1::2] = edges[:, 1]
+        dst[0::2] = edges[:, 1]
+        dst[1::2] = edges[:, 0]
+        counts = np.bincount(src, minlength=n).astype(np.int64)
+        want_offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(counts, out=want_offsets[1:])
+        want_cols = dst[np.argsort(src, kind="stable")]
+        np.testing.assert_array_equal(row_offsets, want_offsets)
+        np.testing.assert_array_equal(col_indices, want_cols)
+
+
+def test_csr_from_edges_bounds():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime import (
+        native_loader,
+    )
+
+    if not native_loader.available():
+        pytest.skip("librt_loader.so not built")
+    with pytest.raises(ValueError, match="out of range"):
+        native_loader.csr_from_edges(4, np.asarray([[0, 9]], dtype=np.int64))

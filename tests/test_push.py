@@ -132,3 +132,22 @@ def test_auto_capacity_grows_and_shrinks():
     assert eng.capacity < n  # shrunk toward max(1024, 2*peak)
     f3 = np.asarray(eng.f_values(padded))  # still correct at shrunk size
     np.testing.assert_array_equal(f1, f3)
+
+
+def test_auto_capacity_shrink_has_hysteresis():
+    """Shrink is bounded by the HISTORICAL peak and skipped for empty
+    batches: alternating thin/fat batches must not thrash grow/shrink."""
+    n, edges = generators.grid_edges(40, 40)
+    g = CSRGraph.from_edges(n, edges)
+    eng = PushEngine(PaddedAdjacency.from_host(g))
+    fat = pad_queries([np.arange(8, dtype=np.int32) * 123 % n])
+    thin = pad_queries([np.array([0], dtype=np.int32)])
+    eng.f_values(fat)
+    peak = eng._max_need
+    assert peak > 0
+    cap_after_fat = eng.capacity
+    eng.f_values(thin)  # thin batch: capacity must respect the fat peak
+    assert eng.capacity >= min(eng.graph.n, max(1024, 2 * peak))
+    eng.f_values(np.zeros((0, 4), dtype=np.int32))  # empty batch: no-op
+    assert eng.capacity >= min(eng.graph.n, max(1024, 2 * peak))
+    assert cap_after_fat >= eng.capacity  # never grew without need

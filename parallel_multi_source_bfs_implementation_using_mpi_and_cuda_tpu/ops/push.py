@@ -355,3 +355,73 @@ class PushEngine(QueryEngineBase):
     def query_stats(self, queries):
         f, levels, reached = self._run(queries)
         return np.asarray(levels), np.asarray(reached), np.asarray(f)
+
+    def level_stats(self, queries):
+        """Per-level trace (MSBFS_STATS=2): single-level dispatches so each
+        BFS level is individually timed.  Returns (levels, reached, f,
+        level_counts, level_seconds) with the BitBellEngine.level_stats
+        contract — row d of ``level_counts`` is the vertices discovered at
+        distance d per query (row 0 = sources); the per-query stats are the
+        loop's own counters, so they match :meth:`query_stats` exactly.
+        Lanes advance in lockstep (a converged lane's rows read 0), and
+        auto-capacity growth restarts the trace like ``_run`` re-runs."""
+        import sys
+        import time as _time
+
+        queries = jnp.asarray(queries, dtype=jnp.int32)
+        k = queries.shape[0]
+        if k == 0:
+            z = np.zeros(0, dtype=np.int64)
+            return (
+                z.astype(np.int32),
+                z.astype(np.int32),
+                z,
+                np.zeros((0, 0), dtype=np.int64),
+                np.zeros(0),
+            )
+        while True:
+            t0 = _time.perf_counter()
+            carry = _push_init_batch(self.graph, queries, self.capacity)
+            reached_prev = np.asarray(carry[4]).astype(np.int64)
+            level_counts = [reached_prev.copy()]
+            level_seconds = [_time.perf_counter() - t0]
+            while True:
+                t0 = _time.perf_counter()
+                carry = _push_chunk_batch(
+                    self.graph, carry, self.capacity, jnp.int32(1),
+                    self.max_levels,
+                )
+                reached = np.asarray(carry[4]).astype(np.int64)
+                level_seconds.append(_time.perf_counter() - t0)
+                level_counts.append(reached - reached_prev)
+                reached_prev = reached
+                if not np.asarray(carry[6]).any():
+                    break
+                if (
+                    self.max_levels is not None
+                    and int(np.asarray(carry[5]).max()) >= self.max_levels
+                ):
+                    break
+            need = int(np.asarray(carry[7]).max())
+            if need <= self.capacity:
+                break
+            if not self.auto_capacity:
+                raise FrontierOverflow(
+                    f"frontier exceeded capacity={self.capacity} (a level "
+                    f"needed >= {need}); construct PushEngine with a larger "
+                    "capacity"
+                )
+            grown = min(self.graph.n, max(2 * self.capacity, 4 * need))
+            print(
+                f"PushEngine: frontier overflowed capacity={self.capacity} "
+                f"(level needed >= {need}); re-tracing at {grown}",
+                file=sys.stderr,
+            )
+            self.capacity = grown
+        return (
+            np.asarray(carry[3]),
+            reached_prev.astype(np.int32),
+            np.asarray(carry[2]),
+            np.stack(level_counts),
+            np.asarray(level_seconds),
+        )

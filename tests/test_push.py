@@ -151,3 +151,36 @@ def test_auto_capacity_shrink_has_hysteresis():
     eng.f_values(np.zeros((0, 4), dtype=np.int32))  # empty batch: no-op
     assert eng.capacity >= min(eng.graph.n, max(1024, 2 * peak))
     assert cap_after_fat >= eng.capacity  # never grew without need
+
+
+def test_push_level_stats_match_query_stats_and_oracle():
+    n, edges = GRAPHS["grid"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 5, max_group=3, seed=510)
+    queries[1] = np.zeros(0, dtype=np.int32)
+    padded = pad_queries(queries)
+    eng = PushEngine(PaddedAdjacency.from_host(g))
+    levels, reached, f, lc, secs = eng.level_stats(padded)
+    w = eng.query_stats(padded)
+    np.testing.assert_array_equal(levels, w[0])
+    np.testing.assert_array_equal(reached, w[1])
+    np.testing.assert_array_equal(f, w[2])
+    assert lc.shape[0] == len(secs) and lc.shape[1] == len(queries)
+    np.testing.assert_array_equal(lc.sum(axis=0), reached)
+    assert (lc[-1] == 0).all()  # trailing discovers-nothing probe
+    for i, q in enumerate(queries):
+        dist = oracle_bfs(n, edges, q)
+        for d in range(lc.shape[0]):
+            assert lc[d, i] == int((dist == d).sum())
+
+
+def test_push_level_stats_grows_capacity():
+    n, edges = GRAPHS["grid"]
+    g = CSRGraph.from_edges(n, edges)
+    eng = PushEngine(PaddedAdjacency.from_host(g))
+    eng.capacity = 2  # force the growth-restart path inside the trace
+    padded = pad_queries([np.array([0], dtype=np.int32)])
+    levels, reached, f, lc, _ = eng.level_stats(padded)
+    assert eng.capacity > 2
+    w = eng.query_stats(padded)
+    np.testing.assert_array_equal(f, w[2])

@@ -41,6 +41,43 @@ def is_virtual_cpu(n_devices, environ=None):
     return count is not None and count >= n_devices
 
 
+def wait_for_device(max_wait_s=1800, probe_timeout_s=120, sleep_s=60):
+    """Wait until a JAX backend in the CURRENT environment can actually
+    execute (probed in a subprocess — when the axon TPU tunnel has an
+    outage, backend init HANGS inside import, so an in-process check
+    could never time out).  Returns True when a probe succeeds, False
+    after ``max_wait_s``.  Healthy environments (CPU included) pass the
+    first probe in seconds, so callers can invoke this unconditionally."""
+    import subprocess
+    import sys
+    import time
+
+    code = (
+        "import jax, numpy as np, jax.numpy as jnp, sys;"
+        "sys.exit(0 if int(np.asarray(jnp.arange(4).sum())) == 6 else 1)"
+    )
+    deadline = time.monotonic() + max_wait_s
+    while True:
+        try:
+            rc = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=probe_timeout_s,
+                capture_output=True,
+            ).returncode
+            if rc == 0:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if time.monotonic() > deadline:
+            return False
+        print(
+            "wait_for_device: backend unavailable, retrying...",
+            file=sys.stderr,
+            flush=True,
+        )
+        time.sleep(sleep_s)
+
+
 def virtual_cpu_env(n_devices=8, base=None):
     """A copy of ``base`` (default ``os.environ``) adjusted so a fresh
     interpreter comes up on the CPU backend with exactly ``n_devices``

@@ -172,3 +172,30 @@ def test_cli_level_stats_stderr(tmp_path, capsys, monkeypatch):
     assert "active_queries" in captured.err  # per-level table
     assert "reached" in captured.err  # per-query table still present
     assert "active_queries" not in captured.out  # stdout stays reference-exact
+
+
+def test_cli_stats_multichip(tmp_path, capsys, monkeypatch):
+    """MSBFS_STATS=1 works at -gn > 1: the per-shard counters merge over
+    the mesh exactly like F values."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+        main,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_graph_bin,
+        save_query_bin,
+    )
+
+    n, edges = generators.gnm_edges(50, 140, seed=212)
+    g, q = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
+    save_graph_bin(g, n, edges)
+    save_query_bin(q, [[0], [3, 7], [9]])
+    monkeypatch.setenv("MSBFS_STATS", "1")
+    rc = main(["main.py", "-g", g, "-q", q, "-gn", "8"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "levels" in captured.err and captured.err.count("\n") >= 4
+    assert "not available" not in captured.err

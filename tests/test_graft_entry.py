@@ -22,3 +22,12 @@ def test_dryrun_multichip():
 @pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 (virtual) devices")
 def test_dryrun_multichip_odd_axes():
     ge.dryrun_multichip(4)
+
+
+def test_wait_for_device_healthy_env():
+    """On a healthy backend (the test env's CPU platform) the first probe
+    succeeds in seconds; the False path needs an outage, which the probe's
+    subprocess isolation exists to survive (see virtual_cpu.py)."""
+    import virtual_cpu
+
+    assert virtual_cpu.wait_for_device(max_wait_s=5, probe_timeout_s=115)

@@ -59,6 +59,33 @@ def parse_args(argv: List[str]):
     return graph_file, query_file, num_gpu
 
 
+def _level_chunk_policy(graph) -> Optional[int]:
+    """Per-dispatch level bound for the bit-plane engines (None = whole BFS
+    in one dispatch).  MSBFS_LEVEL_CHUNK forces a value (0 disables); the
+    default auto-detects road-class degree profiles — low max degree and
+    low mean degree mean the BFS is deep (thousands of levels on road
+    networks), and an unbounded while_loop dispatch doing thousands of
+    forest passes is the pattern that crashed the TPU worker
+    (docs/PERF_NOTES.md "Push-engine TPU status").  Power-law graphs
+    (high-degree hubs, ~10-level BFS) keep the single-dispatch fast path.
+    The reference runs any graph at any -gn (per-rank serial BFS,
+    main.cu:303-322); this bound is what keeps that promise here."""
+    explicit = os.environ.get("MSBFS_LEVEL_CHUNK")
+    if explicit is not None:
+        try:
+            val = int(explicit)
+        except ValueError:
+            val = 0
+        return val if val > 0 else None
+    if graph.n == 0 or graph.num_directed_edges == 0:
+        return None
+    degrees = graph.degrees
+    mean_deg = graph.num_directed_edges / graph.n
+    if int(degrees.max()) <= 64 and mean_deg <= 8.0:
+        return 32
+    return None
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv if argv is None else argv)
     if len(argv) < 5:  # argc < 5, reference main.cu:204-212
@@ -116,6 +143,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             graph.n, graph.num_directed_edges, max(32, padded.shape[0])
         )
         hbm_have = device_hbm_bytes()
+        level_chunk = _level_chunk_policy(graph)
+
+        def announce_chunk():
+            # Printed ONLY when the selected engine actually applies the
+            # bound — a user-forced backend without a chunked path must not
+            # claim the mitigation is active.
+            if level_chunk:
+                print(
+                    "road-class degree profile: bounding bit-plane "
+                    f"dispatches to {level_chunk} BFS levels "
+                    "(MSBFS_LEVEL_CHUNK overrides)",
+                    file=sys.stderr,
+                )
+
         if n_chips > 1:
             # MSBFS_VSHARD=v splits the CSR over a 'v' mesh axis of that
             # size (vertex sharding for graphs beyond one chip's HBM —
@@ -153,19 +194,54 @@ def main(argv: Optional[List[str]] = None) -> int:
                     " falling back to replicated-graph query sharding",
                     file=sys.stderr,
                 )
+            # MSBFS_BACKEND is honored at -gn > 1 too (round-3; it used to
+            # be single-chip only): "csr"/"vmap" selects the per-query CSR
+            # pull per shard; everything else runs the bitbell default,
+            # with a warning for backends that only exist single-chip.
+            backend = os.environ.get("MSBFS_BACKEND", "auto")
+            if backend in ("dense", "pallas", "bell", "push", "packed"):
+                print(
+                    f"MSBFS_BACKEND={backend} is single-chip only; using "
+                    "the distributed bitbell engine at -gn > 1",
+                    file=sys.stderr,
+                )
+                backend = "auto"
             if vshard > 1 and n_chips % vshard == 0:
                 from .parallel.mesh import make_mesh
                 from .parallel.sharded_bell import ShardedBellEngine
 
+                if backend in ("csr", "vmap"):
+                    print(
+                        f"MSBFS_BACKEND={backend} has no vertex-sharded "
+                        "variant; using the sharded bitbell engine",
+                        file=sys.stderr,
+                    )
                 mesh = make_mesh(
                     num_query_shards=n_chips // vshard,
                     num_vertex_shards=vshard,
                     devices=jax.devices()[:n_chips],
                 )
-                engine = ShardedBellEngine(mesh, graph)
+                announce_chunk()
+                engine = ShardedBellEngine(
+                    mesh, graph, level_chunk=level_chunk
+                )
             else:
                 mesh = default_mesh(max_devices=n_chips)
-                engine = DistributedEngine(mesh, graph)
+                if backend in ("csr", "vmap"):
+                    if level_chunk:
+                        print(
+                            f"warning: MSBFS_BACKEND={backend} has no "
+                            "bounded-dispatch level loop; a high-diameter "
+                            "graph may exceed per-dispatch limits (unset "
+                            "MSBFS_BACKEND for the chunked bitbell engine)",
+                            file=sys.stderr,
+                        )
+                    engine = DistributedEngine(mesh, graph, backend="csr")
+                else:
+                    announce_chunk()
+                    engine = DistributedEngine(
+                        mesh, graph, level_chunk=level_chunk
+                    )
         else:
             # Backend selection (beyond-reference knob, env-controlled so the
             # argv contract stays reference-exact): "dense" runs frontier
@@ -186,10 +262,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "auto-shard the CSR (this run may exhaust memory)",
                     file=sys.stderr,
                 )
+            if level_chunk and backend in (
+                "dense", "vmap", "pallas", "bell", "packed"
+            ):
+                print(
+                    f"warning: MSBFS_BACKEND={backend} has no "
+                    "bounded-dispatch level loop; a high-diameter graph "
+                    "may exceed per-dispatch limits (unset MSBFS_BACKEND "
+                    "for the chunked bitbell engine, or use push)",
+                    file=sys.stderr,
+                )
             use_dense = backend == "dense"
             if backend == "auto" and is_tpu_backend():
                 threshold = _env_int("MSBFS_DENSE_THRESHOLD", 8192)
-                use_dense = graph.n <= threshold
+                # Road-class profiles skip the dense engine: its level loop
+                # is one unbounded dispatch of n^2 matmuls, the worst shape
+                # for a thousands-of-levels BFS; the chunked bitbell below
+                # is the bounded path.
+                use_dense = graph.n <= threshold and not level_chunk
             if use_dense:
                 from .ops.dense import DenseGraph
 
@@ -237,7 +327,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 from .models.bell import BellGraph
                 from .ops.bitbell import BitBellEngine
 
-                engine = BitBellEngine(BellGraph.from_host(graph))
+                announce_chunk()
+                engine = BitBellEngine(
+                    BellGraph.from_host(graph), level_chunk=level_chunk
+                )
         stats_env = os.environ.get("MSBFS_STATS", "")
         stats_mode = stats_env in ("1", "2")
         # MSBFS_STATS=2: additionally trace each BFS level (frontier size,

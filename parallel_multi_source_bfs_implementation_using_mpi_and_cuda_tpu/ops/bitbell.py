@@ -134,6 +134,13 @@ def sparse_hits_or(
     """
     n = graph.n
     start, count, vals = graph.sparse
+    if vals.shape[0] == 0:
+        # Edgeless dedup CSR (a caller can force sparse_budget > 0 on a
+        # graph with no edges): no frontier vertex has outgoing edges, so
+        # the hit planes are identically zero.  The general path would
+        # clip indices into [0, -1] (inverted bounds) and gather from a
+        # 0-size array — undefined; shapes are static, so guard here.
+        return jnp.zeros_like(frontier)
     active = (frontier != jnp.uint32(0)).any(axis=1)  # (n,)
     ids = compact_indices(active, budget, fill_value=n)  # (B,) ascending
     valid_id = ids < n
@@ -191,6 +198,72 @@ def hybrid_expand(graph: BellGraph, budget: int):
     return expand
 
 
+def bit_level_init(
+    frontier0: jax.Array,  # (n, W) uint32 source planes (caller-cast)
+    counts0: jax.Array,  # (K,) per-query source counts
+    cast=lambda x: x,  # varying-axes cast for shard_map callers
+):
+    """The 7-tuple loop carry for :func:`bit_level_loop` /
+    :func:`bit_level_chunk`: (visited, frontier, f, levels, reached, level,
+    updated) with sources already counted at distance 0."""
+    return (
+        frontier0,  # visited = sources
+        frontier0,
+        # Sources contribute distance 0; deriving the zero init from counts0
+        # (rather than a literal) gives it counts0's varying-axes type, so
+        # the same loop works unchanged inside shard_map shards.
+        cast(counts0.astype(jnp.int64) * 0),
+        cast(jnp.where(counts0 > 0, 1, 0).astype(jnp.int32)),
+        cast(counts0),
+        jnp.int32(0),
+        cast(jnp.any(counts0 > 0)),
+    )
+
+
+def bit_level_body(expand, counts_of=unpack_counts):
+    """One BFS level over the 7-tuple carry.  ``counts_of`` maps the
+    newly-reached planes ``expand`` returns to per-query discovery counts —
+    ``unpack_counts`` when the planes are global, a psum-composed variant
+    when each shard returns only its own vertex block."""
+
+    def body(carry):
+        visited, frontier, f, levels, reached, level, _ = carry
+        new = expand(visited, frontier)
+        counts = counts_of(new)
+        found = counts > 0
+        dist = level + 1  # newly discovered vertices are at this distance
+        return (
+            visited | new,
+            new,
+            f + counts.astype(jnp.int64) * dist.astype(jnp.int64),
+            jnp.where(found, dist + 1, levels),
+            reached + counts,
+            level + 1,
+            jnp.any(found),
+        )
+
+    return body
+
+
+def bit_level_chunk(carry, expand, chunk, max_levels, counts_of=unpack_counts):
+    """Advance the carry by at most ``chunk`` levels (or to ``max_levels``/
+    convergence).  The bounded dual of :func:`bit_level_loop`: host-chunked
+    callers dispatch this repeatedly so no single XLA dispatch performs
+    unbounded work — the same mitigation that keeps the push engine alive
+    on road-class graphs (ops.push.default_push_chunk; docs/PERF_NOTES.md
+    "Push-engine TPU status"), now available to every bit-plane engine for
+    high-diameter graphs at any ``-gn``."""
+    start = carry[5]
+
+    def cond(c):
+        go = jnp.logical_and(c[6], c[5] < start + chunk)
+        if max_levels is not None:
+            go = jnp.logical_and(go, c[5] < max_levels)
+        return go
+
+    return lax.while_loop(cond, bit_level_body(expand, counts_of), carry)
+
+
 def bit_level_loop(
     frontier0: jax.Array,  # (n, W) uint32 source planes
     counts0: jax.Array,  # (K,) per-query source counts
@@ -216,35 +289,10 @@ def bit_level_loop(
             go = jnp.logical_and(go, level < max_levels)
         return go
 
-    def body(carry):
-        visited, frontier, f, levels, reached, level, _ = carry
-        new = expand(visited, frontier)
-        counts = unpack_counts(new)
-        found = counts > 0
-        dist = level + 1  # newly discovered vertices are at this distance
-        return (
-            visited | new,
-            new,
-            f + counts.astype(jnp.int64) * dist.astype(jnp.int64),
-            jnp.where(found, dist + 1, levels),
-            reached + counts,
-            level + 1,
-            jnp.any(found),
-        )
-
-    carry = (
-        frontier0,  # visited = sources
-        frontier0,
-        # Sources contribute distance 0; deriving the zero init from counts0
-        # (rather than a literal) gives it counts0's varying-axes type, so
-        # the same loop works unchanged inside shard_map shards.
-        cast(counts0.astype(jnp.int64) * 0),
-        cast(jnp.where(counts0 > 0, 1, 0).astype(jnp.int32)),
-        cast(counts0),
-        jnp.int32(0),
-        cast(jnp.any(counts0 > 0)),
+    carry = bit_level_init(frontier0, counts0, cast)
+    _, _, f, levels, reached, _, _ = lax.while_loop(
+        cond, bit_level_body(expand), carry
     )
-    _, _, f, levels, reached, _, _ = lax.while_loop(cond, body, carry)
     return f, levels, reached
 
 
@@ -310,6 +358,58 @@ def bitbell_run(
     )
 
 
+def _bitbell_expand(graph: BellGraph, sparse_budget: int):
+    """The engine's expansion hook: hybrid pull/push when a budget and a
+    dedup CSR exist, pure forest pull otherwise."""
+    if sparse_budget and graph.sparse is not None:
+        return hybrid_expand(graph, sparse_budget)
+
+    def expand(visited, frontier):
+        return bell_hits_or(frontier, graph) & ~visited
+
+    return expand
+
+
+@jax.jit
+def _bitbell_init_carry(graph: BellGraph, queries: jax.Array):
+    frontier0 = pack_queries(graph.n, queries)
+    return bit_level_init(frontier0, unpack_counts(frontier0))
+
+
+@partial(jax.jit, static_argnames=("max_levels", "sparse_budget"))
+def _bitbell_chunk(graph, carry, chunk, max_levels, sparse_budget):
+    return bit_level_chunk(
+        carry, _bitbell_expand(graph, sparse_budget), chunk, max_levels
+    )
+
+
+def bitbell_run_chunked(
+    graph: BellGraph,
+    queries: jax.Array,
+    level_chunk: int,
+    max_levels: Optional[int] = None,
+    sparse_budget: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`bitbell_run` with per-dispatch work bounded to ``level_chunk``
+    levels: a host loop re-dispatches :func:`bit_level_chunk` with the carry
+    preserved on device, paying one cheap host sync (a scalar read) per
+    chunk.  This is the safe path for high-diameter graphs — an unbounded
+    thousands-of-levels while_loop in ONE dispatch is the pattern that
+    crashed the TPU worker (docs/PERF_NOTES.md "Push-engine TPU status");
+    on ~10-level power-law graphs the single-dispatch ``bitbell_run`` is
+    preferred (no host syncs at all)."""
+    carry = _bitbell_init_carry(graph, queries)
+    while True:
+        carry = _bitbell_chunk(
+            graph, carry, jnp.int32(level_chunk), max_levels, sparse_budget
+        )
+        if not bool(np.asarray(carry[6])):
+            break
+        if max_levels is not None and int(np.asarray(carry[5])) >= max_levels:
+            break
+    return carry[2], carry[3], carry[4]
+
+
 class BitBellEngine(PackedEngineBase):
     """Bit-plane all-queries-at-once engine over a BellGraph.
 
@@ -320,7 +420,12 @@ class BitBellEngine(PackedEngineBase):
     ``sparse_budget``: hybrid pull/push threshold (edge slots).  None
     auto-sizes from the graph (:func:`default_sparse_budget`) when the
     graph retains its dedup CSR; 0 disables the hybrid (pure forest
-    pulls, the round-1 behavior)."""
+    pulls, the round-1 behavior).
+
+    ``level_chunk``: levels per XLA dispatch (None = whole BFS in one
+    dispatch, the fast path for shallow graphs).  Set for high-diameter
+    graphs so per-dispatch work stays bounded (:func:`bitbell_run_chunked`);
+    the CLI auto-enables it for road-class degree profiles."""
 
     k_align = WORD_BITS
 
@@ -329,6 +434,7 @@ class BitBellEngine(PackedEngineBase):
         graph: BellGraph,
         max_levels: Optional[int] = None,
         sparse_budget: Optional[int] = None,
+        level_chunk: Optional[int] = None,
     ):
         self.graph = graph
         self.max_levels = max_levels
@@ -336,20 +442,30 @@ class BitBellEngine(PackedEngineBase):
             e = graph.sparse[2].shape[0] if graph.sparse is not None else 0
             sparse_budget = default_sparse_budget(e) if e else 0
         self.sparse_budget = int(sparse_budget)
+        self.level_chunk = level_chunk
         self._level_warm_shapes = set()  # level_stats warms once per shape
+
+    def _bitbell_run(self, queries):
+        if self.level_chunk:
+            return bitbell_run_chunked(
+                self.graph,
+                queries,
+                self.level_chunk,
+                self.max_levels,
+                self.sparse_budget,
+            )
+        return bitbell_run(
+            self.graph, queries, self.max_levels, self.sparse_budget
+        )
 
     def f_values(self, queries) -> jax.Array:
         queries, k = self._pad_queries(queries)
-        f, _, _ = bitbell_run(
-            self.graph, queries, self.max_levels, self.sparse_budget
-        )
+        f, _, _ = self._bitbell_run(queries)
         return f[:k]
 
     def query_stats(self, queries):
         queries, k = self._pad_queries(queries)
-        f, levels, reached = bitbell_run(
-            self.graph, queries, self.max_levels, self.sparse_budget
-        )
+        f, levels, reached = self._bitbell_run(queries)
         return (
             np.asarray(levels)[:k],
             np.asarray(reached)[:k],

@@ -268,8 +268,9 @@ class PushEngine(QueryEngineBase):
     capacity-proportional — on thin-wavefront graphs an oversized bound is
     pure waste (measured on v5e: the hit scatter dominates at
     ~12 ns/slot).  Default (None) is auto mode: start from a wavefront-
-    sized guess (2*sqrt(n), the perimeter scale of a road-network disc);
-    if a run overflows, re-run at the capacity the run itself measured it
+    sized guess (8*sqrt(n), floor 2048 — multi-source road wavefronts run
+    several disc perimeters wide, see __init__); if a run overflows,
+    re-run at the capacity the run itself measured it
     needs (the loop tracks the max per-level frontier), so a fat-frontier
     graph costs ONE discarded run + one recompile, not a doubling series
     (worst case capacity=n, always sufficient).  Growth is reported on
@@ -314,7 +315,7 @@ class PushEngine(QueryEngineBase):
                 self._max_need = max(self._max_need, need)
                 if (
                     self.auto_capacity
-                    and k
+                    and need > 0
                     and 2 * self._max_need < self.capacity // 2
                 ):
                     # Growth overshoots deliberately (a retry costs a full
@@ -322,9 +323,13 @@ class PushEngine(QueryEngineBase):
                     # runs stop paying capacity-proportional cost for
                     # headroom they don't need.  The HISTORICAL peak (not
                     # this batch's) is the bound: alternating thin/fat
-                    # batches must not thrash grow/shrink cycles, and an
-                    # empty batch (k=0, need=0) must not collapse a tuned
-                    # capacity.
+                    # batches must not thrash grow/shrink cycles.  The
+                    # need > 0 guard keeps source-less batches — compile()
+                    # and the CLI warm with all -1 dummies — from ever
+                    # adapting capacity: a warm-up shrink would discard the
+                    # program that was just compiled and push 1-2 recompiles
+                    # (plus, on road-class graphs, a discarded overflow run)
+                    # into the timed computation span.
                     self.capacity = min(
                         max(self.graph.n, 1), max(1024, 2 * self._max_need)
                     )

@@ -49,16 +49,10 @@ def _distributed_bitbell_run(
     """Merged per-query (f, levels, reached), each (k_pad,), via the
     bit-packed BELL engine per shard (padding slots stay -1, like the
     reference's never-computed all_F_values entries, main.cu:325)."""
-    from ..ops.bitbell import WORD_BITS, bitbell_run
+    from ..ops.bitbell import bitbell_run
 
     def shard_body(graph, qblock):
-        qblock = qblock[0]  # local leading extent 1 on 'q'
-        j, s = qblock.shape
-        pad = (-j) % WORD_BITS
-        if pad:
-            qblock = jnp.concatenate(
-                [qblock, jnp.full((pad, s), -1, dtype=qblock.dtype)], axis=0
-            )
+        qblock, j = _pad_qblock(qblock)
         f, levels, reached = bitbell_run(graph, qblock, max_levels, sparse_budget)
         axes = (QUERY_AXIS, VERTEX_AXIS)
         return (
@@ -73,6 +67,127 @@ def _distributed_bitbell_run(
         in_specs=(P(), P(QUERY_AXIS)),
         out_specs=(P(), P(), P()),
     )(graph, query_grid)
+
+
+def _pad_qblock(qblock):
+    """Drop the local 'q' extent-1 axis and right-pad J to a multiple of 32
+    with -1 rows (semantics-preserving, main.cu:49).  Returns (qblock, j)."""
+    from ..ops.bitbell import WORD_BITS
+
+    qblock = qblock[0]
+    j, s = qblock.shape
+    pad = (-j) % WORD_BITS
+    if pad:
+        qblock = jnp.concatenate(
+            [qblock, jnp.full((pad, s), -1, dtype=qblock.dtype)], axis=0
+        )
+    return qblock, j
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _distributed_bitbell_init(mesh: Mesh, graph, query_grid: jax.Array):
+    """Per-shard bit-plane loop carries, sharded over 'q' via a leading
+    axis (element i of the tuple is the i-th bit_level_init carry slot)."""
+    from ..ops.bitbell import bit_level_init, pack_queries, unpack_counts
+
+    def shard_body(graph, qblock):
+        qblock, _ = _pad_qblock(qblock)
+        frontier0 = pack_queries(graph.n, qblock)
+        carry = bit_level_init(frontier0, unpack_counts(frontier0))
+        return tuple(x[None] for x in carry)
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(), P(QUERY_AXIS)),
+        out_specs=(P(QUERY_AXIS),) * 7,
+    )(graph, query_grid)
+
+
+@partial(jax.jit, static_argnames=("mesh", "max_levels", "sparse_budget"))
+def _distributed_bitbell_chunk(
+    mesh: Mesh, graph, carry, chunk, max_levels, sparse_budget
+):
+    """Advance every shard's carry by <= ``chunk`` levels in ONE dispatch;
+    also returns a replicated any-shard-still-running flag so the host
+    loop syncs one scalar, not the carries."""
+    from ..ops.bitbell import _bitbell_expand, bit_level_chunk
+
+    def shard_body(graph, *carry):
+        local = tuple(x[0] for x in carry)
+        out = bit_level_chunk(
+            local, _bitbell_expand(graph, sparse_budget), chunk, max_levels
+        )
+        any_up = lax.pmax(
+            out[6].astype(jnp.int32), (QUERY_AXIS, VERTEX_AXIS)
+        )
+        max_level = lax.pmax(out[5], (QUERY_AXIS, VERTEX_AXIS))
+        return tuple(x[None] for x in out) + (any_up, max_level)
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(),) + (P(QUERY_AXIS),) * 7,
+        out_specs=(P(QUERY_AXIS),) * 7 + (P(), P()),
+    )(graph, *carry)
+
+
+@partial(jax.jit, static_argnames=("mesh", "j", "k", "k_pad", "w"))
+def _distributed_bitbell_finish(
+    mesh: Mesh, f, levels, reached, j: int, k: int, k_pad: int, w: int
+):
+    """Merge per-shard counters into replicated (k_pad,) results (the
+    Gatherv+argmin contract, main.cu:324-397)."""
+
+    def shard_body(f, levels, reached):
+        axes = (QUERY_AXIS, VERTEX_AXIS)
+        return (
+            merge_local_f(f[0][:j], j, w, k, k_pad, axes),
+            merge_local_f(levels[0][:j].astype(jnp.int64), j, w, k, k_pad, axes),
+            merge_local_f(reached[0][:j].astype(jnp.int64), j, w, k, k_pad, axes),
+        )
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(QUERY_AXIS),) * 3,
+        out_specs=(P(), P(), P()),
+    )(f, levels, reached)
+
+
+def _distributed_bitbell_run_chunked(
+    mesh: Mesh,
+    graph,
+    query_grid: jax.Array,
+    k: int,
+    k_pad: int,
+    w: int,
+    max_levels,
+    sparse_budget: int,
+    level_chunk: int,
+):
+    """Host-chunked distributed bitbell: per-dispatch work bounded to
+    ``level_chunk`` levels per shard, carries living on device between
+    dispatches.  The high-diameter-safe dual of
+    :func:`_distributed_bitbell_run` (same results bit for bit)."""
+    carry = _distributed_bitbell_init(mesh, graph, query_grid)
+    while True:
+        *carry, any_up, max_level = _distributed_bitbell_chunk(
+            mesh,
+            graph,
+            tuple(carry),
+            jnp.int32(level_chunk),
+            max_levels,
+            sparse_budget,
+        )
+        if not int(np.asarray(any_up)):
+            break
+        if max_levels is not None and int(np.asarray(max_level)) >= max_levels:
+            break
+    j = query_grid.shape[1]
+    return _distributed_bitbell_finish(
+        mesh, carry[2], carry[3], carry[4], j, k, k_pad, w
+    )
 
 
 @partial(
@@ -122,7 +237,13 @@ class DistributedEngine(QueryEngineBase):
     ``backend`` picks the per-shard engine: ``"bitbell"`` (default) runs the
     bit-packed BELL reduction forest — the fastest single-chip engine — on
     each shard's query slice; ``"csr"`` runs the per-query vmap CSR pull
-    (accepts a custom ``expand`` hook, e.g. the dense-MXU frontier)."""
+    (accepts a custom ``expand`` hook, e.g. the dense-MXU frontier).
+
+    ``level_chunk`` (bitbell backend): levels per XLA dispatch.  None runs
+    the whole BFS in one dispatch (fast for shallow graphs); an int bounds
+    per-dispatch work for high-diameter graphs — the reference handles any
+    graph at any -gn (per-rank serial BFS, main.cu:303-322), and this is
+    what keeps that promise on TPU (see ops.bitbell.bitbell_run_chunked)."""
 
     def __init__(
         self,
@@ -132,6 +253,7 @@ class DistributedEngine(QueryEngineBase):
         query_chunk: Optional[int] = None,
         expand=graph_expand,
         backend: str = "bitbell",
+        level_chunk: Optional[int] = None,
     ):
         self.mesh = mesh
         self.w = mesh.shape[QUERY_AXIS]
@@ -173,14 +295,13 @@ class DistributedEngine(QueryEngineBase):
         self.max_levels = max_levels
         self.query_chunk = query_chunk
         self.expand = expand
+        if level_chunk is not None and backend != "bitbell":
+            raise ValueError("level_chunk requires backend='bitbell'")
+        self.level_chunk = level_chunk
 
-    def f_values(self, queries: np.ndarray) -> jax.Array:
-        """(K, S) -1-padded queries -> (K,) int64 F values (replicated)."""
-        sharded, k, k_pad, chunk = shard_queries(
-            self.mesh, np.asarray(queries), self.query_chunk
-        )
-        if self.backend == "bitbell":
-            merged, _, _ = _distributed_bitbell_run(
+    def _bitbell_merged(self, sharded, k, k_pad):
+        if self.level_chunk:
+            return _distributed_bitbell_run_chunked(
                 self.mesh,
                 self.bell,
                 sharded,
@@ -189,7 +310,26 @@ class DistributedEngine(QueryEngineBase):
                 self.w,
                 self.max_levels,
                 self.sparse_budget,
+                self.level_chunk,
             )
+        return _distributed_bitbell_run(
+            self.mesh,
+            self.bell,
+            sharded,
+            k,
+            k_pad,
+            self.w,
+            self.max_levels,
+            self.sparse_budget,
+        )
+
+    def f_values(self, queries: np.ndarray) -> jax.Array:
+        """(K, S) -1-padded queries -> (K,) int64 F values (replicated)."""
+        sharded, k, k_pad, chunk = shard_queries(
+            self.mesh, np.asarray(queries), self.query_chunk
+        )
+        if self.backend == "bitbell":
+            merged, _, _ = self._bitbell_merged(sharded, k, k_pad)
         else:
             merged = _distributed_f_values(
                 self.mesh,
@@ -212,16 +352,7 @@ class DistributedEngine(QueryEngineBase):
         sharded, k, k_pad, _ = shard_queries(
             self.mesh, np.asarray(queries), self.query_chunk
         )
-        f, levels, reached = _distributed_bitbell_run(
-            self.mesh,
-            self.bell,
-            sharded,
-            k,
-            k_pad,
-            self.w,
-            self.max_levels,
-            self.sparse_budget,
-        )
+        f, levels, reached = self._bitbell_merged(sharded, k, k_pad)
         return (
             np.asarray(levels[:k]).astype(np.int32),
             np.asarray(reached[:k]).astype(np.int32),

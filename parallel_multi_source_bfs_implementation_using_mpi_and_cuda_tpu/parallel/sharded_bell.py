@@ -40,13 +40,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.bell import DEFAULT_WIDTHS, BellGraph
 from ..models.csr import CSRGraph
 from ..ops.bitbell import (
-    WORD_BITS,
     bell_hits_or,
+    bit_level_chunk,
+    bit_level_init,
     bit_level_loop,
     pack_queries,
     unpack_counts,
 )
 from ..ops.engine import QueryEngineBase
+from .distributed import _distributed_bitbell_finish, _pad_qblock
 from .mesh import QUERY_AXIS, VERTEX_AXIS
 from .scheduler import merge_local_f, shard_queries
 
@@ -225,13 +227,7 @@ def _sharded_bitbell_run(
 
     def shard_body(forest, qblock):
         local = jax.tree.map(lambda x: x[0], forest)  # drop 'v' stack axis
-        qblock = qblock[0]  # local leading extent 1 on 'q'
-        j, s = qblock.shape
-        pad = (-j) % WORD_BITS
-        if pad:
-            qblock = jnp.concatenate(
-                [qblock, jnp.full((pad, s), -1, dtype=qblock.dtype)], axis=0
-            )
+        qblock, j = _pad_qblock(qblock)
         n_pad = local.n
 
         def vvary(x):
@@ -269,9 +265,140 @@ def _sharded_bitbell_run(
     )(forest, query_grid)
 
 
+def _sharded_expand_own(local: BellGraph, block: int):
+    """Own-block expansion: gather the global frontier planes from each
+    shard's own block (the halo exchange), run the shard-local forest pass,
+    and return only the shard's own block of newly-reached planes.  The
+    own-block formulation lets the chunked loop carry (L, W) blocks sharded
+    over 'v' between dispatches instead of replicated (n_pad, W) planes —
+    numerically identical to :func:`_sharded_bitbell_run`'s expand (hits
+    are zero outside owned rows by construction of the block forest)."""
+    me = lax.axis_index(VERTEX_AXIS)
+
+    def expand(visited_own, frontier_own):
+        global_frontier = lax.all_gather(
+            frontier_own, VERTEX_AXIS, tiled=True
+        )
+        hits = bell_hits_or(global_frontier, local)
+        hits_own = lax.dynamic_slice_in_dim(
+            hits, me * block, block, axis=0
+        )
+        return hits_own & ~visited_own
+
+    return expand
+
+
+@partial(jax.jit, static_argnames=("mesh", "block"))
+def _sharded_bitbell_init(mesh: Mesh, forest, query_grid: jax.Array, block: int):
+    """Per-(q,v)-shard own-block loop carries: planes are (L, W) blocks
+    sharded over ('v', 'q'); counters are per-q-shard rows."""
+
+    def shard_body(forest, qblock):
+        local = jax.tree.map(lambda x: x[0], forest)
+        qblock, _ = _pad_qblock(qblock)
+        frontier0 = pack_queries(local.n, qblock)
+        counts0 = unpack_counts(frontier0)
+        me = lax.axis_index(VERTEX_AXIS)
+        own0 = lax.dynamic_slice_in_dim(frontier0, me * block, block, axis=0)
+        carry = bit_level_init(own0, counts0)
+        return (carry[0], carry[1]) + tuple(x[None] for x in carry[2:])
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(VERTEX_AXIS), P(QUERY_AXIS)),
+        out_specs=(P(VERTEX_AXIS, QUERY_AXIS),) * 2 + (P(QUERY_AXIS),) * 5,
+    )(forest, query_grid)
+
+
+@partial(jax.jit, static_argnames=("mesh", "block", "max_levels"))
+def _sharded_bitbell_chunk(
+    mesh: Mesh, forest, carry, chunk, block: int, max_levels
+):
+    """Advance every shard's own-block carry by <= ``chunk`` levels in one
+    dispatch; per-level discovery counts come from a psum over 'v' of each
+    shard's own block (identical to counting the gathered global planes)."""
+
+    def shard_body(forest, v_own, f_own, f, lv, rc, level, upd):
+        local = jax.tree.map(lambda x: x[0], forest)
+        local_carry = (
+            v_own,
+            f_own,
+            f[0],
+            lv[0],
+            rc[0],
+            level[0],
+            upd[0],
+        )
+        out = bit_level_chunk(
+            local_carry,
+            _sharded_expand_own(local, block),
+            chunk,
+            max_levels,
+            counts_of=lambda new: lax.psum(unpack_counts(new), VERTEX_AXIS),
+        )
+        any_up = lax.pmax(out[6].astype(jnp.int32), (QUERY_AXIS, VERTEX_AXIS))
+        max_level = lax.pmax(out[5], (QUERY_AXIS, VERTEX_AXIS))
+        return (
+            (out[0], out[1])
+            + tuple(x[None] for x in out[2:])
+            + (any_up, max_level)
+        )
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(VERTEX_AXIS),)
+        + (P(VERTEX_AXIS, QUERY_AXIS),) * 2
+        + (P(QUERY_AXIS),) * 5,
+        out_specs=(P(VERTEX_AXIS, QUERY_AXIS),) * 2
+        + (P(QUERY_AXIS),) * 5
+        + (P(), P()),
+    )(forest, *carry)
+
+
+def _sharded_bitbell_run_chunked(
+    mesh: Mesh,
+    forest,
+    query_grid: jax.Array,
+    k: int,
+    k_pad: int,
+    w: int,
+    block: int,
+    max_levels,
+    level_chunk: int,
+):
+    """Host-chunked vertex-sharded bitbell: same results as
+    :func:`_sharded_bitbell_run`, with per-dispatch work bounded to
+    ``level_chunk`` levels so high-diameter (road-class) graphs never run
+    thousands of halo-exchange levels inside one XLA dispatch."""
+    carry = _sharded_bitbell_init(mesh, forest, query_grid, block)
+    while True:
+        *carry, any_up, max_level = _sharded_bitbell_chunk(
+            mesh,
+            forest,
+            tuple(carry),
+            jnp.int32(level_chunk),
+            block,
+            max_levels,
+        )
+        if not int(np.asarray(any_up)):
+            break
+        if max_levels is not None and int(np.asarray(max_level)) >= max_levels:
+            break
+    j = query_grid.shape[1]
+    return _distributed_bitbell_finish(
+        mesh, carry[2], carry[3], carry[4], j, k, k_pad, w
+    )
+
+
 class ShardedBellEngine(QueryEngineBase):
     """Queries round-robin over 'q', CSR vertex-sharded over 'v', all-K
-    bit-plane level loop with one word-packed halo all_gather per level."""
+    bit-plane level loop with one word-packed halo all_gather per level.
+
+    ``level_chunk``: levels per XLA dispatch (None = whole BFS in one
+    dispatch).  Set for high-diameter graphs — same rationale and contract
+    as DistributedEngine/BitBellEngine."""
 
     def __init__(
         self,
@@ -280,6 +407,7 @@ class ShardedBellEngine(QueryEngineBase):
         max_levels: Optional[int] = None,
         widths: Sequence[int] = DEFAULT_WIDTHS,
         min_bucket_rows: Optional[int] = None,
+        level_chunk: Optional[int] = None,
     ):
         self.mesh = mesh
         self.w = mesh.shape[QUERY_AXIS]
@@ -291,6 +419,7 @@ class ShardedBellEngine(QueryEngineBase):
         vspec = NamedSharding(mesh, P(VERTEX_AXIS))
         self.forest = jax.device_put(stacked, vspec)
         self.max_levels = max_levels
+        self.level_chunk = level_chunk
 
     def _run(self, queries: np.ndarray):
         # Reference bounds check (main.cu:48-50): sources outside [0, n) are
@@ -301,16 +430,29 @@ class ShardedBellEngine(QueryEngineBase):
         queries = np.asarray(queries)
         queries = np.where((queries >= 0) & (queries < self.n), queries, -1)
         sharded, k, k_pad, _ = shard_queries(self.mesh, queries, None)
-        f, levels, reached = _sharded_bitbell_run(
-            self.mesh,
-            self.forest,
-            sharded,
-            k,
-            k_pad,
-            self.w,
-            self.block,
-            self.max_levels,
-        )
+        if self.level_chunk:
+            f, levels, reached = _sharded_bitbell_run_chunked(
+                self.mesh,
+                self.forest,
+                sharded,
+                k,
+                k_pad,
+                self.w,
+                self.block,
+                self.max_levels,
+                self.level_chunk,
+            )
+        else:
+            f, levels, reached = _sharded_bitbell_run(
+                self.mesh,
+                self.forest,
+                sharded,
+                k,
+                k_pad,
+                self.w,
+                self.block,
+                self.max_levels,
+            )
         return f, levels, reached, k
 
     def f_values(self, queries: np.ndarray) -> jax.Array:

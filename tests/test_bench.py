@@ -1,0 +1,92 @@
+"""bench.py contract tests: the driver must ALWAYS get one parsable JSON
+line — a result when the backend works, an error record when it doesn't
+(round-3 hardening after BENCH_r02 recorded rc=124 with parsed: null).
+
+All cases run bench.py as a subprocess from the repo root, exactly like
+the driver does, against the virtual CPU platform."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from virtual_cpu import virtual_cpu_env
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO_ROOT, "bench.py")
+
+
+def run_bench(extra_env, timeout=600):
+    env = virtual_cpu_env(8)
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, BENCH],
+        env=env,
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def last_json_line(stdout: str) -> dict:
+    lines = [l for l in stdout.strip().splitlines() if l.lstrip().startswith("{")]
+    assert lines, f"no JSON line in stdout: {stdout!r}"
+    return json.loads(lines[-1])
+
+
+@pytest.mark.slow
+def test_success_emits_metric_and_extras():
+    proc = run_bench(
+        {
+            "BENCH_SCALE": "10",
+            "BENCH_K": "32",
+            "BENCH_MAX_S": "8",
+            "BENCH_REPEATS": "1",
+            "BENCH_EXTRA_KS": "64",
+            "BENCH_WAIT_S": "120",
+            "BENCH_RUN_S": "540",
+        }
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = last_json_line(proc.stdout)
+    assert rec["unit"] == "TEPS"
+    assert rec["value"] and rec["value"] > 0
+    assert rec["vs_baseline"] is not None
+    extras = rec["detail"]["extra_metrics"]
+    assert len(extras) == 1 and extras[0]["value"] > 0
+    assert "64-query" in extras[0]["metric"]
+
+
+def test_outage_fast_parsable_failure():
+    """A dead backend must produce an error JSON line within the
+    BENCH_WAIT_S budget — not a hang into the driver's kill timeout."""
+    proc = run_bench(
+        {"JAX_PLATFORMS": "bogus_platform", "BENCH_WAIT_S": "1"},
+        timeout=180,
+    )
+    assert proc.returncode == 2
+    rec = last_json_line(proc.stdout)
+    assert rec["value"] is None
+    assert "device unavailable" in rec["error"]
+    assert rec["vs_baseline"] is None
+    assert rec["metric"].startswith("TEPS")
+
+
+def test_midrun_stall_hits_hard_deadline():
+    """BENCH_RUN_S bounds the workload: a child that cannot finish in time
+    is killed and reported, again as parsable JSON."""
+    proc = run_bench(
+        {
+            "BENCH_SCALE": "10",
+            "BENCH_WAIT_S": "120",
+            "BENCH_RUN_S": "1",
+        },
+        timeout=300,
+    )
+    assert proc.returncode == 3
+    rec = last_json_line(proc.stdout)
+    assert rec["value"] is None
+    assert "hard deadline" in rec["error"]

@@ -254,3 +254,28 @@ def test_estimate_hbm_bytes_routing_properties():
     assert eight > 16 * 2 * (1 << 20)  # plane floor: 16 B * words * n
     assert est(1 << 21, 1 << 25, 64) > one  # monotone in n
     assert est(1 << 20, 1 << 26, 64) > one  # monotone in e
+
+
+def test_sparse_hits_or_edgeless_graph():
+    """Forcing a sparse budget on an edgeless graph must be well-defined:
+    the dedup CSR is empty, and the general path's index arithmetic would
+    clip into inverted bounds (advisor r2).  Sources are reached, nothing
+    else; a direct sparse_hits_or call returns all-zero hit planes."""
+    import jax.numpy as jnp
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        pack_queries,
+        sparse_hits_or,
+    )
+
+    n = 16
+    g = CSRGraph.from_edges(n, np.zeros((0, 2), dtype=np.int64))
+    bg = BellGraph.from_host(g)
+    assert bg.sparse is not None and bg.sparse[2].shape[0] == 0
+    queries = pad_queries([np.array([3], dtype=np.int32)], pad_to=4)
+    frontier = pack_queries(n, jnp.asarray(np.tile(queries, (32, 1))))
+    hits = np.asarray(sparse_hits_or(frontier, bg, budget=8))
+    assert (hits == 0).all()
+    eng = BitBellEngine(bg, sparse_budget=8)
+    levels, reached, f = eng.query_stats(np.tile(queries, (32, 1)))
+    assert (reached == 1).all() and (f == 0).all() and (levels == 1).all()

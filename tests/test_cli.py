@@ -189,3 +189,76 @@ def test_single_chip_hbm_warning(tmp_path, capsys, monkeypatch):
     captured = capsys.readouterr()
     assert rc == 0  # proceeds (small graph fits in reality)
     assert "run with -gn > 1" in captured.err
+
+
+@pytest.fixture(scope="module")
+def road_files(tmp_path_factory):
+    """A path graph (diameter ~240): road-class degree profile, so the CLI
+    must auto-bound bit-plane dispatches (round-3 high-diameter safety)."""
+    d = tmp_path_factory.mktemp("cli_road")
+    n = 240
+    edges = np.stack(
+        [np.arange(n - 1), np.arange(1, n)], axis=1
+    ).astype(np.int64)
+    queries = [[0], [n - 1], [5, 120]]
+    gpath, qpath = str(d / "g.bin"), str(d / "q.bin")
+    save_graph_bin(gpath, n, edges)
+    save_query_bin(qpath, queries)
+    want = oracle_best(
+        [oracle_f(oracle_bfs(n, edges, np.asarray(s))) for s in queries]
+    )
+    return gpath, qpath, want
+
+
+def _assert_report(out, want, gn):
+    min_f, min_k = want
+    m = REPORT_RE.match(out)
+    assert m, f"report format mismatch:\n{out!r}"
+    assert int(m["mink"]) == min_k + 1 and int(m["minf"]) == min_f
+    assert int(m["gn"]) == gn
+
+
+def test_road_class_auto_chunk_gn1_vs_gn8(road_files, capsys, monkeypatch):
+    """The -gn 1 and -gn 8 paths agree on a high-diameter graph, and both
+    announce the bounded-dispatch routing (reference: any graph at any
+    -gn, main.cu:303-322)."""
+    gpath, qpath, want = road_files
+    monkeypatch.delenv("MSBFS_LEVEL_CHUNK", raising=False)
+    for gn in (1, 8):
+        rc, out, err = run_cli(
+            ["main.py", "-g", gpath, "-q", qpath, "-gn", str(gn)], capsys
+        )
+        assert rc == 0
+        assert "road-class degree profile" in err
+        _assert_report(out, want, gn)
+
+
+def test_road_class_vertex_sharded_chunked(road_files, capsys, monkeypatch):
+    gpath, qpath, want = road_files
+    monkeypatch.setenv("MSBFS_VSHARD", "2")
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "8"], capsys
+    )
+    assert rc == 0
+    assert "road-class degree profile" in err
+    _assert_report(out, want, 8)
+
+
+def test_multichip_honors_backend_env(files, capsys, monkeypatch):
+    """MSBFS_BACKEND is honored at -gn > 1 (round 3; it used to be
+    single-chip only): csr routes to the per-query pull, single-chip-only
+    backends warn and fall back."""
+    gpath, qpath, want = files
+    monkeypatch.setenv("MSBFS_BACKEND", "csr")
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "4"], capsys
+    )
+    assert rc == 0
+    _assert_report(out, want, 4)
+    monkeypatch.setenv("MSBFS_BACKEND", "push")
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "4"], capsys
+    )
+    assert rc == 0
+    assert "single-chip only" in err
+    _assert_report(out, want, 4)

@@ -1,0 +1,153 @@
+"""High-diameter safety at any -gn (round 3): the bit-plane engines can
+bound per-dispatch work to ``level_chunk`` BFS levels, host-chunking the
+level loop like the push engine does (ops.push.default_push_chunk), with
+the carry preserved on device across dispatches.
+
+The load-bearing case is a >= 500-level graph through DistributedEngine
+and ShardedBellEngine on the virtual mesh — the reference handles any
+graph at any -gn (per-rank serial BFS, main.cu:303-322), and these tests
+pin that the chunked paths return bit-identical results to the unchunked
+single-dispatch loops."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+    _level_chunk_policy,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+    BitBellEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
+    DistributedEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+    make_mesh,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (
+    ShardedBellEngine,
+)
+
+
+def deep_problem():
+    """A 600-vertex path: BFS from an endpoint runs 600 levels."""
+    n = 600
+    edges = np.stack(
+        [np.arange(n - 1), np.arange(1, n)], axis=1
+    ).astype(np.int64)
+    queries = [
+        np.array([0], dtype=np.int32),
+        np.array([n - 1], dtype=np.int32),
+        np.array([7, 300], dtype=np.int32),
+        np.zeros(0, dtype=np.int32),
+    ]
+    return CSRGraph.from_edges(n, edges), pad_queries(queries)
+
+
+@pytest.fixture(scope="module")
+def deep():
+    g, padded = deep_problem()
+    ref = BitBellEngine(BellGraph.from_host(g)).query_stats(padded)
+    assert ref[0].max() >= 500  # the >=500-level precondition
+    return g, padded, ref
+
+
+def assert_stats_equal(ref, got):
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("chunk", [1, 32, 1000])
+def test_bitbell_chunked_matches_unchunked(deep, chunk):
+    g, padded, ref = deep
+    eng = BitBellEngine(BellGraph.from_host(g), level_chunk=chunk)
+    assert_stats_equal(ref, eng.query_stats(padded))
+
+
+def test_distributed_chunked_deep_graph(deep):
+    g, padded, ref = deep
+    mesh = make_mesh(num_query_shards=8)
+    eng = DistributedEngine(mesh, g, level_chunk=32)
+    assert_stats_equal(ref, eng.query_stats(padded))
+    np.testing.assert_array_equal(
+        np.asarray(eng.f_values(padded)), np.asarray(ref[2])
+    )
+
+
+def test_sharded_bell_chunked_deep_graph(deep):
+    g, padded, ref = deep
+    mesh = make_mesh(num_query_shards=4, num_vertex_shards=2)
+    eng = ShardedBellEngine(mesh, g, level_chunk=32)
+    assert_stats_equal(ref, eng.query_stats(padded))
+
+
+def test_sharded_bell_chunked_uneven_blocks(deep):
+    g, padded, ref = deep
+    mesh = make_mesh(num_query_shards=1, num_vertex_shards=8)
+    eng = ShardedBellEngine(mesh, g, level_chunk=7)  # 600 % 7 != 0 too
+    assert_stats_equal(ref, eng.query_stats(padded))
+
+
+def test_chunked_hybrid_power_law():
+    """Chunking composes with the hybrid pull/push expansion."""
+    n, edges = generators.rmat_edges(9, edge_factor=8, seed=31)
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 6, max_group=4, seed=32)
+    padded = pad_queries(queries)
+    ref = BitBellEngine(BellGraph.from_host(g), sparse_budget=64).query_stats(
+        padded
+    )
+    got = BitBellEngine(
+        BellGraph.from_host(g), sparse_budget=64, level_chunk=2
+    ).query_stats(padded)
+    assert_stats_equal(ref, got)
+
+
+def test_chunked_respects_max_levels(deep):
+    g, padded, _ = deep
+    ref = BitBellEngine(BellGraph.from_host(g), max_levels=5).query_stats(
+        padded
+    )
+    got = BitBellEngine(
+        BellGraph.from_host(g), max_levels=5, level_chunk=2
+    ).query_stats(padded)
+    assert_stats_equal(ref, got)
+    mesh = make_mesh(num_query_shards=4, num_vertex_shards=2)
+    sharded = ShardedBellEngine(mesh, g, max_levels=5, level_chunk=2)
+    assert_stats_equal(ref, sharded.query_stats(padded))
+    dist = DistributedEngine(
+        make_mesh(num_query_shards=8), g, max_levels=5, level_chunk=2
+    )
+    assert_stats_equal(ref, dist.query_stats(padded))
+
+
+def test_level_chunk_requires_bitbell_backend(deep):
+    g, _, _ = deep
+    mesh = make_mesh(num_query_shards=2, devices=jax.devices()[:2])
+    with pytest.raises(ValueError):
+        DistributedEngine(mesh, g, backend="csr", level_chunk=8)
+
+
+def test_policy_detects_road_class(monkeypatch):
+    monkeypatch.delenv("MSBFS_LEVEL_CHUNK", raising=False)
+    g_road, _ = deep_problem()
+    assert _level_chunk_policy(g_road) == 32
+    n, edges = generators.rmat_edges(10, edge_factor=16, seed=7)
+    g_rmat = CSRGraph.from_edges(n, edges)
+    assert _level_chunk_policy(g_rmat) is None  # hubs exceed the degree cap
+    monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "0")
+    assert _level_chunk_policy(g_road) is None  # 0 disables
+    monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "64")
+    assert _level_chunk_policy(g_rmat) == 64  # explicit wins

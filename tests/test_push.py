@@ -184,3 +184,20 @@ def test_push_level_stats_grows_capacity():
     assert eng.capacity > 2
     w = eng.query_stats(padded)
     np.testing.assert_array_equal(f, w[2])
+
+
+def test_push_warmup_never_adapts_capacity():
+    """compile()/the CLI warm engines with all -1 dummy batches (sources
+    present in shape only).  A source-less batch must not shrink a tuned
+    capacity: the shrink discards the program that was just compiled and
+    moves recompiles into the timed computation span (advisor r2)."""
+    n, edges = generators.grid_edges(60, 60)  # n big enough that the
+    g = CSRGraph.from_edges(n, edges)  # auto guess exceeds the 1024 floor
+    eng = PushEngine(PaddedAdjacency.from_host(g))
+    cap0 = eng.capacity
+    assert cap0 > 1024  # precondition: a shrink would be observable
+    dummy = np.full((4, 3), -1, dtype=np.int32)
+    eng.f_values(dummy)  # k > 0 but need == 0: the advisor's trigger
+    assert eng.capacity == cap0
+    eng.compile((4, 3))
+    assert eng.capacity == cap0

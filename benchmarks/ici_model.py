@@ -105,6 +105,43 @@ def measure():
         )
         print(json.dumps(rows[-1]), flush=True)
 
+    # ---- round-3 validation: the compacted halo + in-block push removes
+    # the per-level n_pad scaling on road-class (thin-wavefront) graphs.
+    # Mid-BFS per-level cost via the engine's stepped trace, dense
+    # (halo_budget=0) vs auto sparse, at two sizes: dense must scale with
+    # n_pad, sparse must not (docs/PERF_NOTES.md "ICI cost model").
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+        CSRGraph,
+        pad_queries,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (
+        ShardedBellEngine,
+    )
+
+    for n in (1 << 18, 1 << 20):
+        edges = np.stack(
+            [np.arange(n - 1), np.arange(1, n)], axis=1
+        ).astype(np.int64)
+        g = CSRGraph.from_edges(n, edges)
+        mesh = make_mesh(num_query_shards=1, num_vertex_shards=8)
+        srcs = rng.integers(0, n, size=32)
+        queries = pad_queries(
+            [np.asarray([s], dtype=np.int32) for s in srcs]
+        )
+        for mode, kw in (
+            ("dense", {"halo_budget": 0}),
+            ("sparse+push", {}),
+        ):
+            eng = ShardedBellEngine(mesh, g, max_levels=60, **kw)
+            _, _, _, _, secs = eng.level_stats(queries)
+            mid = float(np.median(secs[5:]))
+            print(
+                json.dumps(
+                    {"road_n": n, "mode": mode, "mid_level_s": mid}
+                ),
+                flush=True,
+            )
+
 
 def main():
     if os.environ.get("MSBFS_ICI_CHILD"):
@@ -132,7 +169,7 @@ def main():
     # p=4, w=2 points; predict the other p=4 rows; report p rows as the
     # observed p-(in)sensitivity.  On real ICI the standard ring model
     # multiplies plane bytes by (p-1)/p — see docs/PERF_NOTES.md.
-    fit = [r for r in rows if r["p"] == 4 and r["w"] == 2]
+    fit = [r for r in rows if r.get("p") == 4 and r.get("w") == 2]
     if len(fit) < 2 or fit[0]["n_pad"] == fit[-1]["n_pad"]:
         sys.exit("need both p=4, w=2 points for the fit; child died early?")
     a, b = fit[0], fit[-1]
@@ -144,6 +181,8 @@ def main():
         f"BW_eff={bw/1e9:.2f} GB/s per shard"
     )
     for r in rows:
+        if "road_n" in r:
+            continue
         pred = r["n_pad"] * r["w"] * 4 * inv_bw
         tag = "" if r["p"] == 4 else "  [p-scaling: observed only]"
         print(
@@ -151,6 +190,39 @@ def main():
             f"{r['halo_s']*1e3:7.3f} ms/level, byte-linear model "
             f"{pred*1e3:7.3f} ({(pred/r['halo_s']-1)*100:+.0f}%){tag}"
         )
+    road = [r for r in rows if "road_n" in r]
+    if road:
+        print("# road-class mid-BFS level cost (stepped trace, p=8, K=32):")
+        for r in road:
+            print(
+                f"n={r['road_n']:>8} {r['mode']:>11}: "
+                f"{r['mid_level_s']*1e3:7.3f} ms/level"
+            )
+        by = {(r["road_n"], r["mode"]): r["mid_level_s"] for r in road}
+        ns = sorted({r["road_n"] for r in road})
+        keys = [(n, m) for n in ns for m in ("dense", "sparse+push")]
+        if len(ns) == 2 and all(k in by for k in keys):
+            d_ratio = by[(ns[1], "dense")] / max(by[(ns[0], "dense")], 1e-9)
+            s_ratio = by[(ns[1], "sparse+push")] / max(
+                by[(ns[0], "sparse+push")], 1e-9
+            )
+            print(
+                f"# n x{ns[1]//ns[0]}: dense level cost x{d_ratio:.2f}, "
+                f"sparse+push x{s_ratio:.2f}"
+            )
+            print(
+                "# CPU-mesh caveat: a shared-memory all_gather is ~free, so"
+                " both modes are bound by the O(L) own-block plane"
+                " materialization here and wall-clock shows no sparse win"
+                " (a path graph's E~2n makes the forest pass as cheap as"
+                " the memset).  What this run validates is the BYTE model:"
+                " the dense halo is byte-linear (fit above) at n_pad*w*4"
+                " B/level, while the sparse exchange is budget-bounded at"
+                " p*B*(4+4w) B/level — at road-24M/K=64/p=8 that is 191 MB"
+                " vs ~1.6 MB of ICI traffic per level, which is the term"
+                " the ICI projection says dominates road-class sharded BFS"
+                " on real hardware (docs/PERF_NOTES.md)."
+            )
 
 
 if __name__ == "__main__":

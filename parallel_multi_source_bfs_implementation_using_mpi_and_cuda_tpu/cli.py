@@ -335,7 +335,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         stats_mode = stats_env in ("1", "2")
         # MSBFS_STATS=2: additionally trace each BFS level (frontier size,
         # wall time) via the engine's stepped loop, when it has one.
-        stats_level = stats_env == "2" and hasattr(engine, "level_stats")
+        stats_level = stats_env == "2" and callable(
+            getattr(engine, "level_stats", None)
+        )
         ckpt_path = os.environ.get("MSBFS_CHECKPOINT")
         ckpt_chunk = _env_int("MSBFS_CHECKPOINT_CHUNK", 64)
         if ckpt_path:

@@ -270,6 +270,7 @@ def bit_level_loop(
     expand,  # (visited, frontier) -> newly-reached global planes
     max_levels,
     cast=lambda x: x,  # varying-axes cast for shard_map callers
+    counts_of=unpack_counts,  # see bit_level_body
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """The shared bit-plane level loop: returns (f, levels, reached).
 
@@ -291,7 +292,7 @@ def bit_level_loop(
 
     carry = bit_level_init(frontier0, counts0, cast)
     _, _, f, levels, reached, _, _ = lax.while_loop(
-        cond, bit_level_body(expand), carry
+        cond, bit_level_body(expand, counts_of), carry
     )
     return f, levels, reached
 

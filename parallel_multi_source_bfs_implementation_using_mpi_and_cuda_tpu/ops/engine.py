@@ -75,7 +75,9 @@ class QueryEngineBase:
         self.best(dummy)
         if warm_stats and queries_shape[0]:
             self.query_stats(dummy)
-        if warm_levels and queries_shape[0] and hasattr(self, "level_stats"):
+        if warm_levels and queries_shape[0] and callable(
+            getattr(self, "level_stats", None)
+        ):
             self.level_stats(dummy)
 
     def query_stats(self, queries):

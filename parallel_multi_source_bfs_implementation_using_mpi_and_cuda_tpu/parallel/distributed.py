@@ -190,6 +190,53 @@ def _distributed_bitbell_run_chunked(
     )
 
 
+def stepped_level_stats(init, step, finish, k, max_levels, warmed: bool):
+    """Shared per-level trace driver for the multi-chip engines
+    (MSBFS_STATS=2 at -gn > 1): single-level dispatches so each BFS level
+    is individually timed, with the BitBellEngine.level_stats contract —
+    (levels, reached, f, level_counts, level_seconds), ``level_counts`` row
+    d = vertices discovered at distance d per query (row 0 = sources).
+
+    ``init()`` -> carry (the 7-tuple whose slot 6 is the per-shard updated
+    flags); ``step(carry)`` -> carry advanced by ONE level; ``finish(carry)``
+    -> merged (f, levels, reached) replicated arrays.  The per-query stats
+    are the loop's own counters, so they match ``query_stats`` exactly.
+    Each timed row includes that level's merge dispatch — this is a
+    diagnostic mode, not the performance path.  ``warmed`` False compiles
+    all three programs with one untimed init+step+finish pass first."""
+    import time as _time
+
+    if not warmed:
+        finish(step(init()))
+    t0 = _time.perf_counter()
+    carry = init()
+    _, _, reached0 = finish(carry)
+    reached_prev = np.asarray(reached0[:k]).astype(np.int64)
+    level_seconds = [_time.perf_counter() - t0]
+    level_counts = [reached_prev.copy()]
+    # Loop/truncation shape mirrors BitBellEngine.level_stats exactly: test
+    # before stepping, so the trailing row is the discovers-nothing probe
+    # and max_levels truncation produces the same row count.
+    while np.asarray(carry[6]).any():
+        if max_levels is not None and len(level_counts) > max_levels:
+            break
+        t0 = _time.perf_counter()
+        carry = step(carry)
+        _, _, reached_m = finish(carry)
+        reached = np.asarray(reached_m[:k]).astype(np.int64)
+        level_seconds.append(_time.perf_counter() - t0)
+        level_counts.append(reached - reached_prev)
+        reached_prev = reached
+    f, levels, reached_m = finish(carry)
+    return (
+        np.asarray(levels[:k]).astype(np.int32),
+        np.asarray(reached_m[:k]).astype(np.int32),
+        np.asarray(f[:k]),
+        np.stack(level_counts),
+        np.asarray(level_seconds),
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=("mesh", "k", "k_pad", "w", "query_chunk", "max_levels", "expand"),
@@ -298,6 +345,12 @@ class DistributedEngine(QueryEngineBase):
         if level_chunk is not None and backend != "bitbell":
             raise ValueError("level_chunk requires backend='bitbell'")
         self.level_chunk = level_chunk
+        self._level_warm_shapes = set()
+        if backend != "bitbell":
+            # The stepped trace drives the bitbell carry; mask the method so
+            # callers (the CLI's MSBFS_STATS=2 route) can probe support with
+            # callable(getattr(engine, "level_stats", None)).
+            self.level_stats = None
 
     def _bitbell_merged(self, sharded, k, k_pad):
         if self.level_chunk:
@@ -358,3 +411,39 @@ class DistributedEngine(QueryEngineBase):
             np.asarray(reached[:k]).astype(np.int32),
             np.asarray(f[:k]),
         )
+
+    def level_stats(self, queries):
+        """Per-level trace (MSBFS_STATS=2) at -gn > 1: the shared stepped
+        driver over this engine's init/chunk/finish programs — the same
+        counters as :meth:`query_stats`, one timed dispatch per level."""
+        queries = np.asarray(queries)
+        sharded, k, k_pad, _ = shard_queries(
+            self.mesh, queries, self.query_chunk
+        )
+        j = sharded.shape[1]
+
+        def init():
+            return _distributed_bitbell_init(self.mesh, self.bell, sharded)
+
+        def step(carry):
+            *out, _, _ = _distributed_bitbell_chunk(
+                self.mesh,
+                self.bell,
+                tuple(carry),
+                jnp.int32(1),
+                self.max_levels,
+                self.sparse_budget,
+            )
+            return tuple(out)
+
+        def finish(carry):
+            return _distributed_bitbell_finish(
+                self.mesh, carry[2], carry[3], carry[4], j, k, k_pad, self.w
+            )
+
+        warmed = queries.shape in self._level_warm_shapes
+        out = stepped_level_stats(
+            init, step, finish, k, self.max_levels, warmed
+        )
+        self._level_warm_shapes.add(queries.shape)
+        return out

@@ -44,10 +44,13 @@ from ..ops.bitbell import (
     bit_level_chunk,
     bit_level_init,
     bit_level_loop,
+    pack_byte_planes,
     pack_queries,
+    unpack_byte_planes,
     unpack_counts,
 )
 from ..ops.engine import QueryEngineBase
+from ..ops.push import compact_indices
 from .distributed import _distributed_bitbell_finish, _pad_qblock
 from .mesh import QUERY_AXIS, VERTEX_AXIS
 from .scheduler import merge_local_f, shard_queries
@@ -212,43 +215,100 @@ def build_sharded_forest(
     return stacked, L, n_pad
 
 
-@partial(jax.jit, static_argnames=("mesh", "k", "k_pad", "w", "block", "max_levels"))
+def build_push_halo(g: CSRGraph, p: int, L: int, n_pad: int):
+    """Per-shard IN-BLOCK push CSR, harmonized across shards for SPMD.
+
+    For shard b, the adjacency "global source u -> u's neighbors inside
+    block b", keyed by a sorted compact source table (only sources with at
+    least one in-block edge), so memory is O(E_b + sources_b), not
+    O(n_pad) per shard.  Neighbor values are block-LOCAL row indices.
+    This is what lets a thin level scatter gathered (id, words) pairs
+    straight into the shard's own hit planes instead of running the full
+    forest gather (the 'v'-axis port of the single-chip hybrid's
+    sparse_hits_or; ops/bitbell.py).
+
+    Returns a 4-tuple of stacked arrays — (src_ids (p, M), src_start
+    (p, M), src_cnt (p, M), vals (p, E)) — padded to cross-shard maxima
+    (src_ids pads with n_pad so searchsorted stays sorted; vals pads with
+    L, the scatter-drop row).  Dedup (set semantics) keeps the edge budget
+    honest, exactly like the single-chip hybrid's CSR.
+    """
+    u, v, _ = g.deduped_pairs()  # sorted by (src, dst)
+    # One stable partition by destination block (blocks are uniform L), not
+    # p full-size masks over E: the stable argsort preserves the (src, dst)
+    # order within each block, so per-block sources stay sorted.
+    blk = v // L
+    order = np.argsort(blk, kind="stable")
+    u_s, v_s, blk_s = u[order], v[order], blk[order]
+    bounds = np.searchsorted(blk_s, np.arange(p + 1))
+    ids_l, start_l, cnt_l, vals_l = [], [], [], []
+    for b in range(p):
+        sl = slice(bounds[b], bounds[b + 1])
+        ub, vb = u_s[sl], v_s[sl] - b * L
+        uniq, first = np.unique(ub, return_index=True)  # ub is sorted
+        cnt = np.diff(np.append(first, ub.size))
+        ids_l.append(uniq)
+        start_l.append(first)
+        cnt_l.append(cnt)
+        vals_l.append(vb)
+    m_pad = max((len(x) for x in ids_l), default=0)
+    e_pad = max((len(x) for x in vals_l), default=0)
+
+    def pad(arrs, to, fill):
+        out = np.full((p, to), fill, dtype=np.int32)
+        for i, a in enumerate(arrs):
+            out[i, : len(a)] = a
+        return jnp.asarray(out)
+
+    return (
+        pad(ids_l, m_pad, n_pad),
+        pad(start_l, m_pad, 0),
+        pad(cnt_l, m_pad, 0),
+        pad(vals_l, e_pad, L),
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "k", "k_pad", "w", "block", "max_levels", "halo_budget",
+        "push_budget",
+    ),
+)
 def _sharded_bitbell_run(
     mesh: Mesh,
     forest,  # shard-stacked BellGraph, leaves sharded over 'v'
+    push,  # stacked in-block push CSR (build_push_halo) or None
     query_grid: jax.Array,  # (W, J, S) cyclic layout, sharded over 'q'
     k: int,
     k_pad: int,
     w: int,
     block: int,
     max_levels,
+    halo_budget: int = 0,
+    push_budget: int = 0,
 ):
-    """Merged per-query (f, levels, reached), each (k_pad,) replicated."""
+    """Merged per-query (f, levels, reached), each (k_pad,) replicated.
 
-    def shard_body(forest, qblock):
+    Own-block formulation throughout (see :func:`_sharded_expand_own`): the
+    loop carries each shard's (L, W) block, the halo all_gather opens each
+    level, and per-query counts are a psum over 'v' of own-block counts —
+    bit-identical to counting the gathered global planes."""
+
+    def shard_body(forest, push, qblock):
         local = jax.tree.map(lambda x: x[0], forest)  # drop 'v' stack axis
+        push = jax.tree.map(lambda x: x[0], push)
         qblock, j = _pad_qblock(qblock)
-        n_pad = local.n
-
-        def vvary(x):
-            # Collective outputs carry a ('q','v')-varying type; give the
-            # initial loop carry the same one.
-            return lax.pcast(x, (VERTEX_AXIS,), to="varying")
-
-        frontier0 = pack_queries(n_pad, qblock)
+        frontier0 = pack_queries(local.n, qblock)
         counts0 = unpack_counts(frontier0)
         me = lax.axis_index(VERTEX_AXIS)
-
-        def expand(visited, frontier):
-            hits = bell_hits_or(frontier, local)  # zero outside owned rows
-            new = hits & ~visited
-            # Halo exchange: shards own disjoint row blocks, so gathering
-            # each shard's own (L, W) slice reconstructs the global planes.
-            mine = lax.dynamic_slice_in_dim(new, me * block, block, axis=0)
-            return lax.all_gather(mine, VERTEX_AXIS, tiled=True)
-
+        own0 = lax.dynamic_slice_in_dim(frontier0, me * block, block, axis=0)
         f, levels, reached = bit_level_loop(
-            vvary(frontier0), counts0, expand, max_levels, cast=vvary
+            own0,
+            counts0,
+            _sharded_expand_own(local, block, halo_budget, push, push_budget),
+            max_levels,
+            counts_of=lambda new: lax.psum(unpack_counts(new), VERTEX_AXIS),
         )
         axes = (QUERY_AXIS, VERTEX_AXIS)
         return (
@@ -260,32 +320,177 @@ def _sharded_bitbell_run(
     return jax.shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(VERTEX_AXIS), P(QUERY_AXIS)),
+        in_specs=(P(VERTEX_AXIS), P(VERTEX_AXIS), P(QUERY_AXIS)),
         out_specs=(P(), P(), P()),
-    )(forest, query_grid)
+    )(forest, push, query_grid)
 
 
-def _sharded_expand_own(local: BellGraph, block: int):
+def _push_own_hits(push, flat_ids, flat_words, deg, st, block, push_budget):
+    """Scatter gathered (global id, word row) frontier pairs into this
+    shard's own-block hit planes via its in-block push CSR — the budget-
+    bounded dual of the forest gather for thin levels (cost proportional
+    to ``push_budget``, independent of the shard's slot count).
+
+    Same owner-fill + byte-lane scatter-max machinery as the single-chip
+    ``sparse_hits_or`` (elementwise max on 0/1 bytes IS bitwise OR, and
+    colliding rows — several frontier vertices sharing an in-block
+    neighbor — resolve exactly like the reference kernel's benign write
+    race, main.cu:30-33)."""
+    vals = push[3]
+    m = flat_ids.shape[0]
+    pos = jnp.cumsum(deg) - deg  # exclusive: edge range start per source
+    total = pos[-1] + deg[-1]
+    own = (
+        jnp.zeros((push_budget,), jnp.int32)
+        .at[jnp.where(deg > 0, pos, push_budget)]
+        .max(jnp.arange(m, dtype=jnp.int32), mode="drop")
+    )
+    own = lax.cummax(own)
+    j = jnp.arange(push_budget, dtype=jnp.int32)
+    within = j - jnp.take(pos, own)
+    valid_e = j < total
+    eidx = jnp.clip(jnp.take(st, own) + within, 0, vals.shape[0] - 1)
+    nbr = jnp.where(valid_e, jnp.take(vals, eidx), block)  # row `block` drops
+    src_bytes = unpack_byte_planes(flat_words)  # (m, K) 0/1 bytes
+    rows = jnp.take(src_bytes, own, axis=0)  # (push_budget, K)
+    hit_bytes = (
+        jnp.zeros((block + 1, rows.shape[1]), jnp.uint8).at[nbr].max(rows)
+    )
+    return pack_byte_planes(hit_bytes[:block])
+
+
+def _sharded_expand_own(
+    local: BellGraph,
+    block: int,
+    halo_budget: int = 0,
+    push=None,
+    push_budget: int = 0,
+):
     """Own-block expansion: gather the global frontier planes from each
     shard's own block (the halo exchange), run the shard-local forest pass,
     and return only the shard's own block of newly-reached planes.  The
     own-block formulation lets the chunked loop carry (L, W) blocks sharded
     over 'v' between dispatches instead of replicated (n_pad, W) planes —
-    numerically identical to :func:`_sharded_bitbell_run`'s expand (hits
-    are zero outside owned rows by construction of the block forest)."""
+    numerically identical to the full-plane formulation (hits are zero
+    outside owned rows by construction of the block forest).
+
+    ``halo_budget`` > 0 enables the COMPACTED halo: when every shard's own
+    new-frontier fits the budget, the level exchanges (global row id, word
+    row) pairs — p * budget * 4*(1+W) bytes — instead of the full
+    n_pad * 4*W plane bytes, and each shard rebuilds the global planes with
+    one bounded scatter.  This is the fix the ICI cost model calls for on
+    high-diameter graphs, where thousands of thin-wavefront levels
+    otherwise pay a full-plane all_gather each (docs/PERF_NOTES.md "ICI
+    cost model": road-class sharded levels are halo-bound).  The per-level
+    routing predicate is a pmax over 'v' of the own-row count, so every
+    shard of a 'v' ring takes the same branch; reconstruction is exact —
+    row ids are globally unique, so the scatter has no collisions — and
+    overflow is impossible by construction (the dense branch runs instead).
+    """
     me = lax.axis_index(VERTEX_AXIS)
+    n_pad = local.n
+    # push leaves arrive shard-local here: src_ids (M,), vals (E,).  M or E
+    # of zero means NO shard has in-block edges (edgeless graph) — the
+    # lookup/scatter shapes would be degenerate, so fall back to forest.
+    can_push = (
+        push is not None
+        and push_budget > 0
+        and push[0].shape[0] > 0
+        and push[3].shape[0] > 0
+    )
+
+    def forest_own(global_frontier):
+        hits = bell_hits_or(global_frontier, local)
+        return lax.dynamic_slice_in_dim(hits, me * block, block, axis=0)
+
+    def dense_level(frontier_own):
+        return forest_own(
+            lax.all_gather(frontier_own, VERTEX_AXIS, tiled=True)
+        )
+
+    def sparse_level(frontier_own):
+        w = frontier_own.shape[1]
+        active = (frontier_own != jnp.uint32(0)).any(axis=1)  # (L,)
+        ids = compact_indices(active, halo_budget, fill_value=block)
+        valid = ids < block
+        words = jnp.where(
+            valid[:, None],
+            jnp.take(frontier_own, jnp.minimum(ids, block - 1), axis=0),
+            jnp.uint32(0),
+        )
+        gids = jnp.where(valid, me * block + ids, n_pad)  # sentinel drops
+        all_ids = lax.all_gather(gids, VERTEX_AXIS)  # (p, B)
+        all_words = lax.all_gather(words, VERTEX_AXIS)  # (p, B, W)
+        flat_ids = all_ids.reshape(-1)
+        flat_words = all_words.reshape(-1, w)
+
+        def rebuild_planes(_):
+            return forest_own(
+                jnp.zeros((n_pad, w), dtype=jnp.uint32)
+                .at[flat_ids]
+                .max(flat_words, mode="drop")
+            )
+
+        if not can_push:
+            return rebuild_planes(None)
+        # In-block push when the frontier's in-block edges fit the budget;
+        # the predicate is shard-local (neither branch has a collective —
+        # the gathers above already happened), so each shard routes
+        # independently.
+        src_ids, src_start, src_cnt = push[0], push[1], push[2]
+        m_tab = src_ids.shape[0]
+        pos = jnp.searchsorted(src_ids, flat_ids)
+        pos_c = jnp.minimum(pos, m_tab - 1).astype(jnp.int32)
+        match = (jnp.take(src_ids, pos_c) == flat_ids) & (flat_ids < n_pad)
+        deg = jnp.where(match, jnp.take(src_cnt, pos_c), 0)
+        st = jnp.where(match, jnp.take(src_start, pos_c), 0)
+        # int64 sum: hub-heavy frontiers can exceed 2^31 total in-block
+        # degree, and an int32 wrap here would pass the budget check and
+        # push with garbage cumsum offsets (silently wrong results).
+        edges_needed = jnp.sum(deg.astype(jnp.int64))
+        return lax.cond(
+            edges_needed <= push_budget,
+            lambda _: _push_own_hits(
+                push, flat_ids, flat_words, deg, st, block, push_budget
+            ),
+            rebuild_planes,
+            None,
+        )
 
     def expand(visited_own, frontier_own):
-        global_frontier = lax.all_gather(
-            frontier_own, VERTEX_AXIS, tiled=True
-        )
-        hits = bell_hits_or(global_frontier, local)
-        hits_own = lax.dynamic_slice_in_dim(
-            hits, me * block, block, axis=0
-        )
+        if halo_budget:
+            own_rows = jnp.sum(
+                (frontier_own != jnp.uint32(0)).any(axis=1), dtype=jnp.int32
+            )
+            fits = lax.pmax(own_rows, VERTEX_AXIS) <= halo_budget
+            hits_own = lax.cond(
+                fits, sparse_level, dense_level, frontier_own
+            )
+        else:
+            hits_own = dense_level(frontier_own)
         return hits_own & ~visited_own
 
     return expand
+
+
+def default_halo_budget(n_pad: int, p: int) -> int:
+    """Auto compacted-halo budget: own-frontier rows per shard.  Sized so a
+    sparse exchange moves well under the full plane bytes — p * B * (1+W)
+    vs n_pad * W words — while catching the thin wavefronts that dominate
+    road-class BFS; the dense branch still serves fat mid-levels.  At the
+    default, exchange bytes break even around a ~1.5%-dense frontier (W=2,
+    p=8), comfortably above any road wavefront."""
+    return int(max(2048, n_pad // (64 * max(p, 1))))
+
+
+def default_push_halo_budget(e_directed: int, p: int) -> int:
+    """Auto in-block push budget: edge slots per shard, sized like the
+    single-chip hybrid's E/64 rule (ops.bitbell.default_sparse_budget) but
+    per shard — a push step costs ~budget scatter slots vs ~E/p gathered
+    slots for the shard's forest pass, so E/(64 p) keeps every push step
+    well under a dense level; floored so small shards qualify at all,
+    capped to bound the (budget, K) byte-scatter transient."""
+    return int(min(max(e_directed // (64 * max(p, 1)), 1 << 14), 1 << 22))
 
 
 @partial(jax.jit, static_argnames=("mesh", "block"))
@@ -311,16 +516,28 @@ def _sharded_bitbell_init(mesh: Mesh, forest, query_grid: jax.Array, block: int)
     )(forest, query_grid)
 
 
-@partial(jax.jit, static_argnames=("mesh", "block", "max_levels"))
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "block", "max_levels", "halo_budget", "push_budget"),
+)
 def _sharded_bitbell_chunk(
-    mesh: Mesh, forest, carry, chunk, block: int, max_levels
+    mesh: Mesh,
+    forest,
+    push,
+    carry,
+    chunk,
+    block: int,
+    max_levels,
+    halo_budget: int = 0,
+    push_budget: int = 0,
 ):
     """Advance every shard's own-block carry by <= ``chunk`` levels in one
     dispatch; per-level discovery counts come from a psum over 'v' of each
     shard's own block (identical to counting the gathered global planes)."""
 
-    def shard_body(forest, v_own, f_own, f, lv, rc, level, upd):
+    def shard_body(forest, push, v_own, f_own, f, lv, rc, level, upd):
         local = jax.tree.map(lambda x: x[0], forest)
+        push = jax.tree.map(lambda x: x[0], push)
         local_carry = (
             v_own,
             f_own,
@@ -332,7 +549,7 @@ def _sharded_bitbell_chunk(
         )
         out = bit_level_chunk(
             local_carry,
-            _sharded_expand_own(local, block),
+            _sharded_expand_own(local, block, halo_budget, push, push_budget),
             chunk,
             max_levels,
             counts_of=lambda new: lax.psum(unpack_counts(new), VERTEX_AXIS),
@@ -348,18 +565,19 @@ def _sharded_bitbell_chunk(
     return jax.shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(VERTEX_AXIS),)
+        in_specs=(P(VERTEX_AXIS), P(VERTEX_AXIS))
         + (P(VERTEX_AXIS, QUERY_AXIS),) * 2
         + (P(QUERY_AXIS),) * 5,
         out_specs=(P(VERTEX_AXIS, QUERY_AXIS),) * 2
         + (P(QUERY_AXIS),) * 5
         + (P(), P()),
-    )(forest, *carry)
+    )(forest, push, *carry)
 
 
 def _sharded_bitbell_run_chunked(
     mesh: Mesh,
     forest,
+    push,
     query_grid: jax.Array,
     k: int,
     k_pad: int,
@@ -367,6 +585,8 @@ def _sharded_bitbell_run_chunked(
     block: int,
     max_levels,
     level_chunk: int,
+    halo_budget: int = 0,
+    push_budget: int = 0,
 ):
     """Host-chunked vertex-sharded bitbell: same results as
     :func:`_sharded_bitbell_run`, with per-dispatch work bounded to
@@ -377,10 +597,13 @@ def _sharded_bitbell_run_chunked(
         *carry, any_up, max_level = _sharded_bitbell_chunk(
             mesh,
             forest,
+            push,
             tuple(carry),
             jnp.int32(level_chunk),
             block,
             max_levels,
+            halo_budget,
+            push_budget,
         )
         if not int(np.asarray(any_up)):
             break
@@ -398,7 +621,12 @@ class ShardedBellEngine(QueryEngineBase):
 
     ``level_chunk``: levels per XLA dispatch (None = whole BFS in one
     dispatch).  Set for high-diameter graphs — same rationale and contract
-    as DistributedEngine/BitBellEngine."""
+    as DistributedEngine/BitBellEngine.
+
+    ``halo_budget``: compacted-halo threshold in own-frontier rows per
+    shard (:func:`_sharded_expand_own`).  None auto-sizes from the graph
+    (:func:`default_halo_budget`); 0 always exchanges full planes (the
+    round-2 behavior)."""
 
     def __init__(
         self,
@@ -408,6 +636,8 @@ class ShardedBellEngine(QueryEngineBase):
         widths: Sequence[int] = DEFAULT_WIDTHS,
         min_bucket_rows: Optional[int] = None,
         level_chunk: Optional[int] = None,
+        halo_budget: Optional[int] = None,
+        push_budget: Optional[int] = None,
     ):
         self.mesh = mesh
         self.w = mesh.shape[QUERY_AXIS]
@@ -420,6 +650,24 @@ class ShardedBellEngine(QueryEngineBase):
         self.forest = jax.device_put(stacked, vspec)
         self.max_levels = max_levels
         self.level_chunk = level_chunk
+        if halo_budget is None:
+            halo_budget = default_halo_budget(self.n_pad, p)
+        self.halo_budget = int(halo_budget)
+        if push_budget is None:
+            # Pre-dedup directed count: a cheap upper bound of the dedup
+            # edge count, good enough for a budget heuristic.
+            push_budget = default_push_halo_budget(
+                graph.num_directed_edges, p
+            )
+        self.push_budget = int(push_budget)
+        if self.halo_budget and self.push_budget:
+            self.push = jax.device_put(
+                build_push_halo(graph, p, self.block, self.n_pad), vspec
+            )
+        else:
+            self.push = None
+            self.push_budget = 0
+        self._level_warm_shapes = set()
 
     def _run(self, queries: np.ndarray):
         # Reference bounds check (main.cu:48-50): sources outside [0, n) are
@@ -434,6 +682,7 @@ class ShardedBellEngine(QueryEngineBase):
             f, levels, reached = _sharded_bitbell_run_chunked(
                 self.mesh,
                 self.forest,
+                self.push,
                 sharded,
                 k,
                 k_pad,
@@ -441,17 +690,22 @@ class ShardedBellEngine(QueryEngineBase):
                 self.block,
                 self.max_levels,
                 self.level_chunk,
+                self.halo_budget,
+                self.push_budget,
             )
         else:
             f, levels, reached = _sharded_bitbell_run(
                 self.mesh,
                 self.forest,
+                self.push,
                 sharded,
                 k,
                 k_pad,
                 self.w,
                 self.block,
                 self.max_levels,
+                self.halo_budget,
+                self.push_budget,
             )
         return f, levels, reached, k
 
@@ -469,3 +723,45 @@ class ShardedBellEngine(QueryEngineBase):
             np.asarray(reached[:k]).astype(np.int32),
             np.asarray(f[:k]),
         )
+
+    def level_stats(self, queries):
+        """Per-level trace (MSBFS_STATS=2) on the vertex-sharded engine:
+        the shared stepped driver (parallel.distributed.stepped_level_stats)
+        over this engine's own-block init/chunk programs."""
+        from .distributed import stepped_level_stats
+
+        queries = np.asarray(queries)
+        queries = np.where((queries >= 0) & (queries < self.n), queries, -1)
+        sharded, k, k_pad, _ = shard_queries(self.mesh, queries, None)
+        j = sharded.shape[1]
+
+        def init():
+            return _sharded_bitbell_init(
+                self.mesh, self.forest, sharded, self.block
+            )
+
+        def step(carry):
+            *out, _, _ = _sharded_bitbell_chunk(
+                self.mesh,
+                self.forest,
+                self.push,
+                tuple(carry),
+                jnp.int32(1),
+                self.block,
+                self.max_levels,
+                self.halo_budget,
+                self.push_budget,
+            )
+            return tuple(out)
+
+        def finish(carry):
+            return _distributed_bitbell_finish(
+                self.mesh, carry[2], carry[3], carry[4], j, k, k_pad, self.w
+            )
+
+        warmed = queries.shape in self._level_warm_shapes
+        out = stepped_level_stats(
+            init, step, finish, k, self.max_levels, warmed
+        )
+        self._level_warm_shapes.add(queries.shape)
+        return out

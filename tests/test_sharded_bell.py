@@ -145,3 +145,100 @@ def test_sharded_bell_query_stats_match_single_chip(problem):
     assert a is not None
     for x, y in zip(a, b):
         np.testing.assert_array_equal(x, y)
+
+
+class TestSparseHalo:
+    """Round-3 compacted halo + in-block push: when a level's own-frontier
+    rows fit halo_budget, shards exchange (global id, words) pairs instead
+    of full planes; when the frontier's in-block edges also fit
+    push_budget, the local expansion scatters those pairs directly (no
+    forest gather at all).  Every routing combination must be bit-identical
+    to the dense reference (docs/PERF_NOTES.md "ICI cost model" names this
+    the road-class fix)."""
+
+    def _road(self):
+        n = 300
+        edges = np.stack(
+            [np.arange(n - 1), np.arange(1, n)], axis=1
+        ).astype(np.int64)
+        queries = [
+            np.array([0], dtype=np.int32),
+            np.array([n - 1], dtype=np.int32),
+            np.array([7, 150], dtype=np.int32),
+            np.zeros(0, dtype=np.int32),
+        ]
+        return n, edges, queries, pad_queries(queries)
+
+    @pytest.mark.parametrize(
+        "halo,push",
+        [
+            (16, None),  # sparse exchange + auto push
+            (16, 1),  # sparse exchange, push budget too small -> rebuild
+            (16, 0),  # sparse exchange, push disabled -> rebuild+forest
+            (0, None),  # dense exchange only (round-2 behavior)
+            (None, None),  # full auto
+        ],
+    )
+    def test_road_all_routings_match_oracle(self, halo, push):
+        n, edges, queries, padded = self._road()
+        g = CSRGraph.from_edges(n, edges)
+        mesh = make_mesh(num_query_shards=2, num_vertex_shards=4)
+        eng = ShardedBellEngine(mesh, g, halo_budget=halo, push_budget=push)
+        got = np.asarray(eng.f_values(padded))
+        want = oracle_f_values(n, edges, queries)
+        np.testing.assert_array_equal(got, want)
+
+    def test_power_law_mixed_branches(self, problem):
+        """Fat mid-levels take the dense path, thin head/tail the sparse
+        path, within ONE run — stats must still match the oracle."""
+        n, edges, queries, padded = problem
+        g = CSRGraph.from_edges(n, edges)
+        mesh = make_mesh(num_query_shards=2, num_vertex_shards=4)
+        eng = ShardedBellEngine(mesh, g, halo_budget=4, push_budget=32)
+        levels, reached, f = eng.query_stats(padded)
+        for i, q in enumerate(queries):
+            dist = oracle_bfs(n, edges, q)
+            assert f[i] == oracle_f(dist)
+            assert reached[i] == int((dist >= 0).sum())
+
+    def test_chunked_composes_with_push_halo(self):
+        n, edges, queries, padded = self._road()
+        g = CSRGraph.from_edges(n, edges)
+        mesh = make_mesh(num_query_shards=1, num_vertex_shards=8)
+        ref = ShardedBellEngine(mesh, g, halo_budget=0).query_stats(padded)
+        got = ShardedBellEngine(
+            mesh, g, halo_budget=8, push_budget=64, level_chunk=16
+        ).query_stats(padded)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_level_stats_with_push_halo(self):
+        n, edges, queries, padded = self._road()
+        g = CSRGraph.from_edges(n, edges)
+        mesh = make_mesh(num_query_shards=2, num_vertex_shards=4)
+        eng = ShardedBellEngine(mesh, g, halo_budget=8, push_budget=64)
+        levels, reached, f, lc, secs = eng.level_stats(padded)
+        w = eng.query_stats(padded)
+        np.testing.assert_array_equal(levels, w[0])
+        np.testing.assert_array_equal(reached, w[1])
+        np.testing.assert_array_equal(f, w[2])
+        np.testing.assert_array_equal(lc.sum(axis=0), reached)
+
+    def test_edgeless_graph_push_guard(self):
+        g = CSRGraph.from_edges(5, np.zeros((0, 2), dtype=np.int64))
+        mesh = make_mesh(num_query_shards=2, num_vertex_shards=4)
+        eng = ShardedBellEngine(mesh, g, halo_budget=4, push_budget=16)
+        padded = pad_queries([np.array([2], dtype=np.int32)])
+        levels, reached, f = eng.query_stats(padded)
+        assert reached[0] == 1 and f[0] == 0 and levels[0] == 1
+
+    def test_budget_defaults(self):
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (
+            default_halo_budget,
+            default_push_halo_budget,
+        )
+
+        assert default_halo_budget(1 << 20, 8) == max(2048, (1 << 20) // 512)
+        assert default_push_halo_budget(1 << 26, 8) == (1 << 26) // 512
+        assert default_push_halo_budget(0, 8) == 1 << 14  # floor
+        assert default_push_halo_budget(1 << 40, 8) == 1 << 22  # cap

@@ -199,3 +199,99 @@ def test_cli_stats_multichip(tmp_path, capsys, monkeypatch):
     assert rc == 0
     assert "levels" in captured.err and captured.err.count("\n") >= 4
     assert "not available" not in captured.err
+
+
+@pytest.mark.parametrize("kind", ["distributed", "sharded"])
+def test_multichip_level_stats_match_query_stats(problem, kind):
+    """Round-3: MSBFS_STATS=2 coverage on the multi-chip engines — the
+    stepped trace's counters must match query_stats exactly, and the
+    per-level rows must be the oracle's per-distance histograms."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
+        DistributedEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (
+        ShardedBellEngine,
+    )
+
+    n, edges, queries, padded = problem
+    graph = CSRGraph.from_edges(n, edges)
+    if kind == "distributed":
+        eng = DistributedEngine(make_mesh(num_query_shards=8), graph)
+    else:
+        eng = ShardedBellEngine(
+            make_mesh(num_query_shards=2, num_vertex_shards=4), graph
+        )
+    levels, reached, f, lvl_counts, lvl_secs = eng.level_stats(padded)
+    w_levels, w_reached, w_f = eng.query_stats(padded)
+    np.testing.assert_array_equal(levels, w_levels)
+    np.testing.assert_array_equal(reached, w_reached)
+    np.testing.assert_array_equal(f, w_f)
+    assert lvl_counts.shape[1] == len(queries)
+    assert lvl_counts.shape[0] == len(lvl_secs)
+    np.testing.assert_array_equal(lvl_counts.sum(axis=0), reached)
+    assert (lvl_counts[-1] == 0).all()
+    for i, q in enumerate(queries):
+        dist = oracle_bfs(n, edges, q)
+        for d in range(lvl_counts.shape[0]):
+            assert lvl_counts[d, i] == int((dist == d).sum())
+
+
+def test_multichip_level_stats_max_levels(problem):
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
+        DistributedEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    n, edges, queries, padded = problem
+    graph = CSRGraph.from_edges(n, edges)
+    eng = DistributedEngine(
+        make_mesh(num_query_shards=8), graph, max_levels=3
+    )
+    levels, reached, f, lvl_counts, _ = eng.level_stats(padded)
+    w = eng.query_stats(padded)
+    np.testing.assert_array_equal(levels, w[0])
+    np.testing.assert_array_equal(reached, w[1])
+    np.testing.assert_array_equal(f, w[2])
+    assert lvl_counts.shape[0] <= 4  # sources row + max_levels steps
+
+
+def test_cli_level_stats_multichip(tmp_path, capsys, monkeypatch):
+    """MSBFS_STATS=2 now works at -gn > 1 (round-3; it used to fall back
+    to per-query stats only)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+        main,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_graph_bin,
+        save_query_bin,
+    )
+
+    n, edges = generators.gnm_edges(40, 120, seed=113)
+    g, q = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
+    save_graph_bin(g, n, edges)
+    save_query_bin(q, [[0], [1, 2]])
+    monkeypatch.setenv("MSBFS_STATS", "2")
+    for vshard in ("0", "4"):
+        monkeypatch.setenv("MSBFS_VSHARD", vshard)
+        rc = main(["main.py", "-g", g, "-q", q, "-gn", "8"])
+        captured = capsys.readouterr()
+        assert rc == 0
+        assert "active_queries" in captured.err
+        assert "not available" not in captured.err

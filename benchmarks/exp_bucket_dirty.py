@@ -1,0 +1,147 @@
+"""Experiment: per-bucket frontier dirty-flags for the dense mid-levels.
+
+Hypothesis (round-2 verdict item 7): a BELL bucket's gather can be skipped
+when every owner in the bucket is already visited by ALL K queries — the
+owner's new bits are masked to zero by ``& ~visited`` regardless, so
+zeroing its hits early is semantics-preserving (including hub chunk rows:
+deeper forest levels only feed that same owner's final hit).
+
+Before building the cond-per-bucket machinery, this script measures the
+HEADROOM: per BFS level, how many padded slots belong to buckets whose
+owners are all fully visited (the slots a dirty-flag would skip), on the
+bitbell engine's own stepped trace.
+
+RESULT (2026-07-30, r3): **negative — the lever cannot fire.**  On
+RMAT-16/K=64 (and RMAT-14 in debugging), the fraction of vertices visited
+by ALL 64 query groups is 0.0000 at EVERY level including convergence,
+so no bucket is ever skippable (skippable_frac 0.0000 across the board;
+whole-BFS headroom 0.0 dense-level-equivalents).  Root cause is
+structural, not statistical: a single query group whose sources land
+outside the giant component (near-certain as K grows — random groups of
+1-64 sources regularly fall into small components) never visits the
+giant component's vertices, so the all-K intersection that would clean a
+bucket stays empty forever.  Per-word flags (32-query granularity) fail
+the same way — one stray group per word suffices — and per-owner
+granularity is no longer a *bucket* skip (that is exactly what the
+hybrid's frontier-sparse push already exploits at edge granularity).
+The dense-mid-level cost therefore cannot be cut by visited-set dirty
+flags; the remaining levers are layout-side (fill, widths ladder), not
+frontier-side.  Kept runnable for re-checking on other graph families.
+
+Run: python benchmarks/exp_bucket_dirty.py [scale] [K]
+(re-execs onto the virtual CPU platform when needed)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def measure(scale: int, k: int) -> None:
+    import numpy as np
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+        CSRGraph,
+        pad_queries,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        BitBellEngine,
+        _pack_queries_jit,
+        bitbell_step,
+    )
+
+    n, edges = generators.rmat_edges(scale, edge_factor=16, seed=42)
+    g = CSRGraph.from_edges(n, edges)
+    bell = BellGraph.from_host(g)
+    eng = BitBellEngine(bell)
+    queries = pad_queries(
+        generators.random_queries(n, k, max_group=64, seed=43), pad_to=64
+    )
+    queries, _ = eng._pad_queries(queries)
+
+    # Level-0 bucket membership: owner -> bucket, and slots per bucket.
+    # Owners appear in _bucket_rows order: ascending within each bucket.
+    shapes0 = bell.level_shapes[0]
+    deg = np.zeros(n, dtype=np.int64)
+    _, _, dd = g.deduped_pairs()
+    deg[: dd.shape[0]] = dd
+    widths = [w for _, w in shapes0]
+    bucket_of = np.full(n, -1, dtype=np.int64)
+    prev_w = 0
+    for bi, w in enumerate(widths):
+        if bi == len(widths) - 1:
+            sel = deg > prev_w
+        else:
+            sel = (deg > prev_w) & (deg <= w)
+        bucket_of[sel] = bi
+        prev_w = w
+    slots_per_owner = np.where(
+        bucket_of == len(widths) - 1,
+        -(-deg // widths[-1]) * widths[-1],
+        np.where(bucket_of >= 0, np.asarray(widths)[np.maximum(bucket_of, 0)], 0),
+    )
+
+    visited = _pack_queries_jit(n, queries)
+    frontier = visited
+    total_slots = int(sum(r * w for r, w in shapes0))
+    full_word = np.uint32(0xFFFFFFFF)
+    level = 0
+    rows = []
+    while True:
+        vis = np.asarray(visited)
+        fully = (vis == full_word).all(axis=1)  # all K queries visited
+        skippable = 0
+        for bi in range(len(widths)):
+            owners = bucket_of == bi
+            if owners.any() and fully[owners].all():
+                skippable += int(slots_per_owner[owners].sum())
+        rows.append(
+            {
+                "level": level,
+                "fully_visited_frac": round(float(fully.mean()), 4),
+                "skippable_slots": skippable,
+                "skippable_frac": round(skippable / max(total_slots, 1), 4),
+            }
+        )
+        print(json.dumps(rows[-1]), flush=True)
+        visited, frontier, counts = bitbell_step(bell, visited, frontier, 0)
+        if not np.asarray(counts).any():
+            break
+        level += 1
+    tot = sum(r["skippable_frac"] for r in rows)
+    print(
+        f"# whole-BFS skippable work: {tot:.4f} dense-level-equivalents "
+        f"over {len(rows)} levels (scale={scale}, K={k})"
+    )
+
+
+def main():
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    if os.environ.get("MSBFS_EXP_CHILD"):
+        measure(scale, k)
+        return
+    from virtual_cpu import virtual_cpu_env
+
+    env = virtual_cpu_env(1)
+    env["MSBFS_EXP_CHILD"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+        env=env,
+        cwd=REPO,
+    )
+    sys.exit(proc.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -184,23 +184,37 @@ class BellGraph:
         * forest cols arrays: ~e/fill slots x 4 B (fill >= 0.7 floor);
         * per-level gather intermediate: slots x ceil(k/32) words x 4 B
           (XLA materializes the take before the OR-fold);
-        * hybrid dedup CSR: (e + 2n) x 4 B (single chip only — the
-          sharded engine is pull-only and skips it);
+        * hybrid dedup CSR: (e + 2n) x 4 B (single chip only); the
+          sharded engine instead carries its in-block push CSR — ~e/p
+          neighbor slots plus a <= min(n, e/p)-entry source table of
+          three int32 arrays per shard (parallel/sharded_bell.py
+          build_push_halo);
         * bit planes (+ the hybrid's byte-lane scratch on one chip):
           n x words x 16 B (+ n x k_pad B) — NOT divided by vertex
-          shards: every shard holds full global planes (the halo
-          all_gather reconstructs them each level, parallel/sharded_bell).
+          shards: the halo exchange reconstructs global planes each level
+          (parallel/sharded_bell), so a shard's transients still span
+          n_pad rows.
 
         ``k`` is padded to the engine's word multiple.  Only the
         edge-proportional terms shrink with ``vertex_shards``; used by the
         CLI to route graphs that exceed one chip onto the vertex-sharded
-        engine instead of dying in an allocator error."""
+        engine instead of dying in an allocator error.
+        tests/test_hbm_estimate.py pins the estimate against the actually
+        constructed layouts (and against memory_stats on real TPU)."""
         k_pad = max(32, -(-k // 32) * 32)
         w = k_pad // 32
-        slots = int(e / 0.7) + 1
+        # Fill floor is scale-dependent: measured RMAT fills are 0.34-0.50
+        # below ~2^25 directed edges (padding overhead dominates the short
+        # ladders of small graphs) and >= 0.7 from RMAT-18 up (0.766) —
+        # the scales where routing decisions actually matter.  Small
+        # graphs use the conservative floor; over-reserving them is
+        # harmless since they fit either way.
+        fill_floor = 0.7 if e >= (1 << 25) else 0.33
+        slots = int(e / fill_floor) + 1
         per_shard_edges = (4 * slots + 4 * w * slots) // max(1, vertex_shards)
         if vertex_shards > 1:
-            return per_shard_edges + 16 * w * n
+            push_csr = (4 * e + 12 * min(n, e)) // vertex_shards
+            return per_shard_edges + push_csr + 16 * w * n
         return per_shard_edges + 4 * (e + 2 * n) + n * (16 * w + k_pad)
 
     @staticmethod
@@ -310,40 +324,51 @@ class BellGraph:
                 jnp.asarray(item_count.astype(np.int32)),
                 jnp.asarray(item_vals.astype(np.int32)),
             )
-        levels: List[List[np.ndarray]] = []
+        level_cols: List[jax.Array] = []
+        level_shapes: List[tuple] = []
         level_sizes: List[int] = []
         padded_slots = 0
         # Global (cross-level) output offset bookkeeping for the final take:
         # outputs of all levels are concatenated in order.
         out_offset: List[int] = []
 
+        from ..runtime import native_loader  # lazy: avoid import cycle
+
         first_row = None
         rows_per_owner = None
         walk: List[Tuple[np.ndarray, np.ndarray]] = []  # (rpo, fr) per level
         while True:
-            sentinel_items = item_vals.shape[0]
-            cols_b, rows_per_owner, first_row = _bucket_rows(
-                item_start, item_count, widths, sentinel_items
+            # Sentinel slots point at the previous value array's always-zero
+            # row: index n of the extended frontier for level 0, the
+            # previous level's row count for deeper levels.
+            prev_rows = n if not level_sizes else level_sizes[-1]
+            native = native_loader.bell_level(
+                item_start, item_count, item_vals, widths, prev_rows
             )
+            if native is not None:
+                # Fused native build: assignment + padded fill + value map
+                # + sentinel fix in two passes writing the final int32
+                # directly (runtime/loader.cpp msbfs_bell_assign/fill).
+                flat, shapes, rows_per_owner, first_row = native
+            else:
+                cols_b, rows_per_owner, first_row = _bucket_rows(
+                    item_start, item_count, widths, item_vals.shape[0]
+                )
+                # Map item indices -> value-array row ids (level 0:
+                # frontier ids; deeper: previous-level output rows); the
+                # sentinel item maps to the zero row.
+                vals_ext = np.concatenate(
+                    [item_vals, np.asarray([prev_rows], dtype=np.int64)]
+                )
+                flat, shapes = BellGraph.pack_level(
+                    [vals_ext[cb].astype(np.int32) for cb in cols_b]
+                )
             walk.append((rows_per_owner, first_row))
-            # Map item indices -> value-array row ids (level 0: frontier ids;
-            # deeper: previous-level output rows).  Sentinel item maps to the
-            # value array's zero row.
-            vals_ext = np.concatenate(
-                [item_vals, np.asarray([-1], dtype=np.int64)]
-            )
-            mapped = []
-            level_rows = 0
-            for cb in cols_b:
-                m = vals_ext[cb]
-                # -1 => previous array's sentinel row (its row count is the
-                # previous level's size, known at runtime build; store -1 and
-                # fix when uploading, see below).
-                mapped.append(m)
-                level_rows += cb.shape[0]
-            levels.append(mapped)
+            level_rows = sum(r for r, _ in shapes)
+            level_cols.append(jnp.asarray(flat))
+            level_shapes.append(shapes)
             level_sizes.append(level_rows)
-            padded_slots += sum(cb.size for cb in cols_b)
+            padded_slots += sum(r * w for r, w in shapes)
             out_offset.append(sum(level_sizes[:-1]))
 
             if int(rows_per_owner.max(initial=0)) <= 1:
@@ -369,22 +394,6 @@ class BellGraph:
             done |= newly
         total_rows = sum(level_sizes)
         final_slot[final_slot < 0] = total_rows  # zero sentinel row
-
-        # Fix level-0 sentinel mapping: -1 -> frontier's zero row (= n_pad
-        # index n); deeper levels' -1 -> previous level's sentinel row (=
-        # its row count).  The runtime appends one zero row per value array.
-        level_cols: List[jax.Array] = []
-        level_shapes: List[tuple] = []
-        for li, mapped in enumerate(levels):
-            prev_rows = n if li == 0 else level_sizes[li - 1]
-            fixed = []
-            for m in mapped:
-                m = m.copy()
-                m[m < 0] = prev_rows
-                fixed.append(m.astype(np.int32))
-            flat, shapes = BellGraph.pack_level(fixed)
-            level_cols.append(jnp.asarray(flat))
-            level_shapes.append(shapes)
 
         return BellGraph(
             level_cols=level_cols,

@@ -20,16 +20,40 @@ def rmat_edges(
     b: float = 0.19,
     c: float = 0.19,
     seed: int = 0,
+    native: bool = None,
 ) -> Tuple[int, np.ndarray]:
     """Graph500-style R-MAT: n = 2^scale vertices, m = edge_factor * n records.
 
     Vectorized quadrant sampling (one (m, scale) draw), no per-edge Python.
     Returns (n, edges[m, 2] int32); duplicates/self-loops are kept, matching
     the reference loader's no-dedup behavior (main.cu:106-116).
+
+    ``native`` (default: env MSBFS_NATIVE_RMAT=1) samples via the C++
+    generator (runtime/loader.cpp msbfs_rmat_edges) — same construction,
+    ~20x faster at RMAT-25 scale, but a DIFFERENT RNG stream, so a given
+    seed yields a different (identically distributed) graph; existing
+    BASELINE rows keep the NumPy stream for comparability.
     """
     n = 1 << scale
     m = edge_factor * n
     d = 1.0 - a - b - c
+    if native is None:
+        import os
+
+        native = os.environ.get("MSBFS_NATIVE_RMAT") == "1"
+    if native:
+        from ..runtime import native_loader
+
+        edges = native_loader.rmat_edges(scale, m, a, b, c, seed)
+        if edges is None:
+            # Explicitly requested stream must not silently substitute the
+            # NumPy one (same seed, DIFFERENT graph -> irreproducible
+            # benchmark rows); same contract as utils/io.py's native flag.
+            raise RuntimeError(
+                "native R-MAT requested (MSBFS_NATIVE_RMAT/native=True) "
+                "but librt_loader.so is not built (run `make native`)"
+            )
+        return n, edges
     rng = np.random.default_rng(seed)
     # Level-by-level quadrant sampling (keeps peak memory at O(m), not
     # O(m * scale)): P(u_bit=1) = c+d; P(v_bit=1 | u_bit) = b/(a+b) or

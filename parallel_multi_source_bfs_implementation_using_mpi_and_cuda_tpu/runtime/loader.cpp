@@ -185,4 +185,164 @@ int64_t msbfs_dedup_rows(int64_t n, int64_t num_slots,
   return w;
 }
 
+// ---- BELL bucketing (native fast path of models/bell._bucket_rows + the
+// map/fix/pack passes that follow it).  The NumPy build materializes the
+// padded slot index matrix in int64, fancy-indexes it through the value
+// array (another int64 pass), masks the sentinel, casts to int32 and
+// concatenates — five full-size passes.  This pair of functions does one
+// O(V) assignment pass and one O(slots) fill pass that writes the final
+// int32 flat array directly, which is what makes RMAT-25-class host
+// builds take seconds instead of minutes (docs/PERF_NOTES.md "Native BELL
+// bucketing").  Row ordering is identical to _bucket_rows: buckets in
+// ladder order, owners ascending within a bucket, hub owners chunked into
+// ceil(count / W_max) rows.
+
+namespace {
+
+// Bucket of a nonzero count: first ladder width >= count, else the hub
+// (last) bucket.  B is tiny (<= 27), so a linear scan beats binary search.
+inline int bucket_of(int64_t count, int num_widths, const int32_t* widths) {
+  for (int b = 0; b < num_widths - 1; ++b) {
+    if (count <= widths[b]) return b;
+  }
+  return num_widths - 1;
+}
+
+}  // namespace
+
+// Pass 1: per-owner row assignment.  Fills rows_per_owner (V), first_row
+// (V, global row index, 0 for row-less owners), bucket_rows (B) and
+// flat_off (B, slot offset of each bucket's first row in the flat array).
+// Returns total padded slots, or -1 on bad input.
+int64_t msbfs_bell_assign(int64_t v_total, const int64_t* item_count,
+                          int num_widths, const int32_t* widths,
+                          int64_t* rows_per_owner, int64_t* first_row,
+                          int64_t* bucket_rows, int64_t* flat_off) {
+  if (v_total < 0 || num_widths <= 0) return -1;
+  const int64_t w_max = widths[num_widths - 1];
+  for (int b = 0; b < num_widths; ++b) bucket_rows[b] = 0;
+  for (int64_t v = 0; v < v_total; ++v) {
+    const int64_t cnt = item_count[v];
+    if (cnt <= 0) {
+      rows_per_owner[v] = 0;
+      continue;
+    }
+    const int b = bucket_of(cnt, num_widths, widths);
+    const int64_t rows = b == num_widths - 1 ? (cnt + w_max - 1) / w_max : 1;
+    rows_per_owner[v] = rows;
+    bucket_rows[b] += rows;
+  }
+  // Exclusive scans: global row base and flat slot offset per bucket.
+  std::vector<int64_t> row_base(num_widths), cursor(num_widths);
+  int64_t rows_acc = 0, slots_acc = 0;
+  for (int b = 0; b < num_widths; ++b) {
+    row_base[b] = rows_acc;
+    flat_off[b] = slots_acc;
+    rows_acc += bucket_rows[b];
+    slots_acc += bucket_rows[b] * widths[b];
+  }
+  for (int b = 0; b < num_widths; ++b) cursor[b] = 0;
+  for (int64_t v = 0; v < v_total; ++v) {
+    if (item_count[v] <= 0) {
+      first_row[v] = 0;
+      continue;
+    }
+    const int b = bucket_of(item_count[v], num_widths, widths);
+    first_row[v] = row_base[b] + cursor[b];
+    cursor[b] += rows_per_owner[v];
+  }
+  return slots_acc;
+}
+
+// Pass 2: write the mapped, sentinel-fixed flat int32 cols array.  Value of
+// slot i of owner v's chunk rows = item_vals[item_start[v] + offset], and
+// padding slots get sentinel_value directly (the NumPy path's -1 ->
+// prev_rows fix folded in).  Returns 0, or nonzero on bad input.
+int msbfs_bell_fill(int64_t v_total, const int64_t* item_start,
+                    const int64_t* item_count, int num_widths,
+                    const int32_t* widths, const int32_t* item_vals,
+                    int64_t num_items, const int64_t* first_row,
+                    const int64_t* bucket_rows, const int64_t* flat_off,
+                    int32_t sentinel_value, int32_t* flat_out) {
+  if (v_total < 0 || num_widths <= 0) return 1;
+  std::vector<int64_t> row_base(num_widths);
+  int64_t rows_acc = 0;
+  for (int b = 0; b < num_widths; ++b) {
+    row_base[b] = rows_acc;
+    rows_acc += bucket_rows[b];
+  }
+  for (int64_t v = 0; v < v_total; ++v) {
+    const int64_t cnt = item_count[v];
+    if (cnt <= 0) continue;
+    const int b = bucket_of(cnt, num_widths, widths);
+    const int64_t w = widths[b];
+    const int64_t start = item_start[v];
+    if (start < 0 || start + cnt > num_items) return 2;
+    int64_t slot = flat_off[b] + (first_row[v] - row_base[b]) * w;
+    const int64_t rows = b == num_widths - 1 ? (cnt + w - 1) / w : 1;
+    int64_t item = 0;
+    for (int64_t r = 0; r < rows; ++r) {
+      for (int64_t i = 0; i < w; ++i, ++slot) {
+        flat_out[slot] =
+            item < cnt ? item_vals[start + item++] : sentinel_value;
+      }
+    }
+  }
+  return 0;
+}
+
+// ---- R-MAT generator (native fast path of models/generators.rmat_edges:
+// same conditional-bit construction and final id permutation, but one
+// quadrant draw per bit instead of two and a splitmix64 stream instead of
+// NumPy's Philox, so the stream differs — callers opt in knowing seeds
+// produce a different-but-identically-distributed graph).
+
+namespace {
+
+inline uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9E3779B97F4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+inline double u01(uint64_t* s) {
+  return static_cast<double>(splitmix64(s) >> 11) * 0x1.0p-53;
+}
+
+}  // namespace
+
+// Fills out (m, 2) int32 with R-MAT edges over n = 2^scale vertices.
+// Returns 0, or nonzero on bad parameters.
+int msbfs_rmat_edges(int32_t scale, int64_t m, double a, double b, double c,
+                     uint64_t seed, int32_t* out) {
+  if (scale <= 0 || scale > 30 || m < 0) return 1;
+  if (a < 0 || b < 0 || c < 0 || a + b + c > 1.0) return 2;
+  const double t_ab = a + b, t_abc = a + b + c;
+  uint64_t s = seed * 0x9E3779B97F4A7C15ULL + 0xD1B54A32D192ED03ULL;
+  const int64_t n = int64_t{1} << scale;
+  for (int64_t i = 0; i < m; ++i) {
+    int64_t u = 0, v = 0;
+    for (int32_t bit = 0; bit < scale; ++bit) {
+      const double r = u01(&s);
+      const int64_t u_bit = r >= t_ab ? 1 : 0;
+      const int64_t v_bit = (r >= a && r < t_ab) || r >= t_abc ? 1 : 0;
+      u = (u << 1) | u_bit;
+      v = (v << 1) | v_bit;
+    }
+    out[2 * i] = static_cast<int32_t>(u);
+    out[2 * i + 1] = static_cast<int32_t>(v);
+  }
+  // Fisher-Yates permutation of vertex ids (the Graph500 relabeling step
+  // that decorrelates degree from id), applied in place over the edges.
+  std::vector<int32_t> perm(n);
+  for (int64_t i = 0; i < n; ++i) perm[i] = static_cast<int32_t>(i);
+  for (int64_t i = n - 1; i > 0; --i) {
+    const int64_t j = static_cast<int64_t>(splitmix64(&s) % (i + 1));
+    std::swap(perm[i], perm[j]);
+  }
+  for (int64_t i = 0; i < 2 * m; ++i) out[i] = perm[out[i]];
+  return 0;
+}
+
 }  // extern "C"

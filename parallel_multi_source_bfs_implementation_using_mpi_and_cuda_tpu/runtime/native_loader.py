@@ -71,6 +71,29 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(dtype=np.int64, ndim=1, flags="C_CONTIGUOUS"),
         ]
         lib.msbfs_dedup_rows.restype = ctypes.c_int64
+        i64v = np.ctypeslib.ndpointer(
+            dtype=np.int64, ndim=1, flags="C_CONTIGUOUS"
+        )
+        i32v = np.ctypeslib.ndpointer(
+            dtype=np.int32, ndim=1, flags="C_CONTIGUOUS"
+        )
+        lib.msbfs_bell_assign.argtypes = [
+            ctypes.c_int64, i64v, ctypes.c_int, i32v, i64v, i64v, i64v, i64v,
+        ]
+        lib.msbfs_bell_assign.restype = ctypes.c_int64
+        lib.msbfs_bell_fill.argtypes = [
+            ctypes.c_int64, i64v, i64v, ctypes.c_int, i32v, i32v,
+            ctypes.c_int64, i64v, i64v, i64v, ctypes.c_int32, i32v,
+        ]
+        lib.msbfs_bell_fill.restype = ctypes.c_int
+        lib.msbfs_rmat_edges.argtypes = [
+            ctypes.c_int32, ctypes.c_int64, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double, ctypes.c_uint64,
+            np.ctypeslib.ndpointer(
+                dtype=np.int32, ndim=2, flags="C_CONTIGUOUS"
+            ),
+        ]
+        lib.msbfs_rmat_edges.restype = ctypes.c_int
         _lib = lib
     except (OSError, AttributeError):
         # AttributeError: a stale .so built before a newer symbol existed —
@@ -153,3 +176,61 @@ def dedup_rows(row_offsets: np.ndarray, col_indices: np.ndarray):
     if w < 0:
         raise ValueError("native dedup_rows: corrupt CSR input")
     return out_dst[:w], out_deg[:n]
+
+
+def bell_level(item_start, item_count, item_vals, widths, sentinel_value):
+    """Fused native build of one BELL forest level: bucket assignment +
+    padded-row fill + value mapping + sentinel fix in two O(V)/O(slots)
+    passes writing the final int32 flat array directly (the NumPy path,
+    models/bell._bucket_rows + the map/fix/pack that follows, makes five
+    full-size passes through int64 intermediates).
+
+    Returns (flat int32, shapes, rows_per_owner int64, first_row int64)
+    with exactly models/bell semantics, or None when the library is
+    unavailable or lacks the symbols (stale .so)."""
+    lib = _get_lib()
+    if lib is None or not hasattr(lib, "msbfs_bell_assign"):
+        return None
+    item_start = np.ascontiguousarray(item_start, dtype=np.int64)
+    item_count = np.ascontiguousarray(item_count, dtype=np.int64)
+    item_vals = np.ascontiguousarray(item_vals, dtype=np.int32)
+    widths_arr = np.ascontiguousarray(widths, dtype=np.int32)
+    v_total = item_count.shape[0]
+    nb = widths_arr.shape[0]
+    rows_per_owner = np.empty(max(v_total, 1), dtype=np.int64)
+    first_row = np.empty(max(v_total, 1), dtype=np.int64)
+    bucket_rows = np.empty(max(nb, 1), dtype=np.int64)
+    flat_off = np.empty(max(nb, 1), dtype=np.int64)
+    slots = lib.msbfs_bell_assign(
+        v_total, item_count, nb, widths_arr, rows_per_owner, first_row,
+        bucket_rows, flat_off,
+    )
+    if slots < 0:
+        raise ValueError("native bell_assign: bad input")
+    flat = np.empty(slots, dtype=np.int32)
+    rc = lib.msbfs_bell_fill(
+        v_total, item_start, item_count, nb, widths_arr, item_vals,
+        item_vals.shape[0], first_row, bucket_rows, flat_off,
+        np.int32(sentinel_value), flat,
+    )
+    if rc != 0:
+        raise ValueError(f"native bell_fill failed (rc={rc})")
+    shapes = tuple(
+        (int(bucket_rows[b]), int(widths_arr[b])) for b in range(nb)
+    )
+    return flat, shapes, rows_per_owner[:v_total], first_row[:v_total]
+
+
+def rmat_edges(scale, m, a, b, c, seed):
+    """Native R-MAT edge sampler: same construction as
+    models/generators.rmat_edges but a different RNG stream (splitmix64),
+    so a given seed yields a different — identically distributed — graph.
+    Returns an (m, 2) int32 array or None when unavailable."""
+    lib = _get_lib()
+    if lib is None or not hasattr(lib, "msbfs_rmat_edges"):
+        return None
+    out = np.empty((m, 2), dtype=np.int32)
+    rc = lib.msbfs_rmat_edges(scale, m, a, b, c, np.uint64(seed), out)
+    if rc != 0:
+        raise ValueError(f"native rmat_edges failed (rc={rc})")
+    return out

@@ -1,0 +1,152 @@
+"""Pin BellGraph.estimate_hbm_bytes against reality (round 3).
+
+The estimate silently drives the CLI's engine routing (cli.py: replicate
+vs vertex-shard, warn-or-proceed), so it must track what the layouts
+actually allocate.  Two layers of pinning:
+
+* structural (every platform): build the real layouts and compare the
+  estimate against the live device arrays plus the engine's documented
+  transients (gather intermediate, bit planes, byte scratch).  The live
+  part is measured (jax.tree leaves' nbytes), so fill/level-size/sparse
+  drift in the builders breaks this test; the transient part follows the
+  engine code and the estimate's own docstring.
+* memory_stats (real TPU only, MSBFS_TEST_TPU=1): peak_bytes_in_use
+  around an actual run must be bracketed by the estimate within the
+  documented factor.
+
+Documented bracketing factor: estimate within [1x, 4x] of the structural
+footprint (the estimate is deliberately worst-case: 0.7 fill floor, all
+per-level intermediates counted at once).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+
+BRACKET = 4.0  # documented worst-case overestimate factor
+
+
+def leaves_bytes(tree) -> int:
+    return sum(
+        x.nbytes for x in jax.tree.leaves(tree) if hasattr(x, "nbytes")
+    )
+
+
+def single_chip_structural(bell: BellGraph, n: int, k_pad: int) -> int:
+    """Live arrays + the run's transients, mirroring the engine's actual
+    allocations (ops/bitbell.py): three (n, W) planes, the hybrid's
+    (n+1, k_pad) byte scratch, and the largest level's gather
+    intermediate (slots x W words)."""
+    w = k_pad // 32
+    live = leaves_bytes(bell)
+    slots = max(
+        (sum(r * wd for r, wd in lvl) for lvl in bell.level_shapes),
+        default=0,
+    )
+    transients = 3 * 4 * w * bell.n + (bell.n + 1) * k_pad + 4 * w * slots
+    return live + transients
+
+
+@pytest.mark.parametrize(
+    "kind,scale",
+    [("rmat", 11), ("rmat", 13), ("road", 12)],
+)
+def test_estimate_brackets_single_chip_structure(kind, scale):
+    if kind == "rmat":
+        n, edges = generators.rmat_edges(scale, edge_factor=16, seed=61)
+    else:
+        n, edges = generators.grid_edges(64, max(1, (2**scale) // 64))
+    g = CSRGraph.from_edges(n, edges)
+    for k in (32, 64, 256):
+        bell = BellGraph.from_host(g)
+        est = BellGraph.estimate_hbm_bytes(g.n, g.num_directed_edges, k)
+        actual = single_chip_structural(bell, g.n, max(32, -(-k // 32) * 32))
+        assert actual <= est <= BRACKET * actual, (
+            f"{kind}-{scale} k={k}: estimate {est} vs structural {actual} "
+            f"(ratio {est/actual:.2f}) outside [1, {BRACKET}]"
+        )
+
+
+def test_estimate_brackets_sharded_structure():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (
+        ShardedBellEngine,
+    )
+
+    n, edges = generators.rmat_edges(11, edge_factor=16, seed=62)
+    g = CSRGraph.from_edges(n, edges)
+    p = 4
+    mesh = make_mesh(num_query_shards=2, num_vertex_shards=p)
+    eng = ShardedBellEngine(mesh, g)
+    k_pad, w = 64, 2
+    est = BellGraph.estimate_hbm_bytes(
+        g.n, g.num_directed_edges, k_pad, vertex_shards=p
+    )
+    # Per-shard live bytes: the stacked leaves hold all p shards.
+    live = (leaves_bytes(eng.forest) + leaves_bytes(eng.push)) // p
+    # Transients per shard: two (L, W) carried blocks, the gathered
+    # (n_pad, W) planes + (n_pad, W) hit planes, the (L+1, K) push byte
+    # scratch, and the largest level's gather intermediate.
+    slots = max(
+        (
+            sum(r * wd for r, wd in lvl)
+            for lvl in eng.forest.level_shapes
+        ),
+        default=0,
+    )
+    transients = (
+        2 * 4 * w * eng.block
+        + 2 * 4 * w * eng.n_pad
+        + (eng.block + 1) * k_pad
+        + 4 * w * slots
+    )
+    actual = live + transients
+    assert actual <= est <= BRACKET * actual, (
+        f"sharded estimate {est} vs structural {actual} "
+        f"(ratio {est/actual:.2f}) outside [1, {BRACKET}]"
+    )
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("MSBFS_TEST_TPU"),
+    reason="memory_stats ground truth needs the real device",
+)
+def test_estimate_brackets_memory_stats():
+    n, edges = generators.rmat_edges(14, edge_factor=16, seed=63)
+    g = CSRGraph.from_edges(n, edges)
+    dev = jax.local_devices()[0]
+    base = (dev.memory_stats() or {}).get("bytes_in_use")
+    if base is None:
+        pytest.skip("backend exposes no memory_stats")
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        BitBellEngine,
+    )
+
+    k = 64
+    eng = BitBellEngine(BellGraph.from_host(g))
+    queries = pad_queries(
+        generators.random_queries(n, k, max_group=8, seed=64)
+    )
+    eng.best(queries)
+    peak = (dev.memory_stats() or {}).get("peak_bytes_in_use", 0) - base
+    est = BellGraph.estimate_hbm_bytes(g.n, g.num_directed_edges, k)
+    assert peak > 0
+    assert peak <= est <= BRACKET * peak, (
+        f"estimate {est} vs measured peak {peak} "
+        f"(ratio {est/peak:.2f}) outside [1, {BRACKET}]"
+    )

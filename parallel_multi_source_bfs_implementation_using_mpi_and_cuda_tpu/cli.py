@@ -222,8 +222,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     devices=jax.devices()[:n_chips],
                 )
                 announce_chunk()
+
+                def _opt_env_int(name):
+                    # None = unset (engine auto-sizes); 0 disables.
+                    raw = os.environ.get(name)
+                    if raw is None or raw == "":
+                        return None
+                    try:
+                        return int(raw)
+                    except ValueError:
+                        return None
+
                 engine = ShardedBellEngine(
-                    mesh, graph, level_chunk=level_chunk
+                    mesh,
+                    graph,
+                    level_chunk=level_chunk,
+                    halo_budget=_opt_env_int("MSBFS_HALO_BUDGET"),
+                    push_budget=_opt_env_int("MSBFS_PUSH_HALO"),
                 )
             else:
                 mesh = default_mesh(max_devices=n_chips)

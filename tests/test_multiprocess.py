@@ -77,6 +77,9 @@ def test_two_process_cluster_matches_single_process():
         assert r["global_devices"] == nproc * local_devices
         assert r["local_devices"] == local_devices
         assert (r["min_f"], r["min_k"]) == (want_f, want_k), r
+        # Vertex-sharded run whose halo collectives crossed the process
+        # boundary (mp_worker interleaves the 'v' axis over processes).
+        assert (r["sharded_min_f"], r["sharded_min_k"]) == (want_f, want_k), r
     assert outs[0]["process_id"] != outs[1]["process_id"]
 
 

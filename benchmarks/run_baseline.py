@@ -15,6 +15,8 @@ Configs (BASELINE.md "Measurement plan"):
   4. Grid road-network (USA-road-d stand-in), high diameter
   5. Vertex-sharded CSR (RMAT-27-class; scaled-down shape on one host;
      needs >= 2 devices, same virtual-mesh fallback as config 3)
+  6. Road-class graph on the vertex-sharded engine (chunked dispatches +
+     compacted sparse halo + in-block push; needs >= 2 devices)
 
 Usage: python benchmarks/run_baseline.py [--config N] [--all] [--scale-cap S]
                                          [--engine bitbell|bell|packed] [--out F]
@@ -271,9 +273,59 @@ def config5(scale=20):
     }
 
 
-CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+def config6(scale=18):
+    """Road-class graph on the VERTEX-SHARDED engine (round 3): chunked
+    dispatches + compacted sparse halo + in-block push — the capability
+    the ICI model identified as missing before road-scale graphs shard
+    well (docs/PERF_NOTES.md "Compacted sparse halo").  Complements
+    config 4 (single-chip push engine) with the multi-chip path."""
+    import jax
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (
+        ShardedBellEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        pad_queries,
+    )
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        raise NeedsDevices(2)
+    n_v = min(4, ndev)
+    n_q = max(1, ndev // n_v)
+    side = 1 << (scale // 2)
+    n, edges = generators.road_edges(side, side, seed=46)
+    g = CSRGraph.from_edges(n, edges)
+    queries = pad_queries(
+        generators.random_queries(n, 16, max_group=8, seed=44), pad_to=8
+    )
+    mesh = make_mesh(num_query_shards=n_q, num_vertex_shards=n_v)
+    engine = ShardedBellEngine(mesh, g, level_chunk=32)
+    r = _run(engine, queries, g.num_directed_edges)
+    return {
+        "config": 6,
+        "workload": (
+            f"synthetic-road {side}x{side}, 16 groups, sharded bitbell "
+            f"({n_q}q x {n_v}v, chunked + sparse halo)"
+        ),
+        **r,
+    }
+
+
+CONFIGS = {
+    1: config1, 2: config2, 3: config3, 4: config4, 5: config5, 6: config6,
+}
 # Default RMAT scale per config, cappable with --scale-cap (RAM-limited hosts).
-SCALES = {2: 20, 3: 22, 4: 20, 5: 20}
+SCALES = {2: 20, 3: 22, 4: 20, 5: 20, 6: 18}
 
 
 

@@ -196,10 +196,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 )
             # MSBFS_BACKEND is honored at -gn > 1 too (round-3; it used to
             # be single-chip only): "csr"/"vmap" selects the per-query CSR
-            # pull per shard; everything else runs the bitbell default,
-            # with a warning for backends that only exist single-chip.
+            # pull per shard, "push" the query-sharded work-optimal push
+            # engine (road-class); everything else runs the bitbell
+            # default, with a warning for backends that only exist
+            # single-chip.
             backend = os.environ.get("MSBFS_BACKEND", "auto")
-            if backend in ("dense", "pallas", "bell", "push", "packed"):
+            if backend in ("dense", "pallas", "bell", "packed"):
                 print(
                     f"MSBFS_BACKEND={backend} is single-chip only; using "
                     "the distributed bitbell engine at -gn > 1",
@@ -210,7 +212,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 from .parallel.mesh import make_mesh
                 from .parallel.sharded_bell import ShardedBellEngine
 
-                if backend in ("csr", "vmap"):
+                if backend in ("csr", "vmap", "push"):
                     print(
                         f"MSBFS_BACKEND={backend} has no vertex-sharded "
                         "variant; using the sharded bitbell engine",
@@ -240,6 +242,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                     halo_budget=_opt_env_int("MSBFS_HALO_BUDGET"),
                     push_budget=_opt_env_int("MSBFS_PUSH_HALO"),
                 )
+            elif backend == "push":
+                from .parallel.push_dist import DistributedPushEngine
+
+                try:
+                    engine = DistributedPushEngine(
+                        default_mesh(max_devices=n_chips), graph
+                    )
+                except ValueError as exc:
+                    # Degree beyond the width cap: same user-facing
+                    # engine-choice error as the single-chip push route.
+                    print(str(exc), file=sys.stderr)
+                    return 1
             else:
                 mesh = default_mesh(max_devices=n_chips)
                 if backend in ("csr", "vmap"):

@@ -209,6 +209,25 @@ def _push_chunk_batch(adj, carry, capacity, chunk, max_levels):
     )(carry)
 
 
+# Grid variants for the query-sharded distributed push engine
+# (parallel/push_dist.py): the (W, J, S) cyclic layout keeps its leading
+# axis sharded over the 'q' mesh axis, and since every per-lane op is
+# independent, XLA partitions the double-vmapped program with no
+# collectives inside the loop.
+@partial(jax.jit, static_argnames=("capacity",))
+def _push_init_grid(adj, grid, capacity):
+    return jax.vmap(jax.vmap(partial(_push_init, adj, capacity=capacity)))(
+        grid
+    )
+
+
+@partial(jax.jit, static_argnames=("capacity", "max_levels"))
+def _push_chunk_grid(adj, carry, capacity, chunk, max_levels):
+    return jax.vmap(
+        jax.vmap(lambda c: _push_chunk(adj, c, capacity, chunk, max_levels))
+    )(carry)
+
+
 def default_push_chunk() -> int:
     """Levels per dispatch.  Unbounded single-dispatch runs of the level
     loop crash the TPU worker on this platform once per-dispatch work
@@ -228,24 +247,29 @@ def default_push_chunk() -> int:
 
 def push_run(
     adj: PaddedAdjacency,
-    queries: jax.Array,  # (K, S)
+    queries: jax.Array,  # (K, S) — or any batch layout init_fn accepts
     capacity: int,
     max_levels=None,
     chunk: Optional[int] = None,
+    init_fn=_push_init_batch,
+    chunk_fn=_push_chunk_batch,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """(K, S) queries -> per-query (f, levels, reached, max_count);
-    max_count > capacity means that query's run overflowed (truncated).
+    """Per-query (f, levels, reached, max_count) in the batch layout of
+    ``init_fn``; max_count > capacity means that query's run overflowed
+    (truncated).
 
     Host-chunked orchestrator: each dispatch advances every query by at
     most ``chunk`` levels (see :func:`default_push_chunk`), with a cheap
-    (K,)-bool host sync between dispatches."""
+    bool host sync between dispatches.  ``init_fn``/``chunk_fn`` select
+    the batch layout — the (K, S) single-device vmap by default, the
+    mesh-sharded (W, J, S) grid for the distributed engine
+    (parallel/push_dist.py) — so the convergence protocol lives in ONE
+    place."""
     if chunk is None:
         chunk = default_push_chunk()
-    carry = _push_init_batch(adj, queries, capacity)
+    carry = init_fn(adj, queries, capacity)
     while True:
-        carry = _push_chunk_batch(
-            adj, carry, capacity, jnp.int32(chunk), max_levels
-        )
+        carry = chunk_fn(adj, carry, capacity, jnp.int32(chunk), max_levels)
         updated = np.asarray(carry[6])
         if not updated.any():
             break
@@ -297,6 +321,13 @@ class PushEngine(QueryEngineBase):
         self.max_levels = max_levels
         self._max_need = 0  # historical peak frontier across runs
 
+    def _dispatch(self, queries):
+        """One full push BFS over the (K, S) batch at the current capacity:
+        returns per-query (f, levels, reached, max_count) host-side arrays.
+        Subclasses override this to change WHERE the lanes execute (e.g.
+        sharded over a mesh) without touching the capacity protocol."""
+        return push_run(self.graph, queries, self.capacity, self.max_levels)
+
     def _run(self, queries):
         import sys
 
@@ -307,9 +338,7 @@ class PushEngine(QueryEngineBase):
         else:
             k = queries.shape[0]
         while True:
-            f, levels, reached, max_count = push_run(
-                self.graph, queries, self.capacity, self.max_levels
-            )
+            f, levels, reached, max_count = self._dispatch(queries)
             need = int(jnp.max(max_count[:k])) if k else 0
             if need <= self.capacity:
                 self._max_need = max(self._max_need, need)

@@ -1,0 +1,84 @@
+"""Query-sharded push engine: work-optimal road-class BFS at -gn > 1.
+
+The bit-plane distributed engines are level-synchronous pulls — O(D * E)
+on a diameter-D graph even with bounded dispatches — while the reference
+handles road-class graphs at any -gn by running its per-rank BFS loop on
+each rank's query slice (main.cu:303-322).  This engine is that model's
+TPU-native dual for the push engine: the PaddedAdjacency is replicated
+over the mesh (the reference's full-graph-per-rank model, SURVEY.md C8),
+the (W, J, S) cyclic query grid (reference round-robin, main.cu:303-307)
+is sharded over the 'q' axis, and the double-vmapped push programs
+(ops/push.py ``_push_init_grid``/``_push_chunk_grid``) partition
+trivially — every lane's compact/gather/scatter state is its own, so XLA
+runs each shard's lanes on its shard's device with NO collectives inside
+the level loop; the only cross-device traffic is the host's convergence
+read between chunk dispatches.
+
+Capacity semantics (auto-grow on overflow, historical-peak shrink,
+:class:`ops.push.FrontierOverflow` on explicit bounds) are inherited
+unchanged from PushEngine — only the dispatch site differs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.csr import CSRGraph
+from ..ops.push import (
+    PaddedAdjacency,
+    PushEngine,
+    _push_chunk_grid,
+    _push_init_grid,
+    push_run,
+)
+from .mesh import QUERY_AXIS
+from .scheduler import shard_queries
+
+
+class DistributedPushEngine(PushEngine):
+    """PushEngine whose lanes execute sharded over the 'q' mesh axis."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        graph: CSRGraph,
+        capacity: Optional[int] = None,
+        max_levels: Optional[int] = None,
+    ):
+        adj = PaddedAdjacency.from_host(graph)
+        super().__init__(adj, capacity=capacity, max_levels=max_levels)
+        self.mesh = mesh
+        self.w = mesh.shape[QUERY_AXIS]
+        # Replicate the adjacency on every mesh device (reference
+        # main.cu:242-295: full graph per rank, uploaded once).
+        self.graph = jax.device_put(adj, NamedSharding(mesh, P()))
+        # The inherited stepped trace would dispatch through the UNSHARDED
+        # single-vmap programs — an effectively single-chip run dressed as
+        # this engine; mask it so MSBFS_STATS=2 falls back honestly to the
+        # per-query table (cli probes callable(getattr(...))).
+        self.level_stats = None
+
+    def _dispatch(self, queries):
+        sharded, _, _, _ = shard_queries(
+            self.mesh, np.asarray(queries), None
+        )
+        f, levels, reached, max_count = push_run(
+            self.graph,
+            sharded,
+            self.capacity,
+            self.max_levels,
+            init_fn=_push_init_grid,
+            chunk_fn=_push_chunk_grid,
+        )
+
+        def to_global(x):
+            # grid[r, j] holds global query r + j*W (reference assignment,
+            # main.cu:303-307): transposing restores global order.
+            return jnp.asarray(np.asarray(x).T.reshape(-1))
+
+        return tuple(to_global(x) for x in (f, levels, reached, max_count))

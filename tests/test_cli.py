@@ -255,10 +255,18 @@ def test_multichip_honors_backend_env(files, capsys, monkeypatch):
     )
     assert rc == 0
     _assert_report(out, want, 4)
-    monkeypatch.setenv("MSBFS_BACKEND", "push")
+    monkeypatch.setenv("MSBFS_BACKEND", "dense")
     rc, out, err = run_cli(
         ["main.py", "-g", gpath, "-q", qpath, "-gn", "4"], capsys
     )
     assert rc == 0
     assert "single-chip only" in err
+    _assert_report(out, want, 4)
+    # push is a REAL multi-chip route since round 3 (DistributedPushEngine)
+    monkeypatch.setenv("MSBFS_BACKEND", "push")
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "4"], capsys
+    )
+    assert rc == 0
+    assert "single-chip only" not in err
     _assert_report(out, want, 4)

@@ -49,8 +49,12 @@ class DistributedPushEngine(PushEngine):
         graph: CSRGraph,
         capacity: Optional[int] = None,
         max_levels: Optional[int] = None,
+        max_width: Optional[int] = None,
     ):
-        adj = PaddedAdjacency.from_host(graph)
+        if max_width is None:
+            adj = PaddedAdjacency.from_host(graph)
+        else:
+            adj = PaddedAdjacency.from_host(graph, max_width=max_width)
         super().__init__(adj, capacity=capacity, max_levels=max_levels)
         self.mesh = mesh
         self.w = mesh.shape[QUERY_AXIS]

@@ -124,6 +124,60 @@ def _sharded_bell(g):
     )
 
 
+def _bitbell_chunked(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        BitBellEngine,
+    )
+
+    return BitBellEngine(BellGraph.from_host(g), level_chunk=2)
+
+
+def _distributed_chunked(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
+        DistributedEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    return DistributedEngine(make_mesh(num_query_shards=8), g, level_chunk=2)
+
+
+def _sharded_bell_sparse(g):
+    """Compacted halo + in-block push, budgets forced tiny so the sparse
+    AND rebuild branches execute, composed with chunked dispatches."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (
+        ShardedBellEngine,
+    )
+
+    return ShardedBellEngine(
+        make_mesh(num_query_shards=2, num_vertex_shards=4),
+        g,
+        level_chunk=3,
+        halo_budget=8,
+        push_budget=64,
+    )
+
+
+def _distributed_push(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.push_dist import (
+        DistributedPushEngine,
+    )
+
+    return DistributedPushEngine(
+        make_mesh(num_query_shards=4), g, max_width=512
+    )
+
+
 ENGINES = {
     "vmap": _vmap,
     "packed": _packed,
@@ -131,10 +185,14 @@ ENGINES = {
     "pallas_ell": _pallas_ell,
     "bell": _bell,
     "bitbell": _bitbell,
+    "bitbell_chunked": _bitbell_chunked,
     "push": _push,
     "distributed": _distributed,
+    "distributed_chunked": _distributed_chunked,
+    "distributed_push": _distributed_push,
     "sharded_csr": _sharded_csr,
     "sharded_bell": _sharded_bell,
+    "sharded_bell_sparse": _sharded_bell_sparse,
 }
 
 

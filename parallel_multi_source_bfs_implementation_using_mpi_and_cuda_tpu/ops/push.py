@@ -328,6 +328,22 @@ class PushEngine(QueryEngineBase):
         sharded over a mesh) without touching the capacity protocol."""
         return push_run(self.graph, queries, self.capacity, self.max_levels)
 
+    # Stepped-trace hooks (level_stats): subclasses with a different batch
+    # layout override these three; the trace loop itself is layout-blind
+    # (scalar reads reduce over whatever shape the carry has, per-query
+    # rows go through _to_query_order).
+    def _trace_init(self, queries):
+        return _push_init_batch(self.graph, queries, self.capacity)
+
+    def _trace_chunk(self, carry):
+        return _push_chunk_batch(
+            self.graph, carry, self.capacity, jnp.int32(1), self.max_levels
+        )
+
+    def _to_query_order(self, x) -> np.ndarray:
+        """Carry leaf -> (K_pad,) numpy array in global query order."""
+        return np.asarray(x)
+
     def _run(self, queries):
         import sys
 
@@ -415,17 +431,14 @@ class PushEngine(QueryEngineBase):
             )
         while True:
             t0 = _time.perf_counter()
-            carry = _push_init_batch(self.graph, queries, self.capacity)
-            reached_prev = np.asarray(carry[4]).astype(np.int64)
+            carry = self._trace_init(queries)
+            reached_prev = self._to_query_order(carry[4]).astype(np.int64)
             level_counts = [reached_prev.copy()]
             level_seconds = [_time.perf_counter() - t0]
             while True:
                 t0 = _time.perf_counter()
-                carry = _push_chunk_batch(
-                    self.graph, carry, self.capacity, jnp.int32(1),
-                    self.max_levels,
-                )
-                reached = np.asarray(carry[4]).astype(np.int64)
+                carry = self._trace_chunk(carry)
+                reached = self._to_query_order(carry[4]).astype(np.int64)
                 level_seconds.append(_time.perf_counter() - t0)
                 level_counts.append(reached - reached_prev)
                 reached_prev = reached
@@ -453,9 +466,9 @@ class PushEngine(QueryEngineBase):
             )
             self.capacity = grown
         return (
-            np.asarray(carry[3]),
+            self._to_query_order(carry[3]),
             reached_prev.astype(np.int32),
-            np.asarray(carry[2]),
+            self._to_query_order(carry[2]),
             np.stack(level_counts),
             np.asarray(level_seconds),
         )

@@ -61,11 +61,6 @@ class DistributedPushEngine(PushEngine):
         # Replicate the adjacency on every mesh device (reference
         # main.cu:242-295: full graph per rank, uploaded once).
         self.graph = jax.device_put(adj, NamedSharding(mesh, P()))
-        # The inherited stepped trace would dispatch through the UNSHARDED
-        # single-vmap programs — an effectively single-chip run dressed as
-        # this engine; mask it so MSBFS_STATS=2 falls back honestly to the
-        # per-query table (cli probes callable(getattr(...))).
-        self.level_stats = None
 
     def _dispatch(self, queries):
         sharded, _, _, _ = shard_queries(
@@ -79,10 +74,33 @@ class DistributedPushEngine(PushEngine):
             init_fn=_push_init_grid,
             chunk_fn=_push_chunk_grid,
         )
+        return tuple(
+            jnp.asarray(self._to_query_order(x))
+            for x in (f, levels, reached, max_count)
+        )
 
-        def to_global(x):
-            # grid[r, j] holds global query r + j*W (reference assignment,
-            # main.cu:303-307): transposing restores global order.
-            return jnp.asarray(np.asarray(x).T.reshape(-1))
+    # Stepped-trace hooks: same sharded grid layout as _dispatch, so
+    # MSBFS_STATS=2 times the DISTRIBUTED per-level dispatches (the
+    # inherited single-vmap hooks would measure an unsharded run).
+    def _trace_init(self, queries):
+        sharded, _, _, _ = shard_queries(
+            self.mesh, np.asarray(queries), None
+        )
+        return _push_init_grid(self.graph, sharded, self.capacity)
 
-        return tuple(to_global(x) for x in (f, levels, reached, max_count))
+    def _trace_chunk(self, carry):
+        return _push_chunk_grid(
+            self.graph, carry, self.capacity, jnp.int32(1), self.max_levels
+        )
+
+    def _to_query_order(self, x) -> np.ndarray:
+        # grid[r, j] holds global query r + j*W (reference assignment,
+        # main.cu:303-307): transposing restores global order.
+        return np.asarray(x).T.reshape(-1)
+
+    def level_stats(self, queries):
+        """Per-level trace in global query order, sliced to the true K
+        (the cyclic grid pads K up to a multiple of the 'q' axis)."""
+        k = np.asarray(queries).shape[0]
+        levels, reached, f, lc, secs = super().level_stats(queries)
+        return levels[:k], reached[:k], f[:k], lc[:, :k], secs

@@ -106,3 +106,47 @@ def test_cli_routes_push_backend_multichip(tmp_path, capsys, monkeypatch):
     assert "single-chip only" not in captured.err
     assert f"Query number (k) with minimum F value: {want_k + 1}" in captured.out
     assert f"Minimum F value: {want_f}" in captured.out
+
+
+def test_level_stats_distributed(road):
+    n, edges, queries, padded = road
+    g = CSRGraph.from_edges(n, edges)
+    mesh = make_mesh(num_query_shards=4, devices=jax.devices()[:4])
+    eng = DistributedPushEngine(mesh, g)
+    levels, reached, f, lc, secs = eng.level_stats(padded)
+    w = eng.query_stats(padded)
+    np.testing.assert_array_equal(levels, w[0])
+    np.testing.assert_array_equal(reached, w[1])
+    np.testing.assert_array_equal(f, w[2])
+    assert lc.shape == (len(secs), len(queries))
+    np.testing.assert_array_equal(lc.sum(axis=0), reached)
+    assert (lc[-1] == 0).all()
+    for i, q in enumerate(queries):
+        dist = oracle_bfs(n, edges, q)
+        for d in range(lc.shape[0]):
+            assert lc[d, i] == int((dist == d).sum())
+
+
+def test_cli_stats2_push_multichip(tmp_path, capsys, monkeypatch):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+        main,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_graph_bin,
+        save_query_bin,
+    )
+
+    n = 90
+    edges = np.stack(
+        [np.arange(n - 1), np.arange(1, n)], axis=1
+    ).astype(np.int64)
+    gpath, qpath = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
+    save_graph_bin(gpath, n, edges)
+    save_query_bin(qpath, [[0], [n - 1]])
+    monkeypatch.setenv("MSBFS_BACKEND", "push")
+    monkeypatch.setenv("MSBFS_STATS", "2")
+    rc = main(["main.py", "-g", gpath, "-q", qpath, "-gn", "4"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "active_queries" in captured.err  # per-level table present
+    assert "not available" not in captured.err

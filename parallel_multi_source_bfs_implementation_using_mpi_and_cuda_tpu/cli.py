@@ -103,6 +103,23 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     import jax
 
+    # Multi-host bring-up (the mpirun analog, reference main.cu:197-201):
+    # launch one process per host with MSBFS_COORDINATOR=<addr:port>,
+    # MSBFS_NUM_PROCESSES and MSBFS_PROCESS_ID set; the mesh then spans
+    # every host's devices and XLA's collectives ride ICI/DCN.  Unset =
+    # single-process (the common case).  Genuine bring-up failures
+    # propagate, like MPI_Init aborting.  MUST run before anything that
+    # initializes the XLA backend (jax.distributed's own contract).
+    coordinator = os.environ.get("MSBFS_COORDINATOR")
+    if coordinator:
+        from .parallel.mesh import initialize_distributed
+
+        initialize_distributed(
+            coordinator_address=coordinator,
+            num_processes=_env_int("MSBFS_NUM_PROCESSES", 1),
+            process_id=_env_int("MSBFS_PROCESS_ID", 0),
+        )
+
     from .utils.platform import is_tpu_backend
     from .utils.xla_cache import configure_compilation_cache
 
@@ -454,17 +471,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "engine; ignored for this run\n"
             )
 
-    sys.stdout.write(
-        format_report(
-            graph_path=graph_file,
-            query_path=query_file,
-            min_k=min_k,
-            min_f=min_f,
-            num_gpu=num_gpu,
-            preprocessing_time=pre.seconds,
-            computation_time=comp.seconds,
+    # Rank-0-only report, exactly the reference's contract (main.cu:403-414
+    # prints on world_rank 0 alone); every process computes — the merged
+    # result is replicated — but only process 0 speaks on stdout.
+    if jax.process_index() == 0:
+        sys.stdout.write(
+            format_report(
+                graph_path=graph_file,
+                query_path=query_file,
+                min_k=min_k,
+                min_f=min_f,
+                num_gpu=num_gpu,
+                preprocessing_time=pre.seconds,
+                computation_time=comp.seconds,
+            )
         )
-    )
     return 0
 
 

@@ -35,7 +35,11 @@ from jax import lax
 
 from ..models.csr import DeviceCSR
 
-NOT_REACHED = jnp.int32(-1)
+# Plain Python int, NOT jnp.int32(-1): a module-level jnp constant would
+# materialize a device array at import time and initialize the XLA backend,
+# which breaks multi-host bring-up (jax.distributed.initialize must run
+# before ANY backend-touching call; cli.py's MSBFS_COORDINATOR path).
+NOT_REACHED = -1
 
 
 def init_distances(

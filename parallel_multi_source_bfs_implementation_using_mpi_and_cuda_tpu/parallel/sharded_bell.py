@@ -625,8 +625,11 @@ class ShardedBellEngine(QueryEngineBase):
 
     ``halo_budget``: compacted-halo threshold in own-frontier rows per
     shard (:func:`_sharded_expand_own`).  None auto-sizes from the graph
-    (:func:`default_halo_budget`); 0 always exchanges full planes (the
-    round-2 behavior)."""
+    (:func:`default_halo_budget`) on TPU backends and resolves to 0 (all
+    dense) elsewhere — the sparse path trades ICI bytes for HBM-bandwidth
+    byte-lane work, a trade only real interconnects win (see __init__);
+    0 always exchanges full planes (the round-2 behavior).  Analogous for
+    ``push_budget`` (the in-block push edge budget)."""
 
     def __init__(
         self,
@@ -650,14 +653,27 @@ class ShardedBellEngine(QueryEngineBase):
         self.forest = jax.device_put(stacked, vspec)
         self.max_levels = max_levels
         self.level_chunk = level_chunk
+        # Auto budgets are TPU-only: the sparse path trades ICI halo bytes
+        # (the real-hardware bottleneck, ~2 ms/level at road-24M) for
+        # HBM-bandwidth byte-lane work (~30 us on TPU) — but on the
+        # shared-memory CPU mesh the "halo" is nearly free and the
+        # byte-lane term is paid at full price, measured a ~2x per-level
+        # REGRESSION (benchmarks/ici_model.py road rows).  Explicit
+        # budgets always win (tests and the CLI env knobs set them).
+        from ..utils.platform import is_tpu_backend
+
         if halo_budget is None:
-            halo_budget = default_halo_budget(self.n_pad, p)
+            halo_budget = (
+                default_halo_budget(self.n_pad, p) if is_tpu_backend() else 0
+            )
         self.halo_budget = int(halo_budget)
         if push_budget is None:
             # Pre-dedup directed count: a cheap upper bound of the dedup
             # edge count, good enough for a budget heuristic.
-            push_budget = default_push_halo_budget(
-                graph.num_directed_edges, p
+            push_budget = (
+                default_push_halo_budget(graph.num_directed_edges, p)
+                if is_tpu_backend()
+                else 0
             )
         self.push_budget = int(push_budget)
         if self.halo_budget and self.push_budget:

@@ -127,7 +127,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from .ops.engine import Engine
     from .parallel.distributed import DistributedEngine
-    from .parallel.mesh import default_mesh
+    from .parallel.mesh import make_mesh
     from .utils.io import load_graph_bin, load_query_bin, pad_queries
     from .utils.report import format_report
     from .utils.timing import Span
@@ -148,7 +148,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"Could not open query file {query_file}", file=sys.stderr)
             return 1
         padded = pad_queries(queries)
-        n_chips = max(1, min(num_gpu, len(jax.devices())))
+        if jax.process_count() > 1:
+            # Multi-host: -gn is devices PER HOST (the reference's per-rank
+            # GPU binding, main.cu:227-228 `rank % numGPU`), and the mesh
+            # must span every process — a mesh over one host's chips would
+            # hand other ranks non-addressable devices.
+            per_host = max(1, min(num_gpu, jax.local_device_count()))
+            by_proc = {}
+            for d in jax.devices():
+                by_proc.setdefault(d.process_index, []).append(d)
+            mesh_devices = [
+                d for pid in sorted(by_proc) for d in by_proc[pid][:per_host]
+            ]
+        else:
+            mesh_devices = jax.devices()[: max(1, min(num_gpu, len(jax.devices())))]
+        n_chips = len(mesh_devices)
         # HBM routing: estimate the default engine's footprint and compare
         # to the per-chip budget.  A graph beyond one chip auto-routes to
         # the vertex-sharded engine (multi-chip) or warns (single chip) —
@@ -226,7 +240,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                 )
                 backend = "auto"
             if vshard > 1 and n_chips % vshard == 0:
-                from .parallel.mesh import make_mesh
                 from .parallel.sharded_bell import ShardedBellEngine
 
                 if backend in ("csr", "vmap", "push"):
@@ -238,7 +251,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 mesh = make_mesh(
                     num_query_shards=n_chips // vshard,
                     num_vertex_shards=vshard,
-                    devices=jax.devices()[:n_chips],
+                    devices=mesh_devices,
                 )
                 announce_chunk()
 
@@ -264,7 +277,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
                 try:
                     engine = DistributedPushEngine(
-                        default_mesh(max_devices=n_chips), graph
+                        make_mesh(
+                            num_query_shards=n_chips, devices=mesh_devices
+                        ),
+                        graph,
                     )
                 except ValueError as exc:
                     # Degree beyond the width cap: same user-facing
@@ -272,7 +288,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     print(str(exc), file=sys.stderr)
                     return 1
             else:
-                mesh = default_mesh(max_devices=n_chips)
+                mesh = make_mesh(
+                    num_query_shards=n_chips, devices=mesh_devices
+                )
                 if backend in ("csr", "vmap"):
                     if level_chunk:
                         print(

@@ -108,11 +108,24 @@ def test_initialize_distributed_propagates_bad_cluster():
     )
     # A genuine bring-up failure must be LOUD: either a raised exception
     # (rc 0 + RAISED marker) or the coordination client's own fatal abort
-    # (nonzero rc, no marker).  What it must never do is return as if the
-    # cluster came up — the swallow bug this test was written against.
+    # (nonzero rc WITH a recognizable bring-up signature — an unrelated
+    # crash, e.g. a broken import, must still fail this test).  What it
+    # must never do is return as if the cluster came up — the swallow bug
+    # this test was written against.
     assert "SWALLOWED" not in proc.stdout, proc.stdout
     if proc.returncode == 0:
         assert "RAISED" in proc.stdout, (proc.stdout, proc.stderr[-2000:])
+    else:
+        blob = proc.stderr + proc.stdout
+        assert any(
+            sig in blob
+            for sig in (
+                "DEADLINE_EXCEEDED",
+                "Coordination",
+                "coordination",
+                "distributed service",
+            )
+        ), (proc.returncode, blob[-2000:])
 
 
 def test_two_process_cli_end_to_end(tmp_path):
@@ -176,3 +189,62 @@ def test_two_process_cli_end_to_end(tmp_path):
     # transport may chat on stdout, so assert on the report lines.
     assert "Minimum F value" not in outs[1]
     assert "Graph:" not in outs[1]
+
+
+def test_two_process_cli_gn_below_global(tmp_path):
+    """Multi-host with -gn smaller than the global device count: -gn is
+    devices PER HOST (the reference's per-rank binding, main.cu:227-228),
+    so -gn 1 on a 2-host x 2-device cluster builds a 2-device mesh with
+    one chip from EACH process — not host 0's two chips, which would hand
+    rank 1 non-addressable devices (round-3 review finding)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_graph_bin,
+        save_query_bin,
+    )
+
+    n, edges = generators.gnm_edges(80, 240, seed=825)
+    queries = generators.random_queries(n, 6, max_group=3, seed=826)
+    gpath, qpath = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
+    save_graph_bin(gpath, n, edges)
+    save_query_bin(qpath, [list(map(int, q)) for q in queries])
+    want_f, want_k = oracle_best(
+        [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    )
+
+    nproc, port = 2, _free_port()
+    base = virtual_cpu_env(2)
+    procs = []
+    for pid in range(nproc):
+        env = dict(
+            base,
+            MSBFS_COORDINATOR=f"127.0.0.1:{port}",
+            MSBFS_NUM_PROCESSES=str(nproc),
+            MSBFS_PROCESS_ID=str(pid),
+        )
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, os.path.join(REPO, "main.py"),
+                    "-g", gpath, "-q", qpath, "-gn", "1",
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                cwd=REPO,
+            )
+        )
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process CLI (-gn 1) timed out")
+        assert p.returncode == 0, f"CLI worker failed:\n{err[-3000:]}"
+        outs.append(out)
+    assert f"Query number (k) with minimum F value: {want_k + 1}" in outs[0]
+    assert f"Minimum F value: {want_f}" in outs[0]
+    assert "GPU # : 1 GPU" in outs[0]  # reported verbatim (main.cu:411)
+    assert "Minimum F value" not in outs[1]

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-3 TPU measurement runbook — run when the axon tunnel is up
+# (probe: timeout 110 python -c "import jax; print(jax.devices())").
+# Captures every number the round-3 work needs certified, in order of
+# importance.  Each step is independently restartable; the persistent XLA
+# cache makes repeats cheap.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== 1. headline bench (K=64 + K=256 extra; the driver artifact twin)"
+python bench.py | tee /tmp/bench_r3_headline.json
+
+echo "== 2. RMAT-24 (the BASELINE.json target scale)"
+BENCH_SCALE=24 BENCH_REPEATS=2 BENCH_EXTRA_KS= python bench.py \
+    | tee /tmp/bench_r3_rmat24.json
+
+echo "== 3. estimate_hbm_bytes ground truth via memory_stats"
+MSBFS_TEST_TPU=1 python -m pytest \
+    tests/test_hbm_estimate.py::test_estimate_brackets_memory_stats -q
+
+echo "== 4. road-class single chip (config 4, push engine)"
+python benchmarks/run_baseline.py --config 4
+
+echo "== 5. chunked bitbell on a road graph (the -gn>1 safety path, 1 chip)"
+python - <<'EOF'
+import time
+import numpy as np
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph, pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import generators
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import BellGraph
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import BitBellEngine
+
+side = 512
+n, edges = generators.road_edges(side, side, seed=46)
+g = CSRGraph.from_edges(n, edges)
+q = pad_queries(generators.random_queries(n, 16, max_group=8, seed=44), pad_to=8)
+eng = BitBellEngine(BellGraph.from_host(g), level_chunk=32)
+eng.compile(q.shape)
+t0 = time.perf_counter(); out = eng.best(q); dt = time.perf_counter() - t0
+print(f"road-{side} chunked bitbell: {dt:.2f}s best={out} "
+      f"({16 * g.num_directed_edges / dt / 1e6:.2f} MTEPS)")
+EOF
+
+echo "== done; fold numbers into BASELINE.md and docs/PERF_NOTES.md"

@@ -315,10 +315,7 @@ def bitbell_step(
     drives the loop so each level can be timed individually; honors the
     hybrid budget so traced levels run the same pull/push routing as the
     production loop."""
-    if sparse_budget and graph.sparse is not None:
-        new = hybrid_expand(graph, sparse_budget)(visited, frontier)
-    else:
-        new = bell_hits_or(frontier, graph) & ~visited
+    new = _bitbell_expand(graph, sparse_budget)(visited, frontier)
     return visited | new, new, unpack_counts(new)
 
 
@@ -346,11 +343,7 @@ def bitbell_run(
     ``sparse_budget`` > 0 (and a graph built with ``keep_sparse``) enables
     the hybrid pull/push level loop (:func:`hybrid_expand`)."""
     frontier0 = pack_queries(graph.n, queries)
-    if sparse_budget and graph.sparse is not None:
-        expand_hits = hybrid_expand(graph, sparse_budget)
-    else:
-        def expand_hits(visited, frontier):
-            return bell_hits_or(frontier, graph) & ~visited
+    expand_hits = _bitbell_expand(graph, sparse_budget)
     return bit_level_loop(
         frontier0,
         unpack_counts(frontier0),
@@ -361,8 +354,16 @@ def bitbell_run(
 
 def _bitbell_expand(graph: BellGraph, sparse_budget: int):
     """The engine's expansion hook: hybrid pull/push when a budget and a
-    dedup CSR exist, pure forest pull otherwise."""
-    if sparse_budget and graph.sparse is not None:
+    NON-EMPTY dedup CSR exist, pure forest pull otherwise.  The edge-count
+    guard matters: with an empty CSR the sparse branch degenerates to a
+    constant-zero plane whose varying-axes type differs from the pull
+    branch's under shard_map, and lax.cond rejects the mismatch (found by
+    the fuzz sweep on an edgeless graph through DistributedEngine)."""
+    if (
+        sparse_budget
+        and graph.sparse is not None
+        and graph.sparse[2].shape[0] > 0
+    ):
         return hybrid_expand(graph, sparse_budget)
 
     def expand(visited, frontier):

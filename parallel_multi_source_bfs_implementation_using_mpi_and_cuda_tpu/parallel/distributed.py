@@ -325,10 +325,15 @@ class DistributedEngine(QueryEngineBase):
             self.bell = jax.device_put(bell, replicated)
             # Per-shard hybrid pull/push (same speedup as the single-chip
             # engine — the sparse scatter is shard-local, no collectives).
+            # The edge-count guard mirrors BitBellEngine: an EMPTY dedup
+            # CSR must resolve to budget 0 (fuzz-found: a nonzero budget on
+            # an edgeless graph trips a varying-axes mismatch between the
+            # hybrid's cond branches under shard_map).
+            e_dedup = (
+                bell.sparse[2].shape[0] if bell.sparse is not None else 0
+            )
             self.sparse_budget = (
-                default_sparse_budget(bell.sparse[2].shape[0])
-                if bell.sparse is not None
-                else 0
+                default_sparse_budget(e_dedup) if e_dedup else 0
             )
             self.graph = None  # keep the attribute set backend-uniform
         elif backend == "csr":

@@ -1,0 +1,130 @@
+"""Randomized property fuzzing: many seeds x graph families x query
+shapes, every result checked against the host deque-BFS oracle.
+
+The per-engine suites pin fixed fixtures; this sweep hunts the input
+space — duplicate/self-loop-heavy multigraphs, disconnected pieces,
+empty and out-of-range query groups, K not a multiple of the word width,
+single-vertex and edgeless graphs — through the default single-chip
+engine and (one seed per family) the distributed route."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+    BitBellEngine,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+
+def random_problem(rng: np.random.Generator):
+    family = rng.choice(["gnm", "rmat", "grid", "multi", "edgeless"])
+    if family == "gnm":
+        n = int(rng.integers(2, 220))
+        m = int(rng.integers(0, 3 * n))
+        n, edges = generators.gnm_edges(n, m, seed=int(rng.integers(1 << 30)))
+    elif family == "rmat":
+        n, edges = generators.rmat_edges(
+            int(rng.integers(4, 9)),
+            edge_factor=int(rng.integers(2, 12)),
+            seed=int(rng.integers(1 << 30)),
+        )
+    elif family == "grid":
+        n, edges = generators.grid_edges(
+            int(rng.integers(2, 24)), int(rng.integers(2, 24))
+        )
+        # Random deletions: disconnects pieces, keeps the road profile.
+        keep = rng.random(edges.shape[0]) < 0.8
+        edges = edges[keep]
+    elif family == "multi":
+        # Duplicate- and self-loop-heavy multigraph.
+        n = int(rng.integers(2, 80))
+        base = rng.integers(0, n, size=(int(rng.integers(1, 4 * n)), 2))
+        loops = np.stack([np.arange(min(n, 5))] * 2, axis=1)
+        edges = np.concatenate([base, base[:: 2], loops]).astype(np.int64)
+    else:
+        n = int(rng.integers(1, 40))
+        edges = np.zeros((0, 2), dtype=np.int64)
+
+    k = int(rng.integers(1, 12))
+    queries = []
+    for _ in range(k):
+        size = int(rng.integers(0, 6))
+        q = rng.integers(0, max(n, 1), size=size)
+        if size and rng.random() < 0.3:
+            q[0] = rng.choice([-1, n, n + 7])  # out-of-range sources drop
+        queries.append(q.astype(np.int32))
+    return n, edges, queries
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_bitbell_matches_oracle(seed):
+    rng = np.random.default_rng(1000 + seed)
+    n, edges, queries = random_problem(rng)
+    g = CSRGraph.from_edges(n, edges)
+    padded = pad_queries(queries)
+    eng = BitBellEngine(BellGraph.from_host(g))
+    got = np.asarray(eng.f_values(padded))
+    want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")
+    assert eng.best(padded) == oracle_best(want), f"seed={seed}"
+
+
+@pytest.mark.parametrize("seed", [2000, 2001, 2002])
+def test_fuzz_distributed_matches_oracle(seed):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
+        DistributedEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+
+    rng = np.random.default_rng(seed)
+    n, edges, queries = random_problem(rng)
+    g = CSRGraph.from_edges(n, edges)
+    padded = pad_queries(queries)
+    eng = DistributedEngine(make_mesh(num_query_shards=8), g)
+    got = np.asarray(eng.f_values(padded))
+    want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")
+
+
+@pytest.mark.parametrize("seed", [3000, 3001])
+def test_fuzz_sharded_sparse_matches_oracle(seed):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (
+        ShardedBellEngine,
+    )
+
+    rng = np.random.default_rng(seed)
+    n, edges, queries = random_problem(rng)
+    g = CSRGraph.from_edges(n, edges)
+    padded = pad_queries(queries)
+    eng = ShardedBellEngine(
+        make_mesh(num_query_shards=2, num_vertex_shards=4),
+        g,
+        halo_budget=int(rng.integers(1, 32)),
+        push_budget=int(rng.integers(1, 128)),
+        level_chunk=int(rng.integers(1, 8)),
+    )
+    got = np.asarray(eng.f_values(padded))
+    want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")

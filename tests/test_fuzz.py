@@ -128,3 +128,47 @@ def test_fuzz_sharded_sparse_matches_oracle(seed):
     got = np.asarray(eng.f_values(padded))
     want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
     np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")
+
+
+@pytest.mark.parametrize("seed", [4000, 4001, 4002, 4003])
+def test_fuzz_push_matches_oracle(seed):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push import (
+        PaddedAdjacency,
+        PushEngine,
+    )
+
+    rng = np.random.default_rng(seed)
+    n, edges, queries = random_problem(rng)
+    g = CSRGraph.from_edges(n, edges)
+    padded = pad_queries(queries)
+    eng = PushEngine(PaddedAdjacency.from_host(g, max_width=1024))
+    if rng.random() < 0.5:
+        eng.capacity = int(rng.integers(1, 8))  # force auto-grow retries
+    got = np.asarray(eng.f_values(padded))
+    want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")
+
+
+@pytest.mark.parametrize("seed", [5000, 5001])
+def test_fuzz_distributed_push_matches_oracle(seed):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.push_dist import (
+        DistributedPushEngine,
+    )
+
+    rng = np.random.default_rng(seed)
+    n, edges, queries = random_problem(rng)
+    g = CSRGraph.from_edges(n, edges)
+    padded = pad_queries(queries)
+    eng = DistributedPushEngine(
+        make_mesh(num_query_shards=int(rng.choice([2, 4, 8]))),
+        g,
+        max_width=1024,
+    )
+    got = np.asarray(eng.f_values(padded))
+    want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")

@@ -1,0 +1,80 @@
+#!/usr/bin/env python3
+"""Measure the cost of ALWAYS bounding bit-plane dispatches (round 4).
+
+Round 3 gated the bounded level loop (``bitbell_run_chunked``) behind a
+degree heuristic because the unbounded single-dispatch path was assumed
+faster on shallow power-law graphs.  The heuristic can be fooled (VERDICT
+r3 "Missing" #2: one >64-degree hub on a deep graph takes the unbounded
+path), so round 4 wants the bound unconditional — IF the cost on shallow
+graphs is small.  The chunked loop's inner while_loop exits on
+convergence, so a ~10-level power-law BFS pays exactly one extra host
+scalar sync; this script measures that end to end.
+
+Prints one line per scenario: engine wall time unchunked vs chunked and
+the ratio.  Run on the CPU mesh for the routing decision; re-run on TPU
+via benchmarks/tpu_r4_runbook.sh step 6 for the certified number.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+    CSRGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+    BitBellEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+    pad_queries,
+)
+
+
+def scenario(name, g, k, repeats=3, chunk=32):
+    q = pad_queries(
+        generators.random_queries(g.n, k, max_group=8, seed=7), pad_to=8
+    )
+    bell = BellGraph.from_host(g)
+    rows = {}
+    for label, level_chunk in (("unchunked", None), (f"chunk={chunk}", chunk)):
+        eng = BitBellEngine(bell, level_chunk=level_chunk)
+        eng.compile(q.shape)
+        best = None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = eng.best(q)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        rows[label] = (best, out)
+    (tu, ou), (tc, oc) = rows["unchunked"], rows[f"chunk={chunk}"]
+    assert ou == oc, f"{name}: chunked result {oc} != unchunked {ou}"
+    print(
+        f"{name}: unchunked {tu:.4f}s  chunk={chunk} {tc:.4f}s  "
+        f"ratio {tc / tu:.3f}  (K={k})"
+    )
+
+
+def main():
+    import jax
+
+    print(f"backend: {jax.default_backend()}, devices: {len(jax.devices())}")
+    scale = int(os.environ.get("CHUNK_COST_SCALE", "18"))
+    n, edges = generators.rmat_edges(scale, edge_factor=16, seed=42)
+    scenario(f"RMAT-{scale} power-law", CSRGraph.from_edges(n, edges), 64)
+    side = int(os.environ.get("CHUNK_COST_SIDE", "256"))
+    n, edges = generators.road_edges(side, side, seed=46)
+    scenario(f"road-{side}x{side}", CSRGraph.from_edges(n, edges), 16)
+
+
+if __name__ == "__main__":
+    main()

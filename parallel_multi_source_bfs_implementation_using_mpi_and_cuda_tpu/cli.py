@@ -59,31 +59,75 @@ def parse_args(argv: List[str]):
     return graph_file, query_file, num_gpu
 
 
-def _level_chunk_policy(graph) -> Optional[int]:
-    """Per-dispatch level bound for the bit-plane engines (None = whole BFS
-    in one dispatch).  MSBFS_LEVEL_CHUNK forces a value (0 disables); the
-    default auto-detects road-class degree profiles — low max degree and
-    low mean degree mean the BFS is deep (thousands of levels on road
-    networks), and an unbounded while_loop dispatch doing thousands of
-    forest passes is the pattern that crashed the TPU worker
-    (docs/PERF_NOTES.md "Push-engine TPU status").  Power-law graphs
-    (high-degree hubs, ~10-level BFS) keep the single-dispatch fast path.
-    The reference runs any graph at any -gn (per-rank serial BFS,
-    main.cu:303-322); this bound is what keeps that promise here."""
-    explicit = os.environ.get("MSBFS_LEVEL_CHUNK")
+_AUTO_LEVEL_CHUNK = 32
+
+
+def _road_class(graph) -> bool:
+    """Deep-BFS degree profile (road networks/grids: low max and mean
+    degree mean thousands of BFS levels).  Routing hint ONLY — it keeps
+    the dense MXU engine off deep graphs and selects which warnings
+    print; the bounded level loop itself no longer depends on it
+    (round 4, see :func:`_level_chunk_policy`)."""
+    if graph.n == 0 or graph.num_directed_edges == 0:
+        return False
+    mean_deg = graph.num_directed_edges / graph.n
+    return int(graph.degrees.max()) <= 64 and mean_deg <= 8.0
+
+
+_UNSET = object()
+
+
+def _explicit_level_chunk() -> Optional[int]:
+    """Parsed MSBFS_LEVEL_CHUNK, or None when unset/empty (empty means
+    unset, like the file's other optional knobs) or malformed.  A
+    MALFORMED value warns and falls back to the auto policy — a typo must
+    not switch off a safety mitigation."""
+    raw = os.environ.get("MSBFS_LEVEL_CHUNK")
+    if raw is None or raw == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        print(
+            f"MSBFS_LEVEL_CHUNK={raw!r} is not an integer; "
+            "using the auto bound",
+            file=sys.stderr,
+        )
+        return None
+
+
+def _level_chunk_policy(graph, explicit=_UNSET) -> Optional[int]:
+    """Per-dispatch level bound for the level-loop engines (None = whole
+    BFS in one dispatch).  ALWAYS bounded by default (round 4): the
+    round-3 degree heuristic could be fooled — a single >64-degree hub on
+    an otherwise deep graph silently took the unbounded single-dispatch
+    path, exactly the pattern that crashed the TPU worker
+    (docs/PERF_NOTES.md "Push-engine TPU status").  The bounded loop
+    exits its in-dispatch while_loop on convergence, so a shallow
+    power-law BFS pays one host scalar sync total; measured at or below
+    the unchunked path on both graph classes (benchmarks/
+    exp_chunk_cost.py: RMAT-17/18 ratios 0.90-0.98, road 0.98-0.99 on
+    the CPU backend).  MSBFS_LEVEL_CHUNK: > 0 forces the bound, 0
+    explicitly disables it (single unbounded dispatch); malformed/empty
+    fall back to auto (:func:`_explicit_level_chunk`).  The reference
+    runs any graph at any -gn (per-rank serial BFS, main.cu:303-322);
+    this unconditional bound is what keeps that promise here."""
+    if explicit is _UNSET:
+        explicit = _explicit_level_chunk()
     if explicit is not None:
-        try:
-            val = int(explicit)
-        except ValueError:
-            val = 0
-        return val if val > 0 else None
+        if explicit > 0:
+            return explicit
+        if explicit == 0:
+            return None  # the documented explicit opt-out
+        # Negative = sign typo, not an opt-out: warn and keep the bound.
+        print(
+            f"MSBFS_LEVEL_CHUNK={explicit} is negative; "
+            "using the auto bound (0 disables)",
+            file=sys.stderr,
+        )
     if graph.n == 0 or graph.num_directed_edges == 0:
         return None
-    degrees = graph.degrees
-    mean_deg = graph.num_directed_edges / graph.n
-    if int(degrees.max()) <= 64 and mean_deg <= 8.0:
-        return 32
-    return None
+    return _AUTO_LEVEL_CHUNK
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -152,11 +196,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             # Multi-host: -gn is devices PER HOST (the reference's per-rank
             # GPU binding, main.cu:227-228 `rank % numGPU`), and the mesh
             # must span every process — a mesh over one host's chips would
-            # hand other ranks non-addressable devices.
-            per_host = max(1, min(num_gpu, jax.local_device_count()))
+            # hand other ranks non-addressable devices.  per_host derives
+            # from the GLOBAL device list, not this process's local count:
+            # on heterogeneous hosts every rank must compute the same
+            # per_host or they build divergent meshes (SPMD mismatch).
             by_proc = {}
             for d in jax.devices():
                 by_proc.setdefault(d.process_index, []).append(d)
+            per_host = max(
+                1, min(num_gpu, min(len(v) for v in by_proc.values()))
+            )
             mesh_devices = [
                 d for pid in sorted(by_proc) for d in by_proc[pid][:per_host]
             ]
@@ -174,13 +223,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             graph.n, graph.num_directed_edges, max(32, padded.shape[0])
         )
         hbm_have = device_hbm_bytes()
-        level_chunk = _level_chunk_policy(graph)
+        explicit_chunk = _explicit_level_chunk()
+        level_chunk = _level_chunk_policy(graph, explicit_chunk)
+        road_class = _road_class(graph)
 
         def announce_chunk():
             # Printed ONLY when the selected engine actually applies the
-            # bound — a user-forced backend without a chunked path must not
-            # claim the mitigation is active.
-            if level_chunk:
+            # bound AND the graph's profile predicts a deep BFS (the case
+            # the user cares about); the bound itself is on for every
+            # graph — a user-forced backend without a chunked path must
+            # not claim the mitigation is active.
+            if level_chunk and road_class:
                 print(
                     "road-class degree profile: bounding bit-plane "
                     f"dispatches to {level_chunk} BFS levels "
@@ -292,12 +345,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     num_query_shards=n_chips, devices=mesh_devices
                 )
                 if backend in ("csr", "vmap"):
-                    if level_chunk:
+                    if road_class or (explicit_chunk or 0) > 0:
+                        # The distributed per-query pull is the one path
+                        # left without a bounded level loop; say so both
+                        # when the graph looks deep and when the user
+                        # explicitly asked for a bound it can't honor.
                         print(
                             f"warning: MSBFS_BACKEND={backend} has no "
-                            "bounded-dispatch level loop; a high-diameter "
-                            "graph may exceed per-dispatch limits (unset "
-                            "MSBFS_BACKEND for the chunked bitbell engine)",
+                            "bounded-dispatch level loop at -gn > 1; a "
+                            "high-diameter graph may exceed per-dispatch "
+                            "limits (unset MSBFS_BACKEND for the chunked "
+                            "bitbell engine)",
                             file=sys.stderr,
                         )
                     engine = DistributedEngine(mesh, graph, backend="csr")
@@ -326,35 +384,36 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "auto-shard the CSR (this run may exhaust memory)",
                     file=sys.stderr,
                 )
-            if level_chunk and backend in (
-                "dense", "vmap", "pallas", "bell", "packed"
-            ):
-                print(
-                    f"warning: MSBFS_BACKEND={backend} has no "
-                    "bounded-dispatch level loop; a high-diameter graph "
-                    "may exceed per-dispatch limits (unset MSBFS_BACKEND "
-                    "for the chunked bitbell engine, or use push)",
-                    file=sys.stderr,
-                )
+            # Every single-chip backend honors level_chunk (round 4):
+            # the generic Engine (dense/vmap/pallas), BellEngine and
+            # PackedEngine run the host-chunked distance loop
+            # (ops.bfs.host_chunked_loop), bitbell its bit-plane dual,
+            # and the push engine chunks natively — so no backend choice
+            # can reach an unbounded dispatch.
             use_dense = backend == "dense"
             if backend == "auto" and is_tpu_backend():
                 threshold = _env_int("MSBFS_DENSE_THRESHOLD", 8192)
-                # Road-class profiles skip the dense engine: its level loop
-                # is one unbounded dispatch of n^2 matmuls, the worst shape
-                # for a thousands-of-levels BFS; the chunked bitbell below
-                # is the bounded path.
-                use_dense = graph.n <= threshold and not level_chunk
+                # Road-class profiles skip the dense engine: thousands of
+                # n^2-matmul levels is the worst shape for a deep BFS even
+                # chunked; the bitbell forest below is the cheaper path.
+                # A mis-detected profile is now a perf miss, not a safety
+                # hole — the dense loop is bounded too.
+                use_dense = graph.n <= threshold and not road_class
             if use_dense:
                 from .ops.dense import DenseGraph
 
-                engine = Engine(DenseGraph.from_host(graph))
+                engine = Engine(
+                    DenseGraph.from_host(graph), level_chunk=level_chunk
+                )
             elif backend == "vmap":
-                engine = Engine(graph.to_device())
+                engine = Engine(graph.to_device(), level_chunk=level_chunk)
             elif backend == "pallas":
                 # ELL-slab layout + Pallas VMEM-resident-frontier kernel.
                 from .models.ell import EllGraph
 
-                engine = Engine(EllGraph.from_host(graph))
+                engine = Engine(
+                    EllGraph.from_host(graph), level_chunk=level_chunk
+                )
             elif backend == "bell":
                 # Scatter-free bucketed-ELL reduction forest (ops.bell);
                 # pull-only, so skip the hybrid's dedup-CSR upload.
@@ -362,7 +421,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 from .ops.bell import BellEngine
 
                 engine = BellEngine(
-                    BellGraph.from_host(graph, keep_sparse=False)
+                    BellGraph.from_host(graph, keep_sparse=False),
+                    level_chunk=level_chunk,
                 )
             elif backend == "push":
                 # Frontier-compacted queue BFS: work-optimal on
@@ -383,7 +443,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 from .ops.packed import PackedEngine
 
                 edge_chunks = _env_int("MSBFS_EDGE_CHUNKS", 1)
-                engine = PackedEngine(graph.to_device(), edge_chunks=edge_chunks)
+                engine = PackedEngine(
+                    graph.to_device(),
+                    edge_chunks=edge_chunks,
+                    level_chunk=level_chunk,
+                )
             else:
                 # Default CSR path: bit-packed BELL reduction forest — the
                 # fastest measured engine (RMAT-20/64q on v5e: 2x the packed

@@ -142,6 +142,25 @@ def road_edges(
     return n, edges
 
 
+def hub_tail_edges(
+    tail: int = 2500, hub_fan: int = 100
+) -> Tuple[int, np.ndarray]:
+    """Adversarial degree profile: a ``tail``-vertex path (deep BFS) with
+    one ``hub_fan``-degree hub grafted onto vertex 0 — high max degree on
+    a high-diameter graph.  This is the shape that fooled the round-3
+    road-class heuristic (max_degree <= 64) into the unbounded dispatch
+    path (VERDICT r3); the bounded level loop must engage on it.  Layout:
+    path 0..tail-1, hub = ``tail``, leaves ``tail+1..n-1``."""
+    n = tail + 1 + hub_fan
+    path = np.stack([np.arange(tail - 1), np.arange(1, tail)], axis=1)
+    hub = tail
+    leaves = np.stack(
+        [np.full(hub_fan, hub), np.arange(tail + 1, n)], axis=1
+    )
+    bridge = np.array([[0, hub]])
+    return n, np.concatenate([path, bridge, leaves]).astype(np.int64)
+
+
 def gnm_edges(n: int, m: int, seed: int = 0) -> Tuple[int, np.ndarray]:
     """Uniform G(n, m) multigraph (duplicates and self-loops possible)."""
     rng = np.random.default_rng(seed)

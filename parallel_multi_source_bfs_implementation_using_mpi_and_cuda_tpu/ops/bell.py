@@ -26,8 +26,14 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..models.bell import BellGraph
+from .bfs import distance_chunk, host_chunked_loop, validate_level_chunk
 from .objective import f_of_u
-from .packed import K_ALIGN, PackedEngineBase, packed_init
+from .packed import (
+    K_ALIGN,
+    PackedEngineBase,
+    packed_carry_init,
+    packed_init,
+)
 
 HIT = jnp.uint8
 
@@ -120,6 +126,32 @@ def bell_distances(
     return dist
 
 
+@partial(jax.jit, static_argnames=("chunk", "max_levels"))
+def _bell_chunk(graph, carry, chunk, max_levels):
+    return distance_chunk(
+        carry,
+        lambda d, lvl: bell_expand_packed(d, lvl, graph),
+        chunk,
+        max_levels,
+    )
+
+
+def bell_distances_chunked(
+    graph: BellGraph,
+    queries: jax.Array,
+    level_chunk: int,
+    max_levels: Optional[int] = None,
+) -> jax.Array:
+    """:func:`bell_distances` with per-dispatch work bounded to
+    ``level_chunk`` BFS levels (ops.bfs.host_chunked_loop)."""
+    carry = host_chunked_loop(
+        packed_carry_init(graph, queries),
+        lambda c: _bell_chunk(graph, c, level_chunk, max_levels),
+        max_levels,
+    )
+    return carry[0]
+
+
 @partial(jax.jit, static_argnames=("max_levels",))
 def bell_f_values(
     graph: BellGraph,
@@ -139,14 +171,24 @@ class BellEngine(PackedEngineBase):
         graph: BellGraph,
         max_levels: Optional[int] = None,
         k_align: int = K_ALIGN,
+        level_chunk: Optional[int] = None,
     ):
         self.graph = graph
         self.max_levels = max_levels
         self.k_align = k_align
+        self.level_chunk = validate_level_chunk(level_chunk)
 
     def _distances(self, queries) -> jax.Array:
+        if self.level_chunk:
+            return bell_distances_chunked(
+                self.graph, queries, self.level_chunk, self.max_levels
+            )
         return bell_distances(self.graph, queries, self.max_levels)
 
     def f_values(self, queries) -> jax.Array:
         queries, k = self._pad_queries(queries)
+        if self.level_chunk:
+            from .packed import _f_from_packed_distances
+
+            return _f_from_packed_distances(self._distances(queries))[:k]
         return bell_f_values(self.graph, queries, self.max_levels)[:k]

@@ -31,6 +31,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..models.csr import DeviceCSR
@@ -144,6 +145,74 @@ def stats_from_distances(dist: jax.Array):
     levels = jnp.where(any_reached, jnp.max(dist) + 1, 0).astype(jnp.int32)
     reached = jnp.sum(reached_mask.astype(jnp.int32))
     return levels, reached, f_of_u(dist)
+
+
+def distance_carry_init(n: int, sources: jax.Array, state_size=None):
+    """The (dist, level, updated) carry all distance-matrix level loops
+    share, with sources already at distance 0 (same reference bounds-check
+    semantics as :func:`init_distances`).  ``updated`` starts true iff any
+    valid source exists (an empty set converges after the first no-op
+    dispatch, like the reference's single kernel launch)."""
+    dist0 = init_distances(n, sources, state_size=state_size)
+    return dist0, jnp.int32(0), jnp.any(dist0 == 0)
+
+
+def validate_level_chunk(level_chunk):
+    """Constructor-time guard every chunked engine shares: a non-positive
+    bound would make the in-dispatch while_loop a no-op while ``updated``
+    stays true, so the host driver would re-dispatch forever — fail loud
+    at build time instead of hanging at run time."""
+    if level_chunk is not None and level_chunk <= 0:
+        raise ValueError(
+            f"level_chunk must be positive (got {level_chunk}); "
+            "use None to disable the bound"
+        )
+    return level_chunk
+
+
+def distance_chunk(carry, expand_step, chunk, max_levels):
+    """Advance a (dist, level, updated) carry by at most ``chunk`` BFS
+    levels (or to convergence / ``max_levels``) in ONE dispatch — the
+    bounded dual of the fused while_loop, shared by every distance-matrix
+    engine (generic vmap, dense-MXU, Pallas-ELL, packed CSR, BELL) the way
+    ``bit_level_chunk`` serves the bit-plane engines.  ``expand_step(dist,
+    level) -> newly-reached mask`` is the engine's own expansion."""
+    if isinstance(chunk, int) and chunk <= 0:  # trace-time backstop
+        raise ValueError(f"chunk must be positive (got {chunk})")
+    start = carry[1]
+
+    def cond(c):
+        _, level, updated = c
+        go = jnp.logical_and(updated, level < start + chunk)
+        if max_levels is not None:
+            go = jnp.logical_and(go, level < max_levels)
+        return go
+
+    def body(c):
+        dist, level, _ = c
+        new = expand_step(dist, level)
+        return (jnp.where(new, level + 1, dist), level + 1, jnp.any(new))
+
+    return lax.while_loop(cond, body, carry)
+
+
+def host_chunked_loop(carry, advance, max_levels, level_ix=1, updated_ix=2):
+    """Host-driven bounded-dispatch driver: re-dispatch ``advance`` (a
+    jitted chunk step that bounds its own in-dispatch work) with the carry
+    kept on device, until every query has converged or hit ``max_levels``.
+    Costs one host scalar/array read per chunk.  Always dispatches at least
+    once, so ``engine.compile()`` warms the chunk program even on the
+    all-padding dummy (whose initial ``updated`` is already false).
+    ``updated`` may be a scalar (plane engines) or a per-query array (the
+    vmapped generic engine); a converged query's carry is a fixed point, so
+    extra dispatches for its lane are harmless no-ops."""
+    while True:
+        carry = advance(carry)
+        active = np.asarray(carry[updated_ix])
+        if max_levels is not None:
+            active = active & (np.asarray(carry[level_ix]) < max_levels)
+        if not active.any():
+            return carry
 
 
 def batched_multi_source_bfs(

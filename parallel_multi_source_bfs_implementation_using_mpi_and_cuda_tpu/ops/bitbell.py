@@ -32,6 +32,7 @@ import numpy as np
 from jax import lax
 
 from ..models.bell import BellGraph
+from .bfs import validate_level_chunk
 from .bell import forest_hits
 from .packed import PackedEngineBase
 from .push import compact_indices
@@ -425,9 +426,11 @@ class BitBellEngine(PackedEngineBase):
     pulls, the round-1 behavior).
 
     ``level_chunk``: levels per XLA dispatch (None = whole BFS in one
-    dispatch, the fast path for shallow graphs).  Set for high-diameter
-    graphs so per-dispatch work stays bounded (:func:`bitbell_run_chunked`);
-    the CLI auto-enables it for road-class degree profiles."""
+    dispatch).  Bounds per-dispatch work so high-diameter graphs cannot
+    run an unbounded dispatch (:func:`bitbell_run_chunked`); the CLI
+    auto-enables it for every graph (round 4 — the chunked loop exits on
+    convergence, so shallow BFS pays one host sync; measured cost <= 0,
+    benchmarks/exp_chunk_cost.py)."""
 
     k_align = WORD_BITS
 
@@ -444,7 +447,7 @@ class BitBellEngine(PackedEngineBase):
             e = graph.sparse[2].shape[0] if graph.sparse is not None else 0
             sparse_budget = default_sparse_budget(e) if e else 0
         self.sparse_budget = int(sparse_budget)
-        self.level_chunk = level_chunk
+        self.level_chunk = validate_level_chunk(level_chunk)
         self._level_warm_shapes = set()  # level_stats warms once per shape
 
     def _bitbell_run(self, queries):

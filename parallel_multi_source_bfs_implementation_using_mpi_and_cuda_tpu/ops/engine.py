@@ -18,7 +18,14 @@ import numpy as np
 from jax import lax
 
 from ..models.csr import DeviceCSR
-from .bfs import graph_expand, multi_source_bfs
+from .bfs import (
+    distance_carry_init,
+    distance_chunk,
+    graph_expand,
+    host_chunked_loop,
+    multi_source_bfs,
+    validate_level_chunk,
+)
 from .objective import f_of_u, select_best_jit
 
 
@@ -43,6 +50,37 @@ def _stats_chunked(graph, queries, max_levels, expand):
         return stats_from_distances(dist)
 
     return lax.map(jax.vmap(one), queries)
+
+
+@jax.jit
+def _carry_init_batch(graph, queries):
+    """(J, S) queries -> per-query (dist, level, updated) carry batch."""
+    return jax.vmap(
+        lambda q: distance_carry_init(graph.n, q, state_size=graph.n_pad)
+    )(queries)
+
+
+@partial(jax.jit, static_argnames=("chunk", "max_levels", "expand"))
+def _advance_batch(graph, carry, chunk, max_levels, expand):
+    """One bounded dispatch: each of the J queries advances by <= ``chunk``
+    levels (converged lanes are fixed points)."""
+    return jax.vmap(
+        lambda c: distance_chunk(
+            c, lambda d, lvl: expand(d, lvl, graph), chunk, max_levels
+        )
+    )(carry)
+
+
+@jax.jit
+def _f_from_dist_batch(dist):
+    return jax.vmap(f_of_u)(dist)
+
+
+@jax.jit
+def _stats_from_dist_batch(dist):
+    from .bfs import stats_from_distances
+
+    return jax.vmap(stats_from_distances)(dist)
 
 
 class QueryEngineBase:
@@ -91,6 +129,10 @@ class Engine(QueryEngineBase):
 
     The graph lives in HBM once (reference main.cu:282-295); every call reuses
     it.  ``query_chunk=None`` runs all K queries in a single vmap batch.
+    ``level_chunk`` bounds per-dispatch work to that many BFS levels (the
+    high-diameter safety the bit-plane engines pioneered, now available to
+    every graph representation this engine hosts — CSR pull, dense-MXU,
+    Pallas-ELL); None keeps the whole BFS in one fused dispatch.
     """
 
     def __init__(
@@ -99,11 +141,13 @@ class Engine(QueryEngineBase):
         max_levels: Optional[int] = None,
         query_chunk: Optional[int] = None,
         expand=graph_expand,
+        level_chunk: Optional[int] = None,
     ):
         self.graph = graph
         self.max_levels = max_levels
         self.query_chunk = query_chunk
         self.expand = expand
+        self.level_chunk = validate_level_chunk(level_chunk)
 
     def _chunk_grid(self, queries) -> Tuple[jax.Array, int]:
         """Pad K to the chunk multiple and reshape to (C, chunk, S)."""
@@ -117,18 +161,48 @@ class Engine(QueryEngineBase):
             )
         return queries.reshape((K + pad) // chunk, chunk, S), K
 
+    def _dist_batch(self, queries_batch) -> jax.Array:
+        """Bounded-dispatch path for ONE (J, S) query chunk: final
+        (J, n_pad) distances via the host-chunked driver (one bounded
+        dispatch per ``level_chunk`` levels, carry on device).  Chunks are
+        driven one at a time so only one chunk's distance state is ever
+        resident — the same memory bound as the fused path."""
+        carry = host_chunked_loop(
+            _carry_init_batch(self.graph, queries_batch),
+            lambda c: _advance_batch(
+                self.graph, c, self.level_chunk, self.max_levels, self.expand
+            ),
+            self.max_levels,
+        )
+        return carry[0]
+
     def f_values(self, queries: jax.Array) -> jax.Array:
         """(K, S) int32 -1-padded queries -> (K,) int64 F values."""
         grid, K = self._chunk_grid(queries)
-        out = _f_values_chunked(self.graph, grid, self.max_levels, self.expand)
-        return out.reshape(-1)[:K]
+        if self.level_chunk:
+            out = jnp.concatenate(
+                [_f_from_dist_batch(self._dist_batch(row)) for row in grid]
+            )
+        else:
+            out = _f_values_chunked(
+                self.graph, grid, self.max_levels, self.expand
+            ).reshape(-1)
+        return out[:K]
 
     def query_stats(self, queries):
         """Per-query (levels, reached, F) — the tracing subsystem's data
         source (SURVEY.md section 5: new capability, reference has none).
         Respects query_chunk: the same O(chunk * E) per-level memory bound
-        as f_values."""
+        as f_values (the chunked path runs one query chunk's carry at a
+        time)."""
         grid, K = self._chunk_grid(queries)
+        if self.level_chunk:
+            rows = [_stats_from_dist_batch(self._dist_batch(r)) for r in grid]
+            levels, reached, f = (
+                np.concatenate([np.asarray(x) for x in col])
+                for col in zip(*rows)
+            )
+            return levels[:K], reached[:K], f[:K]
         levels, reached, f = _stats_chunked(
             self.graph, grid, self.max_levels, self.expand
         )

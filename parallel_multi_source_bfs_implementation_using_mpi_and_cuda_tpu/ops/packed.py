@@ -33,7 +33,12 @@ import numpy as np
 from jax import lax
 
 from ..models.csr import DeviceCSR
-from .bfs import init_distances
+from .bfs import (
+    distance_chunk,
+    host_chunked_loop,
+    init_distances,
+    validate_level_chunk,
+)
 from .engine import QueryEngineBase
 from .objective import f_of_u
 
@@ -153,6 +158,52 @@ def packed_distances(
     return dist
 
 
+@jax.jit
+def packed_carry_init(graph, queries):
+    """(K, S) queries -> the shared (dist, level, updated) carry over the
+    query-minor (n, K) distance matrix (used by the packed AND BELL
+    chunked loops)."""
+    dist0 = packed_init(graph.n, queries)
+    return dist0, jnp.int32(0), jnp.any(dist0 == 0)
+
+
+@partial(jax.jit, static_argnames=("chunk", "max_levels", "edge_chunks"))
+def _packed_chunk(graph, carry, chunk, max_levels, edge_chunks):
+    return distance_chunk(
+        carry,
+        lambda d, lvl: _packed_expand(d, lvl, graph, edge_chunks),
+        chunk,
+        max_levels,
+    )
+
+
+def packed_distances_chunked(
+    graph: DeviceCSR,
+    queries: jax.Array,
+    level_chunk: int,
+    max_levels: Optional[int] = None,
+    edge_chunks: int = 1,
+) -> jax.Array:
+    """:func:`packed_distances` with per-dispatch work bounded to
+    ``level_chunk`` BFS levels (the high-diameter safety path; see
+    ops.bfs.host_chunked_loop)."""
+    carry = host_chunked_loop(
+        packed_carry_init(graph, queries),
+        lambda c: _packed_chunk(
+            graph, c, level_chunk, max_levels, edge_chunks
+        ),
+        max_levels,
+    )
+    return carry[0]
+
+
+@jax.jit
+def _f_from_packed_distances(dist):
+    """(n, K) distances -> (K,) int64 F values (the chunked path's tail;
+    the fused path keeps this inside packed_f_values' single program)."""
+    return jax.vmap(f_of_u)(dist.T)
+
+
 @partial(jax.jit, static_argnames=("max_levels", "edge_chunks"))
 def packed_f_values(
     graph: DeviceCSR,
@@ -181,20 +232,33 @@ class PackedEngine(PackedEngineBase):
         max_levels: Optional[int] = None,
         edge_chunks: int = 1,
         k_align: int = K_ALIGN,
+        level_chunk: Optional[int] = None,
     ):
         self.graph = graph
         self.max_levels = max_levels
         self.edge_chunks = edge_chunks
         self.k_align = k_align
+        self.level_chunk = validate_level_chunk(level_chunk)
 
     def _distances(self, queries) -> jax.Array:
+        if self.level_chunk:
+            return packed_distances_chunked(
+                self.graph,
+                queries,
+                self.level_chunk,
+                self.max_levels,
+                self.edge_chunks,
+            )
         return packed_distances(
             self.graph, queries, self.max_levels, self.edge_chunks
         )
 
     def f_values(self, queries) -> jax.Array:
         queries, k = self._pad_queries(queries)
-        f = packed_f_values(
-            self.graph, queries, self.max_levels, self.edge_chunks
-        )
+        if self.level_chunk:
+            f = _f_from_packed_distances(self._distances(queries))
+        else:
+            f = packed_f_values(
+                self.graph, queries, self.max_levels, self.edge_chunks
+            )
         return f[:k]

@@ -25,7 +25,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.csr import CSRGraph, DeviceCSR
-from ..ops.bfs import graph_expand, multi_source_bfs
+from ..ops.bfs import graph_expand, multi_source_bfs, validate_level_chunk
 from ..ops.engine import QueryEngineBase
 from ..ops.objective import f_of_u
 from .mesh import QUERY_AXIS, VERTEX_AXIS
@@ -349,7 +349,7 @@ class DistributedEngine(QueryEngineBase):
         self.expand = expand
         if level_chunk is not None and backend != "bitbell":
             raise ValueError("level_chunk requires backend='bitbell'")
-        self.level_chunk = level_chunk
+        self.level_chunk = validate_level_chunk(level_chunk)
         self._level_warm_shapes = set()
         if backend != "bitbell":
             # The stepped trace drives the bitbell carry; mask the method so

@@ -28,6 +28,7 @@ main.cu:324-397).
 
 from __future__ import annotations
 
+import sys
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
@@ -652,7 +653,9 @@ class ShardedBellEngine(QueryEngineBase):
         vspec = NamedSharding(mesh, P(VERTEX_AXIS))
         self.forest = jax.device_put(stacked, vspec)
         self.max_levels = max_levels
-        self.level_chunk = level_chunk
+        from ..ops.bfs import validate_level_chunk
+
+        self.level_chunk = validate_level_chunk(level_chunk)
         # Auto budgets are TPU-only: the sparse path trades ICI halo bytes
         # (the real-hardware bottleneck, ~2 ms/level at road-24M) for
         # HBM-bandwidth byte-lane work (~30 us on TPU) — but on the
@@ -667,6 +670,7 @@ class ShardedBellEngine(QueryEngineBase):
                 default_halo_budget(self.n_pad, p) if is_tpu_backend() else 0
             )
         self.halo_budget = int(halo_budget)
+        explicit_push = push_budget is not None
         if push_budget is None:
             # Pre-dedup directed count: a cheap upper bound of the dedup
             # edge count, good enough for a budget heuristic.
@@ -681,6 +685,19 @@ class ShardedBellEngine(QueryEngineBase):
                 build_push_halo(graph, p, self.block, self.n_pad), vspec
             )
         else:
+            if explicit_push and self.push_budget and not self.halo_budget:
+                # In-block push is only reachable inside the sparse-halo
+                # branch; a lone EXPLICIT MSBFS_PUSH_HALO would otherwise
+                # be silently dead (ADVICE r3).  An auto-sized budget
+                # zeroed by halo_budget=0 is normal routing, not a user
+                # error — no warning for that.
+                print(
+                    f"warning: push_budget={self.push_budget} ignored "
+                    "because halo_budget is 0 — the in-block push runs "
+                    "only inside the sparse-halo branch (set "
+                    "MSBFS_HALO_BUDGET too)",
+                    file=sys.stderr,
+                )
             self.push = None
             self.push_budget = 0
         self._level_warm_shapes = set()

@@ -115,6 +115,58 @@ def test_chunked_hybrid_power_law():
     assert_stats_equal(ref, got)
 
 
+def test_distance_engines_chunked_match(deep):
+    """Round 4: EVERY single-chip backend honors level_chunk — the
+    generic Engine (CSR pull + dense MXU), PackedEngine and BellEngine
+    run the shared host-chunked distance loop (ops.bfs.host_chunked_loop)
+    and must be bit-identical to the unchunked bitbell reference."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bell import (
+        BellEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.dense import (
+        DenseGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+        Engine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+        PackedEngine,
+    )
+
+    g, padded, ref = deep
+    engines = [
+        Engine(g.to_device(), level_chunk=32),
+        Engine(DenseGraph.from_host(g), level_chunk=32),
+        PackedEngine(g.to_device(), edge_chunks=2, level_chunk=7),
+        BellEngine(BellGraph.from_host(g, keep_sparse=False), level_chunk=32),
+    ]
+    for eng in engines:
+        assert_stats_equal(ref, eng.query_stats(padded))
+        np.testing.assert_array_equal(
+            np.asarray(eng.f_values(padded)), np.asarray(ref[2])
+        )
+
+
+def test_distance_engines_chunked_respect_max_levels(deep):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+        Engine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+        PackedEngine,
+    )
+
+    g, padded, _ = deep
+    ref = Engine(g.to_device(), max_levels=5).query_stats(padded)
+    got = Engine(g.to_device(), max_levels=5, level_chunk=2).query_stats(
+        padded
+    )
+    assert_stats_equal(ref, got)
+    got = PackedEngine(
+        g.to_device(), max_levels=5, level_chunk=2
+    ).query_stats(padded)
+    assert_stats_equal(ref, got)
+
+
 def test_chunked_respects_max_levels(deep):
     g, padded, _ = deep
     ref = BitBellEngine(BellGraph.from_host(g), max_levels=5).query_stats(
@@ -140,14 +192,95 @@ def test_level_chunk_requires_bitbell_backend(deep):
         DistributedEngine(mesh, g, backend="csr", level_chunk=8)
 
 
-def test_policy_detects_road_class(monkeypatch):
+def test_policy_always_bounds(monkeypatch):
+    """Round 4: the bound is unconditional — power-law hubs no longer
+    disable it (the chunked loop exits on convergence, so shallow BFS
+    pays one host sync; benchmarks/exp_chunk_cost.py)."""
     monkeypatch.delenv("MSBFS_LEVEL_CHUNK", raising=False)
     g_road, _ = deep_problem()
     assert _level_chunk_policy(g_road) == 32
     n, edges = generators.rmat_edges(10, edge_factor=16, seed=7)
     g_rmat = CSRGraph.from_edges(n, edges)
-    assert _level_chunk_policy(g_rmat) is None  # hubs exceed the degree cap
+    assert _level_chunk_policy(g_rmat) == 32  # power-law graphs too
     monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "0")
-    assert _level_chunk_policy(g_road) is None  # 0 disables
+    assert _level_chunk_policy(g_road) is None  # explicit 0 disables
     monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "64")
     assert _level_chunk_policy(g_rmat) == 64  # explicit wins
+
+
+def test_policy_malformed_env_falls_back_to_auto(monkeypatch, capsys):
+    """A typo in MSBFS_LEVEL_CHUNK must NOT switch off the safety bound
+    (round-3 behavior mapped garbage to 'disabled'; ADVICE r3)."""
+    g_road, _ = deep_problem()
+    monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "banana")
+    assert _level_chunk_policy(g_road) == 32
+    assert "MSBFS_LEVEL_CHUNK" in capsys.readouterr().err
+    monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "")
+    assert _level_chunk_policy(g_road) == 32
+    assert capsys.readouterr().err == ""  # empty = unset, no noise
+    monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "-32")  # sign typo != opt-out
+    assert _level_chunk_policy(g_road) == 32
+    assert "negative" in capsys.readouterr().err
+
+
+def test_nonpositive_level_chunk_rejected_at_build():
+    """A chunk <= 0 would make every dispatch a no-op and the host driver
+    spin forever; engines must fail loud at construction instead."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+        Engine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+        PackedEngine,
+    )
+
+    g, _ = deep_problem()
+    for bad in (0, -1):
+        with pytest.raises(ValueError):
+            Engine(g.to_device(), level_chunk=bad)
+        with pytest.raises(ValueError):
+            PackedEngine(g.to_device(), level_chunk=bad)
+        with pytest.raises(ValueError):
+            BitBellEngine(BellGraph.from_host(g), level_chunk=bad)
+        with pytest.raises(ValueError):
+            ShardedBellEngine(
+                make_mesh(num_query_shards=4, num_vertex_shards=2),
+                g,
+                level_chunk=bad,
+            )
+
+
+def hub_tail_problem(tail=2500, hub_fan=100):
+    """generators.hub_tail_edges (the round-3 heuristic's blind spot) as a
+    ready-made (graph, padded queries) problem."""
+    n, edges = generators.hub_tail_edges(tail, hub_fan)
+    queries = [
+        np.array([tail - 1], dtype=np.int32),  # tail-deep BFS
+        np.array([tail], dtype=np.int32),  # from the hub
+    ]
+    return CSRGraph.from_edges(n, edges), pad_queries(queries)
+
+
+def test_hub_tail_adversary_bounded_all_engines(monkeypatch):
+    """The adversarial graph gets the bound at any -gn, and the chunked
+    engines agree with the unchunked oracle on it (reference: any graph
+    at any rank count, main.cu:303-322)."""
+    monkeypatch.delenv("MSBFS_LEVEL_CHUNK", raising=False)
+    g, padded = hub_tail_problem()
+    assert int(g.degrees.max()) > 64  # the round-3 heuristic's blind spot
+    chunk = _level_chunk_policy(g)
+    assert chunk == 32
+    ref = BitBellEngine(BellGraph.from_host(g)).query_stats(padded)
+    assert ref[0].max() >= 2000  # the deep precondition
+    engines = [
+        BitBellEngine(BellGraph.from_host(g), level_chunk=chunk),
+        DistributedEngine(
+            make_mesh(num_query_shards=8), g, level_chunk=chunk
+        ),
+        ShardedBellEngine(
+            make_mesh(num_query_shards=4, num_vertex_shards=2),
+            g,
+            level_chunk=chunk,
+        ),
+    ]
+    for eng in engines:
+        assert_stats_equal(ref, eng.query_stats(padded))

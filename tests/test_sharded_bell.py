@@ -224,6 +224,20 @@ class TestSparseHalo:
         np.testing.assert_array_equal(f, w[2])
         np.testing.assert_array_equal(lc.sum(axis=0), reached)
 
+    def test_lone_push_budget_warns(self, capsys):
+        """push_budget without halo_budget is dead config — it must warn,
+        not silently no-op (ADVICE r3)."""
+        n, edges, queries, padded = self._road()
+        g = CSRGraph.from_edges(n, edges)
+        mesh = make_mesh(num_query_shards=2, num_vertex_shards=4)
+        eng = ShardedBellEngine(mesh, g, halo_budget=0, push_budget=16)
+        assert "halo_budget" in capsys.readouterr().err
+        assert eng.push is None and eng.push_budget == 0
+        np.testing.assert_array_equal(
+            np.asarray(eng.f_values(padded)),
+            oracle_f_values(n, edges, queries),
+        )
+
     def test_edgeless_graph_push_guard(self):
         g = CSRGraph.from_edges(5, np.zeros((0, 2), dtype=np.int64))
         mesh = make_mesh(num_query_shards=2, num_vertex_shards=4)

@@ -293,38 +293,66 @@ def main(argv: Optional[List[str]] = None) -> int:
                 )
                 backend = "auto"
             if vshard > 1 and n_chips % vshard == 0:
-                from .parallel.sharded_bell import ShardedBellEngine
-
-                if backend in ("csr", "vmap", "push"):
-                    print(
-                        f"MSBFS_BACKEND={backend} has no vertex-sharded "
-                        "variant; using the sharded bitbell engine",
-                        file=sys.stderr,
-                    )
                 mesh = make_mesh(
                     num_query_shards=n_chips // vshard,
                     num_vertex_shards=vshard,
                     devices=mesh_devices,
                 )
-                announce_chunk()
+                # Engine choice on the ('q', 'v') mesh: the owner-
+                # partitioned push (parallel.push_sharded — work-optimal,
+                # per-level cost proportional to the wavefront) serves
+                # "push" explicitly and road-class graphs on auto, width
+                # cap permitting; the sharded bitbell forest
+                # (parallel.sharded_bell) is the default for everything
+                # else and the fallback when push cannot apply.
+                engine = None
+                if backend == "push" or (backend == "auto" and road_class):
+                    from .parallel.push_sharded import ShardedPushEngine
 
-                def _opt_env_int(name):
-                    # None = unset (engine auto-sizes); 0 disables.
-                    raw = os.environ.get(name)
-                    if raw is None or raw == "":
-                        return None
                     try:
-                        return int(raw)
-                    except ValueError:
-                        return None
+                        engine = ShardedPushEngine(
+                            mesh, graph, level_chunk=level_chunk
+                        )
+                        announce_chunk()
+                    except ValueError as exc:
+                        if backend == "push":
+                            # Explicit choice: surface the engine error
+                            # like the single-chip push route.
+                            print(str(exc), file=sys.stderr)
+                            return 1
+                        print(
+                            f"auto: {exc}; using the sharded bitbell "
+                            "engine",
+                            file=sys.stderr,
+                        )
+                elif backend in ("csr", "vmap"):
+                    print(
+                        f"MSBFS_BACKEND={backend} has no vertex-sharded "
+                        "variant; using the sharded bitbell engine",
+                        file=sys.stderr,
+                    )
+                if engine is None:
+                    from .parallel.sharded_bell import ShardedBellEngine
 
-                engine = ShardedBellEngine(
-                    mesh,
-                    graph,
-                    level_chunk=level_chunk,
-                    halo_budget=_opt_env_int("MSBFS_HALO_BUDGET"),
-                    push_budget=_opt_env_int("MSBFS_PUSH_HALO"),
-                )
+                    announce_chunk()
+
+                    def _opt_env_int(name):
+                        # None = unset (engine auto-sizes); 0 disables.
+                        raw = os.environ.get(name)
+                        if raw is None or raw == "":
+                            return None
+                        try:
+                            return int(raw)
+                        except ValueError:
+                            return None
+
+                    engine = ShardedBellEngine(
+                        mesh,
+                        graph,
+                        level_chunk=level_chunk,
+                        halo_budget=_opt_env_int("MSBFS_HALO_BUDGET"),
+                        push_budget=_opt_env_int("MSBFS_PUSH_HALO"),
+                    )
             elif backend == "push":
                 from .parallel.push_dist import DistributedPushEngine
 

@@ -76,6 +76,28 @@ def compact_indices(
     )
 
 
+def compact_frontier_planes(planes: jax.Array, budget: int, block: int):
+    """Compact a (L, W) uint32 bit-plane frontier under ``budget`` rows.
+
+    Returns (count, ids, valid, words): ``count`` = active rows (caller
+    compares against the budget — entries beyond it DROP, so exceeding it
+    means truncation); ``ids`` (budget,) local row indices, sentinel
+    ``block`` padded; ``valid`` the real-entry mask; ``words`` (budget, W)
+    each row's query words, zero on padding.  Shared by the sparse-halo
+    exchange (parallel.sharded_bell) and the owner-partitioned push
+    (parallel.push_sharded) so the budget/sentinel semantics live once."""
+    active = (planes != jnp.uint32(0)).any(axis=1)
+    count = jnp.sum(active, dtype=jnp.int32)
+    ids = compact_indices(active, budget, fill_value=block)
+    valid = ids < block
+    words = jnp.where(
+        valid[:, None],
+        jnp.take(planes, jnp.minimum(ids, block - 1), axis=0),
+        jnp.uint32(0),
+    )
+    return count, ids, valid, words
+
+
 @jax.tree_util.register_pytree_node_class
 class PaddedAdjacency:
     """(n+1, w) neighbor table: row v = v's (deduped) neighbors, sentinel n

@@ -1,0 +1,552 @@
+"""Owner-partitioned push BFS over the 'v' mesh axis (round 4).
+
+The missing scale story this module closes: a road-class graph too big for
+one chip's HBM.  The vertex-sharded pull engines (parallel.sharded_csr /
+parallel.sharded_bell) handle the capacity, but every level still gathers
+the shard's whole edge partition — O(D * E / p) work per shard on a
+diameter-D graph, thousands of nearly-empty passes on road networks.  The
+single-chip push engine (ops.push) is work-optimal but replicates the
+adjacency (as does its query-sharded twin, parallel.push_dist — deliberate
+there, matching the reference's broadcast model, main.cu:242-280).
+
+This engine is the intersection: the adjacency is PARTITIONED by owner
+(shard b holds only rows [b*L, (b+1)*L)), each shard advances a compacted
+frontier queue over its OWN rows for all K bit-packed queries at once, and
+per level the shards exchange only the BOUNDARY discoveries — candidates
+whose owner is another shard — as compacted (global id, query words)
+pairs over one 'v'-axis ``all_gather`` (the same pair wire format as the
+sparse halo in parallel.sharded_bell).  Per-level cost is proportional to
+the wavefront, not the edge partition:
+
+  * gather:   (C, w) own-frontier adjacency rows (C = frontier capacity,
+    w = max degree — the road-class width cap of ops.push);
+  * scatter:  in-block candidates land directly in the shard's own hit
+    planes (byte-lane scatter-max = bitwise OR, the well-defined form of
+    the reference kernel's benign write race, main.cu:30-33);
+  * exchange: p * B * 4 * (1 + W) bytes of boundary pairs (B = boundary
+    budget) — for contiguous range partitions of road graphs the boundary
+    is the cut between blocks, orders of magnitude below E/p.
+
+Capacities are static shapes.  Like ops.push, results are NEVER silently
+truncated: the loop tracks the peak own-frontier and boundary counts
+(pmax over the mesh), and the engine re-runs at a grown capacity when a
+dispatch overflowed (one discarded run + one recompile, worst case
+capacity = L and boundary = C * w, both always sufficient).
+
+Semantics are the reference's exactly (main.cu:16-89): source bounds
+check, level-synchronous expansion, unreached vertices excluded from
+F(U); results merge over ('q', 'v') with the same Gatherv+argmin contract
+(main.cu:324-397) as every other distributed engine.
+"""
+
+from __future__ import annotations
+
+import sys
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.csr import CSRGraph
+from ..ops.engine import QueryEngineBase
+from ..ops.push import (
+    DEFAULT_MAX_WIDTH,
+    compact_frontier_planes,
+    compact_indices,
+)
+from ..ops.bitbell import (
+    pack_byte_planes,
+    pack_queries,
+    unpack_byte_planes,
+    unpack_counts,
+)
+from .distributed import _distributed_bitbell_finish, _pad_qblock
+from .mesh import QUERY_AXIS, VERTEX_AXIS
+from .scheduler import shard_queries
+
+
+def build_sharded_adjacency(
+    g: CSRGraph, p: int, max_width: int = DEFAULT_MAX_WIDTH
+) -> Tuple[jax.Array, int, int, int]:
+    """Partition ``g`` into ``p`` contiguous vertex blocks of length L and
+    build the stacked (p, L + 1, w) width-padded own-row tables.
+
+    Neighbor values are GLOBAL vertex ids (sentinel n_pad pads); row L of
+    every shard is all-sentinel — the landing pad for padded frontier
+    slots, exactly like ops.push.PaddedAdjacency's row n.  Duplicate
+    neighbors and self-loops are dropped (set semantics, cannot change BFS
+    distances or F(U)).  Raises ValueError when the graph's max degree
+    exceeds ``max_width`` — the engine targets the road-network class.
+
+    Returns (stacked rows, L, n_pad, w).
+    """
+    n = g.n
+    L = -(-max(n, 1) // p)
+    n_pad = p * L
+    u, v, deg = g.deduped_pairs()
+    w = int(deg.max()) if n and deg.size else 0
+    w = max(w, 1)
+    if w > max_width:
+        raise ValueError(
+            f"max degree {w} exceeds width cap {max_width}: the "
+            "owner-partitioned push engine targets low-degree "
+            "(road-class) graphs; use the sharded bitbell engine instead"
+        )
+    table = np.full((n_pad + 1, w), n_pad, dtype=np.int32)
+    offs = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(deg, out=offs[1:])
+    col = np.arange(u.size, dtype=np.int64) - offs[u]
+    table[u, col] = v.astype(np.int32)
+    # (p, L+1, w): block b's rows plus its own sentinel landing-pad row.
+    # Stays a HOST array: the constructor device_puts it with the 'v'
+    # NamedSharding directly, so the full table is never resident on one
+    # chip — the whole point for graphs beyond a single chip's HBM.
+    sentinel = table[n_pad : n_pad + 1]
+    stacked = np.stack(
+        [
+            np.concatenate([table[b * L : (b + 1) * L], sentinel])
+            for b in range(p)
+        ]
+    )
+    return stacked, L, n_pad, w
+
+
+def default_capacity(n_pad: int, block: int) -> int:
+    """Auto own-frontier capacity per shard.  A road wavefront can live
+    entirely inside one shard, so size from the GLOBAL vertex count like
+    ops.push (8*sqrt(n), floor 2048), capped at the block length (always
+    sufficient)."""
+    return int(min(max(block, 1), max(2048, 8 * int(max(n_pad, 1) ** 0.5))))
+
+
+def default_boundary(capacity: int, width: int) -> int:
+    """Auto boundary-pair budget per shard.  Contiguous range partitions
+    of road-class graphs cut few edges per wavefront, so start well below
+    the worst case (capacity * width, always sufficient) and let the
+    overflow protocol grow on demand."""
+    return int(min(capacity * width, max(1024, capacity // 2)))
+
+
+def _push_level(adj_own, visited_own, frontier_own, block, n_pad, cap, bnd):
+    """One owner-partitioned push level inside shard_map.
+
+    Returns (new_own (L, W) planes, own-frontier rows this level, boundary
+    candidates this level) — the counts feed the overflow tracking.
+    """
+    w_words = frontier_own.shape[1]
+    me = lax.axis_index(VERTEX_AXIS)
+    lo = me * block
+    # Compact the own frontier: local row ids (sentinel `block` -> the
+    # adjacency's landing-pad row) and their query words.
+    own_rows, ids, valid, words = compact_frontier_planes(
+        frontier_own, cap, block
+    )
+    # Gather the frontier rows' neighbors: (C, w) GLOBAL ids.  Padded
+    # slots hit row `block` (all n_pad) and drop everywhere below.
+    nbrs = jnp.take(adj_own, ids, axis=0)
+    c, w_deg = nbrs.shape
+    flat_dst = nbrs.reshape(-1)  # (C*w,)
+    flat_words = jnp.broadcast_to(
+        words[:, None, :], (c, w_deg, w_words)
+    ).reshape(c * w_deg, w_words)
+    src_bytes = unpack_byte_planes(flat_words)  # (C*w, K) 0/1 bytes
+    # In-block candidates scatter straight into the own hit planes.
+    local_dst = flat_dst - lo
+    in_block = (local_dst >= 0) & (local_dst < block)
+    hit_bytes = (
+        jnp.zeros((block + 1, src_bytes.shape[1]), jnp.uint8)
+        .at[jnp.where(in_block, local_dst, block)]
+        .max(src_bytes)
+    )
+    # Boundary candidates (another shard owns them): compact to (B,) pairs
+    # and exchange over 'v'.  Sentinel-padded slots (dst == n_pad) are not
+    # boundary; receivers drop pairs outside their block.
+    is_boundary = (flat_dst < n_pad) & ~in_block
+    bcount = jnp.sum(is_boundary, dtype=jnp.int32)
+    bslots = compact_indices(is_boundary, bnd, fill_value=c * w_deg)
+    bvalid = bslots < c * w_deg
+    safe = jnp.minimum(bslots, c * w_deg - 1)
+    bdst = jnp.where(bvalid, jnp.take(flat_dst, safe), n_pad)
+    # Exchange PACKED words — p * B * 4 * (1 + W) bytes on the wire, the
+    # sparse halo's pair format — and unpack to byte lanes on receive.
+    bwords = jnp.where(
+        bvalid[:, None], jnp.take(flat_words, safe, axis=0), jnp.uint32(0)
+    )
+    all_dst = lax.all_gather(bdst, VERTEX_AXIS).reshape(-1)  # (p*B,)
+    all_words = lax.all_gather(bwords, VERTEX_AXIS).reshape(-1, w_words)
+    recv_local = all_dst - lo
+    recv_mine = (recv_local >= 0) & (recv_local < block)
+    hit_bytes = hit_bytes.at[jnp.where(recv_mine, recv_local, block)].max(
+        unpack_byte_planes(all_words)
+    )
+    hits_own = pack_byte_planes(hit_bytes[:block])
+    return hits_own & ~visited_own, own_rows, bcount
+
+
+@partial(jax.jit, static_argnames=("mesh", "block", "n_pad"))
+def _sharded_push_init(
+    mesh: Mesh, query_grid: jax.Array, block: int, n_pad: int
+):
+    """Per-(q,v)-shard loop carries: own-block (L, W) planes sharded over
+    ('v', 'q'), per-q-shard counter rows, and the two replicated peak
+    counters (own-frontier rows / boundary candidates) at zero."""
+
+    def shard_body(qblock):
+        qblock, _ = _pad_qblock(qblock)
+        frontier0 = pack_queries(n_pad, qblock)
+        counts0 = unpack_counts(frontier0)
+        me = lax.axis_index(VERTEX_AXIS)
+        own0 = lax.dynamic_slice_in_dim(frontier0, me * block, block, axis=0)
+        return (
+            own0,  # visited = sources
+            own0,  # frontier
+            (counts0.astype(jnp.int64) * 0)[None],
+            jnp.where(counts0 > 0, 1, 0).astype(jnp.int32)[None],
+            counts0[None],
+            jnp.int32(0)[None],
+            jnp.any(counts0 > 0)[None],
+            jnp.zeros((), jnp.int32),  # peak own-frontier rows
+            jnp.zeros((), jnp.int32),  # peak boundary candidates
+        )
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(QUERY_AXIS),),
+        out_specs=(P(VERTEX_AXIS, QUERY_AXIS),) * 2
+        + (P(QUERY_AXIS),) * 5
+        + (P(), P()),
+    )(query_grid)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "block", "n_pad", "cap", "bnd", "max_levels"),
+)
+def _sharded_push_chunk(
+    mesh: Mesh,
+    adj,  # (p, L+1, w) stacked own-row tables, sharded over 'v'
+    carry,
+    chunk,
+    block: int,
+    n_pad: int,
+    cap: int,
+    bnd: int,
+    max_levels,
+):
+    """Advance every shard's carry by <= ``chunk`` push levels in one
+    dispatch.  Discovery counts are a psum over 'v' of own-block counts
+    (each vertex counts exactly once, on its owner), so every shard sees
+    identical convergence state; the peak own-frontier/boundary counters
+    are pmax'd so the host can detect truncation and re-run."""
+
+    def shard_body(adj, v_own, f_own, f, lv, rc, level, upd, pk_f, pk_b):
+        adj_own = adj[0]
+        start = level[0]
+
+        def cond(c):
+            go = jnp.logical_and(c[6], c[5] < start + chunk)
+            if max_levels is not None:
+                go = jnp.logical_and(go, c[5] < max_levels)
+            return go
+
+        def body(c):
+            visited, frontier, f, levels, reached, lvl, _, pf, pb = c
+            new, own_rows, bcount = _push_level(
+                adj_own, visited, frontier, block, n_pad, cap, bnd
+            )
+            counts = lax.psum(unpack_counts(new), VERTEX_AXIS)
+            found = counts > 0
+            dist = lvl + 1
+            return (
+                visited | new,
+                new,
+                f + counts.astype(jnp.int64) * dist.astype(jnp.int64),
+                jnp.where(found, dist + 1, levels),
+                reached + counts,
+                lvl + 1,
+                jnp.any(found),
+                jnp.maximum(pf, own_rows),
+                jnp.maximum(pb, bcount),
+            )
+
+        # The peak counters arrive replicated (P() specs) but the loop
+        # body computes them from shard-varying values; align the carry's
+        # varying-axes types up front (same concern bit_level_init's
+        # ``cast`` handles for the bit-plane engines).
+        vary = lambda x: lax.pcast(x, (QUERY_AXIS, VERTEX_AXIS), to="varying")
+        out = lax.while_loop(
+            cond,
+            body,
+            (
+                v_own,
+                f_own,
+                f[0],
+                lv[0],
+                rc[0],
+                level[0],
+                upd[0],
+                vary(pk_f),
+                vary(pk_b),
+            ),
+        )
+        axes = (QUERY_AXIS, VERTEX_AXIS)
+        any_up = lax.pmax(out[6].astype(jnp.int32), axes)
+        max_level = lax.pmax(out[5], axes)
+        return (
+            (out[0], out[1])
+            + tuple(x[None] for x in out[2:7])
+            + (lax.pmax(out[7], axes), lax.pmax(out[8], axes))
+            + (any_up, max_level)
+        )
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(VERTEX_AXIS),)
+        + (P(VERTEX_AXIS, QUERY_AXIS),) * 2
+        + (P(QUERY_AXIS),) * 5
+        + (P(), P()),
+        out_specs=(P(VERTEX_AXIS, QUERY_AXIS),) * 2
+        + (P(QUERY_AXIS),) * 5
+        + (P(), P())
+        + (P(), P()),
+    )(adj, *carry)
+
+
+def sharded_push_run(
+    mesh: Mesh,
+    adj,
+    query_grid: jax.Array,
+    k: int,
+    k_pad: int,
+    w: int,
+    block: int,
+    n_pad: int,
+    cap: int,
+    bnd: int,
+    max_levels,
+    level_chunk: int,
+):
+    """Host-chunked owner-partitioned push over the full mesh.  Returns
+    (f, levels, reached, peak_frontier, peak_boundary): the first three
+    replicated (k_pad,) merged results, the peaks for the caller's
+    overflow protocol (> cap / > bnd means this run was truncated and
+    must be discarded)."""
+    carry = _sharded_push_init(mesh, query_grid, block, n_pad)
+    while True:
+        *carry, any_up, max_level = _sharded_push_chunk(
+            mesh,
+            adj,
+            tuple(carry),
+            jnp.int32(level_chunk),
+            block,
+            n_pad,
+            cap,
+            bnd,
+            max_levels,
+        )
+        if not int(np.asarray(any_up)):
+            break
+        if max_levels is not None and int(np.asarray(max_level)) >= max_levels:
+            break
+    peak_f, peak_b = int(np.asarray(carry[7])), int(np.asarray(carry[8]))
+    j = query_grid.shape[1]
+    f, levels, reached = _distributed_bitbell_finish(
+        mesh, carry[2], carry[3], carry[4], j, k, k_pad, w
+    )
+    return f, levels, reached, peak_f, peak_b
+
+
+class ShardedPushEngine(QueryEngineBase):
+    """Owner-partitioned work-optimal BFS: queries round-robin over 'q',
+    adjacency partitioned over 'v', per-level boundary-pair exchange.
+
+    ``capacity``/``boundary`` bound the per-shard compacted frontier and
+    the per-shard boundary send (static shapes).  None = auto mode: start
+    from wavefront-sized guesses (:func:`default_capacity` /
+    :func:`default_boundary`); a run whose pmax'd peak exceeded either
+    bound is DISCARDED and re-run at the measured need (ops.push's
+    protocol — results are never silently truncated).  Explicit ints are
+    hard bounds: overflow raises :class:`FrontierOverflow`.
+
+    ``level_chunk`` bounds per-dispatch work (default 64 levels, the push
+    engine's chunk default) — this engine exists for thousands-of-levels
+    graphs, so the bound is always on.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        graph: CSRGraph,
+        max_levels: Optional[int] = None,
+        max_width: int = DEFAULT_MAX_WIDTH,
+        capacity: Optional[int] = None,
+        boundary: Optional[int] = None,
+        level_chunk: Optional[int] = None,
+    ):
+        from ..ops.push import default_push_chunk
+
+        self.mesh = mesh
+        self.w = mesh.shape[QUERY_AXIS]
+        self.p = mesh.shape[VERTEX_AXIS]
+        self.n = graph.n
+        stacked, self.block, self.n_pad, self.width = build_sharded_adjacency(
+            graph, self.p, max_width
+        )
+        self.adj = jax.device_put(
+            stacked, NamedSharding(mesh, P(VERTEX_AXIS))
+        )
+        self.max_levels = max_levels
+        self.auto_capacity = capacity is None
+        self.capacity = (
+            default_capacity(self.n_pad, self.block)
+            if capacity is None
+            else int(capacity)
+        )
+        self.auto_boundary = boundary is None
+        self.boundary = (
+            default_boundary(self.capacity, self.width)
+            if boundary is None
+            else int(boundary)
+        )
+        from ..ops.bfs import validate_level_chunk
+
+        self.level_chunk = (
+            validate_level_chunk(level_chunk) or default_push_chunk()
+        )
+        self._peak_f = 0  # historical peaks (shrink guard, ops.push style)
+        self._peak_b = 0
+        self._level_warm_shapes = set()
+
+    def _bounds_held(self, peak_f: int, peak_b: int) -> bool:
+        """The never-silently-truncated contract: True when the run's
+        pmax'd peaks fit the static bounds; otherwise grow (auto mode,
+        caller re-runs) or raise (explicit hard bounds)."""
+        from ..ops.push import FrontierOverflow
+
+        ok_f, ok_b = peak_f <= self.capacity, peak_b <= self.boundary
+        if ok_f and ok_b:
+            self._peak_f = max(self._peak_f, peak_f)
+            self._peak_b = max(self._peak_b, peak_b)
+            return True
+        if (not ok_f and not self.auto_capacity) or (
+            not ok_b and not self.auto_boundary
+        ):
+            raise FrontierOverflow(
+                f"sharded push overflow: a level needed frontier >= "
+                f"{peak_f} (capacity={self.capacity}) or boundary >= "
+                f"{peak_b} (boundary={self.boundary}); construct "
+                "ShardedPushEngine with larger bounds"
+            )
+        if not ok_f:
+            self.capacity = min(
+                self.block, max(2 * self.capacity, 4 * peak_f)
+            )
+        if not ok_b:
+            self.boundary = min(
+                self.capacity * self.width,
+                max(2 * self.boundary, 4 * peak_b),
+            )
+        print(
+            "ShardedPushEngine: overflow (frontier "
+            f"{peak_f}, boundary {peak_b}); re-running at "
+            f"capacity={self.capacity}, boundary={self.boundary}",
+            file=sys.stderr,
+        )
+        return False
+
+    def _prologue(self, queries: np.ndarray):
+        queries = np.asarray(queries)
+        queries = np.where(
+            (queries >= 0) & (queries < self.n), queries, -1
+        )
+        return shard_queries(self.mesh, queries, None)
+
+    def _run(self, queries: np.ndarray):
+        sharded, k, k_pad, _ = self._prologue(queries)
+        while True:
+            f, levels, reached, peak_f, peak_b = sharded_push_run(
+                self.mesh,
+                self.adj,
+                sharded,
+                k,
+                k_pad,
+                self.w,
+                self.block,
+                self.n_pad,
+                self.capacity,
+                self.boundary,
+                self.max_levels,
+                self.level_chunk,
+            )
+            if self._bounds_held(peak_f, peak_b):
+                return f, levels, reached, k
+
+    def level_stats(self, queries):
+        """Per-level trace (MSBFS_STATS=2): the shared stepped driver
+        (parallel.distributed.stepped_level_stats) over this engine's
+        init/chunk programs at chunk=1; an overflowed trace is discarded
+        and re-traced at the grown bounds, like :meth:`_run`."""
+        from .distributed import stepped_level_stats
+
+        sharded, k, k_pad, _ = self._prologue(queries)
+        j = sharded.shape[1]
+        while True:
+            peaks = {}
+
+            def init():
+                return _sharded_push_init(
+                    self.mesh, sharded, self.block, self.n_pad
+                )
+
+            def step(carry):
+                *out, _, _ = _sharded_push_chunk(
+                    self.mesh,
+                    self.adj,
+                    tuple(carry),
+                    jnp.int32(1),
+                    self.block,
+                    self.n_pad,
+                    self.capacity,
+                    self.boundary,
+                    self.max_levels,
+                )
+                peaks["fb"] = (out[7], out[8])
+                return tuple(out)
+
+            def finish(carry):
+                return _distributed_bitbell_finish(
+                    self.mesh, carry[2], carry[3], carry[4], j, k, k_pad,
+                    self.w,
+                )
+
+            key = (np.asarray(queries).shape, self.capacity, self.boundary)
+            out = stepped_level_stats(
+                init, step, finish, k, self.max_levels,
+                key in self._level_warm_shapes,
+            )
+            self._level_warm_shapes.add(key)
+            peak_f, peak_b = (
+                (int(np.asarray(x)) for x in peaks["fb"])
+                if peaks
+                else (0, 0)
+            )
+            if self._bounds_held(peak_f, peak_b):
+                return out
+
+    def f_values(self, queries) -> jax.Array:
+        f, _, _, k = self._run(queries)
+        return f[:k]
+
+    def query_stats(self, queries):
+        f, levels, reached, k = self._run(queries)
+        return (
+            np.asarray(levels)[:k].astype(np.int32),
+            np.asarray(reached)[:k].astype(np.int32),
+            np.asarray(f)[:k],
+        )

@@ -51,7 +51,7 @@ from ..ops.bitbell import (
     unpack_counts,
 )
 from ..ops.engine import QueryEngineBase
-from ..ops.push import compact_indices
+from ..ops.push import compact_frontier_planes
 from .distributed import _distributed_bitbell_finish, _pad_qblock
 from .mesh import QUERY_AXIS, VERTEX_AXIS
 from .scheduler import merge_local_f, shard_queries
@@ -411,13 +411,8 @@ def _sharded_expand_own(
 
     def sparse_level(frontier_own):
         w = frontier_own.shape[1]
-        active = (frontier_own != jnp.uint32(0)).any(axis=1)  # (L,)
-        ids = compact_indices(active, halo_budget, fill_value=block)
-        valid = ids < block
-        words = jnp.where(
-            valid[:, None],
-            jnp.take(frontier_own, jnp.minimum(ids, block - 1), axis=0),
-            jnp.uint32(0),
+        _, ids, valid, words = compact_frontier_planes(
+            frontier_own, halo_budget, block
         )
         gids = jnp.where(valid, me * block + ids, n_pad)  # sentinel drops
         all_ids = lax.all_gather(gids, VERTEX_AXIS)  # (p, B)

@@ -84,6 +84,17 @@ def main() -> int:
     )
     s_min_f, s_min_k = sharded.best(queries)
 
+    # Owner-partitioned push over the same process-spanning 'v' ring
+    # (round 4): the per-level boundary-pair all_gather crosses the
+    # process boundary; the tiny level_chunk exercises the host-chunked
+    # dispatch loop across processes too.
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.push_sharded import (
+        ShardedPushEngine,
+    )
+
+    pushed = ShardedPushEngine(mesh_v, g, level_chunk=3)
+    p_min_f, p_min_k = pushed.best(queries)
+
     print(
         json.dumps(
             {
@@ -95,6 +106,8 @@ def main() -> int:
                 "min_k": int(min_k),
                 "sharded_min_f": int(s_min_f),
                 "sharded_min_k": int(s_min_k),
+                "push_min_f": int(p_min_f),
+                "push_min_k": int(p_min_k),
             }
         ),
         flush=True,

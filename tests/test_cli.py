@@ -287,6 +287,64 @@ def test_hub_tail_cli_bound_engaged(tmp_path, capsys, monkeypatch):
     assert seen.pop("dist") == 32
 
 
+def test_vertex_sharded_push_routing(road_files, files, capsys, monkeypatch):
+    """Round 4: on a ('q','v') mesh, MSBFS_BACKEND=push and road-class
+    auto both route to the owner-partitioned push engine; power-law
+    graphs (width cap) fall back to the sharded bitbell on auto and
+    error on explicit push."""
+    import parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.push_sharded as ps_mod
+
+    built = []
+    real = ps_mod.ShardedPushEngine
+
+    class Spy(real):
+        def __init__(self, mesh, graph, **kw):
+            super().__init__(mesh, graph, **kw)  # may raise (width cap)
+            built.append(kw.get("level_chunk"))
+
+    monkeypatch.setattr(ps_mod, "ShardedPushEngine", Spy)
+    monkeypatch.setenv("MSBFS_VSHARD", "2")
+    gpath, qpath, want = road_files
+    # Auto: road-class profile routes to the sharded push engine.
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "8"], capsys
+    )
+    assert rc == 0 and len(built) == 1
+    _assert_report(out, want, 8)
+    # Explicit: same route.
+    monkeypatch.setenv("MSBFS_BACKEND", "push")
+    rc, out, _ = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "8"], capsys
+    )
+    assert rc == 0 and len(built) == 2
+    _assert_report(out, want, 8)
+    # A >width-cap hub graph: explicit push errors; auto (not road-class,
+    # the hub busts the degree heuristic too) runs the sharded bitbell.
+    gpath2, qpath2, _ = files
+    n, edges = generators.hub_tail_edges(tail=50, hub_fan=80)
+    hub_queries = [[0], [n - 1]]
+    gpath3, qpath3 = gpath2 + ".hub", qpath2 + ".hub"
+    save_graph_bin(gpath3, n, edges)
+    save_query_bin(qpath3, hub_queries)
+    want3 = oracle_best(
+        [
+            oracle_f(oracle_bfs(n, edges, np.asarray(q)))
+            for q in hub_queries
+        ]
+    )
+    monkeypatch.setenv("MSBFS_BACKEND", "push")
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath3, "-q", qpath3, "-gn", "8"], capsys
+    )
+    assert rc == 1 and "width cap" in err
+    monkeypatch.setenv("MSBFS_BACKEND", "auto")
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath3, "-q", qpath3, "-gn", "8"], capsys
+    )
+    assert rc == 0 and len(built) == 2  # bitbell served it
+    _assert_report(out, want3, 8)
+
+
 def test_multichip_honors_backend_env(files, capsys, monkeypatch):
     """MSBFS_BACKEND is honored at -gn > 1 (round 3; it used to be
     single-chip only): csr routes to the per-query pull, single-chip-only

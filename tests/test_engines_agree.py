@@ -178,6 +178,24 @@ def _distributed_push(g):
     )
 
 
+def _sharded_push(g):
+    """Owner-partitioned push (round 4): adjacency over 'v', boundary-pair
+    exchange; width cap lifted so the power-law workload fits."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.push_sharded import (
+        ShardedPushEngine,
+    )
+
+    return ShardedPushEngine(
+        make_mesh(num_query_shards=2, num_vertex_shards=4),
+        g,
+        max_width=512,
+        level_chunk=3,
+    )
+
+
 ENGINES = {
     "vmap": _vmap,
     "packed": _packed,
@@ -193,6 +211,7 @@ ENGINES = {
     "sharded_csr": _sharded_csr,
     "sharded_bell": _sharded_bell,
     "sharded_bell_sparse": _sharded_bell_sparse,
+    "sharded_push": _sharded_push,
 }
 
 

@@ -80,6 +80,9 @@ def test_two_process_cluster_matches_single_process():
         # Vertex-sharded run whose halo collectives crossed the process
         # boundary (mp_worker interleaves the 'v' axis over processes).
         assert (r["sharded_min_f"], r["sharded_min_k"]) == (want_f, want_k), r
+        # Owner-partitioned push whose boundary-pair exchange crossed the
+        # process boundary (round 4).
+        assert (r["push_min_f"], r["push_min_k"]) == (want_f, want_k), r
     assert outs[0]["process_id"] != outs[1]["process_id"]
 
 

@@ -44,5 +44,12 @@ echo "== 6. chunked bitbell on a road graph (always-chunk cost check)"
 timeout 1800 python benchmarks/exp_chunk_cost.py \
     2>&1 | tee "$RAW/chunk_cost.txt" || true
 
+echo "== 7. config 6: vertex-sharded road — owner-partitioned push vs bitbell"
+# Decides whether the round-4 auto-routing (road-class + vshard -> sharded
+# push) holds on real ICI; on the CPU mesh the pull side wins because the
+# 'collectives' are free there (docs/PERF_NOTES.md).
+timeout 1800 python benchmarks/run_baseline.py --config 6 \
+    2>&1 | tee "$RAW/config6_sharded.txt" || true
+
 echo "runbook end $(stamp)" | tee -a "$RAW/runbook_meta.txt"
 echo "== done; raw artifacts in $RAW — fold into BASELINE.md + PERF_NOTES"

@@ -179,6 +179,8 @@ class Engine(QueryEngineBase):
     def f_values(self, queries: jax.Array) -> jax.Array:
         """(K, S) int32 -1-padded queries -> (K,) int64 F values."""
         grid, K = self._chunk_grid(queries)
+        if grid.shape[0] == 0:  # K = 0: nothing to run on either path
+            return jnp.zeros((0,), dtype=jnp.int64)
         if self.level_chunk:
             out = jnp.concatenate(
                 [_f_from_dist_batch(self._dist_batch(row)) for row in grid]
@@ -196,6 +198,9 @@ class Engine(QueryEngineBase):
         as f_values (the chunked path runs one query chunk's carry at a
         time)."""
         grid, K = self._chunk_grid(queries)
+        if grid.shape[0] == 0:  # K = 0
+            z = np.zeros(0, dtype=np.int64)
+            return z.astype(np.int32), z.astype(np.int32), z
         if self.level_chunk:
             rows = [_stats_from_dist_batch(self._dist_batch(r)) for r in grid]
             levels, reached, f = (

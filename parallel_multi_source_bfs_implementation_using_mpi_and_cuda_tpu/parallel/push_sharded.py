@@ -96,22 +96,18 @@ def build_sharded_adjacency(
             "owner-partitioned push engine targets low-degree "
             "(road-class) graphs; use the sharded bitbell engine instead"
         )
-    table = np.full((n_pad + 1, w), n_pad, dtype=np.int32)
+    # Fill the (p, L+1, w) stacked layout DIRECTLY (one sentinel-filled
+    # allocation, rows scattered via (owner block, local row)): no
+    # intermediate (n_pad, w) table or per-block copies — peak host
+    # memory is one padded table, which matters because this engine
+    # exists for graphs too big for a chip.  It stays a HOST array: the
+    # constructor device_puts it with the 'v' NamedSharding directly, so
+    # the full table is never resident on one chip either.
+    stacked = np.full((p, L + 1, w), n_pad, dtype=np.int32)
     offs = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(deg, out=offs[1:])
     col = np.arange(u.size, dtype=np.int64) - offs[u]
-    table[u, col] = v.astype(np.int32)
-    # (p, L+1, w): block b's rows plus its own sentinel landing-pad row.
-    # Stays a HOST array: the constructor device_puts it with the 'v'
-    # NamedSharding directly, so the full table is never resident on one
-    # chip — the whole point for graphs beyond a single chip's HBM.
-    sentinel = table[n_pad : n_pad + 1]
-    stacked = np.stack(
-        [
-            np.concatenate([table[b * L : (b + 1) * L], sentinel])
-            for b in range(p)
-        ]
-    )
+    stacked[u // L, u % L, col] = v.astype(np.int32)
     return stacked, L, n_pad, w
 
 

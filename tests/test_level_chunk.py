@@ -223,6 +223,23 @@ def test_policy_malformed_env_falls_back_to_auto(monkeypatch, capsys):
     assert "negative" in capsys.readouterr().err
 
 
+def test_chunked_engine_empty_query_set(deep):
+    """K = 0 must return empty results on the chunked path too (it
+    crashed on an empty concatenate; found in round-4 review)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+        Engine,
+    )
+
+    g, _, _ = deep
+    eng = Engine(g.to_device(), level_chunk=32)
+    empty = np.zeros((0, 1), dtype=np.int32)
+    assert np.asarray(eng.f_values(empty)).shape == (0,)
+    levels, reached, f = eng.query_stats(empty)
+    assert levels.shape == reached.shape == f.shape == (0,)
+    eng.compile((0, 1))  # the CLI warm path
+    assert eng.best(empty) == (-1, -1)
+
+
 def test_nonpositive_level_chunk_rejected_at_build():
     """A chunk <= 0 would make every dispatch a no-op and the host driver
     spin forever; engines must fail loud at construction instead."""

@@ -1,7 +1,7 @@
 # Build the native (C++) runtime components.
 PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
-CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra
+CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
 .PHONY: native clean test
 

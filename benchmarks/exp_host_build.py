@@ -1,0 +1,93 @@
+#!/usr/bin/env python3
+"""Host preprocessing wall time vs native thread count (round 4).
+
+VERDICT r3 item 6: RMAT-25 end-to-end host build (generate + CSR + BELL
+forest) was 9.1 min single-core, extrapolating to ~45+ min at RMAT-27 —
+all before the device sees a byte.  The counting/placement/dedup/bucket
+passes in runtime/loader.cpp are now threaded; this script measures the
+whole pipeline at a given scale for a sweep of MSBFS_NATIVE_THREADS.
+
+Run (CPU env, the host work is jax-free until the final device_put which
+this script skips):
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+        python benchmarks/exp_host_build.py [scale] [threads,threads,...]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_once(scale: int) -> dict:
+    import numpy as np
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime import (
+        native_loader,
+    )
+
+    m = 16 << scale
+    t0 = time.perf_counter()
+    edges = native_loader.rmat_edges(scale, m, 0.57, 0.19, 0.19, seed=42)
+    t_gen = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    n = 1 << scale
+    row_offsets, col_indices = native_loader.csr_from_edges(n, edges)
+    t_csr = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    dst, deg = native_loader.dedup_rows(row_offsets, col_indices)
+    t_dedup = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    start = np.zeros(n, dtype=np.int64)
+    np.cumsum(deg[:-1], out=start[1:])
+    widths = [4, 8, 16, 32, 64, 128, 256, 512]
+    native_loader.bell_level(start, deg, dst, widths, sentinel_value=-1)
+    t_bell = time.perf_counter() - t0
+
+    del edges, row_offsets, col_indices, dst, deg, start
+    return {
+        "gen_s": t_gen,
+        "csr_s": t_csr,
+        "dedup_s": t_dedup,
+        "bell_s": t_bell,
+        "total_s": t_gen + t_csr + t_dedup + t_bell,
+    }
+
+
+def main():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime import (
+        native_loader,
+    )
+
+    if not native_loader.available():
+        sys.exit("native loader not built (make native)")
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 24
+    threads = (
+        [int(x) for x in sys.argv[2].split(",")]
+        if len(sys.argv) > 2
+        else [1, 8]
+    )
+    base = None
+    for t in threads:
+        os.environ["MSBFS_NATIVE_THREADS"] = str(t)
+        r = build_once(scale)
+        if base is None:
+            base = r["total_s"]
+        print(
+            f"RMAT-{scale} threads={t:2d}: gen {r['gen_s']:6.1f}s  "
+            f"csr {r['csr_s']:6.1f}s  dedup {r['dedup_s']:6.1f}s  "
+            f"bell {r['bell_s']:6.1f}s  total {r['total_s']:6.1f}s  "
+            f"speedup x{base / r['total_s']:.2f}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -116,6 +116,8 @@ def measure():
     )
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (
         ShardedBellEngine,
+        default_halo_budget,
+        default_push_halo_budget,
     )
 
     for n in (1 << 18, 1 << 20):
@@ -128,9 +130,18 @@ def measure():
         queries = pad_queries(
             [np.asarray([s], dtype=np.int32) for s in srcs]
         )
+        # Budgets EXPLICIT: the auto policy resolves to 0 (all-dense) on
+        # non-TPU backends, which would silently turn the sparse row into
+        # a second dense row on this CPU mesh.
+        sparse_kw = {
+            "halo_budget": default_halo_budget(n, 8),
+            "push_budget": default_push_halo_budget(
+                g.num_directed_edges, 8
+            ),
+        }
         for mode, kw in (
             ("dense", {"halo_budget": 0}),
-            ("sparse+push", {}),
+            ("sparse+push", sparse_kw),
         ):
             eng = ShardedBellEngine(mesh, g, max_levels=60, **kw)
             _, _, _, _, secs = eng.level_stats(queries)
@@ -141,6 +152,31 @@ def measure():
                 ),
                 flush=True,
             )
+            if mode == "sparse+push":
+                # Round-4: the byte claims as ENGINE COUNTERS, not model
+                # sentences — level_stats records route + wire bytes per
+                # level (ShardedBellEngine.last_halo_trace).
+                tr = eng.last_halo_trace
+                sparse_l = sum(
+                    1 for r in tr if set(r["routes"]) == {"sparse"}
+                )
+                print(
+                    json.dumps(
+                        {
+                            "halo_counters": {
+                                "road_n": n,
+                                "levels": len(tr),
+                                "sparse_levels": sparse_l,
+                                "dense_levels": len(tr) - sparse_l,
+                                "total_bytes": int(
+                                    sum(r["bytes"] for r in tr)
+                                ),
+                                "all_dense_bytes": len(tr) * n * 4,
+                            }
+                        }
+                    ),
+                    flush=True,
+                )
 
 
 def main():
@@ -181,7 +217,7 @@ def main():
         f"BW_eff={bw/1e9:.2f} GB/s per shard"
     )
     for r in rows:
-        if "road_n" in r:
+        if "n_pad" not in r:
             continue
         pred = r["n_pad"] * r["w"] * 4 * inv_bw
         tag = "" if r["p"] == 4 else "  [p-scaling: observed only]"
@@ -189,6 +225,18 @@ def main():
             f"p={r['p']} w={r['w']} n_pad={r['n_pad']}: measured "
             f"{r['halo_s']*1e3:7.3f} ms/level, byte-linear model "
             f"{pred*1e3:7.3f} ({(pred/r['halo_s']-1)*100:+.0f}%){tag}"
+        )
+    for r in rows:
+        if "halo_counters" not in r:
+            continue
+        c = r["halo_counters"]
+        print(
+            f"# engine halo counters (road n={c['road_n']}): "
+            f"{c['levels']} levels, {c['sparse_levels']} sparse / "
+            f"{c['dense_levels']} dense; wire bytes "
+            f"{c['total_bytes']/1e6:.2f} MB vs all-dense "
+            f"{c['all_dense_bytes']/1e6:.2f} MB "
+            f"(x{c['all_dense_bytes']/max(c['total_bytes'],1):.1f} saved)"
         )
     road = [r for r in rows if "road_n" in r]
     if road:

@@ -19,12 +19,13 @@ python -c "import jax; print('jax', jax.__version__)" 2>/dev/null \
 pip show libtpu libtpu-nightly 2>/dev/null | grep -E '^(Name|Version)' \
     | tee -a "$RAW/runbook_meta.txt"
 
-echo "== 1. headline bench (K=64 + K=256 extra; the driver artifact twin)"
+echo "== 1. headline bench (the driver artifact twin; default = the"
+echo "      2,2c,4,1 config sweep, each with its own value/error)"
 python bench.py 2> "$RAW/bench_headline.stderr" \
     | tee "$RAW/bench_headline.json"
 
-echo "== 2. RMAT-24 (the BASELINE.json target scale)"
-BENCH_SCALE=24 BENCH_REPEATS=2 BENCH_EXTRA_KS= python bench.py \
+echo "== 2. RMAT-24 (the BASELINE.json target scale; single-config mode)"
+BENCH_CONFIGS= BENCH_SCALE=24 BENCH_REPEATS=2 BENCH_EXTRA_KS= python bench.py \
     2> "$RAW/bench_rmat24.stderr" | tee "$RAW/bench_rmat24.json"
 
 echo "== 3. estimate_hbm_bytes ground truth via memory_stats"

@@ -497,19 +497,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         ckpt_path = os.environ.get("MSBFS_CHECKPOINT")
         ckpt_chunk = _env_int("MSBFS_CHECKPOINT_CHUNK", 64)
         if ckpt_path:
-            if stats_mode:
-                sys.stderr.write(
-                    "MSBFS_STATS: ignored when MSBFS_CHECKPOINT is set\n"
-                )
-            # The checkpoint path calls f_values on (chunk, S) slices, not
-            # best() on the full (K, S) batch — warm exactly those shapes so
-            # XLA compiles land in the preprocessing span.
+            # The checkpoint path calls f_values/query_stats on (chunk, S)
+            # slices, not best() on the full (K, S) batch — warm exactly
+            # those shapes so XLA compiles land in the preprocessing span.
+            # MSBFS_STATS rides the journal (round 4): per-chunk
+            # levels/reached are recorded alongside F, so the longest runs
+            # are no longer the blindest ones.
             k, s = padded.shape
             for shape_k in {min(max(1, ckpt_chunk), max(k, 1)), *(
                 [k % ckpt_chunk] if k % ckpt_chunk else []
             )}:
                 dummy = np.full((shape_k, s), -1, dtype=np.int32)
-                engine.f_values(dummy)
+                if not (stats_mode and engine.query_stats(dummy) is not None):
+                    engine.f_values(dummy)
         else:
             engine.compile(
                 padded.shape,
@@ -532,14 +532,47 @@ def main(argv: Optional[List[str]] = None) -> int:
             if ckpt_path:
                 from .utils.checkpoint import CheckpointedRunner
 
-                runner = CheckpointedRunner(engine, ckpt_path, chunk=ckpt_chunk)
+                runner = CheckpointedRunner(
+                    engine, ckpt_path, chunk=ckpt_chunk, stats=stats_mode
+                )
                 try:
-                    min_f, min_k = runner.best(
+                    f_arr, _ = runner.run(
                         graph.n, graph.num_directed_edges, np.asarray(padded)
                     )
                 except ValueError as exc:  # stale/foreign journal: fail loud
                     print(f"Checkpoint error: {exc}", file=sys.stderr)
                     return 1
+                if (
+                    stats_mode
+                    and padded.shape[0]
+                    and runner.last_stats is not None
+                    and (runner.last_stats[0] >= 0).any()
+                ):
+                    # -1 rows are F-only entries resumed from a stats-less
+                    # journal; the selection below derives from stats[2].
+                    stats = (*runner.last_stats, f_arr)
+                else:
+                    if (
+                        stats_mode
+                        and padded.shape[0]
+                        and runner.last_stats is not None
+                    ):
+                        # Engine supports stats but every row came from a
+                        # stats-less (pre-round-4) journal: say THAT, not
+                        # "engine doesn't support stats".
+                        sys.stderr.write(
+                            "MSBFS_STATS: the resumed journal predates "
+                            "stats journaling (F-only rows); delete it to "
+                            "recompute with stats\n"
+                        )
+                        stats_mode = False  # suppress the generic note
+                    from .ops.objective import select_best_jit
+                    import jax.numpy as jnp
+
+                    arr = jnp.asarray(f_arr)
+                    min_f, min_k = (
+                        int(x) for x in select_best_jit(arr, arr >= 0)
+                    )
             elif stats_mode and padded.shape[0]:
                 # One BFS pass serves both the report and the stats table:
                 # stats include the F values, so selection derives from them.
@@ -566,13 +599,23 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         if level_rows is not None:
             sys.stderr.write(format_level_stats(*level_rows))
+            halo = getattr(engine, "last_halo_trace", None)
+            if halo:
+                from .utils.trace import format_halo_stats
+
+                sys.stderr.write(format_halo_stats(halo))
         elif stats_env == "2":
             sys.stderr.write(
-                "MSBFS_STATS=2: per-level trace not available on this "
-                "engine; per-query stats only\n"
+                "MSBFS_STATS=2: per-level trace not available "
+                + (
+                    "under checkpointing"
+                    if ckpt_path
+                    else "on this engine"
+                )
+                + "; per-query stats only\n"
             )
         sys.stderr.write(format_query_stats(*stats))
-    elif stats_mode and not ckpt_path:
+    elif stats_mode:
         if padded.shape[0] == 0:
             sys.stderr.write("MSBFS_STATS: no queries\n")
         else:

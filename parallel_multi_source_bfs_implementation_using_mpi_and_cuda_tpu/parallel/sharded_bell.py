@@ -469,6 +469,45 @@ def _sharded_expand_own(
     return expand
 
 
+def halo_level_bytes(
+    n_pad: int, w_words: int, p: int, halo_budget: int, own_rows: int
+):
+    """Wire bytes one q-shard's halo exchange moves for a level whose
+    max-over-'v' own-frontier row count is ``own_rows`` — the OBSERVABLE
+    form of the ICI cost model (docs/PERF_NOTES.md "ICI cost model"),
+    applying exactly the routing predicate `_sharded_expand_own` uses.
+
+    Returns (route, bytes): dense = every shard contributes its (L, W)
+    word block to the all_gather — n_pad * W * 4 bytes of payload per
+    level; sparse = p shards each contribute (budget,) int32 ids +
+    (budget, W) uint32 words — p * budget * 4 * (1 + W) bytes.
+    """
+    if halo_budget and own_rows <= halo_budget:
+        return "sparse", p * halo_budget * 4 * (1 + w_words)
+    return "dense", n_pad * w_words * 4
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _sharded_halo_rows(mesh: Mesh, frontier_own):
+    """Per-q-shard max-over-'v' own-frontier row count for the frontier a
+    stepped trace is ABOUT to expand — the exact quantity the per-level
+    routing predicate compares against halo_budget, exposed so the trace
+    can report which branch ran and its wire bytes (MSBFS_STATS=2)."""
+
+    def shard_body(planes):
+        rows = jnp.sum(
+            (planes != jnp.uint32(0)).any(axis=1), dtype=jnp.int32
+        )
+        return lax.pmax(rows, VERTEX_AXIS)[None]
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(VERTEX_AXIS, QUERY_AXIS),),
+        out_specs=P(QUERY_AXIS),
+    )(frontier_own)
+
+
 def default_halo_budget(n_pad: int, p: int) -> int:
     """Auto compacted-halo budget: own-frontier rows per shard.  Sized so a
     sparse exchange moves well under the full plane bytes — p * B * (1+W)
@@ -755,13 +794,30 @@ class ShardedBellEngine(QueryEngineBase):
     def level_stats(self, queries):
         """Per-level trace (MSBFS_STATS=2) on the vertex-sharded engine:
         the shared stepped driver (parallel.distributed.stepped_level_stats)
-        over this engine's own-block init/chunk programs."""
+        over this engine's own-block init/chunk programs.
+
+        Side product: ``self.last_halo_trace`` — one dict per EXECUTED
+        level with the max-over-'v' own-frontier rows per q-shard, the
+        halo route each q-shard took (``sparse``/``dense``), and the
+        total wire bytes the exchange moved (:func:`halo_level_bytes`).
+        This turns the ICI cost model's byte claims into counters a test
+        can assert exactly (VERDICT r3 item 5)."""
         from .distributed import stepped_level_stats
 
         queries = np.asarray(queries)
         queries = np.where((queries >= 0) & (queries < self.n), queries, -1)
         sharded, k, k_pad, _ = shard_queries(self.mesh, queries, None)
         j = sharded.shape[1]
+        p = self.mesh.shape[VERTEX_AXIS]
+        w_words = -(-j // 32)  # per-q-shard plane words (j padded to 32s)
+        # The probe must not distort the trace's per-level wall times:
+        # step() only keeps a REFERENCE to the (immutable) frontier
+        # planes; the row-count dispatches and host reads run after the
+        # stepped driver finishes.  Memory: one (n_pad, W) plane array
+        # per executed level stays alive until then — bounded by
+        # max_levels in the model-fitting runs, and a diagnostic mode
+        # everywhere.
+        frontier_trace: List[jax.Array] = []
 
         def init():
             return _sharded_bitbell_init(
@@ -769,6 +825,7 @@ class ShardedBellEngine(QueryEngineBase):
             )
 
         def step(carry):
+            frontier_trace.append(carry[1])
             *out, _, _ = _sharded_bitbell_chunk(
                 self.mesh,
                 self.forest,
@@ -792,4 +849,30 @@ class ShardedBellEngine(QueryEngineBase):
             init, step, finish, k, self.max_levels, warmed
         )
         self._level_warm_shapes.add(queries.shape)
+        if not warmed and frontier_trace:
+            frontier_trace.pop(0)  # the untimed compile pass's step
+        rows_trace = [
+            np.asarray(_sharded_halo_rows(self.mesh, f))
+            for f in frontier_trace
+        ]
+        self.last_halo_trace = [
+            {
+                "own_rows": int(rows.max()) if rows.size else 0,
+                "routes": [
+                    halo_level_bytes(
+                        self.n_pad, w_words, p, self.halo_budget, int(r)
+                    )[0]
+                    for r in rows
+                ],
+                "bytes": int(
+                    sum(
+                        halo_level_bytes(
+                            self.n_pad, w_words, p, self.halo_budget, int(r)
+                        )[1]
+                        for r in rows
+                    )
+                ),
+            }
+            for rows in rows_trace
+        ]
         return out

@@ -14,9 +14,12 @@
 // C ABI, bound from Python via ctypes (runtime/native_loader.py).
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 #include <fcntl.h>
@@ -25,6 +28,81 @@
 #include <unistd.h>
 
 namespace {
+
+// ---- Threading helpers (round 4: the counting/placement/dedup/bucket
+// passes are all shardable by edge or owner range; RMAT-26/27-class host
+// preprocessing was single-core-bound at ~45+ min extrapolated).
+// MSBFS_NATIVE_THREADS overrides; default = hardware concurrency, scaled
+// down so tiny inputs never pay thread spawn overhead.
+
+int num_threads_for(int64_t work, int64_t min_per_thread = int64_t{1} << 20) {
+  const char* env = std::getenv("MSBFS_NATIVE_THREADS");
+  if (env && *env) {
+    // Explicit request = exact count (tests pin thread-invariance with
+    // it; benchmarks sweep it), clamped to a sane cap.
+    const int t = std::atoi(env);
+    if (t > 0) return std::min(t, 64);
+  }
+  int t = static_cast<int>(std::thread::hardware_concurrency());
+  if (t <= 0) t = 1;
+  if (t > 64) t = 64;
+  const int64_t by_work =
+      min_per_thread > 0 ? std::max<int64_t>(work / min_per_thread, 1) : 1;
+  return static_cast<int>(std::min<int64_t>(t, by_work));
+}
+
+// fn(t, lo, hi) over a contiguous [0, total) split into T ranges.
+template <typename F>
+void parallel_ranges(int T, int64_t total, F&& fn) {
+  if (T <= 1 || total <= 0) {
+    fn(0, 0, total);
+    return;
+  }
+  const int64_t chunk = (total + T - 1) / T;
+  std::vector<std::thread> threads;
+  threads.reserve(T);
+  for (int t = 0; t < T; ++t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = std::min(total, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back([&fn, t, lo, hi] { fn(t, lo, hi); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+// fn(t) for every t in [0, T) — for passes whose per-thread ranges come
+// from a precomputed partition (e.g. split_rows_by_slots), where skipping
+// a t would drop its rows.
+template <typename F>
+void parallel_tasks(int T, F&& fn) {
+  if (T <= 1) {
+    fn(0);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(T);
+  for (int t = 0; t < T; ++t) {
+    threads.emplace_back([&fn, t] { fn(t); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+// Row boundaries splitting [0, n) so every part covers ~equal SLOTS (the
+// work unit for per-row passes over a power-law CSR; a plain row split
+// would hand one thread all the hubs).
+std::vector<int64_t> split_rows_by_slots(int T, int64_t n,
+                                         const int64_t* row_offsets) {
+  std::vector<int64_t> bounds(T + 1, n);
+  bounds[0] = 0;
+  const int64_t total = n > 0 ? row_offsets[n] : 0;
+  for (int t = 1; t < T; ++t) {
+    const int64_t target = total * t / T;
+    bounds[t] = std::lower_bound(row_offsets, row_offsets + n + 1, target) -
+                row_offsets;
+    if (bounds[t] < bounds[t - 1]) bounds[t] = bounds[t - 1];
+  }
+  return bounds;
+}
 
 struct MappedFile {
   const unsigned char* data = nullptr;
@@ -67,6 +145,96 @@ inline int64_t read_i64(const unsigned char* p) {
 
 constexpr size_t kHeaderBytes = sizeof(int32_t) + sizeof(int64_t);
 
+// Shared parallel CSR build: counting + placement with per-thread
+// histograms, preserving the reference's exact insertion order (record i
+// before record j for i < j within every row — per-thread cursor bases are
+// the prefix over lower-numbered threads, i.e. lower-numbered records).
+// ``read_edge(i, &u, &v)`` abstracts the two edge sources (mmapped file
+// records, in-memory int32 pairs).  Returns 0, or 4 on an out-of-range
+// endpoint.  Per-thread histogram memory is T * (n+1) * 8 B; the thread
+// count is capped so that stays within ~2 GiB.
+template <typename ReadEdge>
+int build_csr_parallel(int64_t n, int64_t m, ReadEdge read_edge,
+                       int64_t* row_offsets, int32_t* col_indices) {
+  int T = num_threads_for(2 * m);
+  if (n > 0) {
+    const int64_t by_mem =
+        std::max<int64_t>((int64_t{2} << 30) / ((n + 1) * 8), 1);
+    T = static_cast<int>(std::min<int64_t>(T, by_mem));
+  }
+  std::atomic<int> err{0};
+  if (T <= 1) {
+    for (int64_t i = 0; i <= n; i++) row_offsets[i] = 0;
+    for (int64_t i = 0; i < m; i++) {
+      int64_t u, v;
+      read_edge(i, &u, &v);
+      if (u < 0 || u >= n || v < 0 || v >= n) return 4;
+      row_offsets[u + 1]++;
+      row_offsets[v + 1]++;
+    }
+    for (int64_t i = 0; i < n; i++) row_offsets[i + 1] += row_offsets[i];
+    std::vector<int64_t> cursor(n > 0 ? n : 1);
+    std::memcpy(cursor.data(), row_offsets,
+                (n > 0 ? n : 1) * sizeof(int64_t));
+    for (int64_t i = 0; i < m; i++) {
+      int64_t u, v;
+      read_edge(i, &u, &v);
+      col_indices[cursor[u]++] = static_cast<int32_t>(v);
+      col_indices[cursor[v]++] = static_cast<int32_t>(u);
+    }
+    return 0;
+  }
+
+  // Pass 1: per-thread degree histograms over disjoint edge ranges.
+  std::vector<std::vector<int64_t>> counts(T);
+  parallel_ranges(T, m, [&](int t, int64_t lo, int64_t hi) {
+    counts[t].assign(n > 0 ? n : 1, 0);
+    for (int64_t i = lo; i < hi; i++) {
+      int64_t u, v;
+      read_edge(i, &u, &v);
+      if (u < 0 || u >= n || v < 0 || v >= n) {
+        err.store(4, std::memory_order_relaxed);
+        return;
+      }
+      counts[t][u]++;
+      counts[t][v]++;
+    }
+  });
+  if (err.load()) return 4;
+  // Histogram reduce + exclusive scan; counts[t][i] becomes thread t's
+  // write cursor for row i (global row start + lower threads' share).
+  row_offsets[0] = 0;
+  parallel_ranges(T, n, [&](int, int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++) {
+      int64_t total = 0;
+      for (int t = 0; t < T; ++t) total += counts[t][i];
+      row_offsets[i + 1] = total;  // per-row degree; scanned below
+    }
+  });
+  for (int64_t i = 0; i < n; i++) row_offsets[i + 1] += row_offsets[i];
+  parallel_ranges(T, n, [&](int, int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++) {
+      int64_t running = row_offsets[i];
+      for (int t = 0; t < T; ++t) {
+        const int64_t c = counts[t][i];
+        counts[t][i] = running;
+        running += c;
+      }
+    }
+  });
+  // Pass 2: placement — same edge ranges, private cursors, insertion
+  // order preserved by construction.
+  parallel_ranges(T, m, [&](int t, int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; i++) {
+      int64_t u, v;
+      read_edge(i, &u, &v);
+      col_indices[counts[t][u]++] = static_cast<int32_t>(v);
+      col_indices[counts[t][v]++] = static_cast<int32_t>(u);
+    }
+  });
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -90,30 +258,16 @@ int msbfs_load_graph_csr(const char* path, int64_t n, int64_t m,
   if (!f.open(path)) return 1;
   if (f.size < kHeaderBytes + static_cast<size_t>(m) * 8) return 3;
   const unsigned char* edges = f.data + kHeaderBytes;
-
-  // Pass 1: degrees (each record counts once for u and once for v).
-  for (int64_t i = 0; i <= n; i++) row_offsets[i] = 0;
-  for (int64_t i = 0; i < m; i++) {
-    const int64_t u = read_i32(edges + i * 8);
-    const int64_t v = read_i32(edges + i * 8 + 4);
-    if (u < 0 || u >= n || v < 0 || v >= n) return 4;
-    row_offsets[u + 1]++;
-    row_offsets[v + 1]++;
-  }
-  for (int64_t i = 0; i < n; i++) row_offsets[i + 1] += row_offsets[i];
-
-  // Pass 2: placement in record order => insertion-order adjacency,
-  // byte-identical to the reference's push_back sequence (main.cu:114-115).
-  int64_t* cursor = new int64_t[n];
-  std::memcpy(cursor, row_offsets, n * sizeof(int64_t));
-  for (int64_t i = 0; i < m; i++) {
-    const int32_t u = read_i32(edges + i * 8);
-    const int32_t v = read_i32(edges + i * 8 + 4);
-    col_indices[cursor[u]++] = v;
-    col_indices[cursor[v]++] = u;
-  }
-  delete[] cursor;
-  return 0;
+  // Counting + placement in record order => insertion-order adjacency,
+  // byte-identical to the reference's push_back sequence (main.cu:114-115);
+  // parallel over edge ranges (see build_csr_parallel).
+  return build_csr_parallel(
+      n, m,
+      [edges](int64_t i, int64_t* u, int64_t* v) {
+        *u = read_i32(edges + i * 8);
+        *v = read_i32(edges + i * 8 + 4);
+      },
+      row_offsets, col_indices);
 }
 
 // In-memory variant of msbfs_load_graph_csr for generator-produced edge
@@ -125,25 +279,13 @@ int msbfs_load_graph_csr(const char* path, int64_t n, int64_t m,
 int msbfs_csr_from_edges(int64_t n, int64_t m, const int32_t* edges,
                          int64_t* row_offsets, int32_t* col_indices) {
   if (n < 0 || m < 0) return 1;
-  for (int64_t i = 0; i <= n; i++) row_offsets[i] = 0;
-  for (int64_t i = 0; i < m; i++) {
-    const int64_t u = edges[2 * i];
-    const int64_t v = edges[2 * i + 1];
-    if (u < 0 || u >= n || v < 0 || v >= n) return 4;
-    row_offsets[u + 1]++;
-    row_offsets[v + 1]++;
-  }
-  for (int64_t i = 0; i < n; i++) row_offsets[i + 1] += row_offsets[i];
-  int64_t* cursor = new int64_t[n > 0 ? n : 1];
-  std::memcpy(cursor, row_offsets, (n > 0 ? n : 1) * sizeof(int64_t));
-  for (int64_t i = 0; i < m; i++) {
-    const int32_t u = edges[2 * i];
-    const int32_t v = edges[2 * i + 1];
-    col_indices[cursor[u]++] = v;
-    col_indices[cursor[v]++] = u;
-  }
-  delete[] cursor;
-  return 0;
+  return build_csr_parallel(
+      n, m,
+      [edges](int64_t i, int64_t* u, int64_t* v) {
+        *u = edges[2 * i];
+        *v = edges[2 * i + 1];
+      },
+      row_offsets, col_indices);
 }
 
 // Per-row neighbor dedup for the set-semantics engine layouts (BELL, padded
@@ -159,28 +301,58 @@ int64_t msbfs_dedup_rows(int64_t n, int64_t num_slots,
                          const int32_t* col_indices, int32_t* out_dst,
                          int64_t* out_deg) {
   if (n < 0 || num_slots < 0) return -1;
-  int64_t w = 0;
+  // Validate the row structure up front (monotone, non-overlapping, in
+  // bounds: otherwise the compaction below could overflow out_dst).
   int64_t prev_end = 0;
-  std::vector<int32_t> scratch;
   for (int64_t u = 0; u < n; ++u) {
     const int64_t s = row_offsets[u];
     const int64_t e = row_offsets[u + 1];
-    // Monotone non-overlapping rows, in bounds: otherwise w could exceed
-    // num_slots and overflow the caller's out_dst buffer.
     if (s < prev_end || e < s || e > num_slots) return -1;
     prev_end = e;
-    scratch.assign(col_indices + s, col_indices + e);
-    std::sort(scratch.begin(), scratch.end());
-    int64_t cnt = 0;
-    int32_t prev = 0;
-    for (int32_t v : scratch) {
-      if (v == static_cast<int32_t>(u)) continue;  // self-loop
-      if (cnt && v == prev) continue;              // duplicate
-      out_dst[w++] = v;
-      prev = v;
-      ++cnt;
+  }
+  const int T = num_threads_for(num_slots, int64_t{1} << 19);
+  const std::vector<int64_t> bounds = split_rows_by_slots(T, n, row_offsets);
+  // Phase A (parallel, slot-balanced row ranges): sort+dedup each row,
+  // writing the thread's rows CONTIGUOUSLY from its slot-region start in
+  // out_dst.  out_dst and col_indices are distinct buffers and thread
+  // regions are disjoint, so there is no aliasing anywhere.
+  std::vector<int64_t> block_len(T, 0);
+  parallel_tasks(T, [&](int t) {
+    std::vector<int32_t> scratch;
+    int64_t w = row_offsets[bounds[t]];
+    const int64_t w0 = w;
+    for (int64_t u = bounds[t]; u < bounds[t + 1]; ++u) {
+      const int64_t s = row_offsets[u];
+      const int64_t e = row_offsets[u + 1];
+      scratch.assign(col_indices + s, col_indices + e);
+      std::sort(scratch.begin(), scratch.end());
+      int64_t cnt = 0;
+      int32_t prev = 0;
+      for (int32_t v : scratch) {
+        if (v == static_cast<int32_t>(u)) continue;  // self-loop
+        if (cnt && v == prev) continue;              // duplicate
+        out_dst[w++] = v;
+        prev = v;
+        ++cnt;
+      }
+      out_deg[u] = cnt;
     }
-    out_deg[u] = cnt;
+    block_len[t] = w - w0;
+  });
+  // Phase B (serial cascade): slide each thread's contiguous block left
+  // onto the end of the previous one — T memmoves at memcpy bandwidth,
+  // in ascending order so a move never clobbers an unmoved block.
+  // Block 0 participates too: row_offsets[0] > 0 is valid at this ABI
+  // (only overlap/underflow is rejected above), and its block must land
+  // at offset 0 like the serial code's.
+  int64_t w = 0;
+  for (int t = 0; t < T; ++t) {
+    const int64_t src = row_offsets[bounds[t]];
+    if (src != w && block_len[t]) {
+      std::memmove(out_dst + w, out_dst + src,
+                   block_len[t] * sizeof(int32_t));
+    }
+    w += block_len[t];
   }
   return w;
 }
@@ -220,20 +392,35 @@ int64_t msbfs_bell_assign(int64_t v_total, const int64_t* item_count,
                           int64_t* bucket_rows, int64_t* flat_off) {
   if (v_total < 0 || num_widths <= 0) return -1;
   const int64_t w_max = widths[num_widths - 1];
-  for (int b = 0; b < num_widths; ++b) bucket_rows[b] = 0;
-  for (int64_t v = 0; v < v_total; ++v) {
-    const int64_t cnt = item_count[v];
-    if (cnt <= 0) {
-      rows_per_owner[v] = 0;
-      continue;
+  const int T = num_threads_for(v_total);
+  const int64_t chunk = T > 0 ? (v_total + T - 1) / T : 0;
+  // Thread-local bucket histograms over contiguous owner ranges; the
+  // per-(bucket, thread) prefix then gives each thread its cursor bases,
+  // so the second scan assigns exactly the serial first_row values.
+  std::vector<std::vector<int64_t>> local(
+      T, std::vector<int64_t>(num_widths, 0));
+  parallel_tasks(T, [&](int t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = std::min(v_total, lo + chunk);
+    for (int64_t v = lo; v < hi; ++v) {
+      const int64_t cnt = item_count[v];
+      if (cnt <= 0) {
+        rows_per_owner[v] = 0;
+        continue;
+      }
+      const int b = bucket_of(cnt, num_widths, widths);
+      const int64_t rows =
+          b == num_widths - 1 ? (cnt + w_max - 1) / w_max : 1;
+      rows_per_owner[v] = rows;
+      local[t][b] += rows;
     }
-    const int b = bucket_of(cnt, num_widths, widths);
-    const int64_t rows = b == num_widths - 1 ? (cnt + w_max - 1) / w_max : 1;
-    rows_per_owner[v] = rows;
-    bucket_rows[b] += rows;
+  });
+  for (int b = 0; b < num_widths; ++b) {
+    bucket_rows[b] = 0;
+    for (int t = 0; t < T; ++t) bucket_rows[b] += local[t][b];
   }
   // Exclusive scans: global row base and flat slot offset per bucket.
-  std::vector<int64_t> row_base(num_widths), cursor(num_widths);
+  std::vector<int64_t> row_base(num_widths);
   int64_t rows_acc = 0, slots_acc = 0;
   for (int b = 0; b < num_widths; ++b) {
     row_base[b] = rows_acc;
@@ -241,16 +428,29 @@ int64_t msbfs_bell_assign(int64_t v_total, const int64_t* item_count,
     rows_acc += bucket_rows[b];
     slots_acc += bucket_rows[b] * widths[b];
   }
-  for (int b = 0; b < num_widths; ++b) cursor[b] = 0;
-  for (int64_t v = 0; v < v_total; ++v) {
-    if (item_count[v] <= 0) {
-      first_row[v] = 0;
-      continue;
+  // local[t][b] -> thread t's starting cursor for bucket b.
+  for (int b = 0; b < num_widths; ++b) {
+    int64_t running = 0;
+    for (int t = 0; t < T; ++t) {
+      const int64_t c = local[t][b];
+      local[t][b] = running;
+      running += c;
     }
-    const int b = bucket_of(item_count[v], num_widths, widths);
-    first_row[v] = row_base[b] + cursor[b];
-    cursor[b] += rows_per_owner[v];
   }
+  parallel_tasks(T, [&](int t) {
+    const int64_t lo = t * chunk;
+    const int64_t hi = std::min(v_total, lo + chunk);
+    std::vector<int64_t> cursor = local[t];
+    for (int64_t v = lo; v < hi; ++v) {
+      if (item_count[v] <= 0) {
+        first_row[v] = 0;
+        continue;
+      }
+      const int b = bucket_of(item_count[v], num_widths, widths);
+      first_row[v] = row_base[b] + cursor[b];
+      cursor[b] += rows_per_owner[v];
+    }
+  });
   return slots_acc;
 }
 
@@ -271,24 +471,33 @@ int msbfs_bell_fill(int64_t v_total, const int64_t* item_start,
     row_base[b] = rows_acc;
     rows_acc += bucket_rows[b];
   }
-  for (int64_t v = 0; v < v_total; ++v) {
-    const int64_t cnt = item_count[v];
-    if (cnt <= 0) continue;
-    const int b = bucket_of(cnt, num_widths, widths);
-    const int64_t w = widths[b];
-    const int64_t start = item_start[v];
-    if (start < 0 || start + cnt > num_items) return 2;
-    int64_t slot = flat_off[b] + (first_row[v] - row_base[b]) * w;
-    const int64_t rows = b == num_widths - 1 ? (cnt + w - 1) / w : 1;
-    int64_t item = 0;
-    for (int64_t r = 0; r < rows; ++r) {
-      for (int64_t i = 0; i < w; ++i, ++slot) {
-        flat_out[slot] =
-            item < cnt ? item_vals[start + item++] : sentinel_value;
+  // Owners write disjoint slot ranges (first_row is a partition), so the
+  // fill parallelizes over contiguous owner ranges with no coordination.
+  std::atomic<int> err{0};
+  const int T = num_threads_for(num_items);
+  parallel_ranges(T, v_total, [&](int, int64_t lo, int64_t hi) {
+    for (int64_t v = lo; v < hi; ++v) {
+      const int64_t cnt = item_count[v];
+      if (cnt <= 0) continue;
+      const int b = bucket_of(cnt, num_widths, widths);
+      const int64_t w = widths[b];
+      const int64_t start = item_start[v];
+      if (start < 0 || start + cnt > num_items) {
+        err.store(2, std::memory_order_relaxed);
+        return;
+      }
+      int64_t slot = flat_off[b] + (first_row[v] - row_base[b]) * w;
+      const int64_t rows = b == num_widths - 1 ? (cnt + w - 1) / w : 1;
+      int64_t item = 0;
+      for (int64_t r = 0; r < rows; ++r) {
+        for (int64_t i = 0; i < w; ++i, ++slot) {
+          flat_out[slot] =
+              item < cnt ? item_vals[start + item++] : sentinel_value;
+        }
       }
     }
-  }
-  return 0;
+  });
+  return err.load();
 }
 
 // ---- R-MAT generator (native fast path of models/generators.rmat_edges:
@@ -319,29 +528,52 @@ int msbfs_rmat_edges(int32_t scale, int64_t m, double a, double b, double c,
   if (scale <= 0 || scale > 30 || m < 0) return 1;
   if (a < 0 || b < 0 || c < 0 || a + b + c > 1.0) return 2;
   const double t_ab = a + b, t_abc = a + b + c;
-  uint64_t s = seed * 0x9E3779B97F4A7C15ULL + 0xD1B54A32D192ED03ULL;
   const int64_t n = int64_t{1} << scale;
-  for (int64_t i = 0; i < m; ++i) {
-    int64_t u = 0, v = 0;
-    for (int32_t bit = 0; bit < scale; ++bit) {
-      const double r = u01(&s);
-      const int64_t u_bit = r >= t_ab ? 1 : 0;
-      const int64_t v_bit = (r >= a && r < t_ab) || r >= t_abc ? 1 : 0;
-      u = (u << 1) | u_bit;
-      v = (v << 1) | v_bit;
+  // Parallel sampling with PER-CHUNK splitmix streams: chunk ci draws
+  // from a stream derived from (seed, ci), so the generated graph is a
+  // deterministic function of the seed alone — independent of the thread
+  // count (round 4; the round-3 single-stream output for a given seed
+  // differs, which the API contract allows: seeds promise
+  // identically-distributed graphs, not a pinned byte stream).
+  const int64_t kChunk = int64_t{1} << 20;
+  const int64_t n_chunks = m > 0 ? (m + kChunk - 1) / kChunk : 0;
+  const int T = num_threads_for(m, int64_t{1} << 18);
+  parallel_ranges(T, n_chunks, [&](int, int64_t clo, int64_t chi) {
+    for (int64_t ci = clo; ci < chi; ++ci) {
+      uint64_t s = (seed + 0x9E3779B97F4A7C15ULL) *
+                       (static_cast<uint64_t>(ci) + 0xD1B54A32D192ED03ULL) +
+                   0x8BB84B93962EACC9ULL;
+      const int64_t lo = ci * kChunk;
+      const int64_t hi = std::min(m, lo + kChunk);
+      for (int64_t i = lo; i < hi; ++i) {
+        int64_t u = 0, v = 0;
+        for (int32_t bit = 0; bit < scale; ++bit) {
+          const double r = u01(&s);
+          const int64_t u_bit = r >= t_ab ? 1 : 0;
+          const int64_t v_bit = (r >= a && r < t_ab) || r >= t_abc ? 1 : 0;
+          u = (u << 1) | u_bit;
+          v = (v << 1) | v_bit;
+        }
+        out[2 * i] = static_cast<int32_t>(u);
+        out[2 * i + 1] = static_cast<int32_t>(v);
+      }
     }
-    out[2 * i] = static_cast<int32_t>(u);
-    out[2 * i + 1] = static_cast<int32_t>(v);
-  }
+  });
   // Fisher-Yates permutation of vertex ids (the Graph500 relabeling step
-  // that decorrelates degree from id), applied in place over the edges.
+  // that decorrelates degree from id) from its own seed-derived stream;
+  // the shuffle itself is inherently sequential (O(n), cheap), the
+  // relabeling application is parallel.
+  uint64_t sp = seed * 0x9E3779B97F4A7C15ULL + 0xD1B54A32D192ED03ULL;
   std::vector<int32_t> perm(n);
   for (int64_t i = 0; i < n; ++i) perm[i] = static_cast<int32_t>(i);
   for (int64_t i = n - 1; i > 0; --i) {
-    const int64_t j = static_cast<int64_t>(splitmix64(&s) % (i + 1));
+    const int64_t j = static_cast<int64_t>(splitmix64(&sp) % (i + 1));
     std::swap(perm[i], perm[j]);
   }
-  for (int64_t i = 0; i < 2 * m; ++i) out[i] = perm[out[i]];
+  const int32_t* perm_p = perm.data();
+  parallel_ranges(T, 2 * m, [&](int, int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) out[i] = perm_p[out[i]];
+  });
   return 0;
 }
 

@@ -46,10 +46,16 @@ class CheckpointedRunner:
     >>> min_f, min_k = runner.best(graph_n, num_edges, padded_queries)
     """
 
-    def __init__(self, engine, path: str, chunk: int = 64):
+    def __init__(self, engine, path: str, chunk: int = 64, stats: bool = False):
         self.engine = engine
         self.path = str(path)
         self.chunk = max(1, int(chunk))  # <= 0 would silently compute nothing
+        # ``stats``: journal per-query (levels, reached) alongside F via
+        # engine.query_stats, so MSBFS_STATS stays alive on checkpointed
+        # runs (round 4 — the longest runs used to be the blindest ones).
+        # Rows resumed from a stats-less journal keep -1 placeholders.
+        self.stats = bool(stats)
+        self.last_stats: Optional[Tuple[np.ndarray, np.ndarray]] = None
 
     # ---- journal ----------------------------------------------------------
     def _read(self, fingerprint: str) -> dict:
@@ -69,8 +75,15 @@ class CheckpointedRunner:
                     f"workload (have {header[1]}, want {fingerprint})"
                 )
             for line in f:
-                gid, fv = line.strip().split(",")
-                done[int(gid)] = int(fv)
+                parts = line.strip().split(",")
+                # 2-column rows are F only (stats-less journals, and every
+                # journal before round 4); 4-column rows add levels,reached.
+                if len(parts) >= 4:
+                    done[int(parts[0])] = (
+                        int(parts[1]), int(parts[2]), int(parts[3]),
+                    )
+                else:
+                    done[int(parts[0])] = (int(parts[1]), -1, -1)
         return done
 
     def _write(self, fingerprint: str, done: dict) -> None:
@@ -78,7 +91,11 @@ class CheckpointedRunner:
         with open(tmp, "w") as f:
             f.write(f"{_MAGIC},{fingerprint}\n")
             for gid in sorted(done):
-                f.write(f"{gid},{done[gid]}\n")
+                fv, lv, rc = done[gid]
+                if lv >= 0 or rc >= 0:
+                    f.write(f"{gid},{fv},{lv},{rc}\n")
+                else:
+                    f.write(f"{gid},{fv}\n")
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)  # atomic: crash keeps the old journal
@@ -98,12 +115,25 @@ class CheckpointedRunner:
             hi = min(lo + self.chunk, k)
             if all(g in done for g in range(lo, hi)):
                 continue
-            f = np.asarray(self.engine.f_values(queries[lo:hi]))
-            for g in range(lo, hi):
-                done[g] = int(f[g - lo])
+            chunk_q = queries[lo:hi]
+            stats = self.engine.query_stats(chunk_q) if self.stats else None
+            if stats is not None:
+                levels, reached, f = stats
+                for g in range(lo, hi):
+                    i = g - lo
+                    done[g] = (int(f[i]), int(levels[i]), int(reached[i]))
+            else:
+                f = np.asarray(self.engine.f_values(chunk_q))
+                for g in range(lo, hi):
+                    done[g] = (int(f[g - lo]), -1, -1)
             computed += hi - lo
             self._write(fp, done)
-        out = np.array([done[g] for g in range(k)], dtype=np.int64)
+        out = np.array([done[g][0] for g in range(k)], dtype=np.int64)
+        if self.stats:
+            self.last_stats = (
+                np.array([done[g][1] for g in range(k)], dtype=np.int32),
+                np.array([done[g][2] for g in range(k)], dtype=np.int32),
+            )
         return out, computed
 
     def best(

@@ -51,6 +51,28 @@ def format_level_stats(level_counts, level_seconds) -> str:
     return "\n".join(lines) + "\n"
 
 
+def format_halo_stats(per_level) -> str:
+    """Per-level halo-exchange table for the vertex-sharded engines
+    (MSBFS_STATS=2 side channel, ``engine.last_halo_trace``): the
+    max-over-shards own-frontier rows, the route the exchange took
+    (``sparse`` = compacted (id, words) pairs, ``dense`` = full planes,
+    ``mixed`` = q-shards diverged) and the total wire bytes it moved —
+    the ICI cost model as counters (docs/PERF_NOTES.md).  Level numbers
+    start at 1: the exchange serves the expansion that discovers that
+    distance (there is none for the distance-0 source row)."""
+    lines = ["level  own_rows  route   halo_bytes"]
+    total = 0
+    for d, row in enumerate(per_level):
+        routes = set(row["routes"])
+        route = routes.pop() if len(routes) == 1 else "mixed"
+        total += int(row["bytes"])
+        lines.append(
+            f"{d + 1:5d}  {row['own_rows']:8d}  {route:6s}  {row['bytes']}"
+        )
+    lines.append(f"total halo bytes: {total}")
+    return "\n".join(lines) + "\n"
+
+
 def format_query_stats(
     levels: Sequence[int], reached: Sequence[int], f_values: Sequence[int]
 ) -> str:

@@ -2,20 +2,57 @@
 
 Repeat runs skip the tens-of-seconds BFS program compile — the analog of
 the reference's nvcc-precompiled kernels.  ``MSBFS_CACHE_DIR=`` (empty)
-disables; unset uses ``~/.cache/msbfs_tpu/xla``.
+disables; unset uses ``~/.cache/msbfs_tpu/xla-<host fingerprint>``.
+
+The fingerprint matters: XLA:CPU serializes AOT executables specialized to
+the compiling machine's CPU features and will LOAD a mismatched entry with
+only a warning — observed to SEGFAULT the process mid-suite when this
+repo's cache dir was reused across differently-featured hosts (round 4;
+the loader even warns "This could lead to execution errors such as
+SIGILL").  Keying the directory by machine + CPU flags makes a foreign
+entry unloadable by construction.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
+
+
+def _host_fingerprint() -> str:
+    bits = [platform.machine()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    bits.append(line.strip())
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256("|".join(bits).encode()).hexdigest()[:12]
 
 
 def configure_compilation_cache() -> None:
     import jax
 
+    # CPU backends skip the persistent cache entirely: XLA:CPU AOT
+    # executable (de)serialization SEGFAULTED mid-suite on the round-4
+    # shard_map chunk programs (cache read on one host, cache write on
+    # another), and the compiles it would save are TPU-sized (tens of
+    # seconds), not CPU-sized.  The accelerator path keeps the cache —
+    # that is where the reference's nvcc-precompiled analogy matters.
+    if jax.default_backend() == "cpu":
+        return
+
     cache_dir = os.environ.get(
         "MSBFS_CACHE_DIR",
-        os.path.join(os.path.expanduser("~"), ".cache", "msbfs_tpu", "xla"),
+        os.path.join(
+            os.path.expanduser("~"),
+            ".cache",
+            "msbfs_tpu",
+            f"xla-{_host_fingerprint()}",
+        ),
     )
     if not cache_dir:
         return

@@ -41,6 +41,7 @@ def last_json_line(stdout: str) -> dict:
 def test_success_emits_metric_and_extras():
     proc = run_bench(
         {
+            "BENCH_CONFIGS": "",
             "BENCH_SCALE": "10",
             "BENCH_K": "32",
             "BENCH_MAX_S": "8",
@@ -64,7 +65,7 @@ def test_outage_fast_parsable_failure():
     """A dead backend must produce an error JSON line within the
     BENCH_WAIT_S budget — not a hang into the driver's kill timeout."""
     proc = run_bench(
-        {"JAX_PLATFORMS": "bogus_platform", "BENCH_WAIT_S": "1"},
+        {"BENCH_CONFIGS": "", "JAX_PLATFORMS": "bogus_platform", "BENCH_WAIT_S": "1"},
         timeout=180,
     )
     assert proc.returncode == 2
@@ -75,11 +76,65 @@ def test_outage_fast_parsable_failure():
     assert rec["metric"].startswith("TEPS")
 
 
+@pytest.mark.slow
+def test_configs_sweep_partial_failure_keeps_partial_results():
+    """BENCH_CONFIGS (round 4): one capture certifies several configs,
+    each with its own value/error — an unknown config cannot zero the
+    ones that measured."""
+    proc = run_bench(
+        {
+            "BENCH_CONFIGS": "1,zz,4",
+            "BENCH_SCALE_CAP": "8",
+            "BENCH_REPEATS": "1",
+            "BENCH_MAX_S": "8",
+            "BENCH_WAIT_S": "120",
+            "BENCH_RUN_S": "540",
+        },
+        timeout=1200,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = last_json_line(proc.stdout)
+    sweep = rec["detail"]["sweep"]
+    assert rec["detail"]["configs_requested"] == ["1", "zz", "4"]
+    assert sweep["1"]["value"] and sweep["1"]["value"] > 0
+    assert "RMAT-8" in sweep["1"]["metric"]
+    assert sweep["zz"]["value"] is None and "unknown" in sweep["zz"]["error"]
+    assert sweep["4"]["value"] and sweep["4"]["value"] > 0
+    assert "road-16x16" in sweep["4"]["metric"]
+    # Headline falls back to the first valued config (no "2" requested).
+    assert rec["value"] == sweep["1"]["value"]
+    # The cumulative record was re-emitted after every config.
+    lines = [
+        l for l in proc.stdout.strip().splitlines()
+        if l.lstrip().startswith("{")
+    ]
+    assert len(lines) == 3
+
+
+def test_configs_sweep_outage_is_one_parsable_record():
+    proc = run_bench(
+        {
+            "BENCH_CONFIGS": "1,2",
+            "JAX_PLATFORMS": "bogus_platform",
+            "BENCH_WAIT_S": "1",
+        },
+        timeout=180,
+    )
+    assert proc.returncode == 2
+    rec = last_json_line(proc.stdout)
+    assert rec["value"] is None and "no config has produced" in rec["error"]
+    sweep = rec["detail"]["sweep"]
+    for c in ("1", "2"):
+        assert sweep[c]["value"] is None
+        assert "device unavailable" in sweep[c]["error"]
+
+
 def test_midrun_stall_hits_hard_deadline():
     """BENCH_RUN_S bounds the workload: a child that cannot finish in time
     is killed and reported, again as parsable JSON."""
     proc = run_bench(
         {
+            "BENCH_CONFIGS": "",
             "BENCH_SCALE": "10",
             "BENCH_WAIT_S": "120",
             "BENCH_RUN_S": "1",

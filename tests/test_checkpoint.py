@@ -84,6 +84,51 @@ def test_checkpoint_partial_journal_completes(problem, tmp_path):
     assert 0 < computed <= padded.shape[0] - 8  # first 8 were journaled
 
 
+def test_checkpoint_stats_journaled(problem, tmp_path):
+    """stats=True journals (levels, reached) alongside F (round 4: stats
+    stay alive under checkpointing), and a resume replays them without
+    recomputing."""
+    n, g, eng, padded, want = problem
+    path = tmp_path / "j.ckpt"
+    r = CheckpointedRunner(eng, path, chunk=4, stats=True)
+    f, computed = r.run(n, g.num_directed_edges, padded)
+    np.testing.assert_array_equal(f, want)
+    levels, reached, f_ref = eng.query_stats(padded)
+    np.testing.assert_array_equal(r.last_stats[0], levels)
+    np.testing.assert_array_equal(r.last_stats[1], reached)
+
+    class Boom:
+        def f_values(self, q):  # pragma: no cover - must not be called
+            raise AssertionError("resume recomputed a completed chunk")
+
+        def query_stats(self, q):  # pragma: no cover
+            raise AssertionError("resume recomputed a completed chunk")
+
+    r2 = CheckpointedRunner(Boom(), path, chunk=4, stats=True)
+    f2, computed2 = r2.run(n, g.num_directed_edges, padded)
+    np.testing.assert_array_equal(f2, want)
+    assert computed2 == 0
+    np.testing.assert_array_equal(r2.last_stats[0], levels)
+    np.testing.assert_array_equal(r2.last_stats[1], reached)
+
+
+def test_checkpoint_stats_less_journal_resumes_with_placeholders(
+    problem, tmp_path
+):
+    """A stats run resuming a pre-round-4 (F-only) journal keeps -1
+    placeholders instead of recomputing or crashing."""
+    n, g, eng, padded, want = problem
+    path = tmp_path / "j.ckpt"
+    CheckpointedRunner(eng, path, chunk=4).run(
+        n, g.num_directed_edges, padded
+    )
+    r = CheckpointedRunner(eng, path, chunk=4, stats=True)
+    f, computed = r.run(n, g.num_directed_edges, padded)
+    np.testing.assert_array_equal(f, want)
+    assert computed == 0
+    assert (r.last_stats[0] == -1).all() and (r.last_stats[1] == -1).all()
+
+
 def test_checkpoint_truncated_header_raises_valueerror(problem, tmp_path):
     """A journal cut off mid-header (magic line only, no fingerprint) must
     raise ValueError — the type cli.py maps to the clean 'Checkpoint error'
@@ -155,3 +200,59 @@ def test_checkpoint_cli_multichip_resume(problem, tmp_path, capsys, monkeypatch)
     assert rc == 0
     for line in expect:
         assert line in second.out
+
+
+def test_checkpoint_cli_stats_alive(problem, tmp_path, capsys, monkeypatch):
+    """MSBFS_STATS=1 + MSBFS_CHECKPOINT prints the per-query stats table
+    (round 4 — it used to say 'ignored'); MSBFS_STATS=2 notes the missing
+    level trace but still prints per-query stats."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+        main,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_graph_bin,
+        save_query_bin,
+    )
+
+    n, g, eng, padded, want = problem
+    edges = generators.gnm_edges(120, 380, seed=701)[1]
+    queries = generators.random_queries(n, 13, max_group=4, seed=702)
+    queries[5] = np.zeros(0, dtype=np.int32)
+    gpath, qpath = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
+    save_graph_bin(gpath, n, edges)
+    save_query_bin(qpath, [list(map(int, q)) for q in queries])
+    monkeypatch.setenv("MSBFS_CHECKPOINT", str(tmp_path / "s.ckpt"))
+    monkeypatch.setenv("MSBFS_CHECKPOINT_CHUNK", "4")
+    monkeypatch.setenv("MSBFS_STATS", "1")
+    rc = main(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "query  levels  reached  F" in out.err
+    assert "ignored" not in out.err
+    # The table's rows are the real per-query counters.
+    levels, reached, f = eng.query_stats(padded)
+    for i in range(padded.shape[0]):
+        assert (
+            f"{i + 1:5d}  {int(levels[i]):6d}  {int(reached[i]):7d}  "
+            f"{int(f[i])}"
+        ) in out.err
+    monkeypatch.setenv("MSBFS_STATS", "2")
+    monkeypatch.setenv("MSBFS_CHECKPOINT", str(tmp_path / "s2.ckpt"))
+    rc = main(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "under checkpointing" in out.err
+    assert "query  levels  reached  F" in out.err
+    # A pre-round-4 (F-only) journal resumed with stats on gets the
+    # dedicated diagnostic, not the generic "engine doesn't support" one.
+    monkeypatch.setenv("MSBFS_CHECKPOINT", str(tmp_path / "s3.ckpt"))
+    monkeypatch.delenv("MSBFS_STATS", raising=False)
+    rc = main(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"])
+    capsys.readouterr()
+    assert rc == 0
+    monkeypatch.setenv("MSBFS_STATS", "1")
+    rc = main(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "predates stats" in out.err
+    assert "not available on this engine" not in out.err

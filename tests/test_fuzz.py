@@ -172,3 +172,36 @@ def test_fuzz_distributed_push_matches_oracle(seed):
     got = np.asarray(eng.f_values(padded))
     want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
     np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")
+
+
+@pytest.mark.parametrize("seed", [6000, 6001])
+def test_fuzz_sharded_push_matches_oracle(seed):
+    """Owner-partitioned push (round 4) on random shapes: random mesh
+    split, tiny random capacities to force the overflow/growth protocol,
+    random level chunk."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.push_sharded import (
+        ShardedPushEngine,
+    )
+
+    rng = np.random.default_rng(seed)
+    n, edges, queries = random_problem(rng)
+    g = CSRGraph.from_edges(n, edges)
+    padded = pad_queries(queries)
+    vs = int(rng.choice([2, 4, 8]))
+    eng = ShardedPushEngine(
+        make_mesh(num_query_shards=8 // vs, num_vertex_shards=vs),
+        g,
+        max_width=1024,
+        level_chunk=int(rng.integers(1, 8)),
+    )
+    if rng.random() < 0.5:
+        eng.capacity = int(rng.integers(1, 6))  # force auto-grow retries
+        eng.boundary = int(rng.integers(1, 6))
+    got = np.asarray(eng.f_values(padded))
+    want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")

@@ -242,3 +242,41 @@ def test_native_rmat_edges_distribution():
     # matching the NumPy generator's qualitative profile.
     deg = np.bincount(e1.ravel(), minlength=1 << scale)
     assert deg.max() > 8 * deg.mean()
+
+
+def test_thread_count_invariance(built, monkeypatch):
+    """Round 4: every parallelized pass (CSR build, dedup, BELL
+    bucketing, R-MAT sampling) must produce BYTE-IDENTICAL output at any
+    MSBFS_NATIVE_THREADS — the parallel decomposition preserves the
+    serial insertion/assignment order by construction."""
+    n, edges = generators.rmat_edges(11, edge_factor=16, seed=17, native=False)
+    outs = []
+    for t in ("1", "8"):
+        monkeypatch.setenv("MSBFS_NATIVE_THREADS", t)
+        ro, ci = native_loader.csr_from_edges(n, edges)
+        dst, deg = native_loader.dedup_rows(ro, ci)
+        e = native_loader.rmat_edges(10, 1 << 14, 0.57, 0.19, 0.19, seed=3)
+        counts = np.maximum(deg, 0)
+        start = np.zeros(n, dtype=np.int64)
+        np.cumsum(counts[:-1], out=start[1:])
+        bell = native_loader.bell_level(
+            start, counts, dst, [4, 16, 64], sentinel_value=-1
+        )
+        outs.append((ro, ci, dst, deg, e, bell))
+    a, b = outs
+    for x, y in zip(a[:5], b[:5]):
+        np.testing.assert_array_equal(x, y)
+    for x, y in zip(a[5], b[5]):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_dedup_rows_nonzero_first_offset(built):
+    """row_offsets[0] > 0 is valid at the C ABI (slots before the first
+    row are simply not part of any row); the compaction must land block 0
+    at output offset 0 (round-4 review caught the parallel version
+    skipping block 0's relocation)."""
+    row_offsets = np.array([1, 3, 4], dtype=np.int64)
+    col_indices = np.array([99, 1, 1, 0], dtype=np.int32)  # slot 0 unused
+    dst, deg = native_loader.dedup_rows(row_offsets, col_indices)
+    np.testing.assert_array_equal(deg, [1, 1])
+    np.testing.assert_array_equal(dst, [1, 0])

@@ -1,0 +1,72 @@
+#!/usr/bin/env python3
+"""Which collectives does the axon remote-compile path accept?
+
+Round-4 finding (benchmarks/raw_r4/road_single_shootout.txt): the first
+mesh-engine run on the REAL chip showed the axon AOT helper rejects an
+s64 max all-reduce ("Supported lowering only of Sum all reduce") while
+the same program's s32 pmax, s32 psum and u32/s32 all_gathers inside the
+level loop compiled and ran.  This probe pins the support matrix so the
+result-merge collectives (parallel/scheduler.py::merge_local_f) can be
+formulated on a supported op; output is committed to raw_r4/.
+
+Each case jits a 1x1-mesh shard_map program and runs it once.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PKG = "parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu"
+
+
+def main():
+    import importlib
+
+    xla_cache = importlib.import_module(f"{PKG}.utils.xla_cache")
+    xla_cache.configure_compilation_cache()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    print(f"device={jax.devices()[0]} jax={jax.__version__}")
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1), ("q", "v"))
+
+    def case(name, dtype, body):
+        x = jnp.arange(8, dtype=dtype)
+        fn = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=(P("q"),), out_specs=P()
+            )
+        )
+        try:
+            out = np.asarray(fn(x))
+            print(f"OK      {name}: {out.ravel()[:4]}")
+        except Exception as exc:  # noqa: BLE001 - cataloguing support
+            msg = "".join(
+                traceback.format_exception_only(type(exc), exc)
+            ).strip().replace("\n", " ")
+            print(f"REJECT  {name}: {msg[:220]}")
+
+    # Inputs enter varying over 'q' only (in_specs P('q')), so reduce over
+    # ('q',) alone — reducing over 'v' too is a JAX type error for psum
+    # (the first probe run hit it), and axes don't change what the axon
+    # helper sees: one all-reduce with the given computation and dtype.
+    axes = ("q",)
+    case("psum s32", jnp.int32, lambda x: lax.psum(x, axes))
+    case("psum s64", jnp.int64, lambda x: lax.psum(x, axes))
+    case("pmax s32", jnp.int32, lambda x: lax.pmax(x, axes))
+    case("pmax s64", jnp.int64, lambda x: lax.pmax(x, axes))
+    case("pmax u32", jnp.uint32, lambda x: lax.pmax(x, axes))
+    case("pmin s32", jnp.int32, lambda x: lax.pmin(x, axes))
+
+
+if __name__ == "__main__":
+    main()

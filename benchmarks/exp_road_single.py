@@ -1,0 +1,173 @@
+#!/usr/bin/env python3
+"""Single-chip road-class engine shootout (round 4).
+
+Config 4 (road-1024, 16 groups) runs the vmapped per-query push engine at
+64.2 s (benchmarks/raw_r4/bench_headline.json) — ~30 ms/level, dominated
+by the per-lane hit scatter: 16 lanes x capacity x width single-byte
+scatter slots every level (~2.1 M slots at ~12 ns/slot,
+docs/PERF_NOTES.md "Op-cost facts").  The round-4 owner-partitioned push
+(parallel.push_sharded) packs all K queries into byte-LANE rows instead —
+scatter cost is per ROW and the row payload rides free up to ~64 B — and
+on a 1x1 mesh it degenerates to exactly the packed single-chip engine
+(no boundary traffic, the all_gather is an identity).  This experiment
+measures, on one chip:
+
+  A. PushEngine            (vmapped per-query, the current config-4 route)
+  B. ShardedPushEngine 1x1 (packed byte-lane rows, union frontier)
+  C. BitBellEngine         (hybrid pull/push forest — the auto default)
+
+on the config-4 workload, plus a half-size road for a second point.
+Winner informs the single-chip road-class auto-routing in cli.py.
+
+Usage: python benchmarks/exp_road_single.py [side] [k] [engines]
+  engines: comma list from {push, spush, ppush, bitbell, bitbellN}
+  (bitbellN = bounded dispatches at N levels/dispatch, e.g. bitbell32;
+  default: push,spush,bitbell)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PKG = "parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu"
+
+
+def measure(name, engine, queries, repeats=3):
+    engine.compile(queries.shape)
+    times, out = [], None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = engine.best(queries)
+        times.append(time.perf_counter() - t0)
+    best = sorted(times)[len(times) // 2]
+    rec = {
+        "engine": name,
+        "computation_s": round(best, 3),
+        "all_runs_s": [round(t, 3) for t in times],
+        "minF": int(out[0]),
+        "minK_1based": int(out[1]) + 1,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    side = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    which = (
+        sys.argv[3].split(",") if len(sys.argv) > 3
+        else ["push", "spush", "bitbell"]
+    )
+
+    import importlib
+
+    generators = importlib.import_module(f"{PKG}.models.generators")
+    csr_mod = importlib.import_module(f"{PKG}.models.csr")
+    io_mod = importlib.import_module(f"{PKG}.utils.io")
+    xla_cache = importlib.import_module(f"{PKG}.utils.xla_cache")
+
+    xla_cache.configure_compilation_cache()
+
+    import jax
+
+    print(f"device={jax.devices()[0]} side={side} k={k}", file=sys.stderr)
+    n, edges = generators.road_edges(side, side, seed=46)
+    g = csr_mod.CSRGraph.from_edges(n, edges)
+    queries = io_mod.pad_queries(
+        generators.random_queries(n, k, max_group=8, seed=44), pad_to=8
+    )
+    results = []
+
+    def leg(name, build):
+        try:
+            eng = build()
+            results.append(measure(name, eng, queries))
+            return eng
+        except Exception as exc:  # noqa: BLE001 - keep other legs alive
+            print(f"  {name} FAILED: {exc}", file=sys.stderr)
+            return None
+
+    if "push" in which:
+        push_mod = importlib.import_module(f"{PKG}.ops.push")
+        eng = leg(
+            "push (vmapped per-query)",
+            lambda: push_mod.PushEngine(
+                push_mod.PaddedAdjacency.from_host(g)
+            ),
+        )
+        if eng:
+            print(
+                f"  capacity after runs: {eng.capacity} "
+                f"(peak {eng._max_need})",
+                file=sys.stderr,
+            )
+
+    bitbell_legs = [w for w in which if w.startswith("bitbell")]
+    if bitbell_legs:
+        bell_mod = importlib.import_module(f"{PKG}.models.bell")
+        bitbell_mod = importlib.import_module(f"{PKG}.ops.bitbell")
+        bg = bell_mod.BellGraph.from_host(g)
+        for w in bitbell_legs:
+            # "bitbell" = unchunked; "bitbellN" = N levels per dispatch
+            # (the CLI's bounded-dispatch policy; N=32 is its auto value).
+            chunk = int(w[len("bitbell"):]) if len(w) > len("bitbell") else None
+            leg(
+                f"bitbell (hybrid, chunk={chunk})",
+                lambda chunk=chunk: bitbell_mod.BitBellEngine(
+                    bg, level_chunk=chunk
+                ),
+            )
+
+    if "ppush" in which:
+        pp_mod = importlib.import_module(f"{PKG}.ops.push_packed")
+        push_mod = importlib.import_module(f"{PKG}.ops.push")
+        eng = leg(
+            "packed push (union frontier)",
+            lambda: pp_mod.PackedPushEngine(
+                push_mod.PaddedAdjacency.from_host(g)
+            ),
+        )
+        if eng:
+            print(
+                f"  capacity after runs: {eng.capacity} "
+                f"(peak {eng._max_need})",
+                file=sys.stderr,
+            )
+
+    if "spush" in which:
+        mesh_mod = importlib.import_module(f"{PKG}.parallel.mesh")
+        ps_mod = importlib.import_module(f"{PKG}.parallel.push_sharded")
+        mesh = mesh_mod.make_mesh(
+            num_query_shards=1, num_vertex_shards=1,
+            devices=jax.devices()[:1],
+        )
+        eng = leg(
+            "sharded push 1x1 (packed lanes)",
+            lambda: ps_mod.ShardedPushEngine(mesh, g),
+        )
+        if eng:
+            print(
+                f"  capacity {eng.capacity} boundary {eng.boundary} "
+                f"(peaks {eng._peak_f}/{eng._peak_b})",
+                file=sys.stderr,
+            )
+
+    fs = {r["minF"] for r in results}
+    ks = {r["minK_1based"] for r in results}
+    agree = len(fs) == 1 and len(ks) == 1 and len(results) == len(which)
+    print(json.dumps({"side": side, "k": k, "agree": agree}), flush=True)
+    if not agree:
+        print("ENGINE DISAGREEMENT OR FAILED LEG", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,7 +59,26 @@ def parse_args(argv: List[str]):
     return graph_file, query_file, num_gpu
 
 
-_AUTO_LEVEL_CHUNK = 32
+# Levels per dispatch for the auto bound.  Retuned 32 -> 128 after the
+# first on-chip deep-graph measurement (road-1024/K=16, TPU v5e, raw in
+# benchmarks/raw_r4/road_single_shootout3.txt): the ~100 ms tunnel
+# dispatch floor makes 66 chunk-32 dispatches cost 18% of the whole
+# computation span, vs 4.6% at 128.  128 thin levels remain orders of
+# magnitude below the per-dispatch work that crashed the TPU worker
+# (docs/PERF_NOTES.md "Push-engine TPU status"), and shallow power-law
+# BFS exits the in-dispatch loop on convergence either way.
+_AUTO_LEVEL_CHUNK = 128
+
+# Backends with no distributed variant: at -gn > 1 they warn and fall back
+# to the distributed bitbell.  ("csr"/"vmap" map to the per-query pull and
+# "push" to real multi-chip routes, so they are absent here.)
+_SINGLE_CHIP_ONLY_BACKENDS = ("dense", "pallas", "bell", "packed", "ppush")
+# Backends whose HBM footprint the bitbell estimate does not model — the
+# single-chip capacity warning stays quiet for these.
+_NON_BITBELL_FOOTPRINT_BACKENDS = _SINGLE_CHIP_ONLY_BACKENDS + (
+    "vmap",
+    "push",
+)
 
 
 def _road_class(graph) -> bool:
@@ -285,7 +304,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # default, with a warning for backends that only exist
             # single-chip.
             backend = os.environ.get("MSBFS_BACKEND", "auto")
-            if backend in ("dense", "pallas", "bell", "packed"):
+            if backend in _SINGLE_CHIP_ONLY_BACKENDS:
                 print(
                     f"MSBFS_BACKEND={backend} is single-chip only; using "
                     "the distributed bitbell engine at -gn > 1",
@@ -399,8 +418,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             # n^2 adjacency fits HBM; "auto" picks it for small graphs on
             # MXU-bearing devices only.
             backend = os.environ.get("MSBFS_BACKEND", "auto")
-            if hbm_need > hbm_have and backend not in (
-                "dense", "vmap", "pallas", "bell", "push", "packed"
+            if (
+                hbm_need > hbm_have
+                and backend not in _NON_BITBELL_FOOTPRINT_BACKENDS
             ):
                 # The estimate models the default (hybrid bitbell) engine,
                 # which also serves unrecognized MSBFS_BACKEND values; the
@@ -462,6 +482,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                 except ValueError as exc:
                     # Degree beyond the width cap: a user-facing
                     # engine-choice error.
+                    print(str(exc), file=sys.stderr)
+                    return 1
+            elif backend == "ppush":
+                # Packed-lane union-frontier push (ops.push_packed): one
+                # compacted queue serves all K bit-packed queries, so the
+                # per-level hit scatter is C*w ROWS for the whole batch
+                # instead of K separate lanes (measured 5.4x over the
+                # vmapped push on road-1024/K=16, BASELINE.md config 4).
+                from .ops.push import PaddedAdjacency
+                from .ops.push_packed import PackedPushEngine
+
+                try:
+                    engine = PackedPushEngine(
+                        PaddedAdjacency.from_host(graph)
+                    )
+                except ValueError as exc:
                     print(str(exc), file=sys.stderr)
                     return 1
             elif backend == "packed":

@@ -1,0 +1,195 @@
+"""Packed-lane push BFS: one union frontier queue for all K queries.
+
+The vmapped push engine (ops.push) is work-optimal per query, but its
+per-level cost is K independent single-byte hit scatters — measured on
+config 4 (road-1024, K=16): ~2.1 M scatter slots/level at ~12 ns/slot is
+~30 ms/level, ~the whole 64-77 s computation span
+(benchmarks/raw_r4/road_single_shootout.txt).  The scatter unit on TPU is
+ROW-latency-bound: a byte-row scatter-max costs the same up to ~64 B of
+payload (docs/PERF_NOTES.md "Round-2 findings"), so K byte lanes per row
+ride free where K single-byte scatters do not.
+
+This engine is the single-chip distillation of the owner-partitioned
+sharded push (parallel.push_sharded with p = 1, minus the mesh): ONE
+compacted frontier queue over the UNION of all K queries' wavefronts,
+each queue row carrying its (K/32,) uint32 query words:
+
+* compact:  (n, W) frontier bit planes -> (C,) union rows + (C, W) words
+  (ops.push.compact_frontier_planes — the shared budget/sentinel
+  semantics);
+* gather:   (C, w) width-padded adjacency rows (ops.push.PaddedAdjacency,
+  global ids, sentinel landing row n);
+* scatter:  ONE (C*w)-row byte-lane scatter-max into the (n+1, K) hit
+  planes — scatter-max of 0/1 bytes IS the bitwise OR a multi-writer push
+  needs, the well-defined form of the reference kernel's benign write
+  race (main.cu:30-33);
+* repack:   hit bytes -> (n, W) planes; new = hits & ~visited; per-query
+  counters (F, levels, reached) accumulate exactly like ops.bitbell.
+
+Per-level cost is C*(1 + w) gather/scatter rows for ALL K queries, vs the
+vmapped engine's K*C_q*w scatter slots — the crossover is wherever query
+wavefronts coexist (always, for multi-query road batches).  The capacity
+C bounds the UNION frontier; the overflow protocol (grow on truncation,
+shrink on measured headroom) is inherited unchanged from ops.push.
+
+Semantics are the reference's exactly (main.cu:16-89): source bounds
+check (main.cu:46-51), level-synchronous expansion, unreached vertices
+excluded from F(U).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .bitbell import (
+    WORD_BITS,
+    pack_byte_planes,
+    pack_queries,
+    unpack_byte_planes,
+    unpack_counts,
+)
+from .push import (
+    PaddedAdjacency,
+    PushEngine,
+    compact_frontier_planes,
+    push_run,
+)
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def _packed_init_batch(adj: PaddedAdjacency, queries: jax.Array, capacity):
+    """Initial carry from a (k_pad, S) -1-padded query batch (k_pad a
+    multiple of 32).  Same tuple layout as ops.push._push_init — (visited,
+    frontier, f, levels, reached, level, updated, peak) — so push_run and
+    the PushEngine trace/orchestration drivers work unchanged; ``peak`` is
+    the (1,) union-frontier row count (scalar-shaped per-batch, not
+    per-query: one queue serves every query)."""
+    n = adj.n
+    planes0 = pack_queries(n, queries)  # bounds check per main.cu:46-51
+    counts0 = unpack_counts(planes0)
+    rows0 = jnp.sum(
+        (planes0 != jnp.uint32(0)).any(axis=1), dtype=jnp.int32
+    ).reshape(1)
+    return (
+        planes0,
+        planes0,
+        counts0.astype(jnp.int64) * 0,  # sources are at distance 0
+        jnp.where(counts0 > 0, 1, 0).astype(jnp.int32),
+        counts0,
+        jnp.int32(0),
+        jnp.any(counts0 > 0),
+        rows0,
+    )
+
+
+@partial(jax.jit, static_argnames=("capacity", "max_levels"))
+def _packed_chunk_batch(
+    adj: PaddedAdjacency, carry, capacity: int, chunk, max_levels
+):
+    """Advance the union-frontier BFS by <= ``chunk`` levels (or to
+    ``max_levels``/convergence) in one dispatch."""
+    n = adj.n
+    start = carry[5]
+
+    def cond(c):
+        go = jnp.logical_and(c[6], c[5] < start + chunk)
+        if max_levels is not None:
+            go = jnp.logical_and(go, c[5] < max_levels)
+        return go
+
+    def body(c):
+        visited, frontier, f, levels, reached, level, _, peak = c
+        rows, ids, valid, words = compact_frontier_planes(
+            frontier, capacity, n
+        )
+        nbrs = jnp.take(adj.rows, ids, axis=0)  # (C, w); sentinel row n
+        cap, w_deg = nbrs.shape
+        flat_dst = nbrs.reshape(-1)  # (C*w,) global ids, sentinel n
+        flat_words = jnp.broadcast_to(
+            words[:, None, :], (cap, w_deg, words.shape[1])
+        ).reshape(cap * w_deg, words.shape[1])
+        src_bytes = unpack_byte_planes(flat_words)  # (C*w, K) 0/1
+        hit_bytes = (
+            jnp.zeros((n + 1, src_bytes.shape[1]), jnp.uint8)
+            .at[flat_dst]
+            .max(src_bytes)  # sentinel slots land on row n, dropped below
+        )
+        new = pack_byte_planes(hit_bytes[:n]) & ~visited
+        counts = unpack_counts(new)
+        dist = level + 1
+        return (
+            visited | new,
+            new,
+            f + counts.astype(jnp.int64) * dist.astype(jnp.int64),
+            jnp.where(counts > 0, dist + 1, levels),
+            reached + counts,
+            level + 1,
+            jnp.any(counts > 0),
+            jnp.maximum(peak, rows),
+        )
+
+    return lax.while_loop(cond, body, carry)
+
+
+def _pad_rows(queries, k_pad: int) -> jnp.ndarray:
+    q = np.asarray(queries)
+    out = np.full((k_pad, q.shape[1]), -1, dtype=np.int32)
+    out[: q.shape[0]] = q
+    return jnp.asarray(out)
+
+
+class PackedPushEngine(PushEngine):
+    """Union-frontier packed-lane push engine over a PaddedAdjacency.
+
+    Inherits the full PushEngine surface — auto/explicit ``capacity`` with
+    the grow-on-overflow / shrink-on-headroom protocol, ``max_levels``,
+    the host-chunked level loop, query_stats and the stepped level trace —
+    but ``capacity`` bounds the UNION frontier across all K queries (the
+    auto start is the same wavefront guess; the first multi-query run
+    typically grows it once and the adapted value persists across runs).
+    """
+
+    def _dispatch(self, queries):
+        k_pad = -(-max(queries.shape[0], 1) // WORD_BITS) * WORD_BITS
+        if self.graph.n == 0:
+            z32 = np.zeros(k_pad, dtype=np.int32)
+            return (
+                np.zeros(k_pad, dtype=np.int64),
+                z32,
+                z32,
+                np.zeros(1, dtype=np.int32),
+            )
+        return push_run(
+            self.graph,
+            _pad_rows(queries, k_pad),
+            self.capacity,
+            self.max_levels,
+            init_fn=_packed_init_batch,
+            chunk_fn=_packed_chunk_batch,
+        )
+
+    # Stepped-trace hooks: same carry layout at chunk=1; the per-query
+    # rows are (k_pad,)-wide, so _to_query_order trims the pad lanes back
+    # to the real query count recorded at trace init.
+    def _trace_init(self, queries):
+        self._trace_k = queries.shape[0]
+        return _packed_init_batch(
+            self.graph,
+            _pad_rows(
+                queries, -(-max(queries.shape[0], 1) // WORD_BITS) * WORD_BITS
+            ),
+            self.capacity,
+        )
+
+    def _trace_chunk(self, carry):
+        return _packed_chunk_batch(
+            self.graph, carry, self.capacity, jnp.int32(1), self.max_levels
+        )
+
+    def _to_query_order(self, x) -> np.ndarray:
+        return np.asarray(x)[: self._trace_k]

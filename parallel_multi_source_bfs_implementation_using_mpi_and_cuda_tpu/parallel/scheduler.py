@@ -76,9 +76,23 @@ def merge_local_f(f_local: jax.Array, j: int, w: int, k: int, k_pad: int, axes):
     reconstructs the full array (every real slot is >= 0 on exactly one
     shard): the SPMD fixed-shape analog of MPI_Gatherv + scatter-by-q
     (main.cu:362-375).
+
+    The 64-bit max rides as TWO u32 maxes of the +1-biased value's halves:
+    the TPU AOT path behind the axon tunnel rejects 64-bit non-sum
+    all-reduces ("Supported lowering only of Sum all reduce" — probed and
+    committed, benchmarks/raw_r4/axon_collective_probe.txt) while u32/s32
+    reductions lower fine.  The split is exact, not approximate: exactly
+    one shard owns each slot and every other shard contributes the biased
+    identity 0 = (0, 0), so the componentwise u32 maxes reconstruct the
+    owner's exact halves (no lexicographic coupling between words can
+    arise when all non-owner words are zero).
     """
     r = lax.axis_index(QUERY_AXIS)
     gids = r.astype(jnp.int32) + jnp.arange(j, dtype=jnp.int32) * w
     f_local = jnp.where(gids < k, f_local, jnp.int64(-1))
     merged = jnp.full((k_pad,), jnp.int64(-1)).at[gids].set(f_local)
-    return lax.pmax(merged, axes)
+    biased = (merged + 1).astype(jnp.uint64)  # >= 0; non-owner slots 0
+    hi = lax.pmax((biased >> 32).astype(jnp.uint32), axes)
+    lo = lax.pmax(biased.astype(jnp.uint32), axes)
+    out = (hi.astype(jnp.uint64) << 32) | lo.astype(jnp.uint64)
+    return out.astype(jnp.int64) - 1

@@ -50,3 +50,26 @@ def pytest_configure(config):
             pass
     env = virtual_cpu_env(forced_device_count() or 8)
     os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drop_cpu_programs_between_modules():
+    """XLA:CPU's JIT segfaults compiling yet another mesh-engine program
+    once a process holds hundreds of live compiled executables (the crash
+    lands in backend_compile_and_load, moves between invocations, and
+    every program passes standalone — docs/PERF_NOTES.md "Measurement
+    traps").  The suite crossed that threshold again in round 4 when new
+    engines added programs (segfault at ~93%, compiling a sharded_csr
+    program).  Dropping every live executable between MODULES keeps the
+    peak far below the tipping point, at the cost of cross-module
+    recompiles — modules overwhelmingly compile their own programs anyway
+    (the persistent on-disk cache is already off on CPU: loading
+    serialized CPU executables segfaults too)."""
+    yield
+    import jax
+
+    if jax.default_backend() == "cpu":
+        jax.clear_caches()

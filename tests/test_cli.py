@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+    _AUTO_LEVEL_CHUNK,
     main,
     parse_args,
 )
@@ -280,11 +281,11 @@ def test_hub_tail_cli_bound_engaged(tmp_path, capsys, monkeypatch):
     rc, out, _ = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"], capsys)
     assert rc == 0
     _assert_report(out, want, 1)
-    assert seen.pop("bitbell") == 32  # bound engaged despite the hub
+    assert seen.pop("bitbell") == _AUTO_LEVEL_CHUNK  # bound engaged despite the hub
     rc, out, _ = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "8"], capsys)
     assert rc == 0
     _assert_report(out, want, 8)
-    assert seen.pop("dist") == 32
+    assert seen.pop("dist") == _AUTO_LEVEL_CHUNK
 
 
 def test_vertex_sharded_push_routing(road_files, files, capsys, monkeypatch):
@@ -343,6 +344,36 @@ def test_vertex_sharded_push_routing(road_files, files, capsys, monkeypatch):
     )
     assert rc == 0 and len(built) == 2  # bitbell served it
     _assert_report(out, want3, 8)
+
+
+def test_ppush_backend_routes_and_warns_multichip(files, capsys, monkeypatch):
+    """MSBFS_BACKEND=ppush (round 4, ops.push_packed): serves -gn 1 via
+    the packed-lane union-frontier push; at -gn > 1 it is single-chip
+    only — warns and falls back to the distributed bitbell."""
+    import parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push_packed as pp_mod
+
+    built = []
+    real = pp_mod.PackedPushEngine
+
+    class Spy(real):
+        def __init__(self, *a, **kw):
+            built.append(1)
+            super().__init__(*a, **kw)
+
+    monkeypatch.setattr(pp_mod, "PackedPushEngine", Spy)
+    gpath, qpath, want = files
+    monkeypatch.setenv("MSBFS_BACKEND", "ppush")
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "1"], capsys
+    )
+    assert rc == 0 and built == [1]  # the route really built the engine
+    _assert_report(out, want, 1)
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "4"], capsys
+    )
+    assert rc == 0
+    assert "single-chip only" in err
+    _assert_report(out, want, 4)
 
 
 def test_multichip_honors_backend_env(files, capsys, monkeypatch):

@@ -89,6 +89,17 @@ def _push(g):
     return PushEngine(PaddedAdjacency.from_host(g, max_width=512))
 
 
+def _packed_push(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push import (
+        PaddedAdjacency,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push_packed import (
+        PackedPushEngine,
+    )
+
+    return PackedPushEngine(PaddedAdjacency.from_host(g, max_width=512))
+
+
 def _distributed(g):
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
         DistributedEngine,
@@ -205,6 +216,7 @@ ENGINES = {
     "bitbell": _bitbell,
     "bitbell_chunked": _bitbell_chunked,
     "push": _push,
+    "packed_push": _packed_push,
     "distributed": _distributed,
     "distributed_chunked": _distributed_chunked,
     "distributed_push": _distributed_push,

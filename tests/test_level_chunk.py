@@ -19,6 +19,7 @@ from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
     pad_queries,
 )
 from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+    _AUTO_LEVEL_CHUNK,
     _level_chunk_policy,
 )
 from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
@@ -198,10 +199,10 @@ def test_policy_always_bounds(monkeypatch):
     pays one host sync; benchmarks/exp_chunk_cost.py)."""
     monkeypatch.delenv("MSBFS_LEVEL_CHUNK", raising=False)
     g_road, _ = deep_problem()
-    assert _level_chunk_policy(g_road) == 32
+    assert _level_chunk_policy(g_road) == _AUTO_LEVEL_CHUNK
     n, edges = generators.rmat_edges(10, edge_factor=16, seed=7)
     g_rmat = CSRGraph.from_edges(n, edges)
-    assert _level_chunk_policy(g_rmat) == 32  # power-law graphs too
+    assert _level_chunk_policy(g_rmat) == _AUTO_LEVEL_CHUNK  # power-law graphs too
     monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "0")
     assert _level_chunk_policy(g_road) is None  # explicit 0 disables
     monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "64")
@@ -213,13 +214,13 @@ def test_policy_malformed_env_falls_back_to_auto(monkeypatch, capsys):
     (round-3 behavior mapped garbage to 'disabled'; ADVICE r3)."""
     g_road, _ = deep_problem()
     monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "banana")
-    assert _level_chunk_policy(g_road) == 32
+    assert _level_chunk_policy(g_road) == _AUTO_LEVEL_CHUNK
     assert "MSBFS_LEVEL_CHUNK" in capsys.readouterr().err
     monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "")
-    assert _level_chunk_policy(g_road) == 32
+    assert _level_chunk_policy(g_road) == _AUTO_LEVEL_CHUNK
     assert capsys.readouterr().err == ""  # empty = unset, no noise
     monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "-32")  # sign typo != opt-out
-    assert _level_chunk_policy(g_road) == 32
+    assert _level_chunk_policy(g_road) == _AUTO_LEVEL_CHUNK
     assert "negative" in capsys.readouterr().err
 
 
@@ -285,7 +286,7 @@ def test_hub_tail_adversary_bounded_all_engines(monkeypatch):
     g, padded = hub_tail_problem()
     assert int(g.degrees.max()) > 64  # the round-3 heuristic's blind spot
     chunk = _level_chunk_policy(g)
-    assert chunk == 32
+    assert chunk == _AUTO_LEVEL_CHUNK
     ref = BitBellEngine(BellGraph.from_host(g)).query_stats(padded)
     assert ref[0].max() >= 2000  # the deep precondition
     engines = [

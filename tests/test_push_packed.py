@@ -1,0 +1,169 @@
+"""Packed-lane (union-frontier) push engine: oracle parity, capacity
+semantics, trace contract — ops/push_packed.py."""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push import (
+    FrontierOverflow,
+    PaddedAdjacency,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push_packed import (
+    PackedPushEngine,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+GRAPHS = {
+    "grid": generators.grid_edges(19, 7),
+    "gnm_sparse": generators.gnm_edges(200, 320, seed=501),
+    "path": (
+        50,
+        np.stack(
+            [np.arange(49, dtype=np.int64), np.arange(1, 50, dtype=np.int64)],
+            axis=1,
+        ),
+    ),
+}
+
+
+def oracle_f_values(n, edges, queries):
+    return [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+
+
+@pytest.mark.parametrize("name", sorted(GRAPHS))
+def test_packed_push_matches_oracle(name):
+    n, edges = GRAPHS[name]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 7, max_group=4, seed=502)
+    queries[3] = np.zeros(0, dtype=np.int32)
+    padded = pad_queries(queries)
+    eng = PackedPushEngine(PaddedAdjacency.from_host(g))
+    got = np.asarray(eng.f_values(padded))
+    want = oracle_f_values(n, edges, queries)
+    np.testing.assert_array_equal(got, want)
+    assert eng.best(padded) == oracle_best(want)
+
+
+def test_packed_push_k_not_multiple_of_32():
+    """The pad-to-32-lanes plumbing: K values straddling word boundaries
+    must neither truncate real queries nor leak pad lanes into results."""
+    n, edges = GRAPHS["grid"]
+    g = CSRGraph.from_edges(n, edges)
+    for k in (1, 31, 32, 33, 64):
+        queries = generators.random_queries(n, k, max_group=4, seed=507 + k)
+        padded = pad_queries(queries)
+        got = np.asarray(
+            PackedPushEngine(PaddedAdjacency.from_host(g)).f_values(padded)
+        )
+        assert got.shape == (k,)
+        np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_packed_push_duplicate_edges_self_loops_oob_sources():
+    n = 30
+    base = generators.gnm_edges(n, 60, seed=503)[1]
+    edges = np.concatenate([base, base[:20], np.stack([np.arange(5)] * 2, 1)])
+    g = CSRGraph.from_edges(n, edges)
+    queries = [
+        np.array([0, -1, n + 5], dtype=np.int32),
+        np.array([n - 1], dtype=np.int32),
+    ]
+    padded = pad_queries(queries)
+    got = np.asarray(
+        PackedPushEngine(PaddedAdjacency.from_host(g)).f_values(padded)
+    )
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_packed_push_union_capacity_overflow_raises():
+    n, edges = GRAPHS["grid"]
+    g = CSRGraph.from_edges(n, edges)
+    eng = PackedPushEngine(PaddedAdjacency.from_host(g), capacity=2)
+    padded = pad_queries([np.array([0], dtype=np.int32)])
+    with pytest.raises(FrontierOverflow):
+        eng.f_values(padded)
+
+
+def test_packed_push_auto_capacity_grows_union():
+    """The union of several disjoint wavefronts must drive growth (the
+    capacity bounds the one shared queue, not any single query)."""
+    n, edges = generators.grid_edges(40, 40)
+    g = CSRGraph.from_edges(n, edges)
+    eng = PackedPushEngine(PaddedAdjacency.from_host(g))
+    assert eng.auto_capacity
+    eng.capacity = 4
+    queries = [
+        np.array([i * 397 % n], dtype=np.int32) for i in range(8)
+    ]
+    padded = pad_queries(queries)
+    f1 = np.asarray(eng.f_values(padded))
+    assert eng.capacity > 4
+    np.testing.assert_array_equal(
+        f1, oracle_f_values(n, edges, queries)
+    )
+
+
+def test_packed_push_stats_and_levels_match_vmapped():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push import (
+        PushEngine,
+    )
+
+    n, edges = GRAPHS["grid"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 6, max_group=3, seed=506)
+    queries[2] = np.zeros(0, dtype=np.int32)
+    padded = pad_queries(queries)
+    a = PackedPushEngine(PaddedAdjacency.from_host(g)).query_stats(padded)
+    b = PushEngine(PaddedAdjacency.from_host(g)).query_stats(padded)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_packed_push_k0():
+    n, edges = GRAPHS["path"]
+    g = CSRGraph.from_edges(n, edges)
+    eng = PackedPushEngine(PaddedAdjacency.from_host(g))
+    out = np.asarray(eng.f_values(np.zeros((0, 4), dtype=np.int32)))
+    assert out.shape == (0,)
+    assert eng.best(np.zeros((0, 4), dtype=np.int32)) == (-1, -1)
+
+
+def test_packed_push_level_stats_match_query_stats_and_oracle():
+    n, edges = GRAPHS["grid"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 5, max_group=3, seed=510)
+    queries[1] = np.zeros(0, dtype=np.int32)
+    padded = pad_queries(queries)
+    eng = PackedPushEngine(PaddedAdjacency.from_host(g))
+    levels, reached, f, lc, secs = eng.level_stats(padded)
+    w = eng.query_stats(padded)
+    np.testing.assert_array_equal(levels, w[0])
+    np.testing.assert_array_equal(reached, w[1])
+    np.testing.assert_array_equal(f, w[2])
+    assert lc.shape[0] == len(secs) and lc.shape[1] == len(queries)
+    np.testing.assert_array_equal(lc.sum(axis=0), reached)
+    assert (lc[-1] == 0).all()
+    for i, q in enumerate(queries):
+        dist = oracle_bfs(n, edges, q)
+        for d in range(lc.shape[0]):
+            assert lc[d, i] == int((dist == d).sum())
+
+
+def test_packed_push_warmup_never_adapts_capacity():
+    n, edges = generators.grid_edges(60, 60)
+    g = CSRGraph.from_edges(n, edges)
+    eng = PackedPushEngine(PaddedAdjacency.from_host(g))
+    cap0 = eng.capacity
+    assert cap0 > 1024
+    eng.f_values(np.full((4, 3), -1, dtype=np.int32))
+    assert eng.capacity == cap0
+    eng.compile((4, 3))
+    assert eng.capacity == cap0

@@ -31,6 +31,9 @@ BENCH_CHUNK (8), BENCH_REPEATS (3), BENCH_MAX_S (64),
 BENCH_ENGINE (bitbell|bell|packed|vmap|dense|pallas|push, default bitbell),
 BENCH_EDGE_CHUNKS (packed engine HBM knob, default 1),
 BENCH_SPARSE (bitbell hybrid budget; empty=auto, 0=pure pull, no dedup CSR),
+BENCH_LEVEL_CHUNK (bitbell levels per dispatch; empty=unchunked, "auto"=the
+CLI's auto bound resolved in the workload child — config 4's preset uses
+"auto" so the road row always measures the product path),
 BENCH_EXTRA_KS (comma list of extra query counts measured into
 detail.extra_metrics, default "256" — the engine's throughput sweet spot,
 BASELINE.md; empty disables), BENCH_WAIT_S (device-probe budget, default
@@ -194,9 +197,24 @@ def run_workload() -> None:
             # the hybrid AND the dedup-CSR upload (HBM-ceiling experiments).
             sparse_env = os.environ.get("BENCH_SPARSE", "")
             sparse_budget = int(sparse_env) if sparse_env else None
+            # BENCH_LEVEL_CHUNK: levels per dispatch; empty = unchunked;
+            # "auto" = the CLI's current auto bound, resolved HERE in the
+            # workload child (the parent stays jax-import-free for outage
+            # robustness) so a policy retune can never desync the
+            # certified row from the product path.
+            chunk_env = os.environ.get("BENCH_LEVEL_CHUNK", "")
+            if chunk_env == "auto":
+                from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+                    _AUTO_LEVEL_CHUNK,
+                )
+
+                level_chunk = _AUTO_LEVEL_CHUNK
+            else:
+                level_chunk = int(chunk_env) if chunk_env else None
             return BitBellEngine(
                 BellGraph.from_host(g, keep_sparse=sparse_budget != 0),
                 sparse_budget=sparse_budget,
+                level_chunk=level_chunk,
             )
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
             PackedEngine,
@@ -306,9 +324,14 @@ CONFIG_PRESETS = {
           "BENCH_SCALE": "20", "BENCH_K": "64", "BENCH_EXTRA_KS": ""},
     "2c": {"BENCH_GRAPH": "rmat", "BENCH_ENGINE": "bitbell",
            "BENCH_SCALE": "20", "BENCH_K": "256", "BENCH_EXTRA_KS": ""},
-    "4": {"BENCH_GRAPH": "road", "BENCH_ENGINE": "push",
+    # Config 4 measures the CLI's auto route for road-class graphs — the
+    # chunked hybrid bitbell, 6.8x the push engine it used to force
+    # (round-4 shootout, BASELINE.md config 4); BENCH_LEVEL_CHUNK pins
+    # the CLI's auto dispatch bound (cli._AUTO_LEVEL_CHUNK) so the row
+    # includes the safety bound the product pays.
+    "4": {"BENCH_GRAPH": "road", "BENCH_ENGINE": "bitbell",
           "BENCH_SCALE": "20", "BENCH_K": "16", "BENCH_MAX_S": "8",
-          "BENCH_EXTRA_KS": ""},
+          "BENCH_LEVEL_CHUNK": "auto", "BENCH_EXTRA_KS": ""},
 }
 
 

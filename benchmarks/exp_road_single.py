@@ -116,7 +116,8 @@ def main():
         bg = bell_mod.BellGraph.from_host(g)
         for w in bitbell_legs:
             # "bitbell" = unchunked; "bitbellN" = N levels per dispatch
-            # (the CLI's bounded-dispatch policy; N=32 is its auto value).
+            # (the CLI's bounded-dispatch policy; its auto value is
+            # cli._AUTO_LEVEL_CHUNK — 128 since round 4's retune).
             chunk = int(w[len("bitbell"):]) if len(w) > len("bitbell") else None
             leg(
                 f"bitbell (hybrid, chunk={chunk})",

@@ -38,7 +38,45 @@ from .packed import (
 HIT = jnp.uint8
 
 
-def forest_hits(frontier: jax.Array, graph: BellGraph, reduce_fn) -> jax.Array:
+def _slot_segments(shapes, slot_budget: int):
+    """Partition a level's bucket layout into contiguous segments of at
+    most ``slot_budget`` slots (static, trace-time).  Buckets are stored
+    consecutively row-major, so a segment is a contiguous slot range;
+    oversized buckets split at row boundaries (a single row wider than
+    the budget stays whole — rows are the atomic reduce unit).  Returns
+    [[(slot_offset, rows, width), ...], ...] with pieces in layout order.
+    """
+    pieces = []
+    off = 0
+    for r_b, w_b in shapes:
+        if r_b == 0:
+            continue
+        rows_per = max(1, slot_budget // w_b)
+        r0 = 0
+        while r0 < r_b:
+            rc = min(rows_per, r_b - r0)
+            pieces.append((off + r0 * w_b, rc, w_b))
+            r0 += rc
+        off += r_b * w_b
+    segments, cur, cur_slots = [], [], 0
+    for p in pieces:
+        s = p[1] * p[2]
+        if cur and cur_slots + s > slot_budget:
+            segments.append(cur)
+            cur, cur_slots = [], 0
+        cur.append(p)
+        cur_slots += s
+    if cur:
+        segments.append(cur)
+    return segments
+
+
+def forest_hits(
+    frontier: jax.Array,
+    graph: BellGraph,
+    reduce_fn,
+    slot_budget: "int | None" = None,
+) -> jax.Array:
     """Shared BELL reduction-forest traversal.
 
     ``frontier`` is (n, C) of any dtype whose zero value means "not in
@@ -53,6 +91,15 @@ def forest_hits(frontier: jax.Array, graph: BellGraph, reduce_fn) -> jax.Array:
     benchmarks/micro_sparse_step.py), so 20+ small per-bucket takes leave
     throughput on the table.  The per-bucket reduces then slice the
     gathered block by the recorded shapes.
+
+    ``slot_budget`` bounds the gathered intermediate: a level whose slot
+    count exceeds it is gathered in contiguous <=budget-slot segments,
+    each reduced before the next streams in — so the live intermediate is
+    budget*C words instead of slots*C.  This is what lets wide-plane
+    (large C) runs fit one chip: RMAT-24 at K=256 materializes a
+    (557M, 8) u32 gather = 17.8 GB > v5e HBM unchunked (measured OOM,
+    benchmarks/raw_r4/bench_rmat24_k256.json's first attempt) but runs
+    inside the budget.  None = the single merged gather per level.
     """
     c = frontier.shape[1]
     zero_row = jnp.zeros((1, c), dtype=frontier.dtype)
@@ -61,7 +108,7 @@ def forest_hits(frontier: jax.Array, graph: BellGraph, reduce_fn) -> jax.Array:
     for flat, shapes in zip(graph.level_cols, graph.level_shapes):
         if flat.shape[-1] == 0:
             out = jnp.zeros((0, c), dtype=frontier.dtype)
-        else:
+        elif slot_budget is None or flat.shape[-1] <= slot_budget:
             g = jnp.take(v_prev, flat, axis=0)
             parts = []
             off = 0
@@ -71,6 +118,21 @@ def forest_hits(frontier: jax.Array, graph: BellGraph, reduce_fn) -> jax.Array:
                 seg = lax.slice_in_dim(g, off, off + r_b * w_b, axis=0)
                 parts.append(reduce_fn(seg.reshape(r_b, w_b, c)))
                 off += r_b * w_b
+            out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+        else:
+            parts = []
+            for seg_pieces in _slot_segments(shapes, slot_budget):
+                a = seg_pieces[0][0]
+                last = seg_pieces[-1]
+                b = last[0] + last[1] * last[2]
+                g = jnp.take(
+                    v_prev, lax.slice_in_dim(flat, a, b, axis=0), axis=0
+                )
+                o = 0
+                for _, rc, w_b in seg_pieces:
+                    seg = lax.slice_in_dim(g, o, o + rc * w_b, axis=0)
+                    parts.append(reduce_fn(seg.reshape(rc, w_b, c)))
+                    o += rc * w_b
             out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
         outs.append(out)
         v_prev = jnp.concatenate([out, zero_row], axis=0)

@@ -23,6 +23,7 @@ discovers nothing (main.cu:61-71), unreached vertices excluded from F.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional, Tuple
 
@@ -84,13 +85,19 @@ def unpack_counts(words: jax.Array) -> jax.Array:
     return bits.sum(axis=0, dtype=jnp.int32).reshape(w * WORD_BITS)
 
 
-def bell_hits_or(frontier: jax.Array, graph: BellGraph) -> jax.Array:
+def bell_hits_or(
+    frontier: jax.Array, graph: BellGraph, slot_budget=None
+) -> jax.Array:
     """(n, W) uint32 frontier planes -> (n, W) per-vertex hit planes.
 
     The shared reduction-forest traversal (ops.bell.forest_hits) with the
     fixed-width max replaced by OR over the packed word lanes.
+    ``slot_budget`` streams the per-level gather in bounded segments
+    (wide-plane HBM ceiling; see forest_hits).
     """
-    return forest_hits(frontier, graph, lambda g: _or_fold(g, 1))
+    return forest_hits(
+        frontier, graph, lambda g: _or_fold(g, 1), slot_budget=slot_budget
+    )
 
 
 def unpack_byte_planes(words: jax.Array) -> jax.Array:
@@ -174,7 +181,7 @@ def sparse_hits_or(
     return pack_byte_planes(hit_bytes[:n])
 
 
-def hybrid_expand(graph: BellGraph, budget: int):
+def hybrid_expand(graph: BellGraph, budget: int, slot_budget=None):
     """The hybrid pull/push expansion hook for :func:`bit_level_loop`:
     per level, route thin frontiers (<= ``budget`` active vertices and
     outgoing edges) through the push scatter and everything else through
@@ -191,7 +198,7 @@ def hybrid_expand(graph: BellGraph, budget: int):
         new = lax.cond(
             pred,
             lambda vf: sparse_hits_or(vf[1], graph, budget),
-            lambda vf: bell_hits_or(vf[1], graph),
+            lambda vf: bell_hits_or(vf[1], graph, slot_budget),
             (visited, frontier),
         )
         return new & ~visited
@@ -303,12 +310,13 @@ def bit_level_loop(
 _pack_queries_jit = jax.jit(pack_queries, static_argnums=0)
 
 
-@partial(jax.jit, static_argnames=("sparse_budget",))
+@partial(jax.jit, static_argnames=("sparse_budget", "slot_budget"))
 def bitbell_step(
     graph: BellGraph,
     visited: jax.Array,
     frontier: jax.Array,
     sparse_budget: int = 0,
+    slot_budget: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One BFS level for all packed queries; returns (visited', frontier',
     per-query newly-discovered counts).  The stepped form of the while-loop
@@ -316,7 +324,9 @@ def bitbell_step(
     drives the loop so each level can be timed individually; honors the
     hybrid budget so traced levels run the same pull/push routing as the
     production loop."""
-    new = _bitbell_expand(graph, sparse_budget)(visited, frontier)
+    new = _bitbell_expand(graph, sparse_budget, slot_budget)(
+        visited, frontier
+    )
     return visited | new, new, unpack_counts(new)
 
 
@@ -332,19 +342,20 @@ def default_sparse_budget(e: int) -> int:
     return int(min(max(e // 64, 1 << 14), 1 << 23))
 
 
-@partial(jax.jit, static_argnames=("max_levels", "sparse_budget"))
+@partial(jax.jit, static_argnames=("max_levels", "sparse_budget", "slot_budget"))
 def bitbell_run(
     graph: BellGraph,
     queries: jax.Array,
     max_levels: Optional[int] = None,
     sparse_budget: int = 0,
+    slot_budget: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(K, S) queries (K % 32 == 0) -> per-query (f, levels, reached).
 
     ``sparse_budget`` > 0 (and a graph built with ``keep_sparse``) enables
     the hybrid pull/push level loop (:func:`hybrid_expand`)."""
     frontier0 = pack_queries(graph.n, queries)
-    expand_hits = _bitbell_expand(graph, sparse_budget)
+    expand_hits = _bitbell_expand(graph, sparse_budget, slot_budget)
     return bit_level_loop(
         frontier0,
         unpack_counts(frontier0),
@@ -353,7 +364,9 @@ def bitbell_run(
     )
 
 
-def _bitbell_expand(graph: BellGraph, sparse_budget: int):
+def _bitbell_expand(
+    graph: BellGraph, sparse_budget: int, slot_budget: Optional[int] = None
+):
     """The engine's expansion hook: hybrid pull/push when a budget and a
     NON-EMPTY dedup CSR exist, pure forest pull otherwise.  The edge-count
     guard matters: with an empty CSR the sparse branch degenerates to a
@@ -365,10 +378,10 @@ def _bitbell_expand(graph: BellGraph, sparse_budget: int):
         and graph.sparse is not None
         and graph.sparse[2].shape[0] > 0
     ):
-        return hybrid_expand(graph, sparse_budget)
+        return hybrid_expand(graph, sparse_budget, slot_budget)
 
     def expand(visited, frontier):
-        return bell_hits_or(frontier, graph) & ~visited
+        return bell_hits_or(frontier, graph, slot_budget) & ~visited
 
     return expand
 
@@ -379,10 +392,17 @@ def _bitbell_init_carry(graph: BellGraph, queries: jax.Array):
     return bit_level_init(frontier0, unpack_counts(frontier0))
 
 
-@partial(jax.jit, static_argnames=("max_levels", "sparse_budget"))
-def _bitbell_chunk(graph, carry, chunk, max_levels, sparse_budget):
+@partial(
+    jax.jit, static_argnames=("max_levels", "sparse_budget", "slot_budget")
+)
+def _bitbell_chunk(
+    graph, carry, chunk, max_levels, sparse_budget, slot_budget=None
+):
     return bit_level_chunk(
-        carry, _bitbell_expand(graph, sparse_budget), chunk, max_levels
+        carry,
+        _bitbell_expand(graph, sparse_budget, slot_budget),
+        chunk,
+        max_levels,
     )
 
 
@@ -392,6 +412,7 @@ def bitbell_run_chunked(
     level_chunk: int,
     max_levels: Optional[int] = None,
     sparse_budget: int = 0,
+    slot_budget: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """:func:`bitbell_run` with per-dispatch work bounded to ``level_chunk``
     levels: a host loop re-dispatches :func:`bit_level_chunk` with the carry
@@ -404,7 +425,12 @@ def bitbell_run_chunked(
     carry = _bitbell_init_carry(graph, queries)
     while True:
         carry = _bitbell_chunk(
-            graph, carry, jnp.int32(level_chunk), max_levels, sparse_budget
+            graph,
+            carry,
+            jnp.int32(level_chunk),
+            max_levels,
+            sparse_budget,
+            slot_budget,
         )
         if not bool(np.asarray(carry[6])):
             break
@@ -440,6 +466,7 @@ class BitBellEngine(PackedEngineBase):
         max_levels: Optional[int] = None,
         sparse_budget: Optional[int] = None,
         level_chunk: Optional[int] = None,
+        slot_budget: Optional[int] = None,
     ):
         self.graph = graph
         self.max_levels = max_levels
@@ -448,9 +475,43 @@ class BitBellEngine(PackedEngineBase):
             sparse_budget = default_sparse_budget(e) if e else 0
         self.sparse_budget = int(sparse_budget)
         self.level_chunk = validate_level_chunk(level_chunk)
+        # Gather-segment budget (slots) for the wide-plane HBM ceiling
+        # (forest_hits).  None = auto per run (:meth:`_slot_budget_for`);
+        # 0 = never segment; an int forces it.  MSBFS_SLOT_BUDGET mirrors
+        # the constructor arg for the CLI/bench surface.
+        if slot_budget is None:
+            env = os.environ.get("MSBFS_SLOT_BUDGET", "")
+            if env:
+                try:
+                    slot_budget = int(env)
+                except ValueError:
+                    slot_budget = None
+        self._slot_budget_arg = slot_budget
+        self._max_level_slots = max(
+            (f.shape[-1] for f in graph.level_cols), default=0
+        )
         self._level_warm_shapes = set()  # level_stats warms once per shape
 
+    def _slot_budget_for(self, w_words: int) -> Optional[int]:
+        """Static gather-segment budget for a run at W = ``w_words``
+        packed words.  Auto engages only when the biggest level's merged
+        gather intermediate (slots x W x 4 B) would claim more than a
+        third of device memory — exactly the regime where the unchunked
+        take OOMs (measured: RMAT-24 x K=256 wants a 17.8 GB intermediate
+        on a 16 GB v5e, benchmarks/raw_r4/bench_rmat24_k256.json's first
+        attempt); below that the single merged gather is faster and
+        memory is a non-issue."""
+        if self._slot_budget_arg is not None:
+            return self._slot_budget_arg or None  # 0 -> never segment
+        from ..utils.platform import device_hbm_bytes
+
+        hbm = device_hbm_bytes()
+        if self._max_level_slots * 4 * w_words <= hbm // 3:
+            return None
+        return max(1 << 22, (hbm // 4) // (4 * w_words))
+
     def _bitbell_run(self, queries):
+        slot_budget = self._slot_budget_for(queries.shape[0] // WORD_BITS)
         if self.level_chunk:
             return bitbell_run_chunked(
                 self.graph,
@@ -458,9 +519,14 @@ class BitBellEngine(PackedEngineBase):
                 self.level_chunk,
                 self.max_levels,
                 self.sparse_budget,
+                slot_budget,
             )
         return bitbell_run(
-            self.graph, queries, self.max_levels, self.sparse_budget
+            self.graph,
+            queries,
+            self.max_levels,
+            self.sparse_budget,
+            slot_budget,
         )
 
     def f_values(self, queries) -> jax.Array:

@@ -149,6 +149,30 @@ def test_fuzz_push_matches_oracle(seed):
     np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")
 
 
+@pytest.mark.parametrize("seed", [4500, 4501, 4502])
+def test_fuzz_packed_push_matches_oracle(seed):
+    """Union-frontier packed-lane push (round 4) on random shapes, with
+    tiny random capacities forcing the overflow/growth protocol over the
+    UNION queue."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push import (
+        PaddedAdjacency,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push_packed import (
+        PackedPushEngine,
+    )
+
+    rng = np.random.default_rng(seed)
+    n, edges, queries = random_problem(rng)
+    g = CSRGraph.from_edges(n, edges)
+    padded = pad_queries(queries)
+    eng = PackedPushEngine(PaddedAdjacency.from_host(g, max_width=1024))
+    if rng.random() < 0.5:
+        eng.capacity = int(rng.integers(1, 8))  # force auto-grow retries
+    got = np.asarray(eng.f_values(padded))
+    want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")
+
+
 @pytest.mark.parametrize("seed", [5000, 5001])
 def test_fuzz_distributed_push_matches_oracle(seed):
     if len(jax.devices()) < 8:

@@ -72,7 +72,14 @@ _AUTO_LEVEL_CHUNK = 128
 # Backends with no distributed variant: at -gn > 1 they warn and fall back
 # to the distributed bitbell.  ("csr"/"vmap" map to the per-query pull and
 # "push" to real multi-chip routes, so they are absent here.)
-_SINGLE_CHIP_ONLY_BACKENDS = ("dense", "pallas", "bell", "packed", "ppush")
+_SINGLE_CHIP_ONLY_BACKENDS = (
+    "dense",
+    "pallas",
+    "bell",
+    "packed",
+    "ppush",
+    "stencil",
+)
 # Backends whose HBM footprint the bitbell estimate does not model — the
 # single-chip capacity warning stays quiet for these.
 _NON_BITBELL_FOOTPRINT_BACKENDS = _SINGLE_CHIP_ONLY_BACKENDS + (
@@ -418,26 +425,76 @@ def main(argv: Optional[List[str]] = None) -> int:
             # n^2 adjacency fits HBM; "auto" picks it for small graphs on
             # MXU-bearing devices only.
             backend = os.environ.get("MSBFS_BACKEND", "auto")
-            if (
+            hbm_warn = (
                 hbm_need > hbm_have
                 and backend not in _NON_BITBELL_FOOTPRINT_BACKENDS
-            ):
-                # The estimate models the default (hybrid bitbell) engine,
-                # which also serves unrecognized MSBFS_BACKEND values; the
-                # recognized non-bitbell backends have different
-                # footprints, so stay quiet for those.
-                print(
-                    f"warning: graph needs ~{hbm_need >> 20} MiB but one "
-                    f"chip has {hbm_have >> 20} MiB; run with -gn > 1 to "
-                    "auto-shard the CSR (this run may exhaust memory)",
-                    file=sys.stderr,
-                )
+            )
             # Every single-chip backend honors level_chunk (round 4):
             # the generic Engine (dense/vmap/pallas), BellEngine and
             # PackedEngine run the host-chunked distance loop
             # (ops.bfs.host_chunked_loop), bitbell its bit-plane dual,
             # and the push engine chunks natively — so no backend choice
             # can reach an unbounded dispatch.
+            #
+            # Stencil routing (round 5): road-class graphs are probed for
+            # a banded adjacency decomposition — lattices/grids, where
+            # frontier expansion is a handful of masked shifts instead of
+            # gathers, breaking the per-level gather/compaction floor on
+            # thousands-of-levels BFS (ops.stencil).  Auto-only on
+            # road-class profiles (the O(m) host probe is skipped for
+            # power-law graphs); MSBFS_STENCIL=0 disables,
+            # MSBFS_BACKEND=stencil forces (error if not banded).
+            engine = None
+            if backend == "stencil" or (
+                backend == "auto"
+                and road_class
+                and os.environ.get("MSBFS_STENCIL", "") != "0"
+            ):
+                from .ops.stencil import (
+                    AUTO_STENCIL_LEVEL_CHUNK,
+                    StencilEngine,
+                    StencilGraph,
+                )
+
+                try:
+                    sg = StencilGraph.from_host(graph)
+                except ValueError as exc:
+                    if backend == "stencil":
+                        print(str(exc), file=sys.stderr)
+                        return 1
+                    sg = None  # auto probe failed: keep the gather engines
+                if sg is not None:
+                    # Stencil levels are gather-free bandwidth streams, so
+                    # the auto dispatch bound can be much larger than the
+                    # gather engines' (ops.stencil); an explicit
+                    # MSBFS_LEVEL_CHUNK still wins.
+                    stencil_chunk = (
+                        level_chunk
+                        if explicit_chunk is not None
+                        else (AUTO_STENCIL_LEVEL_CHUNK if level_chunk else None)
+                    )
+                    print(
+                        "banded adjacency detected: stencil engine "
+                        f"({len(sg.offsets)} offsets, "
+                        f"{int(sg.res_src.shape[0])} residual edges, "
+                        f"{stencil_chunk or 'unbounded'} levels/dispatch; "
+                        "MSBFS_STENCIL=0 disables)",
+                        file=sys.stderr,
+                    )
+                    engine = StencilEngine(sg, level_chunk=stencil_chunk)
+            if hbm_warn and engine is None:
+                # The estimate models the default (hybrid bitbell) engine,
+                # which also serves unrecognized MSBFS_BACKEND values; the
+                # recognized non-bitbell backends have different
+                # footprints, and the stencil route (decided above) has a
+                # far smaller one — warning there would steer users OFF
+                # the engine that fits (review r5).
+                print(
+                    f"warning: graph needs ~{hbm_need >> 20} MiB but one "
+                    f"chip has {hbm_have >> 20} MiB; run with -gn > 1 to "
+                    "auto-shard the CSR (this run may exhaust memory)",
+                    file=sys.stderr,
+                )
             use_dense = backend == "dense"
             if backend == "auto" and is_tpu_backend():
                 threshold = _env_int("MSBFS_DENSE_THRESHOLD", 8192)
@@ -447,7 +504,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # A mis-detected profile is now a perf miss, not a safety
                 # hole — the dense loop is bounded too.
                 use_dense = graph.n <= threshold and not road_class
-            if use_dense:
+            if engine is not None:
+                pass  # stencil route above
+            elif use_dense:
                 from .ops.dense import DenseGraph
 
                 engine = Engine(

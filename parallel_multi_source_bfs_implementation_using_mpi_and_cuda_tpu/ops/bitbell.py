@@ -557,6 +557,11 @@ class BitBellEngine(PackedEngineBase):
         import time
 
         queries, k = self._pad_queries(queries)
+        # Same gather-segment budget as the production run: without it the
+        # traced step materializes the full merged per-level gather and can
+        # OOM on exactly the wide-plane shapes (RMAT-24 x K=256) that the
+        # production path streams within budget (ADVICE r4).
+        slot_budget = self._slot_budget_for(queries.shape[0] // WORD_BITS)
         pack = partial(_pack_queries_jit, self.graph.n)
         # Warm both programs ONCE PER SHAPE so the timed rows measure
         # execution, not XLA compilation.  compile(warm_levels=True) routes
@@ -569,7 +574,11 @@ class BitBellEngine(PackedEngineBase):
             warm_frontier = pack(queries)
             np.asarray(
                 bitbell_step(
-                    self.graph, warm_frontier, warm_frontier, self.sparse_budget
+                    self.graph,
+                    warm_frontier,
+                    warm_frontier,
+                    self.sparse_budget,
+                    slot_budget,
                 )[2]
             )
             self._level_warm_shapes.add(queries.shape)
@@ -588,7 +597,7 @@ class BitBellEngine(PackedEngineBase):
                 break
             t0 = time.perf_counter()
             visited, frontier, c = bitbell_step(
-                self.graph, visited, frontier, self.sparse_budget
+                self.graph, visited, frontier, self.sparse_budget, slot_budget
             )
             counts = np.asarray(c)
             level_seconds.append(time.perf_counter() - t0)

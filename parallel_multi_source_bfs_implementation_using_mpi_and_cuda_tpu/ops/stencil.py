@@ -1,0 +1,372 @@
+"""Banded-adjacency ("stencil") BFS: frontier expansion as masked shifts.
+
+Road networks generated on lattices — and banded graphs generally — have a
+degenerate adjacency structure: almost every directed edge (u, v) has a
+diff ``d = v - u`` drawn from a handful of values (a 2D grid with diagonal
+links has |{±1, ±cols, ±(cols-1), ±(cols+1)}| = 8).  For such graphs the
+per-level neighbor reduce needs NO gathers at all: for each diff d, the
+vertices reachable along d-edges are ``shift(frontier & mask_d, d)`` — a
+contiguous slice-and-pad plus an AND, which the VPU executes at HBM
+bandwidth.  The per-level cost is O(#diffs * n * W) streamed bytes with no
+scatter, no compaction, and no index arithmetic — this is what breaks the
+~5.6 ms/level floor the gather/scatter engines pay on high-diameter
+graphs (VERDICT r4 item 1; docs/PERF_NOTES.md "Round-4 on-chip road
+findings").
+
+Edges whose diff is NOT in the dominant set (e.g. the ~0.05% highway
+shortcuts of the config-4 generator) go to a fixed-size RESIDUAL list,
+expanded per level by one bounded row-gather + byte-lane scatter-OR — the
+same collision-safe primitive as ops.bitbell.sparse_hits_or.  Any graph
+therefore decomposes as stencil + residual; :func:`detect_stencil` routes
+a graph here only when the residual is tiny, so unstructured graphs keep
+their gather engines.
+
+Semantics are the reference's exactly (main.cu:16-89): level-synchronous
+expansion until a level discovers nothing, -1/out-of-range sources dropped
+(main.cu:49), unreached vertices excluded from F — pinned bit-identical to
+the bitbell engine by tests/test_stencil.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .bfs import validate_level_chunk
+from .bitbell import (
+    WORD_BITS,
+    bit_level_chunk,
+    bit_level_init,
+    bit_level_loop,
+    pack_byte_planes,
+    pack_queries,
+    unpack_byte_planes,
+    unpack_counts,
+)
+from .packed import PackedEngineBase
+
+# Routing defaults: at most this many distinct diffs, covering all but
+# MAX_RESIDUAL_FRAC of directed edges.  16 masked shift passes already
+# stream ~16x the plane bytes per level; beyond that the reduction-forest
+# gather is competitive again.
+MAX_OFFSETS = 16
+MAX_RESIDUAL_FRAC = 0.02
+
+
+@jax.tree_util.register_pytree_node_class
+class StencilGraph:
+    """Host-built stencil decomposition of a CSR graph.
+
+    ``offsets``: tuple of nonzero int diffs, each with an (n,) uint8 mask —
+    mask_d[u] = 1 iff directed edge (u, u+d) exists.  ``res_src/res_dst``:
+    residual directed edges (diffs outside ``offsets``), padded to a static
+    length with the sentinel n (dropped by the scatter).  Self-loops (d=0)
+    never change reachability and are dropped entirely.
+    """
+
+    def __init__(self, n, num_directed_edges, offsets, masks, res_src, res_dst):
+        self.n = n
+        self.num_directed_edges = num_directed_edges
+        self.offsets = offsets  # static python ints
+        self.masks = masks  # (n, len(offsets)) uint8 device array
+        self.res_src = res_src  # (R_pad,) int32, sentinel n
+        self.res_dst = res_dst
+
+    @staticmethod
+    def from_host(
+        graph,
+        max_offsets: int = MAX_OFFSETS,
+        max_residual_frac: float = MAX_RESIDUAL_FRAC,
+    ) -> "StencilGraph":
+        """Build from a host CSRGraph; raises ValueError when the graph is
+        not banded enough (see :func:`detect_stencil` for the no-raise
+        routing probe)."""
+        dec = detect_stencil(graph, max_offsets, max_residual_frac)
+        if dec is None:
+            raise ValueError(
+                "graph is not banded: no small diff set covers "
+                f"{1 - max_residual_frac:.0%} of edges "
+                "(MSBFS_BACKEND=stencil needs a lattice/banded graph)"
+            )
+        offsets, masks, res_src, res_dst = dec
+        return StencilGraph(
+            graph.n,
+            graph.num_directed_edges,
+            offsets,
+            jnp.asarray(masks),
+            jnp.asarray(res_src),
+            jnp.asarray(res_dst),
+        )
+
+    def tree_flatten(self):
+        return (
+            (self.masks, self.res_src, self.res_dst),
+            (self.n, self.num_directed_edges, self.offsets),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        n, e, offsets = aux
+        masks, res_src, res_dst = children
+        return cls(n, e, offsets, masks, res_src, res_dst)
+
+
+def _edge_arrays(graph):
+    """(src, dst) int64 directed-edge arrays from a host CSRGraph."""
+    deg = np.diff(np.asarray(graph.row_offsets))
+    src = np.repeat(np.arange(graph.n, dtype=np.int64), deg)
+    dst = np.asarray(graph.col_indices, dtype=np.int64)
+    return src, dst
+
+
+def detect_stencil(
+    graph,
+    max_offsets: int = MAX_OFFSETS,
+    max_residual_frac: float = MAX_RESIDUAL_FRAC,
+):
+    """Probe a host CSRGraph for a banded decomposition.
+
+    Returns (offsets, masks, res_src, res_dst) — offsets a tuple of python
+    ints, masks (n, #offsets) uint8, residual arrays int32 sentinel-padded
+    — or None when no ``max_offsets``-diff set covers at least
+    ``1 - max_residual_frac`` of the directed edges.  Cost: O(m) NumPy
+    passes on the host, paid once in the preprocessing span.
+    """
+    n, m = graph.n, graph.num_directed_edges
+    if n == 0 or m == 0:
+        return None
+    src, dst = _edge_arrays(graph)
+    diffs = dst - src
+    nz = diffs != 0  # self-loops never change reachability
+    vals, counts = np.unique(diffs[nz], return_counts=True)
+    if vals.size == 0:
+        # All edges are self-loops: empty stencil, empty residual.
+        return (
+            (),
+            np.zeros((n, 0), dtype=np.uint8),
+            np.zeros(0, dtype=np.int32),
+            np.zeros(0, dtype=np.int32),
+        )
+    order = np.argsort(counts)[::-1]
+    top = order[:max_offsets]
+    covered = counts[top].sum()
+    # Residual counts self-loop edges as covered (they are dropped, which
+    # is exact for BFS reachability).
+    if (diffs[nz].size - covered) > max_residual_frac * m:
+        return None
+    offsets = tuple(int(v) for v in vals[top])
+    masks = np.zeros((n, len(offsets)), dtype=np.uint8)
+    in_set = np.isin(diffs, vals[top]) & nz
+    if len(offsets):
+        # Vectorized diff -> offset-column mapping (searchsorted over the
+        # sorted diff set; O(m log #offsets), no python loop).
+        off_arr = np.fromiter(offsets, dtype=np.int64, count=len(offsets))
+        sorter = np.argsort(off_arr)
+        cols = sorter[
+            np.searchsorted(off_arr[sorter], diffs[in_set])
+        ]
+        masks[src[in_set], cols] = 1
+    res = nz & ~in_set
+    res_src = src[res].astype(np.int32)
+    res_dst = dst[res].astype(np.int32)
+    return offsets, masks, res_src, res_dst
+
+
+def _shift_planes(planes: jax.Array, d: int) -> jax.Array:
+    """Flat-id shift: out[i + d] = planes[i], zero fill (rows sliding past
+    either end drop — their edges do not exist by mask construction)."""
+    n = planes.shape[0]
+    if d == 0 or abs(d) >= n:
+        return jnp.zeros_like(planes) if d else planes
+    pad = jnp.zeros((abs(d), planes.shape[1]), dtype=planes.dtype)
+    if d > 0:
+        return jnp.concatenate([pad, planes[: n - d]], axis=0)
+    return jnp.concatenate([planes[-d:], pad], axis=0)
+
+
+def stencil_hits(frontier: jax.Array, graph: StencilGraph) -> jax.Array:
+    """(n, W) uint32 frontier planes -> (n, W) per-vertex hit planes via
+    masked shifts + the bounded residual scatter."""
+    hits = jnp.zeros_like(frontier)
+    for i, d in enumerate(graph.offsets):
+        masked = jnp.where(
+            graph.masks[:, i : i + 1] != 0, frontier, jnp.uint32(0)
+        )
+        hits = hits | _shift_planes(masked, d)
+    r = graph.res_src.shape[0]
+    if r:
+        n = graph.n
+        safe_src = jnp.minimum(graph.res_src, n - 1)
+        src_words = jnp.where(
+            (graph.res_src < n)[:, None],
+            jnp.take(frontier, safe_src, axis=0),
+            jnp.uint32(0),
+        )
+        src_bytes = unpack_byte_planes(src_words)  # (R, K) 0/1
+        hit_bytes = (
+            jnp.zeros((n + 1, src_bytes.shape[1]), jnp.uint8)
+            .at[graph.res_dst]
+            .max(src_bytes)
+        )
+        hits = hits | pack_byte_planes(hit_bytes[:n])
+    return hits
+
+
+def _stencil_expand(graph: StencilGraph):
+    def expand(visited, frontier):
+        return stencil_hits(frontier, graph) & ~visited
+
+    return expand
+
+
+@partial(jax.jit, static_argnames=("max_levels",))
+def stencil_run(
+    graph: StencilGraph,
+    queries: jax.Array,
+    max_levels: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(K, S) queries (K % 32 == 0) -> per-query (f, levels, reached),
+    whole BFS in one dispatch."""
+    frontier0 = pack_queries(graph.n, queries)
+    return bit_level_loop(
+        frontier0, unpack_counts(frontier0), _stencil_expand(graph), max_levels
+    )
+
+
+@jax.jit
+def _stencil_init_carry(graph: StencilGraph, queries: jax.Array):
+    frontier0 = pack_queries(graph.n, queries)
+    return bit_level_init(frontier0, unpack_counts(frontier0))
+
+
+@partial(jax.jit, static_argnames=("max_levels",))
+def _stencil_chunk(graph, carry, chunk, max_levels):
+    return bit_level_chunk(carry, _stencil_expand(graph), chunk, max_levels)
+
+
+@jax.jit
+def stencil_step(graph: StencilGraph, visited, frontier):
+    """One traced BFS level (the MSBFS_STATS=2 stepped mode)."""
+    new = _stencil_expand(graph)(visited, frontier)
+    return visited | new, new, unpack_counts(new)
+
+
+# Stencil levels stream ~#offsets * n * W words with no gather/scatter, so
+# a dispatch of even a thousand levels is far below the per-dispatch work
+# that crashed the TPU worker on the gather engines (docs/PERF_NOTES.md
+# "Push-engine TPU status") — while the ~100 ms tunnel dispatch floor
+# makes SMALL chunks expensive on ~2000-level graphs (cli._AUTO_LEVEL_CHUNK
+# discussion).  1024 keeps the safety bound in kind at ~2 dispatches per
+# road-1024 BFS.
+AUTO_STENCIL_LEVEL_CHUNK = 1024
+
+
+class StencilEngine(PackedEngineBase):
+    """All-queries-at-once masked-shift engine over a StencilGraph.
+
+    The bit-plane loop, counters and query padding are shared with
+    ops.bitbell (bit_level_loop and friends); only the per-level expansion
+    differs.  ``level_chunk`` bounds levels per dispatch
+    (AUTO_STENCIL_LEVEL_CHUNK when the CLI routes here)."""
+
+    k_align = WORD_BITS
+
+    def __init__(
+        self,
+        graph: StencilGraph,
+        max_levels: Optional[int] = None,
+        level_chunk: Optional[int] = None,
+    ):
+        self.graph = graph
+        self.max_levels = max_levels
+        self.level_chunk = validate_level_chunk(level_chunk)
+        self._level_warm_shapes = set()
+
+    def _run(self, queries):
+        if self.level_chunk:
+            carry = _stencil_init_carry(self.graph, queries)
+            while True:
+                carry = _stencil_chunk(
+                    self.graph,
+                    carry,
+                    jnp.int32(self.level_chunk),
+                    self.max_levels,
+                )
+                if not bool(np.asarray(carry[6])):
+                    break
+                if (
+                    self.max_levels is not None
+                    and int(np.asarray(carry[5])) >= self.max_levels
+                ):
+                    break
+            return carry[2], carry[3], carry[4]
+        return stencil_run(self.graph, queries, self.max_levels)
+
+    def f_values(self, queries) -> jax.Array:
+        queries, k = self._pad_queries(queries)
+        f, _, _ = self._run(queries)
+        return f[:k]
+
+    def query_stats(self, queries):
+        queries, k = self._pad_queries(queries)
+        f, levels, reached = self._run(queries)
+        return (
+            np.asarray(levels)[:k],
+            np.asarray(reached)[:k],
+            np.asarray(f)[:k],
+        )
+
+    def level_stats(self, queries):
+        """Per-level trace (MSBFS_STATS=2): host-driven stepped BFS, one
+        dispatch per level — same contract as BitBellEngine.level_stats."""
+        import time
+
+        from .bitbell import _pack_queries_jit
+
+        queries, k = self._pad_queries(queries)
+        pack = partial(_pack_queries_jit, self.graph.n)
+        if queries.shape not in self._level_warm_shapes:
+            warm = pack(queries)
+            np.asarray(stencil_step(self.graph, warm, warm)[2])
+            self._level_warm_shapes.add(queries.shape)
+        t0 = time.perf_counter()
+        frontier = pack(queries)
+        counts = np.asarray(unpack_counts(frontier))
+        dt = time.perf_counter() - t0
+        visited = frontier
+        level_counts = [counts]
+        level_seconds = [dt]
+        while counts.any():
+            if (
+                self.max_levels is not None
+                and len(level_counts) > self.max_levels
+            ):
+                break
+            t0 = time.perf_counter()
+            visited, frontier, c = stencil_step(self.graph, visited, frontier)
+            counts = np.asarray(c)
+            level_seconds.append(time.perf_counter() - t0)
+            level_counts.append(counts)
+        lc = np.stack(level_counts)
+        dists = np.arange(lc.shape[0], dtype=np.int64)
+        f = (lc.astype(np.int64) * dists[:, None]).sum(axis=0)
+        reached = lc.sum(axis=0, dtype=np.int32)
+        any_at = lc > 0
+        maxdist = np.where(
+            any_at.any(axis=0),
+            any_at.shape[0] - 1 - any_at[::-1].argmax(axis=0),
+            -1,
+        )
+        levels = (maxdist + 1).astype(np.int32)
+        return (
+            levels[:k],
+            reached[:k],
+            f[:k],
+            lc[:, :k],
+            np.asarray(level_seconds),
+        )

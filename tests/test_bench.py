@@ -59,6 +59,18 @@ def test_success_emits_metric_and_extras():
     extras = rec["detail"]["extra_metrics"]
     assert len(extras) == 1 and extras[0]["value"] > 0
     assert "64-query" in extras[0]["metric"]
+    # Per-config reference model fields (r5): modeled denominator + the
+    # dispatch-floor split + gather utilization.
+    d = rec["detail"]
+    assert d["levels_sum"] and d["levels_sum"] >= d["levels_max"] > 0
+    assert d["ref_model"]["teps"] > 0 and d["ref_model"]["t_s"] > 0
+    assert rec["vs_baseline"] == pytest.approx(
+        rec["value"] / d["ref_model"]["teps"], rel=0.01
+    )
+    assert d["vs_flat_1g5"] is not None
+    assert d["dispatch"]["floor_s"] > 0
+    assert d["dispatch"]["n_dispatches"] >= 2
+    assert d["gather_rows_per_s"] > 0 and d["pct_of_roofline"] > 0
 
 
 def test_outage_fast_parsable_failure():
@@ -77,7 +89,7 @@ def test_outage_fast_parsable_failure():
 
 
 @pytest.mark.slow
-def test_configs_sweep_partial_failure_keeps_partial_results():
+def test_configs_sweep_partial_failure_keeps_partial_results(tmp_path):
     """BENCH_CONFIGS (round 4): one capture certifies several configs,
     each with its own value/error — an unknown config cannot zero the
     ones that measured."""
@@ -89,6 +101,7 @@ def test_configs_sweep_partial_failure_keeps_partial_results():
             "BENCH_MAX_S": "8",
             "BENCH_WAIT_S": "120",
             "BENCH_RUN_S": "540",
+            "BENCH_DETAIL_PATH": str(tmp_path / "sweep_detail.json"),
         },
         timeout=1200,
     )
@@ -109,14 +122,26 @@ def test_configs_sweep_partial_failure_keeps_partial_results():
         if l.lstrip().startswith("{")
     ]
     assert len(lines) == 3
+    # VERDICT r4 item 2: the stdout record is COMPACT — the driver's tail
+    # window must always contain one complete JSON line.  The full sweep
+    # detail lives in the sidecar (detail_path).
+    assert all(len(l) < 4096 for l in lines), max(map(len, lines))
+    dp = rec["detail"]["detail_path"]
+    assert dp and os.path.exists(os.path.join(REPO_ROOT, dp))
+    with open(os.path.join(REPO_ROOT, dp)) as fh:
+        full = json.load(fh)
+    full_sweep = full["detail"]["sweep"]
+    assert full_sweep["1"]["detail"]["computation_s"] > 0
+    assert full_sweep["1"]["detail"]["ref_model"]["teps"] > 0
 
 
-def test_configs_sweep_outage_is_one_parsable_record():
+def test_configs_sweep_outage_is_one_parsable_record(tmp_path):
     proc = run_bench(
         {
             "BENCH_CONFIGS": "1,2",
             "JAX_PLATFORMS": "bogus_platform",
             "BENCH_WAIT_S": "1",
+            "BENCH_DETAIL_PATH": str(tmp_path / "sweep_detail.json"),
         },
         timeout=180,
     )

@@ -256,6 +256,117 @@ def test_estimate_hbm_bytes_routing_properties():
     assert est(1 << 20, 1 << 26, 64) > one  # monotone in e
 
 
+class TestSlotBudget:
+    """Gather-segment streaming (forest_hits slot_budget) must be bit-exact
+    with the single merged gather (slot_budget=0) at ANY budget — including
+    budgets far below every bucket width, where each row becomes its own
+    segment.  This is the path that keeps wide-plane runs (RMAT-24 x K=256)
+    inside HBM; CI never reaches that regime organically, so we force it
+    (ADVICE r4, medium)."""
+
+    def _graphs(self):
+        # Multi-bucket level layouts: rmat (power-law -> several widths),
+        # road/grid (uniform low degree), star (one max-width bucket).
+        yield "rmat", generators.rmat_edges(9, edge_factor=12, seed=911)
+        yield "road", generators.road_edges(24, 24, seed=912)
+        n = 40
+        hub = np.stack(
+            [np.zeros(n - 1, dtype=np.int64), np.arange(1, n, dtype=np.int64)],
+            axis=1,
+        )
+        yield "star", (n, hub)
+
+    @pytest.mark.parametrize("budget", [1, 7, 64])
+    def test_slot_budget_matches_unsegmented(self, budget):
+        for name, (n, edges) in self._graphs():
+            g = CSRGraph.from_edges(n, edges)
+            bg = BellGraph.from_host(g)
+            queries = generators.random_queries(n, 37, max_group=4, seed=913)
+            queries[1] = np.zeros(0, dtype=np.int32)
+            padded = pad_queries(queries)
+            base = BitBellEngine(bg, sparse_budget=0, slot_budget=0)
+            want = base.query_stats(padded)
+            seg = BitBellEngine(bg, sparse_budget=0, slot_budget=budget)
+            for a, b in zip(want, seg.query_stats(padded)):
+                np.testing.assert_array_equal(a, b, err_msg=f"{name}/{budget}")
+
+    @pytest.mark.parametrize("budget", [7, 64])
+    def test_slot_budget_hybrid_and_chunked(self, budget):
+        for name, (n, edges) in self._graphs():
+            g = CSRGraph.from_edges(n, edges)
+            bg = BellGraph.from_host(g)
+            queries = generators.random_queries(n, 33, max_group=4, seed=914)
+            padded = pad_queries(queries)
+            want = BitBellEngine(bg, sparse_budget=0, slot_budget=0).query_stats(
+                padded
+            )
+            # Hybrid pull/push: dense levels stream within budget, thin
+            # levels take the push scatter — same counters either way.
+            hyb = BitBellEngine(bg, sparse_budget=32, slot_budget=budget)
+            for a, b in zip(want, hyb.query_stats(padded)):
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"hybrid {name}/{budget}"
+                )
+            # Host-chunked dispatch loop on top of segmented gathers.
+            chk = BitBellEngine(
+                bg, sparse_budget=0, slot_budget=budget, level_chunk=2
+            )
+            for a, b in zip(want, chk.query_stats(padded)):
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"chunked {name}/{budget}"
+                )
+
+    def test_slot_budget_level_stats_parity(self):
+        """MSBFS_STATS=2's stepped trace honors the budget (ADVICE r4, low):
+        stats from the traced loop must match the production loop when a
+        tiny budget forces segmentation in both."""
+        n, edges = generators.road_edges(16, 16, seed=915)
+        g = CSRGraph.from_edges(n, edges)
+        bg = BellGraph.from_host(g)
+        queries = pad_queries(
+            generators.random_queries(n, 5, max_group=3, seed=916)
+        )
+        eng = BitBellEngine(bg, sparse_budget=0, slot_budget=13)
+        levels, reached, f, lc, secs = eng.level_stats(queries)
+        want = eng.query_stats(queries)
+        np.testing.assert_array_equal(levels, want[0])
+        np.testing.assert_array_equal(reached, want[1])
+        np.testing.assert_array_equal(f, want[2])
+        assert lc.shape[0] == len(secs)
+
+    def test_msbfs_slot_budget_env(self, monkeypatch):
+        n, edges = GRAPHS["gnm"]
+        g = CSRGraph.from_edges(n, edges)
+        bg = BellGraph.from_host(g)
+        monkeypatch.setenv("MSBFS_SLOT_BUDGET", "17")
+        eng = BitBellEngine(bg)
+        assert eng._slot_budget_arg == 17
+        assert eng._slot_budget_for(2) == 17
+        # 0 = never segment, even where auto would engage.
+        monkeypatch.setenv("MSBFS_SLOT_BUDGET", "0")
+        assert BitBellEngine(bg)._slot_budget_for(2) is None
+        # Malformed value falls back to auto (None arg), like every other
+        # env knob in the package.
+        monkeypatch.setenv("MSBFS_SLOT_BUDGET", "banana")
+        assert BitBellEngine(bg)._slot_budget_arg is None
+        # Constructor arg wins over env.
+        monkeypatch.setenv("MSBFS_SLOT_BUDGET", "99")
+        assert BitBellEngine(bg, slot_budget=5)._slot_budget_arg == 5
+        # Env parse happens at construction: results must match the
+        # unsegmented engine bit-for-bit.
+        monkeypatch.setenv("MSBFS_SLOT_BUDGET", "9")
+        queries = pad_queries(
+            generators.random_queries(n, 6, max_group=3, seed=917)
+        )
+        a = BitBellEngine(bg, sparse_budget=0).query_stats(queries)
+        monkeypatch.delenv("MSBFS_SLOT_BUDGET")
+        b = BitBellEngine(bg, sparse_budget=0, slot_budget=0).query_stats(
+            queries
+        )
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+
 def test_sparse_hits_or_edgeless_graph():
     """Forcing a sparse budget on an edgeless graph must be well-defined:
     the dedup CSR is empty, and the general path's index arithmetic would

@@ -221,17 +221,85 @@ def _assert_report(out, want, gn):
 
 def test_road_class_auto_chunk_gn1_vs_gn8(road_files, capsys, monkeypatch):
     """The -gn 1 and -gn 8 paths agree on a high-diameter graph, and both
-    announce the bounded-dispatch routing (reference: any graph at any
-    -gn, main.cu:303-322)."""
+    announce their deep-graph routing (reference: any graph at any
+    -gn, main.cu:303-322).  Since round 5 the single-chip auto route for
+    banded graphs is the stencil engine; -gn > 1 keeps the bounded
+    gather engines."""
     gpath, qpath, want = road_files
     monkeypatch.delenv("MSBFS_LEVEL_CHUNK", raising=False)
-    for gn in (1, 8):
-        rc, out, err = run_cli(
-            ["main.py", "-g", gpath, "-q", qpath, "-gn", str(gn)], capsys
-        )
-        assert rc == 0
-        assert "road-class degree profile" in err
-        _assert_report(out, want, gn)
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "1"], capsys
+    )
+    assert rc == 0
+    assert "banded adjacency detected" in err
+    _assert_report(out, want, 1)
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "8"], capsys
+    )
+    assert rc == 0
+    assert "road-class degree profile" in err
+    _assert_report(out, want, 8)
+
+
+def test_stencil_routing_knobs(road_files, files, capsys, monkeypatch):
+    """MSBFS_STENCIL=0 restores the gather route; MSBFS_BACKEND=stencil
+    forces the engine (hard error on non-banded graphs); at -gn > 1 the
+    stencil backend warns single-chip-only and falls back."""
+    gpath, qpath, want = road_files
+    monkeypatch.delenv("MSBFS_LEVEL_CHUNK", raising=False)
+    monkeypatch.setenv("MSBFS_STENCIL", "0")
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "1"], capsys
+    )
+    assert rc == 0
+    assert "banded adjacency" not in err
+    assert "road-class degree profile" in err
+    _assert_report(out, want, 1)
+    monkeypatch.delenv("MSBFS_STENCIL")
+    # Forced stencil on a banded graph: same report.
+    monkeypatch.setenv("MSBFS_BACKEND", "stencil")
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "1"], capsys
+    )
+    assert rc == 0 and "banded adjacency detected" in err
+    _assert_report(out, want, 1)
+    # Forced stencil on a non-banded (gnm) graph: engine-choice error.
+    g2, q2, _ = files
+    rc, out, err = run_cli(
+        ["main.py", "-g", g2, "-q", q2, "-gn", "1"], capsys
+    )
+    assert rc == 1 and "not banded" in err
+    # At -gn > 1: single-chip-only warning + distributed fallback.
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "8"], capsys
+    )
+    assert rc == 0
+    assert "single-chip only" in err
+    _assert_report(out, want, 8)
+
+
+def test_hbm_warning_suppressed_on_stencil_route(
+    road_files, capsys, monkeypatch
+):
+    """The single-chip capacity warning models the bitbell footprint; when
+    the stencil route (far smaller footprint) is taken it must stay quiet
+    — it would otherwise steer users off the engine that fits (r5)."""
+    gpath, qpath, want = road_files
+    monkeypatch.setenv("MSBFS_HBM_BYTES", "4096")
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "1"], capsys
+    )
+    assert rc == 0
+    assert "banded adjacency detected" in err
+    assert "run with -gn > 1" not in err
+    _assert_report(out, want, 1)
+    # With the stencil route disabled the same graph warns again.
+    monkeypatch.setenv("MSBFS_STENCIL", "0")
+    rc, out, err = run_cli(
+        ["main.py", "-g", gpath, "-q", qpath, "-gn", "1"], capsys
+    )
+    assert rc == 0 and "run with -gn > 1" in err
+    _assert_report(out, want, 1)
 
 
 def test_road_class_vertex_sharded_chunked(road_files, capsys, monkeypatch):

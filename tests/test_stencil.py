@@ -1,0 +1,175 @@
+"""Stencil (banded-adjacency) engine: detection, oracle parity, and
+bit-identity with the bitbell engine on lattice-class graphs."""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+    BitBellEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+    StencilEngine,
+    StencilGraph,
+    detect_stencil,
+)
+
+from oracle import oracle_bfs, oracle_f
+
+
+def oracle_f_values(n, edges, queries):
+    return [oracle_f(oracle_bfs(n, np.asarray(edges, np.int64), q)) for q in queries]
+
+
+LATTICES = {
+    "road": generators.road_edges(24, 24, seed=921),
+    "road_rect": generators.road_edges(13, 37, seed=922),
+    "grid": generators.grid_edges(19, 7),
+}
+
+
+class TestDetection:
+    def test_road_graph_detects(self):
+        n, edges = LATTICES["road"]
+        g = CSRGraph.from_edges(n, edges)
+        dec = detect_stencil(g)
+        assert dec is not None
+        offsets, masks, res_src, res_dst = dec
+        assert 0 not in offsets and len(offsets) <= 16
+        assert masks.shape == (n, len(offsets))
+        # Every directed edge is either a masked offset or a residual.
+        deg = np.diff(np.asarray(g.row_offsets))
+        src = np.repeat(np.arange(n), deg)
+        dst = np.asarray(g.col_indices)
+        nonloop = (src != dst).sum()
+        assert int(masks.sum()) + len(res_src) >= nonloop - 0  # dups collapse
+        assert len(res_src) <= 0.02 * g.num_directed_edges
+
+    def test_random_graph_rejects(self):
+        n, edges = generators.gnm_edges(300, 900, seed=923)
+        g = CSRGraph.from_edges(n, edges)
+        assert detect_stencil(g) is None
+        with pytest.raises(ValueError, match="not banded"):
+            StencilGraph.from_host(g)
+
+    def test_hub_star_rejects(self):
+        n = 200
+        edges = np.stack(
+            [np.zeros(n - 1, dtype=np.int64), np.arange(1, n, dtype=np.int64)],
+            axis=1,
+        )
+        g = CSRGraph.from_edges(n, edges)
+        assert detect_stencil(g) is None
+
+    def test_self_loops_only(self):
+        n = 16
+        edges = np.stack([np.arange(n), np.arange(n)], axis=1).astype(np.int64)
+        g = CSRGraph.from_edges(n, edges)
+        dec = detect_stencil(g)
+        assert dec is not None and dec[0] == ()
+
+    def test_empty_graph(self):
+        g = CSRGraph.from_edges(5, np.zeros((0, 2), dtype=np.int64))
+        assert detect_stencil(g) is None
+
+
+@pytest.mark.parametrize("name", sorted(LATTICES))
+def test_stencil_matches_oracle(name):
+    n, edges = LATTICES[name]
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 9, max_group=4, seed=924)
+    queries[2] = np.zeros(0, dtype=np.int32)
+    queries[4] = np.array([0, -1, n + 3], dtype=np.int32)  # bounds check
+    padded = pad_queries(queries)
+    eng = StencilEngine(StencilGraph.from_host(g))
+    got = np.asarray(eng.f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_stencil_bit_identical_to_bitbell():
+    n, edges = LATTICES["road"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = pad_queries(
+        generators.random_queries(n, 37, max_group=5, seed=925)
+    )
+    a = StencilEngine(StencilGraph.from_host(g)).query_stats(queries)
+    b = BitBellEngine(BellGraph.from_host(g)).query_stats(queries)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_residual_edges_exact():
+    """Grid + a few long random links: the links exceed the offset set and
+    must ride the residual scatter, bit-exactly."""
+    n, grid = generators.grid_edges(15, 11)
+    rng = np.random.default_rng(926)
+    extra = rng.integers(0, n, size=(6, 2)).astype(np.int64)
+    edges = np.concatenate([grid, extra], axis=0)
+    g = CSRGraph.from_edges(n, edges)
+    import jax.numpy as jnp
+
+    dec = detect_stencil(g, max_offsets=4, max_residual_frac=0.5)
+    assert dec is not None and len(dec[2]) > 0  # residual in play
+    sg = StencilGraph(
+        g.n,
+        g.num_directed_edges,
+        dec[0],
+        jnp.asarray(dec[1]),
+        jnp.asarray(dec[2]),
+        jnp.asarray(dec[3]),
+    )
+    queries = generators.random_queries(n, 7, max_group=3, seed=927)
+    padded = pad_queries(queries)
+    got = np.asarray(StencilEngine(sg).f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_duplicate_and_self_loop_edges():
+    n, grid = generators.grid_edges(9, 9)
+    edges = np.concatenate(
+        [grid, grid[:13], np.array([[4, 4], [7, 7]], dtype=np.int64)], axis=0
+    )
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 5, max_group=3, seed=928)
+    padded = pad_queries(queries)
+    eng = StencilEngine(StencilGraph.from_host(g))
+    np.testing.assert_array_equal(
+        np.asarray(eng.f_values(padded)), oracle_f_values(n, edges, queries)
+    )
+
+
+def test_k_above_word_width_and_chunked():
+    n, edges = LATTICES["road_rect"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = pad_queries(
+        generators.random_queries(n, 65, max_group=3, seed=929)
+    )
+    sg = StencilGraph.from_host(g)
+    want = StencilEngine(sg).query_stats(queries)
+    chunked = StencilEngine(sg, level_chunk=3).query_stats(queries)
+    for x, y in zip(want, chunked):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_level_stats_parity():
+    n, edges = LATTICES["grid"]
+    g = CSRGraph.from_edges(n, edges)
+    queries = pad_queries(
+        generators.random_queries(n, 6, max_group=3, seed=930)
+    )
+    eng = StencilEngine(StencilGraph.from_host(g))
+    levels, reached, f, lc, secs = eng.level_stats(queries)
+    want = eng.query_stats(queries)
+    np.testing.assert_array_equal(levels, want[0])
+    np.testing.assert_array_equal(reached, want[1])
+    np.testing.assert_array_equal(f, want[2])
+    assert lc.shape[0] == len(secs)

@@ -581,15 +581,27 @@ def run_sweep(configs) -> int:
             "vs_baseline": (headline or {}).get("vs_baseline"),
             "detail": {"sweep": results, "configs_requested": configs},
         }
+        # Default sidecar next to THIS file, not the cwd: the driver may
+        # launch bench.py from anywhere, and a cwd-relative default would
+        # silently lose the full sweep detail (review r5).
         detail_path = os.environ.get(
             "BENCH_DETAIL_PATH",
-            os.path.join("benchmarks", "bench_sweep_detail.json"),
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "benchmarks",
+                "bench_sweep_detail.json",
+            ),
         )
         try:
             with open(detail_path, "w") as fh:
                 json.dump(full, fh)
                 fh.write("\n")
-        except OSError:
+        except OSError as exc:
+            print(
+                f"bench: cannot write sweep detail sidecar "
+                f"{detail_path!r}: {exc}",
+                file=sys.stderr,
+            )
             detail_path = None
         compact_sweep = {}
         for c, r in results.items():

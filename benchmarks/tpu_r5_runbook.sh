@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-5 TPU measurement runbook (VERDICT r4 "Next round" items 1, 4, 6, 7).
+# Priority order: the two unmeasured certifications first — RMAT-24 x K=256
+# (the r4 attempt died on tunnel outage + HBM OOM at the unchunked gather;
+# this run is memory-conservative: BENCH_SPARSE=0, slot-budget streaming)
+# and config 4 through the NEW stencil route (558f674, never run on chip).
+# Every step tees raw output into benchmarks/raw_r5/; each step is
+# independently restartable (persistent XLA compilation cache).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+RAW=benchmarks/raw_r5
+mkdir -p "$RAW"
+
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+echo "runbook start $(stamp)" | tee -a "$RAW/runbook_meta.txt"
+python -c "import jax; print('jax', jax.__version__)" 2>/dev/null \
+    | tee -a "$RAW/runbook_meta.txt"
+
+echo "== 1. RMAT-24 x K=256 (the r4 casualty; slot-budget streaming path)"
+BENCH_CONFIGS= BENCH_SCALE=24 BENCH_K=256 BENCH_REPEATS=2 BENCH_EXTRA_KS= \
+    BENCH_SPARSE=0 MSBFS_SLOT_BUDGET=67108864 \
+    BENCH_WAIT_S=600 BENCH_RUN_S=7200 python bench.py \
+    2> "$RAW/bench_rmat24_k256.stderr" | tee "$RAW/bench_rmat24_k256.json"
+
+echo "== 2. config 4 through the stencil route (driver-contract bench row)"
+BENCH_CONFIGS=4 BENCH_RUN_S=3600 BENCH_DETAIL_PATH="$RAW/config4_stencil_detail.json" \
+    python bench.py 2> "$RAW/config4_stencil.stderr" \
+    | tee "$RAW/config4_stencil.json"
+
+echo "== 3. on-chip MSBFS_STATS=2 per-level trace, road-1024 (VERDICT r4 weak 1)"
+timeout 1800 python benchmarks/exp_level_trace.py \
+    2>&1 | tee "$RAW/level_trace_road1024.txt" || true
+
+echo "== 4. headline sweep (2,2c,4,1 — the BENCH_r05 artifact twin)"
+BENCH_DETAIL_PATH="$RAW/bench_headline_detail.json" python bench.py \
+    2> "$RAW/bench_headline.stderr" | tee "$RAW/bench_headline.json"
+
+echo "== 5. large .gr fixture end-to-end (converter path at >=10M arcs)"
+timeout 3600 bash benchmarks/exp_gr_end_to_end.sh "$RAW" \
+    2>&1 | tee "$RAW/gr_end_to_end.txt" || true
+
+echo "runbook end $(stamp)" | tee -a "$RAW/runbook_meta.txt"
+echo "== done; raw artifacts in $RAW — fold into BASELINE.md + PERF_NOTES"

@@ -467,10 +467,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     # Stencil levels are gather-free bandwidth streams, so
                     # the auto dispatch bound can be much larger than the
                     # gather engines' (ops.stencil); an explicit
-                    # MSBFS_LEVEL_CHUNK still wins.
+                    # MSBFS_LEVEL_CHUNK still wins.  A NEGATIVE explicit
+                    # value is the warned sign-typo case: it must land on
+                    # the stencil auto bound, not the gather engines' 128
+                    # that _level_chunk_policy fell back to (review r5).
                     stencil_chunk = (
                         level_chunk
-                        if explicit_chunk is not None
+                        if explicit_chunk is not None and explicit_chunk >= 0
                         else (AUTO_STENCIL_LEVEL_CHUNK if level_chunk else None)
                     )
                     print(

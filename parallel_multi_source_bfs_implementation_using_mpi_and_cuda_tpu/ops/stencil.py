@@ -64,9 +64,10 @@ class StencilGraph:
 
     ``offsets``: tuple of nonzero int diffs, each with an (n,) uint8 mask —
     mask_d[u] = 1 iff directed edge (u, u+d) exists.  ``res_src/res_dst``:
-    residual directed edges (diffs outside ``offsets``), padded to a static
-    length with the sentinel n (dropped by the scatter).  Self-loops (d=0)
-    never change reachability and are dropped entirely.
+    the residual directed edges (diffs outside ``offsets``), exactly as
+    many as :func:`detect_stencil` found — per-graph static shapes, no
+    padding.  Self-loops (d=0) never change reachability and are dropped
+    entirely.
     """
 
     def __init__(self, n, num_directed_edges, offsets, masks, res_src, res_dst):
@@ -201,19 +202,14 @@ def stencil_hits(frontier: jax.Array, graph: StencilGraph) -> jax.Array:
     r = graph.res_src.shape[0]
     if r:
         n = graph.n
-        safe_src = jnp.minimum(graph.res_src, n - 1)
-        src_words = jnp.where(
-            (graph.res_src < n)[:, None],
-            jnp.take(frontier, safe_src, axis=0),
-            jnp.uint32(0),
-        )
+        src_words = jnp.take(frontier, graph.res_src, axis=0)
         src_bytes = unpack_byte_planes(src_words)  # (R, K) 0/1
         hit_bytes = (
-            jnp.zeros((n + 1, src_bytes.shape[1]), jnp.uint8)
+            jnp.zeros((n, src_bytes.shape[1]), jnp.uint8)
             .at[graph.res_dst]
             .max(src_bytes)
         )
-        hits = hits | pack_byte_planes(hit_bytes[:n])
+        hits = hits | pack_byte_planes(hit_bytes)
     return hits
 
 

@@ -169,6 +169,37 @@ def load_dimacs_gr(path: str | os.PathLike):
     return n, _canonical_undirected(arcs)
 
 
+def save_dimacs_gr(
+    path: str | os.PathLike, n: int, edges: np.ndarray, comment: str = ""
+) -> int:
+    """Write a DIMACS shortest-path ``.gr`` file from an (m, 2) undirected
+    edge array, USA-road-d convention: both arc directions listed, weight 1
+    (weights are dropped on load — hop-distance objective, main.cu:30-32).
+
+    Returns the number of ``a`` lines written (2m).  This is the
+    round-trip complement of :func:`load_dimacs_gr`, used to fabricate
+    large real-format fixtures where the sandbox cannot fetch the public
+    datasets (zero egress; see benchmarks/exp_gr_end_to_end.sh).
+    """
+    edges = np.asarray(edges)
+    if edges.ndim != 2 or edges.shape[1] != 2:
+        raise ValueError("edges must be (m, 2)")
+    m = int(edges.shape[0])
+    with open(path, "w") as f:
+        if comment:
+            for line in comment.splitlines():
+                f.write(f"c {line}\n")
+        f.write(f"p sp {int(n)} {2 * m}\n")
+        chunk = 1 << 20
+        for lo in range(0, m, chunk):
+            part = edges[lo : lo + chunk].astype(np.int64) + 1  # 1-based
+            both = np.empty((2 * part.shape[0], 2), dtype=np.int64)
+            both[0::2] = part
+            both[1::2] = part[:, ::-1]
+            np.savetxt(f, both, fmt="a %d %d 1")
+    return 2 * m
+
+
 def load_edgelist(path: str | os.PathLike):
     """Parse a SNAP-style whitespace edge list (``# comments``, one
     ``u v`` pair per line, 0-based ids) into (n, edges).

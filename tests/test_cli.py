@@ -278,6 +278,31 @@ def test_stencil_routing_knobs(road_files, files, capsys, monkeypatch):
     _assert_report(out, want, 8)
 
 
+def test_stencil_level_chunk_env(road_files, capsys, monkeypatch):
+    """MSBFS_LEVEL_CHUNK vs the stencil route: positive forces, 0 opts out
+    (unbounded), and a NEGATIVE (warned sign-typo) value must land on the
+    STENCIL auto bound — not the gather engines' smaller fallback that
+    _level_chunk_policy returns (review r5)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+        AUTO_STENCIL_LEVEL_CHUNK,
+    )
+
+    gpath, qpath, want = road_files
+    for env, expect in (
+        ("200", "200 levels/dispatch"),
+        ("0", "unbounded levels/dispatch"),
+        ("-3", f"{AUTO_STENCIL_LEVEL_CHUNK} levels/dispatch"),
+        ("zz", f"{AUTO_STENCIL_LEVEL_CHUNK} levels/dispatch"),
+    ):
+        monkeypatch.setenv("MSBFS_LEVEL_CHUNK", env)
+        rc, out, err = run_cli(
+            ["main.py", "-g", gpath, "-q", qpath, "-gn", "1"], capsys
+        )
+        assert rc == 0 and "banded adjacency detected" in err
+        assert expect in err, (env, err)
+        _assert_report(out, want, 1)
+
+
 def test_hbm_warning_suppressed_on_stencil_route(
     road_files, capsys, monkeypatch
 ):

@@ -162,6 +162,45 @@ def test_load_dimacs_gr_gz_roundtrip(tmp_path):
     assert g.n == 3 and g.num_directed_edges == 4  # 2 undirected, doubled
 
 
+def test_save_dimacs_gr_roundtrip(tmp_path):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_dimacs_gr,
+        save_dimacs_gr,
+    )
+
+    n, edges = generators.road_edges(8, 8, seed=46)
+    p = tmp_path / "road.gr"
+    arcs = save_dimacs_gr(p, n, edges, comment="fixture\ntwo lines")
+    # USA-road-d convention: both directions listed, so 2m arc lines and
+    # the header advertises the arc (not undirected-edge) count.
+    assert arcs == 2 * edges.shape[0]
+    header = [
+        line for line in p.read_text().splitlines() if line.startswith("p ")
+    ]
+    assert header == [f"p sp {n} {arcs}"]
+    n2, edges2 = load_dimacs_gr(p)
+    assert n2 == n
+    canon = np.unique(
+        np.stack(
+            [edges.min(axis=1), edges.max(axis=1)], axis=1
+        ),
+        axis=0,
+    )
+    assert np.array_equal(edges2, canon)
+
+
+def test_save_dimacs_gr_rejects_bad_shape(tmp_path):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_dimacs_gr,
+    )
+
+    with pytest.raises(ValueError, match=r"\(m, 2\)"):
+        save_dimacs_gr(tmp_path / "x.gr", 4, np.zeros((3, 3), np.int32))
+
+
 def test_load_dimacs_gr_errors(tmp_path):
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
         load_dimacs_gr,

@@ -33,8 +33,9 @@ import numpy as np
 from jax import lax
 
 from ..models.bell import BellGraph
-from .bfs import validate_level_chunk
+from .bfs import host_chunked_loop, validate_level_chunk
 from .bell import forest_hits
+from .objective import select_best
 from .packed import PackedEngineBase
 from .push import compact_indices
 
@@ -422,24 +423,229 @@ def bitbell_run_chunked(
     crashed the TPU worker (docs/PERF_NOTES.md "Push-engine TPU status");
     on ~10-level power-law graphs the single-dispatch ``bitbell_run`` is
     preferred (no host syncs at all)."""
-    carry = _bitbell_init_carry(graph, queries)
-    while True:
-        carry = _bitbell_chunk(
+    carry = host_chunked_loop(
+        _bitbell_init_carry(graph, queries),
+        lambda c: _bitbell_chunk(
             graph,
-            carry,
+            c,
             jnp.int32(level_chunk),
             max_levels,
             sparse_budget,
             slot_budget,
-        )
-        if not bool(np.asarray(carry[6])):
-            break
-        if max_levels is not None and int(np.asarray(carry[5])) >= max_levels:
-            break
+        ),
+        max_levels,
+        level_ix=5,
+        updated_ix=6,
+    )
     return carry[2], carry[3], carry[4]
 
 
-class BitBellEngine(PackedEngineBase):
+def stepped_level_trace(engine, queries, step):
+    """Shared MSBFS_STATS=2 host-driven per-level trace for the bit-plane
+    engines (bitbell, stencil): one dispatch per level so each level is
+    individually timed.  ``step(visited, frontier) -> (visited', frontier',
+    counts)`` is the engine's one-level program (already closed over its
+    graph/budgets).  Returns (levels, reached, f, level_counts,
+    level_seconds): ``level_counts`` is (L, K) — row d = vertices
+    discovered at distance d per query (row 0 = sources) — and
+    ``level_seconds`` is (L,) wall time per executed level (row 0 = source
+    packing).  The first three match the engine's ``query_stats`` exactly
+    (same counters, accumulated on host); the stepped loop pays one
+    dispatch per level, so this is a diagnostic mode, not the performance
+    path.  Warms the pack+step programs once per shape so the timed rows
+    measure execution, not XLA compilation (the warm executes one real
+    level; an empty dummy could never warm the step program)."""
+    import time
+
+    queries, k = engine._pad_queries(queries)
+    pack = partial(_pack_queries_jit, engine.graph.n)
+    if queries.shape not in engine._level_warm_shapes:
+        warm = pack(queries)
+        np.asarray(step(warm, warm)[2])
+        engine._level_warm_shapes.add(queries.shape)
+    t0 = time.perf_counter()
+    frontier = pack(queries)
+    counts = np.asarray(unpack_counts(frontier))
+    dt = time.perf_counter() - t0
+    visited = frontier
+    level_counts = [counts]
+    level_seconds = [dt]
+    while counts.any():
+        if (
+            engine.max_levels is not None
+            and len(level_counts) > engine.max_levels
+        ):
+            break
+        t0 = time.perf_counter()
+        visited, frontier, c = step(visited, frontier)
+        counts = np.asarray(c)
+        level_seconds.append(time.perf_counter() - t0)
+        level_counts.append(counts)
+    lc = np.stack(level_counts)  # (L, Kpad)
+    dists = np.arange(lc.shape[0], dtype=np.int64)
+    f = (lc.astype(np.int64) * dists[:, None]).sum(axis=0)
+    reached = lc.sum(axis=0, dtype=np.int32)
+    any_at = lc > 0
+    # levels = while-iterations the query needed = max distance + 1
+    # (reference's kernel-launch count, main.cu:61-71); 0 for empty.
+    maxdist = np.where(
+        any_at.any(axis=0),
+        any_at.shape[0] - 1 - any_at[::-1].argmax(axis=0),
+        -1,
+    )
+    levels = (maxdist + 1).astype(np.int32)
+    return (
+        levels[:k],
+        reached[:k],
+        f[:k],
+        lc[:, :k],
+        np.asarray(level_seconds),
+    )
+
+
+def fused_select(f: jax.Array, k):
+    """:func:`..ops.objective.select_best` over the first ``k`` lanes of a
+    padded (Kpad,) F vector.  The alignment-padding lanes hold F=0 "empty
+    group" results that would otherwise tie-win over every real query
+    (reference tie-break: first strict minimum, main.cu:379-397).  ``k``
+    is TRACED (not a static jit arg): it only feeds this mask, and a
+    static k would recompile the whole fused BFS program for every
+    distinct real-query count sharing one padded shape (review r5)."""
+    return select_best(f, jnp.arange(f.shape[0]) < k)
+
+
+@partial(
+    jax.jit, static_argnames=("max_levels", "sparse_budget", "slot_budget")
+)
+def bitbell_best_fused(
+    graph: BellGraph,
+    queries: jax.Array,
+    k,
+    max_levels: Optional[int] = None,
+    sparse_budget: int = 0,
+    slot_budget: Optional[int] = None,
+):
+    """Whole multi-source BFS + final (minF, minK) selection in ONE XLA
+    program — the unchunked engine path pays exactly one device dispatch
+    per query batch (the reference's serial query loop + two-scan argmin,
+    main.cu:309-397, as one fused program)."""
+    f, _, _ = bitbell_run(graph, queries, max_levels, sparse_budget, slot_budget)
+    return fused_select(f, k)
+
+
+def _chunk_best_tail(
+    graph, carry, k, chunk, max_levels, sparse_budget, slot_budget
+):
+    carry = bit_level_chunk(
+        carry,
+        _bitbell_expand(graph, sparse_budget, slot_budget),
+        chunk,
+        max_levels,
+    )
+    min_f, min_k = fused_select(carry[2], k)
+    return carry + (min_f, min_k)
+
+
+@partial(
+    jax.jit, static_argnames=("max_levels", "sparse_budget", "slot_budget")
+)
+def _bitbell_start_chunk_best(
+    graph, queries, k, chunk, max_levels, sparse_budget, slot_budget=None
+):
+    """Query packing + carry init + first level chunk + selection, fused:
+    the chunked path's FIRST dispatch.  A BFS that converges within one
+    chunk (every shallow power-law run at the 128-level auto bound) gets
+    its full answer from this single program."""
+    return _chunk_best_tail(
+        graph,
+        _bitbell_init_carry(graph, queries),
+        k,
+        chunk,
+        max_levels,
+        sparse_budget,
+        slot_budget,
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("max_levels", "sparse_budget", "slot_budget")
+)
+def _bitbell_chunk_best(
+    graph, carry, k, chunk, max_levels, sparse_budget, slot_budget=None
+):
+    """Continuation dispatch for deep graphs: one more level chunk + the
+    (cheap, (K,)-sized) selection over the F counters so far.  Only the
+    LAST dispatch's (minF, minK) is read by the host."""
+    return _chunk_best_tail(
+        graph, carry, k, chunk, max_levels, sparse_budget, slot_budget
+    )
+
+
+def fused_best_drive(c9, advance, max_levels) -> Tuple[int, int]:
+    """Host driver for the chunked fused-best programs.  ``c9`` is the
+    9-tuple a start/continuation program returns (the 7-tuple loop carry +
+    minF + minK so far).  Same convergence contract as
+    :func:`..ops.bfs.host_chunked_loop`, but PRE-checked — the start
+    program already advanced one chunk, so a converged BFS pays no extra
+    dispatch.  One scalar host read per chunk (the continue flag), two at
+    the end (the answer)."""
+    while True:
+        if not bool(np.asarray(c9[6])):
+            break
+        if max_levels is not None and int(np.asarray(c9[5])) >= max_levels:
+            break
+        c9 = advance(c9)
+    return int(c9[7]), int(c9[8])
+
+
+class FusedBestEngine(PackedEngineBase):
+    """Template for the bit-plane engines whose ``best()`` fuses packing +
+    carry init + the level loop + the final argmin into the dispatched
+    program(s) (r5, VERDICT r4 item 7): a query batch costs
+    ceil(levels/chunk) dispatches — not 2 + chunks.  Through the ~100 ms
+    tunnel dispatch floor that is the difference between ~0.3 s and
+    ~0.1 s for a single shallow query (BASELINE config 1).
+
+    Subclasses provide ``_fused_full(queries, k)`` (the unchunked
+    single-program path -> (minF, minK) arrays) and
+    ``_fused_chunk(state, k, first)`` (one chunked dispatch -> the
+    9-tuple; ``state`` is the padded queries when ``first`` else the
+    7-tuple carry)."""
+
+    def _fused_full(self, queries, k):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def _fused_chunk(self, state, k, first):  # pragma: no cover - iface
+        raise NotImplementedError
+
+    def best(self, queries) -> Tuple[int, int]:
+        queries, k = self._pad_queries(queries)
+        if not self.level_chunk:
+            min_f, min_k = self._fused_full(queries, k)
+            return int(min_f), int(min_k)
+        return fused_best_drive(
+            self._fused_chunk(queries, k, first=True),
+            lambda c: self._fused_chunk(c[:7], k, first=False),
+            self.max_levels,
+        )
+
+    def compile(self, queries_shape, warm_stats=False, warm_levels=False):
+        """Also warm the chunked CONTINUATION program: the all-padding
+        dummy that ``best`` warms with converges after the START program,
+        so without this the continuation would first compile inside the
+        timed span of the first deeper-than-one-chunk run.  A converged
+        carry is a fixed point, so one extra dispatch on it is a no-op."""
+        super().compile(queries_shape, warm_stats, warm_levels)
+        if self.level_chunk and queries_shape[0]:
+            dummy, k = self._pad_queries(
+                np.full(queries_shape, -1, dtype=np.int32)
+            )
+            c9 = self._fused_chunk(dummy, k, first=True)
+            c9 = self._fused_chunk(c9[:7], k, first=False)
+            np.asarray(c9[8])
+
+
+class BitBellEngine(FusedBestEngine):
     """Bit-plane all-queries-at-once engine over a BellGraph.
 
     Inherits the K-alignment padding from PackedEngineBase (k_align = 32
@@ -534,6 +740,31 @@ class BitBellEngine(PackedEngineBase):
         f, _, _ = self._bitbell_run(queries)
         return f[:k]
 
+    def _fused_full(self, queries, k):
+        return bitbell_best_fused(
+            self.graph,
+            queries,
+            k,
+            self.max_levels,
+            self.sparse_budget,
+            self._slot_budget_for(queries.shape[0] // WORD_BITS),
+        )
+
+    def _fused_chunk(self, state, k, first):
+        # W (packed words) from the padded queries on the first dispatch,
+        # from the carry's visited planes on continuations.
+        w = state.shape[0] // WORD_BITS if first else state[0].shape[1]
+        fn = _bitbell_start_chunk_best if first else _bitbell_chunk_best
+        return fn(
+            self.graph,
+            state,
+            k,
+            jnp.int32(self.level_chunk),
+            self.max_levels,
+            self.sparse_budget,
+            self._slot_budget_for(w),
+        )
+
     def query_stats(self, queries):
         queries, k = self._pad_queries(queries)
         f, levels, reached = self._bitbell_run(queries)
@@ -544,79 +775,18 @@ class BitBellEngine(PackedEngineBase):
         )
 
     def level_stats(self, queries):
-        """Per-level trace (MSBFS_STATS=2): host-driven stepped BFS so each
-        level is individually timed.  Returns (levels, reached, f,
-        level_counts, level_seconds) where ``level_counts`` is (L, K) — row
-        d = vertices discovered at distance d per query (row 0 = sources) —
-        and ``level_seconds`` is (L,) wall time per executed level (row 0 =
-        source packing).  The first three match :meth:`query_stats` exactly
-        (they are the same counters, accumulated on host); the stepped loop
-        pays one dispatch per level, so this is a diagnostic mode, not the
-        performance path.
-        """
-        import time
-
-        queries, k = self._pad_queries(queries)
-        # Same gather-segment budget as the production run: without it the
-        # traced step materializes the full merged per-level gather and can
-        # OOM on exactly the wide-plane shapes (RMAT-24 x K=256) that the
-        # production path streams within budget (ADVICE r4).
-        slot_budget = self._slot_budget_for(queries.shape[0] // WORD_BITS)
-        pack = partial(_pack_queries_jit, self.graph.n)
-        # Warm both programs ONCE PER SHAPE so the timed rows measure
-        # execution, not XLA compilation.  compile(warm_levels=True) routes
-        # here, putting these compiles in the CLI's preprocessing span; a
-        # direct caller pays them before its first timed row either way.
-        # (An empty dummy can't warm the step program — the loop would
-        # never execute one.)  The warm executes one real level, so repeat
-        # calls at a warmed shape skip it entirely.
-        if queries.shape not in self._level_warm_shapes:
-            warm_frontier = pack(queries)
-            np.asarray(
-                bitbell_step(
-                    self.graph,
-                    warm_frontier,
-                    warm_frontier,
-                    self.sparse_budget,
-                    slot_budget,
-                )[2]
-            )
-            self._level_warm_shapes.add(queries.shape)
-        t0 = time.perf_counter()
-        frontier = pack(queries)
-        counts = np.asarray(unpack_counts(frontier))
-        dt = time.perf_counter() - t0
-        visited = frontier
-        level_counts = [counts]
-        level_seconds = [dt]
-        while counts.any():
-            if (
-                self.max_levels is not None
-                and len(level_counts) > self.max_levels
-            ):
-                break
-            t0 = time.perf_counter()
-            visited, frontier, c = bitbell_step(
-                self.graph, visited, frontier, self.sparse_budget, slot_budget
-            )
-            counts = np.asarray(c)
-            level_seconds.append(time.perf_counter() - t0)
-            level_counts.append(counts)
-        lc = np.stack(level_counts)  # (L, Kpad)
-        dists = np.arange(lc.shape[0], dtype=np.int64)
-        f = (lc.astype(np.int64) * dists[:, None]).sum(axis=0)
-        reached = lc.sum(axis=0, dtype=np.int32)
-        any_at = lc > 0
-        # levels = while-iterations the query needed = max distance + 1
-        # (reference's kernel-launch count, main.cu:61-71); 0 for empty.
-        maxdist = np.where(
-            any_at.any(axis=0), any_at.shape[0] - 1 - any_at[::-1].argmax(axis=0), -1
-        )
-        levels = (maxdist + 1).astype(np.int32)
-        return (
-            levels[:k],
-            reached[:k],
-            f[:k],
-            lc[:, :k],
-            np.asarray(level_seconds),
+        """Per-level trace (MSBFS_STATS=2) via the shared
+        :func:`stepped_level_trace` driver.  The step closes over the same
+        gather-segment budget as the production run: without it the traced
+        step materializes the full merged per-level gather and can OOM on
+        exactly the wide-plane shapes (RMAT-24 x K=256) that the
+        production path streams within budget (ADVICE r4)."""
+        padded, _ = self._pad_queries(queries)
+        slot_budget = self._slot_budget_for(padded.shape[0] // WORD_BITS)
+        return stepped_level_trace(
+            self,
+            queries,
+            lambda v, fr: bitbell_step(
+                self.graph, v, fr, self.sparse_budget, slot_budget
+            ),
         )

@@ -37,18 +37,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from .bfs import validate_level_chunk
+from .bfs import host_chunked_loop, validate_level_chunk
 from .bitbell import (
     WORD_BITS,
+    FusedBestEngine,
     bit_level_chunk,
     bit_level_init,
     bit_level_loop,
+    fused_select,
     pack_byte_planes,
     pack_queries,
+    stepped_level_trace,
     unpack_byte_planes,
     unpack_counts,
 )
-from .packed import PackedEngineBase
 
 # Routing defaults: at most this many distinct diffs, covering all but
 # MAX_RESIDUAL_FRAC of directed edges.  16 masked shift passes already
@@ -252,6 +254,36 @@ def stencil_step(graph: StencilGraph, visited, frontier):
     return visited | new, new, unpack_counts(new)
 
 
+@partial(jax.jit, static_argnames=("max_levels",))
+def stencil_best_fused(
+    graph: StencilGraph, queries: jax.Array, k, max_levels=None
+):
+    """Whole stencil BFS + final (minF, minK) selection in one XLA
+    program (see ops.bitbell.bitbell_best_fused; ``k`` traced)."""
+    f, _, _ = stencil_run(graph, queries, max_levels)
+    return fused_select(f, k)
+
+
+def _stencil_best_tail(graph, carry, k, chunk, max_levels):
+    carry = bit_level_chunk(carry, _stencil_expand(graph), chunk, max_levels)
+    min_f, min_k = fused_select(carry[2], k)
+    return carry + (min_f, min_k)
+
+
+@partial(jax.jit, static_argnames=("max_levels",))
+def _stencil_start_chunk_best(graph, queries, k, chunk, max_levels):
+    """Packing + init + first level chunk + selection, one dispatch."""
+    return _stencil_best_tail(
+        graph, _stencil_init_carry(graph, queries), k, chunk, max_levels
+    )
+
+
+@partial(jax.jit, static_argnames=("max_levels",))
+def _stencil_chunk_best(graph, carry, k, chunk, max_levels):
+    """Continuation dispatch for BFS deeper than one chunk."""
+    return _stencil_best_tail(graph, carry, k, chunk, max_levels)
+
+
 # Stencil levels stream ~#offsets * n * W words with no gather/scatter, so
 # a dispatch of even a thousand levels is far below the per-dispatch work
 # that crashed the TPU worker on the gather engines (docs/PERF_NOTES.md
@@ -262,7 +294,7 @@ def stencil_step(graph: StencilGraph, visited, frontier):
 AUTO_STENCIL_LEVEL_CHUNK = 1024
 
 
-class StencilEngine(PackedEngineBase):
+class StencilEngine(FusedBestEngine):
     """All-queries-at-once masked-shift engine over a StencilGraph.
 
     The bit-plane loop, counters and query padding are shared with
@@ -285,23 +317,29 @@ class StencilEngine(PackedEngineBase):
 
     def _run(self, queries):
         if self.level_chunk:
-            carry = _stencil_init_carry(self.graph, queries)
-            while True:
-                carry = _stencil_chunk(
+            carry = host_chunked_loop(
+                _stencil_init_carry(self.graph, queries),
+                lambda c: _stencil_chunk(
                     self.graph,
-                    carry,
+                    c,
                     jnp.int32(self.level_chunk),
                     self.max_levels,
-                )
-                if not bool(np.asarray(carry[6])):
-                    break
-                if (
-                    self.max_levels is not None
-                    and int(np.asarray(carry[5])) >= self.max_levels
-                ):
-                    break
+                ),
+                self.max_levels,
+                level_ix=5,
+                updated_ix=6,
+            )
             return carry[2], carry[3], carry[4]
         return stencil_run(self.graph, queries, self.max_levels)
+
+    def _fused_full(self, queries, k):
+        return stencil_best_fused(self.graph, queries, k, self.max_levels)
+
+    def _fused_chunk(self, state, k, first):
+        fn = _stencil_start_chunk_best if first else _stencil_chunk_best
+        return fn(
+            self.graph, state, k, jnp.int32(self.level_chunk), self.max_levels
+        )
 
     def f_values(self, queries) -> jax.Array:
         queries, k = self._pad_queries(queries)
@@ -318,51 +356,11 @@ class StencilEngine(PackedEngineBase):
         )
 
     def level_stats(self, queries):
-        """Per-level trace (MSBFS_STATS=2): host-driven stepped BFS, one
-        dispatch per level — same contract as BitBellEngine.level_stats."""
-        import time
-
-        from .bitbell import _pack_queries_jit
-
-        queries, k = self._pad_queries(queries)
-        pack = partial(_pack_queries_jit, self.graph.n)
-        if queries.shape not in self._level_warm_shapes:
-            warm = pack(queries)
-            np.asarray(stencil_step(self.graph, warm, warm)[2])
-            self._level_warm_shapes.add(queries.shape)
-        t0 = time.perf_counter()
-        frontier = pack(queries)
-        counts = np.asarray(unpack_counts(frontier))
-        dt = time.perf_counter() - t0
-        visited = frontier
-        level_counts = [counts]
-        level_seconds = [dt]
-        while counts.any():
-            if (
-                self.max_levels is not None
-                and len(level_counts) > self.max_levels
-            ):
-                break
-            t0 = time.perf_counter()
-            visited, frontier, c = stencil_step(self.graph, visited, frontier)
-            counts = np.asarray(c)
-            level_seconds.append(time.perf_counter() - t0)
-            level_counts.append(counts)
-        lc = np.stack(level_counts)
-        dists = np.arange(lc.shape[0], dtype=np.int64)
-        f = (lc.astype(np.int64) * dists[:, None]).sum(axis=0)
-        reached = lc.sum(axis=0, dtype=np.int32)
-        any_at = lc > 0
-        maxdist = np.where(
-            any_at.any(axis=0),
-            any_at.shape[0] - 1 - any_at[::-1].argmax(axis=0),
-            -1,
-        )
-        levels = (maxdist + 1).astype(np.int32)
-        return (
-            levels[:k],
-            reached[:k],
-            f[:k],
-            lc[:, :k],
-            np.asarray(level_seconds),
+        """Per-level trace (MSBFS_STATS=2) via the shared
+        ops.bitbell.stepped_level_trace driver — same contract as
+        BitBellEngine.level_stats."""
+        return stepped_level_trace(
+            self,
+            queries,
+            lambda v, fr: stencil_step(self.graph, v, fr),
         )

@@ -69,7 +69,8 @@ def test_success_emits_metric_and_extras():
     )
     assert d["vs_flat_1g5"] is not None
     assert d["dispatch"]["floor_s"] > 0
-    assert d["dispatch"]["n_dispatches"] >= 2
+    # Fused best (r5): the whole unchunked run + argmin is ONE program.
+    assert d["dispatch"]["n_dispatches"] == 1
     assert d["gather_rows_per_s"] > 0 and d["pct_of_roofline"] > 0
 
 

@@ -367,6 +367,79 @@ class TestSlotBudget:
             np.testing.assert_array_equal(x, y)
 
 
+class TestFusedBest:
+    """The r5 fused best() (one program: pack + init + level loop +
+    argmin) must agree with the generic run-then-select path everywhere —
+    including the alignment-padding lanes, whose F=0 empty-group results
+    would tie-win over every real query if the fused selection failed to
+    mask them (fused_select)."""
+
+    @pytest.mark.parametrize("name", sorted(GRAPHS))
+    @pytest.mark.parametrize("level_chunk", [None, 3])
+    def test_matches_generic_best(self, name, level_chunk):
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+            QueryEngineBase,
+        )
+
+        n, edges = GRAPHS[name]
+        g = CSRGraph.from_edges(n, edges)
+        bg = BellGraph.from_host(g)
+        for k in (1, 5, 31, 33):
+            queries = generators.random_queries(
+                n, k, max_group=4, seed=500 + k
+            )
+            padded = pad_queries(queries)
+            eng = BitBellEngine(bg, level_chunk=level_chunk)
+            # The generic path: f_values (trimmed to k) + select_best.
+            want = QueryEngineBase.best(eng, padded)
+            assert eng.best(padded) == want
+            assert want == oracle_best(oracle_f_values(n, edges, queries))
+
+    def test_padding_lane_cannot_win(self):
+        # Every real query has F > 0, so an unmasked padding lane (F=0)
+        # would win the argmin; the fused path must return the real one.
+        n, edges = GRAPHS["grid"]
+        g = CSRGraph.from_edges(n, edges)
+        queries = [np.array([0], dtype=np.int32)]  # k=1 -> 31 pad lanes
+        padded = pad_queries(queries)
+        for level_chunk in (None, 4):
+            eng = BitBellEngine(
+                BellGraph.from_host(g), level_chunk=level_chunk
+            )
+            min_f, min_k = eng.best(padded)
+            assert min_k == 0 and min_f > 0
+
+    def test_k_zero_and_max_levels(self):
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+            QueryEngineBase,
+        )
+
+        n, edges = GRAPHS["gnm"]
+        g = CSRGraph.from_edges(n, edges)
+        bg = BellGraph.from_host(g)
+        empty = np.zeros((0, 3), dtype=np.int32)
+        for level_chunk in (None, 2):
+            eng = BitBellEngine(bg, level_chunk=level_chunk)
+            assert eng.best(empty) == (-1, -1)
+            capped = BitBellEngine(bg, max_levels=2, level_chunk=level_chunk)
+            queries = generators.random_queries(n, 7, max_group=3, seed=507)
+            padded = pad_queries(queries)
+            assert capped.best(padded) == QueryEngineBase.best(capped, padded)
+
+    def test_compile_warms_continuation(self):
+        # compile() must pre-trace BOTH chunked programs; afterwards a
+        # deep run introduces no new compilation (smoke: it just works and
+        # agrees with the oracle).
+        n, edges = GRAPHS["grid"]
+        g = CSRGraph.from_edges(n, edges)
+        queries = generators.random_queries(n, 3, max_group=2, seed=509)
+        padded = pad_queries(queries)
+        eng = BitBellEngine(BellGraph.from_host(g), level_chunk=2)
+        eng.compile(padded.shape)
+        want = oracle_best(oracle_f_values(n, edges, queries))
+        assert eng.best(padded) == want
+
+
 def test_sparse_hits_or_edgeless_graph():
     """Forcing a sparse budget on an edgeless graph must be well-defined:
     the dedup CSR is empty, and the general path's index arithmetic would

@@ -160,6 +160,34 @@ def test_k_above_word_width_and_chunked():
         np.testing.assert_array_equal(x, y)
 
 
+def test_fused_best_matches_generic():
+    """The r5 fused best() (loop + argmin in one program) must agree with
+    the generic run-then-select path on chunked and unchunked routes —
+    a deep lattice exercises several continuation dispatches — and the
+    F=0 alignment-padding lanes must never win."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+        QueryEngineBase,
+    )
+
+    n, edges = LATTICES["road"]
+    g = CSRGraph.from_edges(n, edges)
+    sg = StencilGraph.from_host(g)
+    for k in (1, 5, 33):
+        queries = generators.random_queries(n, k, max_group=3, seed=940 + k)
+        padded = pad_queries(queries)
+        for level_chunk in (None, 4):
+            eng = StencilEngine(sg, level_chunk=level_chunk)
+            eng.compile(padded.shape)
+            want = QueryEngineBase.best(eng, padded)
+            assert eng.best(padded) == want
+    # Padding lanes cannot win: a single real query with F > 0.
+    one = pad_queries([np.array([0], dtype=np.int32)])
+    for level_chunk in (None, 4):
+        min_f, min_k = StencilEngine(sg, level_chunk=level_chunk).best(one)
+        assert min_k == 0 and min_f > 0
+    assert StencilEngine(sg).best(np.zeros((0, 2), np.int32)) == (-1, -1)
+
+
 def test_level_stats_parity():
     n, edges = LATTICES["grid"]
     g = CSRGraph.from_edges(n, edges)

@@ -112,48 +112,102 @@ def trace_bitbell():
 
 
 def micro_decompose_stencil(eng):
-    """One mid-BFS stencil level, sub-op timed: shifts+OR vs residual
-    scatter vs the dispatch floor."""
+    """One mid-BFS stencil level, sub-op timed.  block_until_ready is
+    UNRELIABLE through the axon tunnel (returns early; docs/PERF_NOTES.md
+    "Measurement traps"), so every timed program is reduced to a scalar
+    and fetched — each sample = floor + work; report the floor alongside
+    and read the difference."""
     import jax.numpy as jnp
 
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
-        _pack_queries_jit,
+        unpack_counts,
     )
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+        _shift_planes,
+        _stencil_chunk,
+        _stencil_init_carry,
         stencil_hits,
         stencil_step,
     )
 
-    gq = _pack_queries_jit(eng.graph.n, queries)
-    # advance ~SIDE/2 levels so the wavefront is a full-width diagonal
-    visited = frontier = gq
-    step = jax.jit(lambda v, fr: stencil_step(eng.graph, v, fr))
-    for _ in range(SIDE // 2):
-        visited, frontier, _ = step(visited, frontier)
-    jax.block_until_ready(frontier)
+    # Advance ~SIDE/2 levels via the chunked program (64 levels per
+    # dispatch — NOT one dispatch per level) so the wavefront is a
+    # full-width diagonal, then time single sub-ops on it.
+    padded, _ = eng._pad_queries(queries)
+    carry = _stencil_init_carry(eng.graph, padded)
+    for _ in range(max(1, SIDE // 2 // 64)):
+        carry = _stencil_chunk(eng.graph, carry, jnp.int32(64), None)
+    visited, frontier = carry[0], carry[1]
+    int(np.asarray(frontier[0, 0]))  # force completion
 
     def timeit(name, fn, *args):
-        fn(*args)[0].block_until_ready() if isinstance(
-            fn(*args), tuple
-        ) else jax.block_until_ready(fn(*args))
+        int(np.asarray(fn(*args)))  # warm/compile
         ts = []
-        for _ in range(30):
+        for _ in range(15):
             t0 = time.perf_counter()
-            r = fn(*args)
-            jax.block_until_ready(r)
+            int(np.asarray(fn(*args)))
             ts.append(time.perf_counter() - t0)
         print(
             f"  micro[{name}] median={np.median(ts) * 1e3:.3f}ms "
-            f"min={min(ts) * 1e3:.3f}ms",
+            f"min={min(ts) * 1e3:.3f}ms  (floor included)",
             flush=True,
         )
         return float(np.median(ts))
 
-    hits_fn = jax.jit(lambda fr: stencil_hits(fr, eng.graph))
-    timeit("stencil_hits (shifts+OR)", hits_fn, frontier)
-    timeit("full stencil_step", step, visited, frontier)
-    noop = jax.jit(lambda x: x + 1)
-    timeit("dispatch floor (x+1)", noop, jnp.int32(3))
+    g = eng.graph
+    timeit("floor (x+1)", jax.jit(lambda x: x + 1), jnp.int32(3))
+    timeit(
+        "stencil_hits (full level)",
+        jax.jit(lambda fr: stencil_hits(fr, g).sum()),
+        frontier,
+    )
+    timeit(
+        "full stencil_step (hits+update+counts)",
+        jax.jit(lambda v, fr: stencil_step(g, v, fr)[2].sum()),
+        visited,
+        frontier,
+    )
+    mb = g.mask_bits[:, None]
+    timeit(
+        "shifts+masks only (no residual)",
+        jax.jit(
+            lambda fr: sum(
+                _shift_planes(
+                    jnp.where(
+                        (mb >> jnp.uint32(i)) & jnp.uint32(1) != 0,
+                        fr,
+                        jnp.uint32(0),
+                    ),
+                    d,
+                )
+                for i, d in enumerate(g.offsets)
+            ).sum()
+        ),
+        frontier,
+    )
+    timeit(
+        "unpack_counts",
+        jax.jit(lambda fr: unpack_counts(fr).sum()),
+        frontier,
+    )
+    if g.res_src.shape[0]:
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+            pack_byte_planes,
+            unpack_byte_planes,
+        )
+
+        def residual_only(fr):
+            src_words = jnp.take(fr, g.res_src, axis=0)
+            src_bytes = unpack_byte_planes(src_words)
+            seg = jax.ops.segment_max(
+                src_bytes,
+                g.res_seg,
+                num_segments=g.res_dst_unique.shape[0],
+                indices_are_sorted=True,
+            )
+            return pack_byte_planes(seg).sum()
+
+        timeit("residual segment-OR only", jax.jit(residual_only), frontier)
 
 
 def main():

@@ -514,6 +514,22 @@ def fused_select(f: jax.Array, k):
     return select_best(f, jnp.arange(f.shape[0]) < k)
 
 
+def _pack_status(carry, k):
+    """(4,) int64 [level, updated, minF, minK]: every scalar the host
+    driver needs, in ONE device buffer so one fetch serves the chunk's
+    continue-check AND the final answer — separate reads would each pay
+    their own ~100 ms tunnel round-trip on this platform (review r5)."""
+    min_f, min_k = fused_select(carry[2], k)
+    return jnp.stack(
+        [
+            carry[5].astype(jnp.int64),
+            carry[6].astype(jnp.int64),
+            min_f,
+            min_k.astype(jnp.int64),
+        ]
+    )
+
+
 @partial(
     jax.jit, static_argnames=("max_levels", "sparse_budget", "slot_budget")
 )
@@ -526,11 +542,13 @@ def bitbell_best_fused(
     slot_budget: Optional[int] = None,
 ):
     """Whole multi-source BFS + final (minF, minK) selection in ONE XLA
-    program — the unchunked engine path pays exactly one device dispatch
-    per query batch (the reference's serial query loop + two-scan argmin,
-    main.cu:309-397, as one fused program)."""
+    program returning ONE (2,) int64 buffer — the unchunked engine path
+    pays exactly one dispatch + one fetch per query batch (the
+    reference's serial query loop + two-scan argmin, main.cu:309-397, as
+    one fused program)."""
     f, _, _ = bitbell_run(graph, queries, max_levels, sparse_budget, slot_budget)
-    return fused_select(f, k)
+    min_f, min_k = fused_select(f, k)
+    return jnp.stack([min_f, min_k.astype(jnp.int64)])
 
 
 def _chunk_best_tail(
@@ -542,8 +560,7 @@ def _chunk_best_tail(
         chunk,
         max_levels,
     )
-    min_f, min_k = fused_select(carry[2], k)
-    return carry + (min_f, min_k)
+    return carry + (_pack_status(carry, k),)
 
 
 @partial(
@@ -581,21 +598,23 @@ def _bitbell_chunk_best(
     )
 
 
-def fused_best_drive(c9, advance, max_levels) -> Tuple[int, int]:
-    """Host driver for the chunked fused-best programs.  ``c9`` is the
-    9-tuple a start/continuation program returns (the 7-tuple loop carry +
-    minF + minK so far).  Same convergence contract as
-    :func:`..ops.bfs.host_chunked_loop`, but PRE-checked — the start
-    program already advanced one chunk, so a converged BFS pays no extra
-    dispatch.  One scalar host read per chunk (the continue flag), two at
-    the end (the answer)."""
+def fused_best_drive(c8, advance, max_levels) -> Tuple[int, int]:
+    """Host driver for the chunked fused-best programs.  ``c8`` is the
+    8-tuple a start/continuation program returns: the 7-tuple loop carry
+    + the packed (4,) status buffer (:func:`_pack_status`).  Same
+    convergence contract as :func:`..ops.bfs.host_chunked_loop`, but
+    PRE-checked — the start program already advanced one chunk, so a
+    converged BFS pays no extra dispatch.  Exactly one buffer fetch per
+    chunk serves the continue-check and, on the last chunk, IS the
+    answer."""
     while True:
-        if not bool(np.asarray(c9[6])):
+        level, updated, min_f, min_k = (int(x) for x in np.asarray(c8[7]))
+        if not updated:
             break
-        if max_levels is not None and int(np.asarray(c9[5])) >= max_levels:
+        if max_levels is not None and level >= max_levels:
             break
-        c9 = advance(c9)
-    return int(c9[7]), int(c9[8])
+        c8 = advance(c8)
+    return min_f, min_k
 
 
 class FusedBestEngine(PackedEngineBase):
@@ -607,10 +626,10 @@ class FusedBestEngine(PackedEngineBase):
     ~0.1 s for a single shallow query (BASELINE config 1).
 
     Subclasses provide ``_fused_full(queries, k)`` (the unchunked
-    single-program path -> (minF, minK) arrays) and
+    single-program path -> one (2,) int64 [minF, minK] buffer) and
     ``_fused_chunk(state, k, first)`` (one chunked dispatch -> the
-    9-tuple; ``state`` is the padded queries when ``first`` else the
-    7-tuple carry)."""
+    8-tuple of carry + packed status; ``state`` is the padded queries
+    when ``first`` else the 7-tuple carry)."""
 
     def _fused_full(self, queries, k):  # pragma: no cover - interface
         raise NotImplementedError
@@ -620,12 +639,17 @@ class FusedBestEngine(PackedEngineBase):
 
     def best(self, queries) -> Tuple[int, int]:
         queries, k = self._pad_queries(queries)
+        # np.int32, not python int: a python scalar operand is committed
+        # to the device in its own blocking transfer on this platform
+        # (~45 ms measured); a NumPy scalar rides the dispatch like any
+        # other host buffer.
+        kk = np.int32(k)
         if not self.level_chunk:
-            min_f, min_k = self._fused_full(queries, k)
+            min_f, min_k = np.asarray(self._fused_full(queries, kk))
             return int(min_f), int(min_k)
         return fused_best_drive(
-            self._fused_chunk(queries, k, first=True),
-            lambda c: self._fused_chunk(c[:7], k, first=False),
+            self._fused_chunk(queries, kk, first=True),
+            lambda c: self._fused_chunk(c[:7], kk, first=False),
             self.max_levels,
         )
 
@@ -640,9 +664,12 @@ class FusedBestEngine(PackedEngineBase):
             dummy, k = self._pad_queries(
                 np.full(queries_shape, -1, dtype=np.int32)
             )
-            c9 = self._fused_chunk(dummy, k, first=True)
-            c9 = self._fused_chunk(c9[:7], k, first=False)
-            np.asarray(c9[8])
+            # np.int32 like best(): a python-int k is weak-typed and
+            # would warm a DIFFERENT executable than the one best() runs.
+            kk = np.int32(k)
+            c8 = self._fused_chunk(dummy, kk, first=True)
+            c8 = self._fused_chunk(c8[:7], kk, first=False)
+            np.asarray(c8[7])
 
 
 class BitBellEngine(FusedBestEngine):

@@ -93,7 +93,9 @@ class QueryEngineBase:
     def best(self, queries) -> Tuple[int, int]:
         """Run all groups; return (minF, minK) — reference main.cu:309-397."""
         f = self.f_values(jnp.asarray(queries))
-        min_f, min_k = select_best_jit(f, f >= 0)
+        # One transfer for both scalars (sequential int() reads each pay
+        # a tunnel round-trip on this platform).
+        min_f, min_k = jax.device_get(select_best_jit(f, f >= 0))
         return int(min_f), int(min_k)
 
     def compile(

@@ -62,6 +62,21 @@ class PackedEngineBase(QueryEngineBase):
     k_align: int = K_ALIGN
 
     def _pad_queries(self, queries) -> Tuple[jax.Array, int]:
+        # Host-side padding whenever the input is host data (the CLI,
+        # bench and checkpoint paths all pass NumPy): an eager
+        # jnp.concatenate here would be its own dispatched device program
+        # — one whole ~100 ms tunnel round-trip per query batch on this
+        # platform, dwarfing a shallow BFS (review r5).  The padded array
+        # then rides the jitted program's argument upload.
+        if not isinstance(queries, jax.Array):
+            queries = np.asarray(queries, dtype=np.int32)
+            k, s = queries.shape
+            pad = (-k) % self.k_align if k else self.k_align
+            if pad:
+                queries = np.concatenate(
+                    [queries, np.full((pad, s), -1, dtype=np.int32)], axis=0
+                )
+            return queries, k
         queries = jnp.asarray(queries, dtype=jnp.int32)
         k, s = queries.shape
         # K = 0 still pads to one full alignment group so the engine runs a

@@ -41,6 +41,7 @@ from .bfs import host_chunked_loop, validate_level_chunk
 from .bitbell import (
     WORD_BITS,
     FusedBestEngine,
+    _pack_status,
     bit_level_chunk,
     bit_level_init,
     bit_level_loop,
@@ -60,25 +61,97 @@ MAX_OFFSETS = 16
 MAX_RESIDUAL_FRAC = 0.02
 
 
+# An offset whose mask covers fewer than n/DEMOTE_DENSITY vertices is not
+# worth a full plane pass (each pass streams ~3 plane-sized arrays); its
+# edges ride the compact residual instead, whose per-level cost is O(rows)
+# not O(n).  The demotion total is capped so a pathological diff spectrum
+# cannot grow the residual unboundedly.
+DEMOTE_DENSITY = 64
+
+
 @jax.tree_util.register_pytree_node_class
 class StencilGraph:
     """Host-built stencil decomposition of a CSR graph.
 
-    ``offsets``: tuple of nonzero int diffs, each with an (n,) uint8 mask —
-    mask_d[u] = 1 iff directed edge (u, u+d) exists.  ``res_src/res_dst``:
-    the residual directed edges (diffs outside ``offsets``), exactly as
-    many as :func:`detect_stencil` found — per-graph static shapes, no
-    padding.  Self-loops (d=0) never change reachability and are dropped
-    entirely.
+    ``offsets``: tuple of nonzero int diffs; ``mask_bits`` is ONE (n,)
+    uint32 word per vertex with bit i set iff directed edge (u, u +
+    offsets[i]) exists — a single 4 B/vertex read per level instead of a
+    (n, #offsets) uint8 matrix (round-5 on-chip finding: the stencil
+    level is bandwidth-bound on exactly these auxiliary streams).
+
+    The residual (diffs outside ``offsets``, plus offsets demoted for
+    sparsity) is stored COMPACTED by destination: ``res_src`` (R,) int32
+    source rows, ``res_seg`` (R,) int32 sorted segment ids into
+    ``res_dst_unique`` (U,) int32 — per level one O(R) gather +
+    segment-OR + one O(U) row update, with NO n-sized temporaries.
+    Self-loops (d=0) never change reachability and are dropped entirely.
     """
 
-    def __init__(self, n, num_directed_edges, offsets, masks, res_src, res_dst):
+    def __init__(
+        self,
+        n,
+        num_directed_edges,
+        offsets,
+        mask_bits,
+        res_src,
+        res_seg,
+        res_dst_unique,
+    ):
         self.n = n
         self.num_directed_edges = num_directed_edges
         self.offsets = offsets  # static python ints
-        self.masks = masks  # (n, len(offsets)) uint8 device array
-        self.res_src = res_src  # (R_pad,) int32, sentinel n
-        self.res_dst = res_dst
+        self.mask_bits = mask_bits  # (n,) uint32 offset-presence word
+        self.res_src = res_src  # (R,) int32
+        self.res_seg = res_seg  # (R,) int32, sorted segment ids
+        self.res_dst_unique = res_dst_unique  # (U,) int32
+
+    @classmethod
+    def from_decomposition(
+        cls, n, num_directed_edges, offsets, masks, res_src, res_dst
+    ) -> "StencilGraph":
+        """Pack a :func:`detect_stencil` decomposition into the device
+        layout: demote sparse offsets to the residual, bit-pack the kept
+        masks, compact the residual by destination."""
+        masks = np.asarray(masks, dtype=np.uint8)
+        res_src = np.asarray(res_src, dtype=np.int64)
+        res_dst = np.asarray(res_dst, dtype=np.int64)
+        if len(offsets):
+            counts = masks.sum(axis=0, dtype=np.int64)
+            order = np.argsort(counts)  # sparsest first
+            budget = max(num_directed_edges // 8, 4096) - res_src.size
+            keep = np.ones(len(offsets), dtype=bool)
+            for i in order:
+                if counts[i] >= max(n // DEMOTE_DENSITY, 1):
+                    break  # the rest are denser still
+                if counts[i] > budget:
+                    break  # demotion cap reached
+                keep[i] = False
+                budget -= counts[i]
+                rows = np.nonzero(masks[:, i])[0]
+                res_src = np.concatenate([res_src, rows])
+                res_dst = np.concatenate([res_dst, rows + offsets[i]])
+            offsets = tuple(o for o, k in zip(offsets, keep) if k)
+            masks = masks[:, keep]
+        mask_bits = np.zeros(n, dtype=np.uint32)
+        for i in range(len(offsets)):
+            mask_bits |= masks[:, i].astype(np.uint32) << np.uint32(i)
+        if res_src.size:
+            order = np.argsort(res_dst, kind="stable")
+            res_src = res_src[order]
+            res_dst = res_dst[order]
+            uniq, seg = np.unique(res_dst, return_inverse=True)
+        else:
+            uniq = np.zeros(0, dtype=np.int64)
+            seg = np.zeros(0, dtype=np.int64)
+        return cls(
+            n,
+            num_directed_edges,
+            offsets,
+            jnp.asarray(mask_bits),
+            jnp.asarray(res_src.astype(np.int32)),
+            jnp.asarray(seg.astype(np.int32)),
+            jnp.asarray(uniq.astype(np.int32)),
+        )
 
     @staticmethod
     def from_host(
@@ -96,27 +169,21 @@ class StencilGraph:
                 f"{1 - max_residual_frac:.0%} of edges "
                 "(MSBFS_BACKEND=stencil needs a lattice/banded graph)"
             )
-        offsets, masks, res_src, res_dst = dec
-        return StencilGraph(
-            graph.n,
-            graph.num_directed_edges,
-            offsets,
-            jnp.asarray(masks),
-            jnp.asarray(res_src),
-            jnp.asarray(res_dst),
+        return StencilGraph.from_decomposition(
+            graph.n, graph.num_directed_edges, *dec
         )
 
     def tree_flatten(self):
         return (
-            (self.masks, self.res_src, self.res_dst),
+            (self.mask_bits, self.res_src, self.res_seg, self.res_dst_unique),
             (self.n, self.num_directed_edges, self.offsets),
         )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         n, e, offsets = aux
-        masks, res_src, res_dst = children
-        return cls(n, e, offsets, masks, res_src, res_dst)
+        mask_bits, res_src, res_seg, res_dst_unique = children
+        return cls(n, e, offsets, mask_bits, res_src, res_seg, res_dst_unique)
 
 
 def _edge_arrays(graph):
@@ -194,24 +261,33 @@ def _shift_planes(planes: jax.Array, d: int) -> jax.Array:
 
 def stencil_hits(frontier: jax.Array, graph: StencilGraph) -> jax.Array:
     """(n, W) uint32 frontier planes -> (n, W) per-vertex hit planes via
-    masked shifts + the bounded residual scatter."""
+    masked shifts + the compact residual segment-OR."""
     hits = jnp.zeros_like(frontier)
+    mask_bits = graph.mask_bits[:, None]  # (n, 1), broadcasts over W
     for i, d in enumerate(graph.offsets):
         masked = jnp.where(
-            graph.masks[:, i : i + 1] != 0, frontier, jnp.uint32(0)
+            (mask_bits >> jnp.uint32(i)) & jnp.uint32(1) != 0,
+            frontier,
+            jnp.uint32(0),
         )
         hits = hits | _shift_planes(masked, d)
     r = graph.res_src.shape[0]
     if r:
-        n = graph.n
-        src_words = jnp.take(frontier, graph.res_src, axis=0)
+        # Compact residual: O(R) gather + byte-lane segment-OR into the
+        # U unique destinations, then one O(U) row merge — no n-sized
+        # temporaries (the round-4 formulation zeroed and re-packed a
+        # full (n, K) byte matrix every level).
+        src_words = jnp.take(frontier, graph.res_src, axis=0)  # (R, W)
         src_bytes = unpack_byte_planes(src_words)  # (R, K) 0/1
-        hit_bytes = (
-            jnp.zeros((n, src_bytes.shape[1]), jnp.uint8)
-            .at[graph.res_dst]
-            .max(src_bytes)
+        seg = jax.ops.segment_max(
+            src_bytes,
+            graph.res_seg,
+            num_segments=graph.res_dst_unique.shape[0],
+            indices_are_sorted=True,
         )
-        hits = hits | pack_byte_planes(hit_bytes)
+        upd = pack_byte_planes(seg)  # (U, W)
+        u = graph.res_dst_unique
+        hits = hits.at[u].set(jnp.take(hits, u, axis=0) | upd)
     return hits
 
 
@@ -259,15 +335,16 @@ def stencil_best_fused(
     graph: StencilGraph, queries: jax.Array, k, max_levels=None
 ):
     """Whole stencil BFS + final (minF, minK) selection in one XLA
-    program (see ops.bitbell.bitbell_best_fused; ``k`` traced)."""
+    program returning one (2,) int64 buffer (see
+    ops.bitbell.bitbell_best_fused; ``k`` traced)."""
     f, _, _ = stencil_run(graph, queries, max_levels)
-    return fused_select(f, k)
+    min_f, min_k = fused_select(f, k)
+    return jnp.stack([min_f, min_k.astype(jnp.int64)])
 
 
 def _stencil_best_tail(graph, carry, k, chunk, max_levels):
     carry = bit_level_chunk(carry, _stencil_expand(graph), chunk, max_levels)
-    min_f, min_k = fused_select(carry[2], k)
-    return carry + (min_f, min_k)
+    return carry + (_pack_status(carry, k),)
 
 
 @partial(jax.jit, static_argnames=("max_levels",))

@@ -119,15 +119,37 @@ def test_residual_edges_exact():
 
     dec = detect_stencil(g, max_offsets=4, max_residual_frac=0.5)
     assert dec is not None and len(dec[2]) > 0  # residual in play
-    sg = StencilGraph(
-        g.n,
-        g.num_directed_edges,
-        dec[0],
-        jnp.asarray(dec[1]),
-        jnp.asarray(dec[2]),
-        jnp.asarray(dec[3]),
-    )
+    sg = StencilGraph.from_decomposition(g.n, g.num_directed_edges, *dec)
+    assert sg.res_src.shape[0] > 0
     queries = generators.random_queries(n, 7, max_group=3, seed=927)
+    padded = pad_queries(queries)
+    got = np.asarray(StencilEngine(sg).f_values(padded))
+    np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+
+
+def test_sparse_offset_demotion_exact():
+    """An offset whose mask covers < n/DEMOTE_DENSITY vertices must be
+    demoted into the compact residual — with reachability bit-exact.
+    Grid offsets stay plane passes; a handful of +17 edges (one distinct
+    diff, far under the density cutoff) must ride the residual."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+        DEMOTE_DENSITY,
+    )
+
+    n, grid = generators.grid_edges(31, 17)
+    sparse = np.array([[i * 50, i * 50 + 23] for i in range(5)], np.int64)
+    edges = np.concatenate([grid, sparse], axis=0)
+    g = CSRGraph.from_edges(n, edges)
+    dec = detect_stencil(g, max_offsets=8, max_residual_frac=0.1)
+    assert dec is not None
+    assert 23 in dec[0]  # detection keeps the diff as an offset...
+    assert sparse.shape[0] < n // DEMOTE_DENSITY
+    sg = StencilGraph.from_decomposition(g.n, g.num_directed_edges, *dec)
+    # ...and packing demotes it (plus its reverse) into the residual.
+    assert 23 not in sg.offsets and -23 not in sg.offsets
+    assert sg.res_src.shape[0] >= 2 * sparse.shape[0]
+    assert len(sg.offsets) == len(dec[0]) - 2
+    queries = generators.random_queries(n, 6, max_group=3, seed=931)
     padded = pad_queries(queries)
     got = np.asarray(StencilEngine(sg).f_values(padded))
     np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))

@@ -7,6 +7,7 @@
 # generated .gr fixture — road-class lattice, >= 10M arc lines — pushed
 # through the exact same converter + driver path a real download would use.
 # Big intermediates are deleted after the run; sizes/hashes stay in the log.
+# (No /usr/bin/time in this image: stages are timed with $SECONDS.)
 set -uo pipefail
 cd "$(dirname "$0")/.."
 RAW="${1:-benchmarks/raw_r5}"
@@ -20,10 +21,11 @@ echo "gr end-to-end start $(stamp) (side=$SIDE)"
 echo "== 0. fetch attempt (expected to fail: zero-egress sandbox)"
 timeout 30 curl -sSL -o "$WORK/USA-road-d.NY.gr.gz" \
     "http://www.diag.uniroma1.it/challenge9/data/USA-road-d/USA-road-d.NY.gr.gz" \
-    && echo "fetch OK (unexpected)" || echo "fetch FAILED rc=$? (zero egress, as expected)"
+    2>&1 && echo "fetch OK (unexpected)" || echo "fetch FAILED rc=$? (zero egress, as expected)"
 
 echo "== 1. fabricate .gr fixture (road-${SIDE}x${SIDE}, save_dimacs_gr)"
-/usr/bin/time -v python - "$WORK" "$SIDE" <<'EOF' 2>&1 | grep -E "wrote|Elapsed|Maximum resident"
+T0=$SECONDS
+python - "$WORK" "$SIDE" <<'EOF'
 import sys, time
 from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import generators
 from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import save_dimacs_gr
@@ -35,24 +37,27 @@ t0 = time.perf_counter()
 arcs = save_dimacs_gr(f"{work}/fixture.gr", n, edges,
                       comment=f"generated road-{side}x{side} fixture (zero-egress fallback)")
 print(f"wrote {arcs} arc lines, n={n}, m={edges.shape[0]} "
-      f"(gen {gen_s:.1f}s, write {time.perf_counter()-t0:.1f}s)")
+      f"(gen {gen_s:.1f}s, write {time.perf_counter()-t0:.1f}s)", flush=True)
 EOF
+echo "stage-1 wall: $((SECONDS - T0)) s"
 ls -l "$WORK/fixture.gr"
 
 echo "== 2. gen_cli --convert (the public-dataset ingest path, timed)"
-/usr/bin/time -v python -m parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.gen_cli \
+T0=$SECONDS
+python -m parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.gen_cli \
     --convert "$WORK/fixture.gr" --informat dimacs \
     --graph "$WORK/fixture_graph.bin" \
-    --queries 16 --max-group 8 --query-file "$WORK/fixture_query.bin" --seed 43 \
-    2>&1 | grep -vE "^\s*(Command being|User time|System time|Percent|Average|Voluntary|Involuntary|Swaps|File system|Socket|Signals|Page size|Exit status)"
+    --queries 16 --max-group 8 --query-file "$WORK/fixture_query.bin" --seed 43
+echo "stage-2 wall (parse + canonicalize + write): $((SECONDS - T0)) s"
 ls -l "$WORK"/fixture_graph.bin "$WORK"/fixture_query.bin
 
 echo "== 3. main.py end-to-end (reference argv contract, timed)"
-/usr/bin/time -v python main.py -g "$WORK/fixture_graph.bin" -q "$WORK/fixture_query.bin" -gn 1 \
-    2>&1 | grep -vE "^\s*(Command being|User time|System time|Percent|Average|Voluntary|Involuntary|Swaps|File system|Socket|Signals|Page size|Exit status)"
+T0=$SECONDS
+python main.py -g "$WORK/fixture_graph.bin" -q "$WORK/fixture_query.bin" -gn 1
+echo "stage-3 wall: $((SECONDS - T0)) s"
 
 echo "== 4. artifact hashes, then delete the big intermediates"
 sha256sum "$WORK"/fixture.gr "$WORK"/fixture_graph.bin "$WORK"/fixture_query.bin
 du -h "$WORK"/fixture.gr "$WORK"/fixture_graph.bin
-rm -f "$WORK"/fixture.gr "$WORK"/fixture_graph.bin "$WORK"/fixture_query.bin
+rm -f "$WORK"/fixture.gr "$WORK"/fixture_graph.bin "$WORK"/fixture_query.bin "$WORK"/USA-road-d.NY.gr.gz
 echo "gr end-to-end end $(stamp)"

@@ -112,6 +112,13 @@ class StencilGraph:
         """Pack a :func:`detect_stencil` decomposition into the device
         layout: demote sparse offsets to the residual, bit-pack the kept
         masks, compact the residual by destination."""
+        if len(offsets) > 32:
+            # mask_bits is one uint32 word; a wider offset set would wrap
+            # the shift count and silently collide mask bits.
+            raise ValueError(
+                f"{len(offsets)} offsets exceed the 32-bit mask word "
+                "(max_offsets must be <= 32)"
+            )
         masks = np.asarray(masks, dtype=np.uint8)
         res_src = np.asarray(res_src, dtype=np.int64)
         res_dst = np.asarray(res_dst, dtype=np.int64)
@@ -202,8 +209,9 @@ def detect_stencil(
     """Probe a host CSRGraph for a banded decomposition.
 
     Returns (offsets, masks, res_src, res_dst) — offsets a tuple of python
-    ints, masks (n, #offsets) uint8, residual arrays int32 sentinel-padded
-    — or None when no ``max_offsets``-diff set covers at least
+    ints, masks (n, #offsets) uint8, residual arrays int32 EXACT (one
+    entry per off-stencil directed edge, per-graph static shapes, no
+    padding) — or None when no ``max_offsets``-diff set covers at least
     ``1 - max_residual_frac`` of the directed edges.  Cost: O(m) NumPy
     passes on the host, paid once in the preprocessing span.
     """

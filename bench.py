@@ -91,6 +91,9 @@ REF_EDGE_TEPS = 1.5e9  # naive kernel edge-scan rate (flat r1-r4 estimate)
 # (docs/PERF_NOTES.md "Merged per-level forest gather").  The utilization
 # denominator VERDICT r4 item 6 asks for.
 ROOFLINE_ROWS_PER_S = 254e6
+# v5e nominal HBM bandwidth — the denominator for the stencil engine's
+# modeled stream traffic (its levels are HBM streams, not gathers).
+HBM_BYTES_PER_S = 819e9
 
 
 def reference_model(n, e_directed, k, levels_sum):
@@ -396,6 +399,7 @@ def run_workload() -> None:
     # UPPER bound when the hybrid is on (sparse levels skip the forest);
     # exact for BENCH_SPARSE=0 runs.
     rows_per_s = pct_of_roofline = None
+    stream_bytes_per_s = pct_of_hbm = None
     g_dev = getattr(engine, "graph", None)
     if (
         levels_max is not None
@@ -407,6 +411,24 @@ def run_workload() -> None:
         )
         rows_per_s = round(levels_max * slots_total / best_s)
         pct_of_roofline = round(rows_per_s / ROOFLINE_ROWS_PER_S, 4)
+    elif (
+        levels_max is not None
+        and engine_kind == "stencil"
+        and g_dev is not None
+    ):
+        # The stencil level is an HBM stream, not a gather: model the
+        # per-level traffic per vertex as, for each offset pass, 2 plane
+        # words (frontier in, shifted out) x W plus ONE mask word (the
+        # (n,) uint32 offset-presence word is K-independent), plus ~6
+        # plane-sized streams for the visited/new/counts plumbing, and
+        # state it against the v5e HBM roofline.  A MODEL of issued
+        # traffic (XLA fusion may beat it), the stream analog of
+        # gather_rows_per_s (VERDICT r4 item 6).
+        w_words = -(-k // 32)
+        words_per_vertex = len(g_dev.offsets) * (2 * w_words + 1) + 6 * w_words
+        per_level = words_per_vertex * g_dev.n * 4
+        stream_bytes_per_s = round(levels_max * per_level / best_s)
+        pct_of_hbm = round(stream_bytes_per_s / HBM_BYTES_PER_S, 4)
 
     def result_record(extra_metrics):
         floor_total = (
@@ -464,10 +486,13 @@ def run_workload() -> None:
                 },
                 "gather_rows_per_s": rows_per_s,
                 "pct_of_roofline": pct_of_roofline,
+                "stream_bytes_per_s": stream_bytes_per_s,
+                "pct_of_hbm_roofline": pct_of_hbm,
                 "roofline_note": (
-                    "rows/s vs measured v5e gather ceiling 254M rows/s; "
-                    "upper bound when hybrid is on (exact for "
-                    "BENCH_SPARSE=0)"
+                    "gather engines: rows/s vs measured v5e gather "
+                    "ceiling 254M rows/s (upper bound when hybrid is on; "
+                    "exact for BENCH_SPARSE=0).  stencil: MODELED issued "
+                    "stream bytes/s vs v5e HBM 819 GB/s"
                 ),
                 "extra_metrics": extra_metrics,
                 "baseline_note": baseline_note,

@@ -1,11 +1,27 @@
 #!/bin/bash
-# Round-5 TPU measurement runbook (VERDICT r4 "Next round" items 1, 4, 6, 7).
-# Priority order: the two unmeasured certifications first — RMAT-24 x K=256
-# (the r4 attempt died on tunnel outage + HBM OOM at the unchunked gather;
-# this run is memory-conservative: BENCH_SPARSE=0, slot-budget streaming)
-# and config 4 through the NEW stencil route (558f674, never run on chip).
-# Every step tees raw output into benchmarks/raw_r5/; each step is
-# independently restartable (persistent XLA compilation cache).
+# Round-5 TPU measurement runbook — the executed steps and their raw
+# artifacts (every step was run on 2026-07-31 and is independently
+# re-runnable; the persistent XLA compilation cache makes repeats cheap).
+#
+# Executed artifacts under benchmarks/raw_r5/:
+#   step 1  bench_rmat24_k256.json         (attempt: unchunked W=8 BFS in one
+#           dispatch crashed the TPU worker — honest root cause)
+#           bench_rmat24_k256_retry.json   (CERTIFIED 2.107 GTEPS, vs 1.41)
+#   step 2  config4_stencil_detail.json    (first stencil row 0.97 s + 4g
+#           gather shootout 11.79 s + config 1)
+#   step 2b config4_stencil2_detail.json   (post-optimization: config 4
+#           0.255 s vs_baseline 0.786; config 1 0.145 s)
+#           road_k64_stencil.json (0.277 s, vs 3.00)
+#           road_k256_stencil.json (0.715 s, vs 4.67)
+#   step 3  level_trace_road1024.txt       (MSBFS_STATS=2 stepped trace +
+#           sub-op micros; the stepped mode reads ~109 ms/level of pure
+#           tunnel RTT — per-level device cost needs fixed-count fori
+#           probes, docs/PERF_NOTES.md "Round-5 findings")
+#   step 4  bench_headline.json            (the BENCH_r05 artifact twin)
+#   step 5  gr_end_to_end.txt              (23M-arc .gr -> convert -> main.py)
+#
+# NOTE (hard-won): never OVERWRITE PYTHONPATH on a TPU run — the axon
+# plugin registers via PYTHONPATH=/root/.axon_site; append instead.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 RAW=benchmarks/raw_r5
@@ -16,28 +32,38 @@ echo "runbook start $(stamp)" | tee -a "$RAW/runbook_meta.txt"
 python -c "import jax; print('jax', jax.__version__)" 2>/dev/null \
     | tee -a "$RAW/runbook_meta.txt"
 
-echo "== 1. RMAT-24 x K=256 (the r4 casualty; slot-budget streaming path)"
+echo "== 1. RMAT-24 x K=256 (certified config: bounded dispatches + slot budget)"
 BENCH_CONFIGS= BENCH_SCALE=24 BENCH_K=256 BENCH_REPEATS=2 BENCH_EXTRA_KS= \
-    BENCH_SPARSE=0 MSBFS_SLOT_BUDGET=67108864 \
-    BENCH_WAIT_S=600 BENCH_RUN_S=7200 python bench.py \
-    2> "$RAW/bench_rmat24_k256.stderr" | tee "$RAW/bench_rmat24_k256.json"
+    BENCH_SPARSE=0 MSBFS_SLOT_BUDGET=33554432 BENCH_LEVEL_CHUNK=2 \
+    BENCH_WAIT_S=900 BENCH_RUN_S=7200 python bench.py \
+    2> "$RAW/bench_rmat24_k256_retry.stderr" | tee "$RAW/bench_rmat24_k256_retry.json"
 
-echo "== 2. config 4 through the stencil route (driver-contract bench row)"
-BENCH_CONFIGS=4 BENCH_RUN_S=3600 BENCH_DETAIL_PATH="$RAW/config4_stencil_detail.json" \
-    python bench.py 2> "$RAW/config4_stencil.stderr" \
-    | tee "$RAW/config4_stencil.json"
+echo "== 2. config sweep rows 4,4g,1 (stencil vs gather shootout + latency split)"
+BENCH_CONFIGS=4,4g,1 BENCH_RUN_S=3600 \
+    BENCH_DETAIL_PATH="$RAW/config4_stencil2_detail.json" python bench.py \
+    2> "$RAW/config4_stencil2.stderr" | tee "$RAW/config4_stencil2.json"
 
-echo "== 3. on-chip MSBFS_STATS=2 per-level trace, road-1024 (VERDICT r4 weak 1)"
-timeout 1800 python benchmarks/exp_level_trace.py \
+echo "== 2c. road-class K scaling through the stencil route"
+for K in 64 256; do
+  BENCH_CONFIGS= BENCH_GRAPH=road BENCH_ENGINE=stencil BENCH_SCALE=20 \
+      BENCH_K=$K BENCH_MAX_S=8 BENCH_LEVEL_CHUNK=auto BENCH_EXTRA_KS= \
+      BENCH_REPEATS=3 BENCH_RUN_S=1800 python bench.py \
+      2> "$RAW/road_k${K}_stencil.stderr" | tee "$RAW/road_k${K}_stencil.json"
+done
+
+echo "== 3. on-chip MSBFS_STATS=2 per-level trace + sub-op micros, road-1024"
+PYTHONPATH=/root/repo:${PYTHONPATH:-} timeout 1800 python benchmarks/exp_level_trace.py \
     2>&1 | tee "$RAW/level_trace_road1024.txt" || true
 
 echo "== 4. headline sweep (2,2c,4,1 — the BENCH_r05 artifact twin)"
-BENCH_DETAIL_PATH="$RAW/bench_headline_detail.json" python bench.py \
+BENCH_DETAIL_PATH="$RAW/bench_headline_detail.json" BENCH_RUN_S=2400 python bench.py \
     2> "$RAW/bench_headline.stderr" | tee "$RAW/bench_headline.json"
 
-echo "== 5. large .gr fixture end-to-end (converter path at >=10M arcs)"
+echo "== 5. real-format .gr end-to-end (converter path at 23M arcs)"
 timeout 3600 bash benchmarks/exp_gr_end_to_end.sh "$RAW" \
     2>&1 | tee "$RAW/gr_end_to_end.txt" || true
 
+echo "== 6. multi-chip decisions that still need pod hardware: see"
+echo "      benchmarks/tpu_r4_runbook.sh step 7 (push-vs-pull ICI routing,"
+echo "      configs 3/5/6) — one command when a pod exists."
 echo "runbook end $(stamp)" | tee -a "$RAW/runbook_meta.txt"
-echo "== done; raw artifacts in $RAW — fold into BASELINE.md + PERF_NOTES"

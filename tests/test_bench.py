@@ -74,6 +74,34 @@ def test_success_emits_metric_and_extras():
     assert d["gather_rows_per_s"] > 0 and d["pct_of_roofline"] > 0
 
 
+def test_stencil_config_reports_stream_utilization():
+    """A road/stencil run must carry the stream-bytes utilization fields
+    (the stencil analog of gather_rows_per_s, VERDICT r4 item 6)."""
+    proc = run_bench(
+        {
+            "BENCH_CONFIGS": "",
+            "BENCH_GRAPH": "road",
+            "BENCH_ENGINE": "stencil",
+            "BENCH_SCALE": "10",
+            "BENCH_K": "4",
+            "BENCH_MAX_S": "4",
+            "BENCH_REPEATS": "1",
+            "BENCH_EXTRA_KS": "",
+            "BENCH_LEVEL_CHUNK": "auto",
+            "BENCH_WAIT_S": "120",
+            "BENCH_RUN_S": "540",
+        }
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = last_json_line(proc.stdout)
+    d = rec["detail"]
+    assert rec["value"] and rec["value"] > 0
+    assert d["gather_rows_per_s"] is None  # no gather in this engine
+    assert d["stream_bytes_per_s"] > 0
+    assert 0 < d["pct_of_hbm_roofline"]
+    assert d["levels_max"] > 0 and rec["vs_baseline"] is not None
+
+
 def test_outage_fast_parsable_failure():
     """A dead backend must produce an error JSON line within the
     BENCH_WAIT_S budget — not a hang into the driver's kill timeout."""

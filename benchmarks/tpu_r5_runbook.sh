@@ -52,7 +52,7 @@ for K in 64 256; do
 done
 
 echo "== 3. on-chip MSBFS_STATS=2 per-level trace + sub-op micros, road-1024"
-PYTHONPATH=/root/repo:${PYTHONPATH:-} timeout 1800 python benchmarks/exp_level_trace.py \
+PYTHONPATH=$PWD:${PYTHONPATH:-} timeout 1800 python benchmarks/exp_level_trace.py \
     2>&1 | tee "$RAW/level_trace_road1024.txt" || true
 
 echo "== 4. headline sweep (2,2c,4,1 — the BENCH_r05 artifact twin)"

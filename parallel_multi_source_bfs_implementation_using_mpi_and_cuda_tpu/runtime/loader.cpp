@@ -237,6 +237,53 @@ int build_csr_parallel(int64_t n, int64_t m, ReadEdge read_edge,
 
 }  // namespace
 
+// --- DIMACS .gr text parsing (USA-road-d family) ---------------------------
+// The converter's host bottleneck was the Python line loop (~40 s for a
+// 23M-arc file, benchmarks/raw_r5/gr_end_to_end.txt); these passes parse
+// the same format (comment lines "c", one "p sp <n> <m>" header, arc lines
+// "a <u> <v> <w>" with 1-based endpoints, weights ignored —
+// utils/io.py::load_dimacs_gr documents the contract against reference
+// main.cu:30-32) at memory bandwidth.  Threads own the lines that START in
+// their byte range; a line may extend past the range end.
+
+inline const unsigned char* gr_parse_uint(const unsigned char* p,
+                                          const unsigned char* end,
+                                          int64_t* out) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  if (p >= end || *p < '0' || *p > '9') return nullptr;
+  int64_t x = 0;
+  while (p < end && *p >= '0' && *p <= '9') {
+    x = x * 10 + (*p - '0');
+    if (x > (int64_t{1} << 40)) return nullptr;  // absurd id: malformed
+    ++p;
+  }
+  *out = x;
+  return p;
+}
+
+inline bool gr_is_arc_line(const unsigned char* d, int64_t p, int64_t size) {
+  // Mirror the Python loader's startswith("a ") EXACTLY (io.py): 'a'
+  // followed by a space — not tab — or the two parsers would disagree
+  // on tab-delimited files (review r5).
+  return d[p] == 'a' && p + 1 < size && d[p + 1] == ' ';
+}
+
+// fn(line_start) for every line whose first byte is in [lo, hi).
+template <typename F>
+void gr_for_each_line(const unsigned char* d, int64_t size, int64_t lo,
+                      int64_t hi, F&& fn) {
+  int64_t p = lo;
+  if (lo > 0) {  // align to the first line START inside the range
+    while (p < hi && d[p - 1] != '\n') ++p;
+  }
+  while (p < hi) {
+    fn(p);
+    const void* nl = std::memchr(d + p, '\n', static_cast<size_t>(size - p));
+    if (!nl) break;
+    p = static_cast<const unsigned char*>(nl) - d + 1;
+  }
+}
+
 extern "C" {
 
 // Reads "int32 n, int64 m". Returns 0 on success.
@@ -575,6 +622,101 @@ int msbfs_rmat_edges(int32_t scale, int64_t m, double a, double b, double c,
     for (int64_t i = lo; i < hi; ++i) out[i] = perm_p[out[i]];
   });
   return 0;
+}
+
+// Pass 1 over a DIMACS .gr file: the "p sp <n> <m>" header vertex count
+// and the number of "a " arc lines (so the caller can allocate exactly).
+// Returns 0 ok, 1 open failure, 2 no/malformed header.
+int msbfs_gr_scan(const char* path, int64_t* n_out, int64_t* arcs_out) {
+  MappedFile f;
+  if (!f.open(path)) return 1;
+  const unsigned char* d = f.data;
+  const int64_t size = static_cast<int64_t>(f.size);
+  if (size == 0) return 2;
+  const int T = num_threads_for(size, int64_t{1} << 24);
+  std::vector<int64_t> counts(T, 0);
+  std::atomic<int64_t> header_n{-1};
+  parallel_ranges(T, size, [&](int t, int64_t lo, int64_t hi) {
+    int64_t c = 0;
+    gr_for_each_line(d, size, lo, hi, [&](int64_t p) {
+      if (gr_is_arc_line(d, p, size)) {
+        ++c;
+      } else if (d[p] == 'p' && p + 1 < size && d[p + 1] == ' ') {
+        // startswith("p ") like the Python loader.
+        const unsigned char* q = d + p + 1;
+        const unsigned char* end = d + size;
+        while (q < end && (*q == ' ' || *q == '\t')) ++q;
+        while (q < end && *q != ' ' && *q != '\t' && *q != '\n') ++q;  // tag
+        int64_t nv = -1, mv = -1;
+        const unsigned char* r = gr_parse_uint(q, end, &nv);
+        if (r) r = gr_parse_uint(r, end, &mv);
+        if (r && nv >= 0) header_n.store(nv);
+      }
+    });
+    counts[t] = c;
+  });
+  const int64_t n = header_n.load();
+  if (n < 0) return 2;
+  // The reference wire format stores n as int32 (main.cu:102); a wider
+  // header would let the int32 endpoint cast below wrap silently where
+  // the Python path fails loud (review r5).
+  if (n > INT32_MAX) return 6;
+  int64_t arcs = 0;
+  for (int64_t c : counts) arcs += c;
+  *n_out = n;
+  *arcs_out = arcs;
+  return 0;
+}
+
+// Pass 2: parse every arc line into 0-based endpoint arrays (caller
+// allocates ``arcs`` int32 entries each, from msbfs_gr_scan).  Weights and
+// trailing fields are ignored (hop-distance objective, main.cu:30-32).
+// Returns 0 ok, 1 open failure, 3 malformed arc line, 4 endpoint outside
+// 1..n, 5 arc count changed since the scan.
+int msbfs_gr_arcs(const char* path, int64_t n, int64_t arcs, int32_t* u_out,
+                  int32_t* v_out) {
+  MappedFile f;
+  if (!f.open(path)) return 1;
+  const unsigned char* d = f.data;
+  const int64_t size = static_cast<int64_t>(f.size);
+  const int T = num_threads_for(size, int64_t{1} << 24);
+  // Count per range first so every thread knows its output base (same
+  // byte partition as parallel_ranges uses below: T ranges of equal
+  // chunk), then parse into disjoint slices — file order preserved.
+  std::vector<int64_t> counts(T, 0);
+  parallel_ranges(T, size, [&](int t, int64_t lo, int64_t hi) {
+    int64_t c = 0;
+    gr_for_each_line(d, size, lo, hi, [&](int64_t p) {
+      if (gr_is_arc_line(d, p, size)) ++c;
+    });
+    counts[t] = c;
+  });
+  std::vector<int64_t> base(T + 1, 0);
+  for (int t = 0; t < T; ++t) base[t + 1] = base[t] + counts[t];
+  if (base[T] != arcs) return 5;
+  std::atomic<int> err{0};
+  parallel_ranges(T, size, [&](int t, int64_t lo, int64_t hi) {
+    int64_t w = base[t];
+    gr_for_each_line(d, size, lo, hi, [&](int64_t p) {
+      if (!gr_is_arc_line(d, p, size)) return;
+      const unsigned char* end = d + size;
+      int64_t u = -1, v = -1;
+      const unsigned char* r = gr_parse_uint(d + p + 1, end, &u);
+      if (r) r = gr_parse_uint(r, end, &v);
+      if (!r) {
+        err.store(3);
+        return;
+      }
+      if (u < 1 || u > n || v < 1 || v > n) {
+        err.store(4);
+        return;
+      }
+      u_out[w] = static_cast<int32_t>(u - 1);
+      v_out[w] = static_cast<int32_t>(v - 1);
+      ++w;
+    });
+  });
+  return err.load();
 }
 
 }  // extern "C"

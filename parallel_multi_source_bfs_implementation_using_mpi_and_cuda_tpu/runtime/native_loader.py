@@ -94,6 +94,20 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             ),
         ]
         lib.msbfs_rmat_edges.restype = ctypes.c_int
+        lib.msbfs_gr_scan.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.msbfs_gr_scan.restype = ctypes.c_int
+        lib.msbfs_gr_arcs.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(dtype=np.int32, ndim=1, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, ndim=1, flags="C_CONTIGUOUS"),
+        ]
+        lib.msbfs_gr_arcs.restype = ctypes.c_int
         _lib = lib
     except (OSError, AttributeError):
         # AttributeError: a stale .so built before a newer symbol existed —
@@ -234,3 +248,39 @@ def rmat_edges(scale, m, a, b, c, seed):
     if rc != 0:
         raise ValueError(f"native rmat_edges failed (rc={rc})")
     return out
+
+
+_GR_ERRORS = {
+    1: "cannot open file",
+    2: "no 'p sp <n> <m>' header line",
+    3: "malformed arc line",
+    4: "arc endpoint outside 1..n",
+    5: "arc count changed between scan and parse",
+    6: "header vertex count exceeds int32 (reference format is int32 n)",
+}
+
+
+def load_gr_arcs(path: str):
+    """Native DIMACS .gr parse -> (n, (R, 2) int32 0-based arc array), or
+    None when the native library is unavailable (the caller keeps its
+    Python line loop).  Raises ValueError on a malformed file with the
+    same fail-loud posture as the Python parser (utils/io.py).  Plain
+    text only — .gz files stay on the Python path."""
+    lib = _get_lib()
+    if lib is None or not hasattr(lib, "msbfs_gr_scan"):
+        return None
+    n = ctypes.c_int64()
+    arcs = ctypes.c_int64()
+    rc = lib.msbfs_gr_scan(path.encode(), ctypes.byref(n), ctypes.byref(arcs))
+    if rc != 0:
+        raise ValueError(
+            f"{path}: {_GR_ERRORS.get(rc, f'native gr_scan rc={rc}')}"
+        )
+    u = np.empty(arcs.value, dtype=np.int32)
+    v = np.empty(arcs.value, dtype=np.int32)
+    rc = lib.msbfs_gr_arcs(path.encode(), n.value, arcs.value, u, v)
+    if rc != 0:
+        raise ValueError(
+            f"{path}: {_GR_ERRORS.get(rc, f'native gr_arcs rc={rc}')}"
+        )
+    return int(n.value), np.stack([u, v], axis=1)

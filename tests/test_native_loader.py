@@ -280,3 +280,45 @@ def test_dedup_rows_nonzero_first_offset(built):
     dst, deg = native_loader.dedup_rows(row_offsets, col_indices)
     np.testing.assert_array_equal(deg, [1, 1])
     np.testing.assert_array_equal(dst, [1, 0])
+
+
+def test_gr_parse_matches_python(built, tmp_path, monkeypatch):
+    """Native DIMACS .gr parse == Python line loop, including the
+    canonicalization downstream, and invariant in the thread count."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_dimacs_gr,
+        save_dimacs_gr,
+    )
+
+    n, edges = generators.road_edges(20, 14, seed=71)
+    p = tmp_path / "road.gr"
+    save_dimacs_gr(p, n, edges, comment="native-parity fixture")
+    n_py, e_py = load_dimacs_gr(p, native=False)
+    n_cc, e_cc = load_dimacs_gr(p, native=True)
+    assert n_cc == n_py
+    np.testing.assert_array_equal(e_cc, e_py)
+    monkeypatch.setenv("MSBFS_NATIVE_THREADS", "3")
+    n_t3, e_t3 = load_dimacs_gr(p, native=True)
+    assert n_t3 == n_py
+    np.testing.assert_array_equal(e_t3, e_py)
+
+
+def test_gr_parse_errors_match_python_contract(built, tmp_path):
+    """Native .gr errors keep the Python parser's fail-loud messages:
+    missing header -> 'header', bad endpoint -> 'outside'."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_dimacs_gr,
+    )
+
+    p = tmp_path / "bad.gr"
+    p.write_text("a 1 2 3\n")
+    with pytest.raises(ValueError, match="header"):
+        load_dimacs_gr(p, native=True)
+    p.write_text("p sp 2 1\na 1 9 4\n")
+    with pytest.raises(ValueError, match="outside"):
+        load_dimacs_gr(p, native=True)
+    # Comment/blank/weird lines are ignored like the Python loop; a
+    # final arc line without a trailing newline still parses.
+    p.write_text("c x\n\nq zz\np sp 3 2\na 1 2 9\na 2 3 9")
+    n, e = load_dimacs_gr(p, native=True)
+    assert n == 3 and e.tolist() == [[0, 1], [1, 2]]

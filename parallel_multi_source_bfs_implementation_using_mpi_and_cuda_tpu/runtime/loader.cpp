@@ -635,7 +635,11 @@ int msbfs_gr_scan(const char* path, int64_t* n_out, int64_t* arcs_out) {
   if (size == 0) return 2;
   const int T = num_threads_for(size, int64_t{1} << 24);
   std::vector<int64_t> counts(T, 0);
-  std::atomic<int64_t> header_n{-1};
+  // Per-thread LAST header (byte offset + value); reduced after the join
+  // to the file-order-last one — the Python parser's deterministic
+  // "last 'p ' line wins", which a racy shared store could not match on
+  // a (malformed) multi-header file (review r5).
+  std::vector<int64_t> header_off(T, -1), header_val(T, -1);
   parallel_ranges(T, size, [&](int t, int64_t lo, int64_t hi) {
     int64_t c = 0;
     gr_for_each_line(d, size, lo, hi, [&](int64_t p) {
@@ -650,12 +654,21 @@ int msbfs_gr_scan(const char* path, int64_t* n_out, int64_t* arcs_out) {
         int64_t nv = -1, mv = -1;
         const unsigned char* r = gr_parse_uint(q, end, &nv);
         if (r) r = gr_parse_uint(r, end, &mv);
-        if (r && nv >= 0) header_n.store(nv);
+        if (r && nv >= 0) {
+          header_off[t] = p;
+          header_val[t] = nv;
+        }
       }
     });
     counts[t] = c;
   });
-  const int64_t n = header_n.load();
+  int64_t n = -1, best_off = -1;
+  for (int t = 0; t < T; ++t) {
+    if (header_off[t] > best_off) {
+      best_off = header_off[t];
+      n = header_val[t];
+    }
+  }
   if (n < 0) return 2;
   // The reference wire format stores n as int32 (main.cu:102); a wider
   // header would let the int32 endpoint cast below wrap silently where

@@ -267,7 +267,10 @@ def load_gr_arcs(path: str):
     same fail-loud posture as the Python parser (utils/io.py).  Plain
     text only — .gz files stay on the Python path."""
     lib = _get_lib()
-    if lib is None or not hasattr(lib, "msbfs_gr_scan"):
+    if lib is None:
+        # A stale .so missing the symbol already failed _get_lib's
+        # argtypes setup (AttributeError -> _load_failed), so lib being
+        # non-None implies the symbol exists.
         return None
     n = ctypes.c_int64()
     arcs = ctypes.c_int64()

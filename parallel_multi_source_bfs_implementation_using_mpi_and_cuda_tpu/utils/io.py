@@ -130,7 +130,10 @@ def _canonical_undirected(edges: np.ndarray) -> np.ndarray:
     # np.unique(..., axis=0) falls back to (measured 6.0 s -> 0.3 s on a
     # 2.5M-arc road file, r5) — ids are int32 so lo << 32 | hi is exact.
     keys = np.unique((lo << 32) | hi)
-    return np.stack([keys >> 32, keys & 0xFFFFFFFF], axis=1)
+    # Back to int32 (ids are < 2^31 by construction): the loaders buffer
+    # int32 precisely to halve peak RAM on the big public datasets, and
+    # every downstream consumer re-casts to int32 anyway.
+    return np.stack([keys >> 32, keys & 0xFFFFFFFF], axis=1).astype(np.int32)
 
 
 def load_dimacs_gr(path: str | os.PathLike, native: Optional[bool] = None):

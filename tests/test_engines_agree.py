@@ -259,3 +259,69 @@ def test_engine_agrees(workload, name):
         else (-1, -1)
     )
     assert eng.best(padded) == want
+
+
+def _stencil(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+        StencilEngine,
+        StencilGraph,
+    )
+
+    return StencilEngine(StencilGraph.from_host(g))
+
+
+def _stencil_chunked(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+        StencilEngine,
+        StencilGraph,
+    )
+
+    return StencilEngine(StencilGraph.from_host(g), level_chunk=2)
+
+
+# The banded-class slice of the same guarantee: the stencil engines only
+# accept banded graphs, so they get their cross-engine check on a road
+# lattice against a representative sample of the general engines (every
+# general engine runs any graph; the full matrix above covers them).
+BANDED_ENGINES = {
+    "stencil": _stencil,
+    "stencil_chunked": _stencil_chunked,
+    "bitbell": _bitbell,
+    "bitbell_chunked": _bitbell_chunked,
+    "push": _push,
+    "distributed": _distributed,
+    "sharded_bell": _sharded_bell,
+}
+
+
+@pytest.fixture(scope="module")
+def banded_workload():
+    from oracle import oracle_bfs, oracle_f
+
+    n, edges = generators.road_edges(18, 21, seed=803)
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 9, max_group=5, seed=804)
+    queries[2] = np.zeros(0, dtype=np.int32)
+    queries[5] = np.array([-1, n + 3], dtype=np.int32)
+    padded = pad_queries(queries)
+    reference = np.asarray(
+        [oracle_f(oracle_bfs(n, edges, q)) for q in queries], dtype=np.int64
+    )
+    return g, padded, reference
+
+
+@pytest.mark.parametrize("name", sorted(BANDED_ENGINES))
+def test_engine_agrees_banded(banded_workload, name):
+    g, padded, reference = banded_workload
+    if name.startswith(("distributed", "sharded")) and len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    eng = BANDED_ENGINES[name](g)
+    np.testing.assert_array_equal(np.asarray(eng.f_values(padded)), reference)
+    f = reference
+    valid = f >= 0
+    want = (
+        (int(f[valid].min()), int(np.flatnonzero(f == f[valid].min())[0]))
+        if valid.any()
+        else (-1, -1)
+    )
+    assert eng.best(padded) == want

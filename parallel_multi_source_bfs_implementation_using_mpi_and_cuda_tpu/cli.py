@@ -485,19 +485,6 @@ def main(argv: Optional[List[str]] = None) -> int:
                         file=sys.stderr,
                     )
                     engine = StencilEngine(sg, level_chunk=stencil_chunk)
-            if hbm_warn and engine is None:
-                # The estimate models the default (hybrid bitbell) engine,
-                # which also serves unrecognized MSBFS_BACKEND values; the
-                # recognized non-bitbell backends have different
-                # footprints, and the stencil route (decided above) has a
-                # far smaller one — warning there would steer users OFF
-                # the engine that fits (review r5).
-                print(
-                    f"warning: graph needs ~{hbm_need >> 20} MiB but one "
-                    f"chip has {hbm_have >> 20} MiB; run with -gn > 1 to "
-                    "auto-shard the CSR (this run may exhaust memory)",
-                    file=sys.stderr,
-                )
             use_dense = backend == "dense"
             if backend == "auto" and is_tpu_backend():
                 threshold = _env_int("MSBFS_DENSE_THRESHOLD", 8192)
@@ -581,10 +568,54 @@ def main(argv: Optional[List[str]] = None) -> int:
                 from .models.bell import BellGraph
                 from .ops.bitbell import BitBellEngine
 
-                announce_chunk()
-                engine = BitBellEngine(
-                    BellGraph.from_host(graph), level_chunk=level_chunk
-                )
+                if hbm_warn:
+                    # The estimate models this default HYBRID layout
+                    # (forest + dedup CSR + byte-lane scratch; the other
+                    # backends have different footprints and the stencil
+                    # route a far smaller one, so ONLY this path prints —
+                    # review r5).  Round 5: instead of warning and
+                    # probably OOMing, drop the hybrid CSR and run the
+                    # streamed pure-pull configuration — the
+                    # RMAT-25-certified constants
+                    # (benchmarks/raw_r5/bench_rmat25.json): no dedup
+                    # CSR, 32M-slot gather segments, at most 8 levels per
+                    # dispatch (an unchunked wide-plane dispatch is what
+                    # crashed the TPU worker, raw_r5 root cause).
+                    # Explicit MSBFS_LEVEL_CHUNK/MSBFS_SLOT_BUDGET still
+                    # win via the normal knobs.  Printed in place of
+                    # announce_chunk() so the stated bound is the one
+                    # that actually runs.
+                    streamed_chunk = (
+                        min(level_chunk or 8, 8)
+                        if explicit_chunk is None or explicit_chunk < 0
+                        else level_chunk
+                    )
+                    print(
+                        f"graph needs ~{hbm_need >> 20} MiB (hybrid "
+                        f"layout) but one chip has {hbm_have >> 20} MiB: "
+                        "dropping the hybrid CSR and streaming per-level "
+                        "gathers within budget, "
+                        f"{streamed_chunk or 'unbounded'} levels/dispatch "
+                        "(slower, and a graph beyond even the streamed "
+                        "layout may still exhaust memory; run with "
+                        "-gn > 1 to auto-shard instead)",
+                        file=sys.stderr,
+                    )
+                    engine = BitBellEngine(
+                        BellGraph.from_host(graph, keep_sparse=False),
+                        sparse_budget=0,
+                        level_chunk=streamed_chunk,
+                        slot_budget=(
+                            1 << 25
+                            if not os.environ.get("MSBFS_SLOT_BUDGET")
+                            else None
+                        ),
+                    )
+                else:
+                    announce_chunk()
+                    engine = BitBellEngine(
+                        BellGraph.from_host(graph), level_chunk=level_chunk
+                    )
         stats_env = os.environ.get("MSBFS_STATS", "")
         stats_mode = stats_env in ("1", "2")
         # MSBFS_STATS=2: additionally trace each BFS level (frontier size,

@@ -181,15 +181,25 @@ def test_auto_vshard_routing(tmp_path, capsys, monkeypatch):
 
 
 def test_single_chip_hbm_warning(tmp_path, capsys, monkeypatch):
+    """A beyond-budget graph at -gn 1 routes to the STREAMED layout
+    (r5: no hybrid CSR, segmented gathers, tight dispatch bound — the
+    RMAT-25-certified configuration) instead of warning and OOMing, with
+    a bit-identical report."""
     n, edges = generators.gnm_edges(60, 180, seed=322)
     g, q = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
     save_graph_bin(g, n, edges)
     save_query_bin(q, [[0], [7]])
+    rc = main(["main.py", "-g", g, "-q", q, "-gn", "1"])
+    want = capsys.readouterr().out
+    assert rc == 0
     monkeypatch.setenv("MSBFS_HBM_BYTES", "4096")
     rc = main(["main.py", "-g", g, "-q", q, "-gn", "1"])
     captured = capsys.readouterr()
     assert rc == 0  # proceeds (small graph fits in reality)
+    assert "streaming per-level gathers" in captured.err
     assert "run with -gn > 1" in captured.err
+    # Same report lines 1-5 (the timing lines differ).
+    assert captured.out.splitlines()[:5] == want.splitlines()[:5]
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,314 @@
+"""Pallas/Mosaic probe: the STENCIL level as a fused VMEM kernel.
+
+Every prior Pallas attempt on this stack died on the frontier GATHER
+(docs/PALLAS_LOG.md: Mosaic rejects all gather formulations on jax
+0.9.0 / libtpu 0.0.34).  The round-5 stencil engine removed the gather:
+a road-class level is 8 masked flat-id SHIFTS + OR — lane rolls and
+static row slices, exactly what Mosaic does support.  This probe asks
+whether a fused kernel (one VMEM pass: read frontier once, apply all
+offsets, write hits once) beats the XLA formulation's ~0.18 ms/level
+(docs/PERF_NOTES.md "Round-5 findings"), which streams ~3 plane-sized
+arrays per offset pass.
+
+Formulation: the (n,) uint32 plane (W=1: one word of 32 query bits per
+vertex) is viewed as (R, 128) with R = ceil(n/128) (tail zero-padded).
+A flat shift by d decomposes into a lane roll by r = d mod 128 and a
+static row shift by q = floor(d/128), with lanes below r borrowing one
+more row:
+
+    out[a, b] = in[a - q - (b < r), (b - r) mod 128]
+
+so each offset costs one pltpu.roll along lanes + two statically-shifted
+row copies + a lane-index select.  Single whole-array VMEM block
+(road-1024: 3 x 4.2 MB planes, within the ~16 MB/core VMEM); larger
+graphs would need a haloed grid — this probe answers expressibility and
+per-byte speed first.
+
+Run on the real chip: python benchmarks/pallas_stencil_probe.py
+(PROBE_SIDE=1024 default).
+"""
+
+import os
+import time
+
+import numpy as np
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.xla_cache import (
+    configure_compilation_cache,
+)
+
+configure_compilation_cache()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+SIDE = int(os.environ.get("PROBE_SIDE", "1024"))
+LANES = 128
+ITERS = int(os.environ.get("PROBE_ITERS", "512"))
+
+
+def flat_shift_2d(x, d, lane_idx, pltpu):
+    """(R, 128) view of a flat shift by d: out_flat[i] = x_flat[i - d],
+    zero fill at the array edges."""
+    r = d % LANES  # python ints: static (nonneg also for negative d)
+    q = d // LANES  # floor division pairs with the mod above
+
+    # pltpu.roll with a python-int shift lowers the amount as i64 and
+    # trips Mosaic's "must be 32-bit" check (the same i64 curse as the
+    # gather probes); a static lane concat expresses the same rotation
+    # with no dynamic operand at all.
+    rolled = (
+        jnp.concatenate([x[:, LANES - r :], x[:, : LANES - r]], axis=1)
+        if r
+        else x
+    )
+
+    def row_shift(arr, rows):
+        if rows == 0:
+            return arr
+        R = arr.shape[0]
+        z = jnp.zeros((abs(rows), arr.shape[1]), arr.dtype)
+        if rows > 0:
+            return jnp.concatenate([z, arr[: R - rows]], axis=0)
+        return jnp.concatenate([arr[-rows:], z], axis=0)
+
+    hi = row_shift(rolled, q)  # lanes b >= r
+    if not r:
+        return hi
+    lo = row_shift(rolled, q + 1)  # lanes b < r borrow one more row
+    return jnp.where(lane_idx >= r, hi, lo)
+
+
+def make_kernel(offsets):
+    import jax.experimental.pallas.tpu as pltpu
+
+    def kernel(f_ref, m_ref, o_ref):
+        f = f_ref[...]  # (R, 128) uint32 frontier words
+        m = m_ref[...]  # (R, 128) uint32 offset-presence words
+        lane_idx = lax.broadcasted_iota(jnp.int32, f.shape, 1)
+        hits = jnp.zeros_like(f)
+        for i, d in enumerate(offsets):
+            masked = jnp.where(
+                (m >> jnp.uint32(i)) & jnp.uint32(1) != 0, f, jnp.uint32(0)
+            )
+            hits = hits | flat_shift_2d(masked, d, lane_idx, pltpu)
+        o_ref[...] = hits
+
+    return kernel
+
+
+def pallas_stencil(offsets, rows):
+    import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
+
+    return pl.pallas_call(
+        make_kernel(offsets),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
+    )
+
+
+def make_halo_kernel(offsets, block_rows):
+    import jax.experimental.pallas.tpu as pltpu
+
+    def kernel(fp, fc, fnx, mp, mc, mnx, o_ref):
+        # Three consecutive (B, 128) blocks of the SAME padded array give
+        # the kernel a full block of halo on each side with plain Blocked
+        # specs — pl.Element windows crash this stack's AOT compile
+        # helper (HTTP 500 at any block size), and Mosaic's
+        # tpu.dynamic_rotate rejects i64 roll amounts, so everything here
+        # is static concats and slices.
+        f = jnp.concatenate([fp[...], fc[...], fnx[...]], axis=0)
+        m = jnp.concatenate([mp[...], mc[...], mnx[...]], axis=0)
+        lane_idx = lax.broadcasted_iota(jnp.int32, f.shape, 1)
+        hits = jnp.zeros_like(f)
+        for i, d in enumerate(offsets):
+            masked = jnp.where(
+                (m >> jnp.uint32(i)) & jnp.uint32(1) != 0, f, jnp.uint32(0)
+            )
+            hits = hits | flat_shift_2d(masked, d, lane_idx, pltpu)
+        o_ref[...] = hits[block_rows : 2 * block_rows]
+
+    return kernel
+
+
+def pallas_stencil_halo(offsets, rows_pad, block_rows, halo_rows):
+    """Grid variant for planes beyond one VMEM block: the caller pads ONE
+    full block of zeros on each end, and each grid step reads blocks
+    (i, i+1, i+2) of the same arrays — prev/current/next — so shifts up
+    to block_rows*128 flat positions stay in-window."""
+    import jax.experimental.pallas as pl
+    import jax.experimental.pallas.tpu as pltpu
+
+    del halo_rows  # the halo is one full block in this formulation
+    grid = rows_pad // block_rows - 2
+
+    def spec(off):
+        return pl.BlockSpec(
+            (block_rows, LANES),
+            lambda i, off=off: (i + off, 0),
+            memory_space=pltpu.VMEM,
+        )
+
+    inner = pl.pallas_call(
+        make_halo_kernel(offsets, block_rows),
+        grid=(grid,),
+        in_specs=[spec(0), spec(1), spec(2), spec(0), spec(1), spec(2)],
+        out_specs=spec(1),
+        out_shape=jax.ShapeDtypeStruct((rows_pad, LANES), jnp.uint32),
+    )
+
+    def fn(f2, m2):
+        return inner(f2, f2, f2, m2, m2, m2)
+
+    return fn
+
+
+def main():
+    print(f"devices: {jax.devices()}  jax {jax.__version__}", flush=True)
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+        StencilGraph,
+        stencil_hits,
+    )
+
+    n, edges = generators.road_edges(SIDE, SIDE, seed=46)
+    g = CSRGraph.from_edges(n, edges)
+    sg = StencilGraph.from_host(g)
+    print(
+        f"road-{SIDE}: n={n} offsets={sg.offsets} "
+        f"residual={sg.res_src.shape[0]}",
+        flush=True,
+    )
+
+    rows = -(-n // LANES)
+    # Whole-plane single block only up to ~2 MB (the ~16 MB/core VMEM has
+    # to hold 2 inputs + output + temporaries; the side-1024 whole-array
+    # attempt crashed the remote compile helper) — larger planes take the
+    # haloed grid (overlapping pl.Element windows).
+    use_halo = rows * LANES * 4 > (2 << 20) or os.environ.get("PROBE_HALO")
+    block_rows = int(os.environ.get("PROBE_BLOCK", "1024"))
+    halo_rows = block_rows  # prev/next-block formulation: halo = 1 block
+    if use_halo:
+        assert max(abs(d) for d in sg.offsets) < (block_rows - 1) * LANES
+        rows_pad = 2 * block_rows + -(-rows // block_rows) * block_rows
+        h = block_rows
+    else:
+        rows_pad = rows
+        h = 0
+
+    rng = np.random.default_rng(7)
+    flat = (
+        rng.integers(0, 2**32, size=n, dtype=np.uint32)
+        & rng.integers(0, 2, size=n, dtype=np.uint32) * 0xFFFFFFFF
+    )
+    f2 = np.zeros((rows_pad, LANES), np.uint32)
+    f2.reshape(-1)[h * LANES : h * LANES + n] = flat
+    m2 = np.zeros((rows_pad, LANES), np.uint32)
+    m2.reshape(-1)[h * LANES : h * LANES + n] = np.asarray(sg.mask_bits)
+
+    # ---- compile attempt (the probe's main question) --------------------
+    try:
+        if use_halo:
+            print(
+                f"haloed grid: rows_pad={rows_pad} block={block_rows} "
+                f"halo={halo_rows}",
+                flush=True,
+            )
+            fn = jax.jit(
+                pallas_stencil_halo(sg.offsets, rows_pad, block_rows, halo_rows)
+            )
+        else:
+            fn = jax.jit(pallas_stencil(sg.offsets, rows))
+        out = np.asarray(fn(f2, m2))
+        print("PALLAS STENCIL COMPILED AND RAN", flush=True)
+    except Exception as e:
+        print(f"REJECTED: {type(e).__name__}: {str(e)[:3000]}", flush=True)
+        return 1
+
+    # ---- correctness vs the XLA formulation (shift part only; the
+    # residual is outside the kernel in both designs) ---------------------
+    sg_nores = StencilGraph(
+        sg.n,
+        sg.num_directed_edges,
+        sg.offsets,
+        sg.mask_bits,
+        jnp.zeros(0, jnp.int32),
+        jnp.zeros(0, jnp.int32),
+        jnp.zeros(0, jnp.int32),
+    )
+    want = np.asarray(
+        jax.jit(lambda fr: stencil_hits(fr, sg_nores))(
+            jnp.asarray(flat[:, None])
+        )
+    )[:, 0]
+    got = out.reshape(-1)[h * LANES : h * LANES + n]
+    if np.array_equal(got, want):
+        print("BIT-EXACT vs XLA stencil_hits", flush=True)
+    else:
+        bad = np.flatnonzero(got != want)
+        print(
+            f"MISMATCH at {bad.size} of {n} words (first {bad[:5]}): "
+            f"got {got[bad[:3]]}, want {want[bad[:3]]}",
+            flush=True,
+        )
+        return 1
+
+    # ---- speed: 64 fused levels in one dispatch, both formulations ------
+    def timeit(name, fn_, *args, reps=5):
+        int(np.asarray(fn_(*args)))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            int(np.asarray(fn_(*args)))
+            ts.append(time.perf_counter() - t0)
+        print(f"{name}: median {np.median(ts) * 1e3:.1f} ms", flush=True)
+        return float(np.median(ts))
+
+    floor = timeit("floor (x+1)", jax.jit(lambda x: x + 1), jnp.int32(3))
+    m2j = jnp.asarray(m2)
+    if use_halo:
+        raw = pallas_stencil_halo(sg.offsets, rows_pad, block_rows, halo_rows)
+
+        def pallas_hits(fr, m):
+            # The grid writes only the interior; the output halo BLOCKS
+            # are uninitialized and MUST be zeroed before the next level
+            # reads them as shift sources.
+            o = raw(fr, m)
+            return o.at[:block_rows].set(0).at[rows_pad - block_rows :].set(0)
+
+    else:
+        pallas_hits = pallas_stencil(sg.offsets, rows)
+
+    @jax.jit
+    def loop_pallas(f):
+        return lax.fori_loop(0, ITERS, lambda i, h: pallas_hits(h, m2j), f).sum()
+
+    @jax.jit
+    def loop_xla(fr):
+        return lax.fori_loop(
+            0, 64, lambda i, h: stencil_hits(h, sg_nores), fr
+        ).sum()
+
+    t_p = timeit("ITERSx pallas stencil level", loop_pallas, jnp.asarray(f2))
+    t_x = timeit("ITERSx XLA stencil level", loop_xla, jnp.asarray(flat[:, None]))
+    print(
+        f"per-level: pallas {(t_p - floor) / ITERS * 1e3:.3f} ms, "
+        f"XLA {(t_x - floor) / ITERS * 1e3:.3f} ms",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,11 +18,14 @@ more row:
 
     out[a, b] = in[a - q - (b < r), (b - r) mod 128]
 
-so each offset costs one pltpu.roll along lanes + two statically-shifted
-row copies + a lane-index select.  Single whole-array VMEM block
-(road-1024: 3 x 4.2 MB planes, within the ~16 MB/core VMEM); larger
-graphs would need a haloed grid — this probe answers expressibility and
-per-byte speed first.
+so each offset costs one static lane concat + two statically-shifted
+row copies + a lane-index select (pltpu.roll's shift amount lowers as
+i64 and Mosaic rejects it).  Planes up to ~2 MB run as a single
+whole-array VMEM block; larger planes route to a 3-consecutive-Blocked-
+blocks halo grid — which the axon remote-compile helper currently
+CRASHES on (HTTP 500 for any gridded pallas_call; see
+docs/PALLAS_LOG.md round-5 section), so full-size road-1024 is not
+currently servable by Pallas on this stack.
 
 Run on the real chip: python benchmarks/pallas_stencil_probe.py
 (PROBE_SIDE=1024 default).
@@ -48,7 +51,7 @@ LANES = 128
 ITERS = int(os.environ.get("PROBE_ITERS", "512"))
 
 
-def flat_shift_2d(x, d, lane_idx, pltpu):
+def flat_shift_2d(x, d, lane_idx):
     """(R, 128) view of a flat shift by d: out_flat[i] = x_flat[i - d],
     zero fill at the array edges."""
     r = d % LANES  # python ints: static (nonneg also for negative d)
@@ -81,8 +84,6 @@ def flat_shift_2d(x, d, lane_idx, pltpu):
 
 
 def make_kernel(offsets):
-    import jax.experimental.pallas.tpu as pltpu
-
     def kernel(f_ref, m_ref, o_ref):
         f = f_ref[...]  # (R, 128) uint32 frontier words
         m = m_ref[...]  # (R, 128) uint32 offset-presence words
@@ -92,7 +93,7 @@ def make_kernel(offsets):
             masked = jnp.where(
                 (m >> jnp.uint32(i)) & jnp.uint32(1) != 0, f, jnp.uint32(0)
             )
-            hits = hits | flat_shift_2d(masked, d, lane_idx, pltpu)
+            hits = hits | flat_shift_2d(masked, d, lane_idx)
         o_ref[...] = hits
 
     return kernel
@@ -114,8 +115,6 @@ def pallas_stencil(offsets, rows):
 
 
 def make_halo_kernel(offsets, block_rows):
-    import jax.experimental.pallas.tpu as pltpu
-
     def kernel(fp, fc, fnx, mp, mc, mnx, o_ref):
         # Three consecutive (B, 128) blocks of the SAME padded array give
         # the kernel a full block of halo on each side with plain Blocked
@@ -131,7 +130,7 @@ def make_halo_kernel(offsets, block_rows):
             masked = jnp.where(
                 (m >> jnp.uint32(i)) & jnp.uint32(1) != 0, f, jnp.uint32(0)
             )
-            hits = hits | flat_shift_2d(masked, d, lane_idx, pltpu)
+            hits = hits | flat_shift_2d(masked, d, lane_idx)
         o_ref[...] = hits[block_rows : 2 * block_rows]
 
     return kernel
@@ -192,11 +191,13 @@ def main():
     )
 
     rows = -(-n // LANES)
-    # Whole-plane single block only up to ~2 MB (the ~16 MB/core VMEM has
-    # to hold 2 inputs + output + temporaries; the side-1024 whole-array
-    # attempt crashed the remote compile helper) — larger planes take the
-    # haloed grid (overlapping pl.Element windows).
-    use_halo = rows * LANES * 4 > (2 << 20) or os.environ.get("PROBE_HALO")
+    # Whole-plane single block only up to ~2 MB (the ~16 MB/core VMEM
+    # has to hold 2 inputs + output + temporaries; the side-1024
+    # whole-array attempt crashed the remote compile helper) — larger
+    # planes take the 3-consecutive-Blocked-blocks halo grid, which the
+    # remote compile helper ALSO crashes on today (kept as the re-probe
+    # formulation for toolchain upgrades; pl.Element windows fail too).
+    use_halo = rows * LANES * 4 > (2 << 20) or int(os.environ.get("PROBE_HALO", "0"))
     block_rows = int(os.environ.get("PROBE_BLOCK", "1024"))
     halo_rows = block_rows  # prev/next-block formulation: halo = 1 block
     if use_halo:
@@ -297,11 +298,11 @@ def main():
     @jax.jit
     def loop_xla(fr):
         return lax.fori_loop(
-            0, 64, lambda i, h: stencil_hits(h, sg_nores), fr
+            0, ITERS, lambda i, h: stencil_hits(h, sg_nores), fr
         ).sum()
 
-    t_p = timeit("ITERSx pallas stencil level", loop_pallas, jnp.asarray(f2))
-    t_x = timeit("ITERSx XLA stencil level", loop_xla, jnp.asarray(flat[:, None]))
+    t_p = timeit(f"{ITERS}x pallas stencil level", loop_pallas, jnp.asarray(f2))
+    t_x = timeit(f"{ITERS}x XLA stencil level", loop_xla, jnp.asarray(flat[:, None]))
     print(
         f"per-level: pallas {(t_p - floor) / ITERS * 1e3:.3f} ms, "
         f"XLA {(t_x - floor) / ITERS * 1e3:.3f} ms",

@@ -82,6 +82,60 @@ def test_fuzz_bitbell_matches_oracle(seed):
     assert eng.best(padded) == oracle_best(want), f"seed={seed}"
 
 
+def random_banded_problem(rng: np.random.Generator):
+    """A random BANDED graph the stencil engine must accept: a few random
+    diffs applied on random vertex subsets (symmetrized by CSRGraph's
+    undirected doubling), plus optional long links that ride the
+    residual and optional sparse diffs that trigger offset demotion."""
+    n = int(rng.integers(40, 600))
+    num_offsets = int(rng.integers(1, 6))
+    diffs = rng.choice(
+        np.arange(1, max(2, n // 3)), size=num_offsets, replace=False
+    )
+    rows = []
+    for d in diffs:
+        u = np.nonzero(rng.random(n - int(d)) < rng.uniform(0.4, 0.95))[0]
+        rows.append(np.stack([u, u + int(d)], axis=1))
+    # A handful of long links -> residual; a very sparse diff -> demotion.
+    extra = rng.integers(0, n, size=(int(rng.integers(0, 4)), 2))
+    sparse_d = int(rng.integers(1, n // 2 + 1))
+    sparse_u = rng.integers(0, max(n - sparse_d, 1), size=int(rng.integers(0, 3)))
+    sparse = np.stack([sparse_u, sparse_u + sparse_d], axis=1)
+    edges = np.concatenate(rows + [extra, sparse]).astype(np.int64)
+    k = int(rng.integers(1, 10))
+    queries = []
+    for _ in range(k):
+        size = int(rng.integers(0, 5))
+        q = rng.integers(0, n, size=size)
+        if size and rng.random() < 0.3:
+            q[0] = rng.choice([-1, n, n + 7])
+        queries.append(q.astype(np.int32))
+    return n, edges, queries
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_fuzz_stencil_matches_oracle(seed):
+    """Stencil engine (detection -> demotion -> packed masks -> compact
+    residual -> fused best) against the oracle on random banded graphs.
+    Wide detection limits so every generated graph routes here; chunked
+    on odd seeds."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+        StencilEngine,
+        StencilGraph,
+    )
+
+    rng = np.random.default_rng(7000 + seed)
+    n, edges, queries = random_banded_problem(rng)
+    g = CSRGraph.from_edges(n, edges)
+    sg = StencilGraph.from_host(g, max_offsets=16, max_residual_frac=0.9)
+    padded = pad_queries(queries)
+    eng = StencilEngine(sg, level_chunk=3 if seed % 2 else None)
+    got = np.asarray(eng.f_values(padded))
+    want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")
+    assert eng.best(padded) == oracle_best(want), f"seed={seed}"
+
+
 @pytest.mark.parametrize("seed", [2000, 2001, 2002])
 def test_fuzz_distributed_matches_oracle(seed):
     if len(jax.devices()) < 8:

@@ -261,6 +261,17 @@ inline const unsigned char* gr_parse_uint(const unsigned char* p,
   return p;
 }
 
+// The Python loops parse whole whitespace-split tokens with int(), so
+// "1.5" or "1,2" fail loud there; gr_parse_uint stops at the first
+// non-digit and would silently truncate.  After the LAST parsed id of a
+// line, the next byte must be a token boundary (whitespace/newline/EOF)
+// — further whitespace-separated tokens are legal and ignored, exactly
+// like Python's `u, v, *_ = line.split()`.
+inline bool gr_at_token_boundary(const unsigned char* p,
+                                 const unsigned char* end) {
+  return p >= end || *p == ' ' || *p == '\t' || *p == '\r' || *p == '\n';
+}
+
 inline bool gr_is_arc_line(const unsigned char* d, int64_t p, int64_t size) {
   // Mirror the Python loader's startswith("a ") EXACTLY (io.py): 'a'
   // followed by a space — not tab — or the two parsers would disagree
@@ -282,6 +293,22 @@ void gr_for_each_line(const unsigned char* d, int64_t size, int64_t lo,
     if (!nl) break;
     p = static_cast<const unsigned char*>(nl) - d + 1;
   }
+}
+
+// Threaded count of lines matching ``pred`` — pass 1 of every text
+// parser here, and re-run inside pass 2 so each thread knows its output
+// base (same T and byte partition).
+template <typename Pred>
+void count_lines(const unsigned char* d, int64_t size, int T,
+                 std::vector<int64_t>& counts, Pred&& pred) {
+  counts.assign(T, 0);
+  parallel_ranges(T, size, [&](int t, int64_t lo, int64_t hi) {
+    int64_t c = 0;
+    gr_for_each_line(d, size, lo, hi, [&](int64_t p) {
+      if (pred(p)) ++c;
+    });
+    counts[t] = c;
+  });
 }
 
 extern "C" {
@@ -624,6 +651,84 @@ int msbfs_rmat_edges(int32_t scale, int64_t m, double a, double b, double c,
   return 0;
 }
 
+// SNAP whitespace edge lists ("# comments", one "u v" pair per line,
+// 0-based ids) — the other text format the converter ingests
+// (utils/io.py::load_edgelist).  Same threaded line framework as the
+// .gr parser; a line "counts" when its first byte is a digit (the
+// Python loop skips '#'/'%' and blank lines and would raise on any
+// other junk — the native path returns rc=3 for it instead).
+
+inline bool snap_is_edge_line(const unsigned char* d, int64_t p,
+                              int64_t size) {
+  // Mirror the Python loop exactly (io.py::load_edgelist): skip lines
+  // startswith('#'/'%') and whitespace-only lines; EVERY other line is
+  // an edge line (malformed content then returns rc=3 where Python's
+  // int() raises — never a silent skip).
+  if (d[p] == '#' || d[p] == '%') return false;
+  int64_t q = p;
+  while (q < size && (d[q] == ' ' || d[q] == '\t' || d[q] == '\r')) ++q;
+  return q < size && d[q] != '\n';
+}
+
+// Pass 1: count edge lines.  Returns 0 ok, 1 open failure.
+int msbfs_snap_scan(const char* path, int64_t* pairs_out) {
+  MappedFile f;
+  if (!f.open(path)) return 1;
+  const unsigned char* d = f.data;
+  const int64_t size = static_cast<int64_t>(f.size);
+  const int T = num_threads_for(size, int64_t{1} << 24);
+  std::vector<int64_t> counts;
+  count_lines(d, size, T, counts,
+              [&](int64_t p) { return snap_is_edge_line(d, p, size); });
+  int64_t pairs = 0;
+  for (int64_t c : counts) pairs += c;
+  *pairs_out = pairs;
+  return 0;
+}
+
+// Pass 2: parse every edge line into 0-based id arrays (caller
+// allocates ``pairs`` int32 entries each).  n is discovered as
+// max(id) + 1 by the caller; ids beyond int32 are rejected.  Returns
+// 0 ok, 1 open failure, 3 malformed line, 5 count changed, 6 id
+// exceeds int32.
+int msbfs_snap_pairs(const char* path, int64_t pairs, int32_t* u_out,
+                     int32_t* v_out) {
+  MappedFile f;
+  if (!f.open(path)) return 1;
+  const unsigned char* d = f.data;
+  const int64_t size = static_cast<int64_t>(f.size);
+  const int T = num_threads_for(size, int64_t{1} << 24);
+  std::vector<int64_t> counts;
+  count_lines(d, size, T, counts,
+              [&](int64_t p) { return snap_is_edge_line(d, p, size); });
+  std::vector<int64_t> base(T + 1, 0);
+  for (int t = 0; t < T; ++t) base[t + 1] = base[t] + counts[t];
+  if (base[T] != pairs) return 5;
+  std::atomic<int> err{0};
+  parallel_ranges(T, size, [&](int t, int64_t lo, int64_t hi) {
+    int64_t w = base[t];
+    gr_for_each_line(d, size, lo, hi, [&](int64_t p) {
+      if (!snap_is_edge_line(d, p, size)) return;
+      const unsigned char* end = d + size;
+      int64_t u = -1, v = -1;
+      const unsigned char* r = gr_parse_uint(d + p, end, &u);
+      if (r) r = gr_parse_uint(r, end, &v);
+      if (!r || !gr_at_token_boundary(r, end)) {
+        err.store(3);  // incl. "1.5"-style tokens Python's int() rejects
+        return;
+      }
+      if (u > INT32_MAX || v > INT32_MAX) {
+        err.store(6);
+        return;
+      }
+      u_out[w] = static_cast<int32_t>(u);
+      v_out[w] = static_cast<int32_t>(v);
+      ++w;
+    });
+  });
+  return err.load();
+}
+
 // Pass 1 over a DIMACS .gr file: the "p sp <n> <m>" header vertex count
 // and the number of "a " arc lines (so the caller can allocate exactly).
 // Returns 0 ok, 1 open failure, 2 no/malformed header.
@@ -694,16 +799,11 @@ int msbfs_gr_arcs(const char* path, int64_t n, int64_t arcs, int32_t* u_out,
   const int64_t size = static_cast<int64_t>(f.size);
   const int T = num_threads_for(size, int64_t{1} << 24);
   // Count per range first so every thread knows its output base (same
-  // byte partition as parallel_ranges uses below: T ranges of equal
-  // chunk), then parse into disjoint slices — file order preserved.
-  std::vector<int64_t> counts(T, 0);
-  parallel_ranges(T, size, [&](int t, int64_t lo, int64_t hi) {
-    int64_t c = 0;
-    gr_for_each_line(d, size, lo, hi, [&](int64_t p) {
-      if (gr_is_arc_line(d, p, size)) ++c;
-    });
-    counts[t] = c;
-  });
+  // byte partition both passes), then parse into disjoint slices —
+  // file order preserved.
+  std::vector<int64_t> counts;
+  count_lines(d, size, T, counts,
+              [&](int64_t p) { return gr_is_arc_line(d, p, size); });
   std::vector<int64_t> base(T + 1, 0);
   for (int t = 0; t < T; ++t) base[t + 1] = base[t] + counts[t];
   if (base[T] != arcs) return 5;
@@ -716,8 +816,8 @@ int msbfs_gr_arcs(const char* path, int64_t n, int64_t arcs, int32_t* u_out,
       int64_t u = -1, v = -1;
       const unsigned char* r = gr_parse_uint(d + p + 1, end, &u);
       if (r) r = gr_parse_uint(r, end, &v);
-      if (!r) {
-        err.store(3);
+      if (!r || !gr_at_token_boundary(r, end)) {
+        err.store(3);  // incl. "2.5"-style tokens Python's int() rejects
         return;
       }
       if (u < 1 || u > n || v < 1 || v > n) {

@@ -108,6 +108,18 @@ def _get_lib() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(dtype=np.int32, ndim=1, flags="C_CONTIGUOUS"),
         ]
         lib.msbfs_gr_arcs.restype = ctypes.c_int
+        lib.msbfs_snap_scan.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.msbfs_snap_scan.restype = ctypes.c_int
+        lib.msbfs_snap_pairs.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(dtype=np.int32, ndim=1, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, ndim=1, flags="C_CONTIGUOUS"),
+        ]
+        lib.msbfs_snap_pairs.restype = ctypes.c_int
         _lib = lib
     except (OSError, AttributeError):
         # AttributeError: a stale .so built before a newer symbol existed —
@@ -287,3 +299,35 @@ def load_gr_arcs(path: str):
             f"{path}: {_GR_ERRORS.get(rc, f'native gr_arcs rc={rc}')}"
         )
     return int(n.value), np.stack([u, v], axis=1)
+
+
+_SNAP_ERRORS = {
+    1: "cannot open file",
+    3: "malformed edge line (expected two integer ids)",
+    5: "edge count changed between scan and parse",
+    6: "vertex id exceeds int32",
+}
+
+
+def load_snap_pairs(path: str):
+    """Native SNAP whitespace-edge-list parse -> (R, 2) int32 0-based
+    pairs, or None when the native library is unavailable.  Mirrors the
+    Python loop's skip rules ('#'/'%'/blank) and fail-loud posture
+    (utils/io.py::load_edgelist); plain text only."""
+    lib = _get_lib()
+    if lib is None:
+        return None
+    pairs = ctypes.c_int64()
+    rc = lib.msbfs_snap_scan(path.encode(), ctypes.byref(pairs))
+    if rc != 0:
+        raise ValueError(
+            f"{path}: {_SNAP_ERRORS.get(rc, f'native snap_scan rc={rc}')}"
+        )
+    u = np.empty(pairs.value, dtype=np.int32)
+    v = np.empty(pairs.value, dtype=np.int32)
+    rc = lib.msbfs_snap_pairs(path.encode(), pairs.value, u, v)
+    if rc != 0:
+        raise ValueError(
+            f"{path}: {_SNAP_ERRORS.get(rc, f'native snap_pairs rc={rc}')}"
+        )
+    return np.stack([u, v], axis=1)

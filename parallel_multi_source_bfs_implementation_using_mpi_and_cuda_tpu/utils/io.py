@@ -136,6 +136,31 @@ def _canonical_undirected(edges: np.ndarray) -> np.ndarray:
     return np.stack([keys >> 32, keys & 0xFFFFFFFF], axis=1).astype(np.int32)
 
 
+def _native_text_parse(path, native, parse, label):
+    """The ONE native-dispatch policy for the text converters
+    (load_dimacs_gr / load_edgelist): auto-select the C++ parser when
+    built and the file is plain text, honor native=True/False forcing,
+    keep .gz on the Python path.  ``parse(native_loader)`` runs the
+    native parse and returns its result, or None when the library is
+    unavailable; this helper returns that result or None when the caller
+    should fall through to its Python loop."""
+    if (native is None or native) and not os.fspath(path).endswith(".gz"):
+        from ..runtime import native_loader
+
+        if native_loader.available():
+            out = parse(native_loader)
+            if out is not None:
+                return out
+        if native:
+            raise RuntimeError(
+                f"native {label} parser requested but librt_loader.so is "
+                "not built (run `make native`)"
+            )
+    elif native:
+        raise RuntimeError(f"native {label} parser cannot read .gz files")
+    return None
+
+
 def load_dimacs_gr(path: str | os.PathLike, native: Optional[bool] = None):
     """Parse a DIMACS shortest-path ``.gr`` file (USA-road-d family) into
     (n, edges) for :func:`save_graph_bin`.
@@ -149,21 +174,15 @@ def load_dimacs_gr(path: str | os.PathLike, native: Optional[bool] = None):
     Python line loop on a 23M-arc file), ``False`` the Python path,
     ``None`` auto-selects (native when built and the file is not .gz).
     """
-    if (native is None or native) and not os.fspath(path).endswith(".gz"):
-        from ..runtime import native_loader
-
-        if native_loader.available():
-            parsed = native_loader.load_gr_arcs(os.fspath(path))
-            if parsed is not None:
-                n, arcs = parsed
-                return n, _canonical_undirected(arcs)
-        if native:
-            raise RuntimeError(
-                "native .gr parser requested but librt_loader.so is not "
-                "built (run `make native`)"
-            )
-    elif native:
-        raise RuntimeError("native .gr parser cannot read .gz files")
+    parsed = _native_text_parse(
+        path,
+        native,
+        lambda nl: nl.load_gr_arcs(os.fspath(path)),
+        "DIMACS .gr",
+    )
+    if parsed is not None:
+        n, arcs = parsed
+        return n, _canonical_undirected(arcs)
     n = None
     us: List[np.ndarray] = []
     vs: List[np.ndarray] = []
@@ -227,13 +246,28 @@ def save_dimacs_gr(
     return 2 * m
 
 
-def load_edgelist(path: str | os.PathLike):
+def load_edgelist(path: str | os.PathLike, native: Optional[bool] = None):
     """Parse a SNAP-style whitespace edge list (``# comments``, one
     ``u v`` pair per line, 0-based ids) into (n, edges).
 
     n = max id + 1; pairs are canonicalized to unique undirected edges
     (SNAP files mix one-per-edge and both-directions conventions).
+
+    ``native=True`` forces the C++ parser (plain-text only), ``False``
+    the Python loop, ``None`` auto-selects (native when built and the
+    file is not .gz) — same contract as :func:`load_dimacs_gr`.
     """
+    pairs = _native_text_parse(
+        path,
+        native,
+        lambda nl: nl.load_snap_pairs(os.fspath(path)),
+        "SNAP",
+    )
+    if pairs is not None:
+        if pairs.size == 0:
+            raise ValueError(f"{path}: no edges found")
+        n = int(pairs.max()) + 1
+        return n, _canonical_undirected(pairs)
     us: List[np.ndarray] = []
     chunk: List[int] = []
     with _open_text(path) as f:

@@ -322,3 +322,45 @@ def test_gr_parse_errors_match_python_contract(built, tmp_path):
     p.write_text("c x\n\nq zz\np sp 3 2\na 1 2 9\na 2 3 9")
     n, e = load_dimacs_gr(p, native=True)
     assert n == 3 and e.tolist() == [[0, 1], [1, 2]]
+
+
+def test_snap_parse_matches_python(built, tmp_path, monkeypatch):
+    """Native SNAP edge-list parse == Python line loop (comments, blank
+    lines, both-direction duplicates), thread-invariant."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_edgelist,
+    )
+
+    p = tmp_path / "snap.txt"
+    rng = np.random.default_rng(81)
+    pairs = rng.integers(0, 300, size=(900, 2))
+    lines = ["# SNAP-ish header", "% alt comment", "   ", ""]
+    lines += [f"{u} {v}" for u, v in pairs]
+    lines += [f"{v}\t{u}" for u, v in pairs[:100]]  # tabs + reverse dups
+    p.write_text("\n".join(lines) + "\n")
+    n_py, e_py = load_edgelist(p, native=False)
+    n_cc, e_cc = load_edgelist(p, native=True)
+    assert n_cc == n_py
+    np.testing.assert_array_equal(e_cc, e_py)
+    monkeypatch.setenv("MSBFS_NATIVE_THREADS", "3")
+    n_t3, e_t3 = load_edgelist(p, native=True)
+    assert n_t3 == n_py
+    np.testing.assert_array_equal(e_t3, e_py)
+
+
+def test_snap_parse_errors(built, tmp_path):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_edgelist,
+    )
+
+    p = tmp_path / "bad.txt"
+    p.write_text("# only comments\n\n")
+    with pytest.raises(ValueError, match="no edges"):
+        load_edgelist(p, native=True)
+    p.write_text("1 2\njunk line\n")
+    with pytest.raises(ValueError, match="malformed"):
+        load_edgelist(p, native=True)
+    # Final line without trailing newline still parses.
+    p.write_text("# c\n3 4\n1 2")
+    n, e = load_edgelist(p, native=True)
+    assert n == 5 and e.tolist() == [[1, 2], [3, 4]]

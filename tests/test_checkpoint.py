@@ -59,20 +59,24 @@ def test_checkpoint_resume_skips_done(problem, tmp_path):
     assert computed == 0
 
 
+class CrashAfter:
+    """Engine wrapper that serves ``chunks`` f_values calls, then raises
+    (the mid-run "crash" used by the partial-journal tests)."""
+
+    def __init__(self, inner, chunks):
+        self.inner, self.left = inner, chunks
+
+    def f_values(self, q):
+        if self.left == 0:
+            raise KeyboardInterrupt
+        self.left -= 1
+        return self.inner.f_values(q)
+
+
 def test_checkpoint_partial_journal_completes(problem, tmp_path):
     """Simulate a crash after 2 chunks: a new runner finishes the rest."""
     n, g, eng, padded, want = problem
     path = tmp_path / "j.ckpt"
-
-    class CrashAfter:
-        def __init__(self, inner, chunks):
-            self.inner, self.left = inner, chunks
-
-        def f_values(self, q):
-            if self.left == 0:
-                raise KeyboardInterrupt  # mid-run "crash"
-            self.left -= 1
-            return self.inner.f_values(q)
 
     r1 = CheckpointedRunner(CrashAfter(eng, 2), path, chunk=4)
     with pytest.raises(KeyboardInterrupt):
@@ -256,3 +260,34 @@ def test_checkpoint_cli_stats_alive(problem, tmp_path, capsys, monkeypatch):
     assert rc == 0
     assert "predates stats" in out.err
     assert "not available on this engine" not in out.err
+
+
+def test_checkpoint_stencil_engine_resume(tmp_path):
+    """The checkpoint subsystem composes with the r5 stencil engine: a
+    partial journal written by the STENCIL route resumes to the oracle
+    answer, chunk accounting intact (the engine only needs f_values —
+    this pins that contract for the newest engine)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+        StencilEngine,
+        StencilGraph,
+    )
+
+    n, edges = generators.road_edges(20, 17, seed=751)
+    queries = generators.random_queries(n, 9, max_group=3, seed=752)
+    queries[2] = np.zeros(0, dtype=np.int32)
+    g = CSRGraph.from_edges(n, edges)
+    eng = StencilEngine(StencilGraph.from_host(g), level_chunk=4)
+    padded = pad_queries(queries)
+    want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    path = tmp_path / "j.ckpt"
+
+    # Interrupted run: stop after the first chunk...
+    r1 = CheckpointedRunner(CrashAfter(eng, 1), path, chunk=4)
+    with pytest.raises(KeyboardInterrupt):
+        r1.run(n, g.num_directed_edges, padded)
+    # ...then resume with the real engine: only the rest recomputes.
+    r2 = CheckpointedRunner(eng, path, chunk=4)
+    f, computed = r2.run(n, g.num_directed_edges, padded)
+    np.testing.assert_array_equal(f, want)
+    assert 0 < computed < padded.shape[0]
+    assert r2.best(n, g.num_directed_edges, padded) == oracle_best(want)

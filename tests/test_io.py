@@ -272,6 +272,44 @@ def test_convert_cli_end_to_end(tmp_path, capsys):
     assert f"Minimum F value: {want_f}" in out
 
 
+def test_gen_cli_convert_snap_end_to_end(tmp_path, capsys):
+    """SNAP edge list -> gen_cli --informat snap -> main.py report, vs
+    the oracle (mirrors the DIMACS end-to-end above for the second
+    converter format; exercises the native parser when built)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+        main as cli_main,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.gen_cli import (
+        main as gen_main,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_query_bin,
+    )
+
+    from oracle import oracle_best, oracle_bfs, oracle_f
+
+    snap = tmp_path / "snap.txt"
+    pairs = [(0, 1), (1, 2), (2, 3), (3, 4), (4, 5), (1, 4)]
+    lines = ["# comment\n", "\n"]
+    lines += [f"{u}\t{v}\n" for u, v in pairs]
+    lines += [f"{v} {u}\n" for u, v in pairs[:3]]  # reverse duplicates
+    snap.write_text("".join(lines))
+    gbin, qbin = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
+    rc = gen_main(["--convert", str(snap), "--informat", "snap", "--graph", gbin])
+    assert rc == 0
+    queries = [[0], [3, 5], []]
+    save_query_bin(qbin, queries)
+    rc = cli_main(["main.py", "-g", gbin, "-q", qbin, "-gn", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    edges = np.asarray(pairs, dtype=np.int64)
+    want_f, want_k = oracle_best(
+        [oracle_f(oracle_bfs(6, edges, np.asarray(q))) for q in queries]
+    )
+    assert f"Query number (k) with minimum F value: {want_k + 1}" in out
+    assert f"Minimum F value: {want_f}" in out
+
+
 def test_road_edges_statistics():
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
         generators,

@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test
+.PHONY: native clean test resilience
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -13,5 +13,11 @@ $(PKG)/runtime/librt_loader.so: $(PKG)/runtime/loader.cpp
 clean:
 	rm -f $(PKG)/runtime/librt_loader.so
 
-test: native
+# Fault-injection rehearsal on the virtual CPU mesh (docs/RESILIENCE.md):
+# every recovery path — retry, watchdog, ladder, survivor resharding —
+# driven by deterministic fault plans with a fixed jitter seed.
+resilience: native
+	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_resilience.py -x -q
+
+test: native resilience
 	python -m pytest tests/ -x -q

@@ -38,6 +38,38 @@ from jax import config as _jax_config
 # final O(n) reduction).
 _jax_config.update("jax_enable_x64", True)
 
+import jax as _jax  # noqa: E402
+
+if not hasattr(_jax, "shard_map"):
+    # jax < 0.4.35 ships shard_map under jax.experimental only; every
+    # mesh engine in .parallel calls the stable-namespace spelling
+    # (f positional + mesh/in_specs/out_specs keywords, valid for both).
+    # Alias it so the runtime comes up on whatever jax the host bakes in.
+    # Replication checking is disabled: the engines annotate varying axes
+    # with lax.pcast, which the old checker doesn't understand.
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def _shard_map(f, **kwargs):
+        kwargs.setdefault("check_rep", False)
+        return _experimental_shard_map(f, **kwargs)
+
+    _jax.shard_map = _shard_map
+
+if not hasattr(_jax.lax, "pcast"):
+    # Pre-VMA jax has no varying-axes type system; with replication
+    # checking off (above) the cast is semantically a no-op.
+    _jax.lax.pcast = lambda x, axes, to: x
+
+if not hasattr(_jax.distributed, "is_initialized"):
+    # jax < 0.4.39 has no public initialization probe; the internal
+    # global state's client handle is the same signal the newer public
+    # API reads.
+    from jax._src import distributed as _internal_distributed
+
+    _jax.distributed.is_initialized = (
+        lambda: _internal_distributed.global_state.client is not None
+    )
+
 from .models.csr import CSRGraph, DeviceCSR  # noqa: E402
 from .models.bell import BellGraph  # noqa: E402
 from .ops.bfs import multi_source_bfs, batched_multi_source_bfs  # noqa: E402

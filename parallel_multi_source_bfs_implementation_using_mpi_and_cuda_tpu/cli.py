@@ -36,6 +36,14 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    """Float env knob, same malformed-falls-back convention."""
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
 def parse_args(argv: List[str]):
     """Linear argv scan, reference-exact (main.cu:216-224)."""
     graph_file: Optional[str] = None
@@ -156,6 +164,38 @@ def _level_chunk_policy(graph, explicit=_UNSET) -> Optional[int]:
     return _AUTO_LEVEL_CHUNK
 
 
+def _bitbell_ladder(graph, level_chunk):
+    """Degradation rungs for the default single-chip bitbell route: on
+    RESOURCE_EXHAUSTED the supervisor (runtime.supervisor) swaps in the
+    next rung and re-runs the chunk instead of dying — wide-plane ->
+    level-chunked -> streamed, the same ladder the up-front HBM estimate
+    picks from, now applied reactively when the estimate was wrong.
+    Factories are lazy: a rung's layout is built only when reached."""
+    from .models.bell import BellGraph
+    from .ops.bitbell import BitBellEngine
+
+    rungs = []
+    if not level_chunk:
+        rungs.append((
+            "level-chunked",
+            lambda: BitBellEngine(
+                BellGraph.from_host(graph), level_chunk=_AUTO_LEVEL_CHUNK
+            ),
+        ))
+    rungs.append((
+        "streamed",
+        lambda: BitBellEngine(
+            BellGraph.from_host(graph, keep_sparse=False),
+            sparse_budget=0,
+            level_chunk=min(level_chunk or 8, 8),
+            slot_budget=(
+                1 << 25 if not os.environ.get("MSBFS_SLOT_BUDGET") else None
+            ),
+        ),
+    ))
+    return rungs
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv if argv is None else argv)
     if len(argv) < 5:  # argc < 5, reference main.cu:204-212
@@ -170,6 +210,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     if graph_file is None or query_file is None:
         print("Missing -g or -q argument", file=sys.stderr)
         return -1
+
+    # Resilience layer bring-up (runtime.supervisor, docs/RESILIENCE.md):
+    # install the fault plan BEFORE any load so the loader seams see it.
+    # A fresh plan per main() call keeps repeated in-process runs (tests)
+    # deterministic.  A malformed MSBFS_FAULTS is a fail-loud InputError:
+    # a typo'd plan silently arming nothing would make every recovery
+    # rehearsal vacuous.
+    from .utils import faults
+    from .utils.report import format_failure
+
+    try:
+        fault_plan = faults.FaultPlan.from_env()
+    except ValueError as exc:
+        from .runtime.supervisor import InputError
+
+        err = InputError(str(exc))
+        print(format_failure(err), file=sys.stderr)
+        return err.exit_code
+    faults.activate(fault_plan)
 
     import jax
 
@@ -204,19 +263,28 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # ---- preprocessing span: load + device placement (+ XLA compile),
     # the analog of main.cu:235-298 (load + MPI broadcast + H2D upload).
+    from .runtime.supervisor import classify
+
     with Span() as pre:
         try:
             graph = load_graph_bin(graph_file)
-        except (IOError, OSError, ValueError):
-            # ValueError covers corrupt contents (out-of-range vertex ids),
-            # where the reference would hit undefined behavior (main.cu:114).
+        except (IOError, OSError, ValueError, IndexError) as exc:
+            # Typed taxonomy instead of a blanket net: corrupt contents /
+            # unreadable files classify as InputError, whose exit code IS
+            # the reference's EXIT_FAILURE (main.cu:95-99); anything else
+            # (an injected device fault, say) keeps its own documented
+            # code (docs/RESILIENCE.md).
+            err = classify(exc)
             print(f"Could not open graph file {graph_file}", file=sys.stderr)
-            return 1  # reference exits EXIT_FAILURE (main.cu:95-99)
+            print(format_failure(err), file=sys.stderr)
+            return err.exit_code
         try:
             queries = load_query_bin(query_file)
-        except (IOError, OSError, ValueError):
+        except (IOError, OSError, ValueError, IndexError) as exc:
+            err = classify(exc)
             print(f"Could not open query file {query_file}", file=sys.stderr)
-            return 1
+            print(format_failure(err), file=sys.stderr)
+            return err.exit_code
         padded = pad_queries(queries)
         if jax.process_count() > 1:
             # Multi-host: -gn is devices PER HOST (the reference's per-rank
@@ -267,6 +335,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     file=sys.stderr,
                 )
 
+        # Capacity-degradation rungs for the supervisor; populated by the
+        # routes that have a documented smaller-footprint fallback.
+        ladder_rungs = []
         if n_chips > 1:
             # MSBFS_VSHARD=v splits the CSR over a 'v' mesh axis of that
             # size (vertex sharding for graphs beyond one chip's HBM —
@@ -590,6 +661,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                         if explicit_chunk is None or explicit_chunk < 0
                         else level_chunk
                     )
+                    if explicit_chunk == 0:
+                        # ADVICE r5: an explicit 0 (unbounded) here is
+                        # exactly the unchunked wide-plane dispatch this
+                        # branch exists to avoid (it crashed the TPU
+                        # worker, raw_r5 root cause) — clamp to the
+                        # streamed bound instead of honoring it, loudly.
+                        streamed_chunk = 8
+                        print(
+                            "MSBFS_LEVEL_CHUNK=0 would issue an unbounded "
+                            "wide-plane dispatch on an over-HBM graph "
+                            "(documented worker crash); clamping to 8 "
+                            "levels/dispatch",
+                            file=sys.stderr,
+                        )
                     print(
                         f"graph needs ~{hbm_need >> 20} MiB (hybrid "
                         f"layout) but one chip has {hbm_have >> 20} MiB: "
@@ -616,6 +701,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                     engine = BitBellEngine(
                         BellGraph.from_host(graph), level_chunk=level_chunk
                     )
+                    ladder_rungs = _bitbell_ladder(graph, level_chunk)
+
+        # ---- resilient execution (runtime.supervisor): every engine call
+        # below runs supervised — watchdog, typed taxonomy, transient
+        # retry with backoff, capacity degradation down the ladder,
+        # survivor resharding on chip loss.  Knobs: MSBFS_WATCHDOG
+        # (seconds, 0/unset = off), MSBFS_RETRIES, MSBFS_BACKOFF,
+        # MSBFS_FAULT_SEED (replayable jitter).  docs/RESILIENCE.md.
+        from .runtime.supervisor import (
+            ChunkSupervisor,
+            MsbfsError,
+            RetryPolicy,
+        )
+
+        engine = ChunkSupervisor(
+            engine,
+            policy=RetryPolicy(
+                max_retries=_env_int("MSBFS_RETRIES", 2),
+                base_delay=_env_float("MSBFS_BACKOFF", 0.1),
+                seed=_env_int("MSBFS_FAULT_SEED", 0),
+            ),
+            watchdog=_env_float("MSBFS_WATCHDOG", 0.0) or None,
+            ladder=ladder_rungs,
+            plan=fault_plan,
+        )
         stats_env = os.environ.get("MSBFS_STATS", "")
         stats_mode = stats_env in ("1", "2")
         # MSBFS_STATS=2: additionally trace each BFS level (frontier size,
@@ -625,26 +735,36 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         ckpt_path = os.environ.get("MSBFS_CHECKPOINT")
         ckpt_chunk = _env_int("MSBFS_CHECKPOINT_CHUNK", 64)
-        if ckpt_path:
-            # The checkpoint path calls f_values/query_stats on (chunk, S)
-            # slices, not best() on the full (K, S) batch — warm exactly
-            # those shapes so XLA compiles land in the preprocessing span.
-            # MSBFS_STATS rides the journal (round 4): per-chunk
-            # levels/reached are recorded alongside F, so the longest runs
-            # are no longer the blindest ones.
-            k, s = padded.shape
-            for shape_k in {min(max(1, ckpt_chunk), max(k, 1)), *(
-                [k % ckpt_chunk] if k % ckpt_chunk else []
-            )}:
-                dummy = np.full((shape_k, s), -1, dtype=np.int32)
-                if not (stats_mode and engine.query_stats(dummy) is not None):
-                    engine.f_values(dummy)
-        else:
-            engine.compile(
-                padded.shape,
-                warm_stats=stats_mode and not stats_level,
-                warm_levels=stats_level,
-            )
+        try:
+            if ckpt_path:
+                # The checkpoint path calls f_values/query_stats on
+                # (chunk, S) slices, not best() on the full (K, S) batch —
+                # warm exactly those shapes so XLA compiles land in the
+                # preprocessing span.  MSBFS_STATS rides the journal
+                # (round 4): per-chunk levels/reached are recorded
+                # alongside F, so the longest runs are no longer the
+                # blindest ones.
+                k, s = padded.shape
+                for shape_k in {min(max(1, ckpt_chunk), max(k, 1)), *(
+                    [k % ckpt_chunk] if k % ckpt_chunk else []
+                )}:
+                    dummy = np.full((shape_k, s), -1, dtype=np.int32)
+                    if not (
+                        stats_mode and engine.query_stats(dummy) is not None
+                    ):
+                        engine.f_values(dummy)
+            else:
+                engine.compile(
+                    padded.shape,
+                    warm_stats=stats_mode and not stats_level,
+                    warm_levels=stats_level,
+                )
+        except MsbfsError as err:
+            # The supervisor exhausted its recovery budget during warm-up:
+            # same one-line report + documented exit code as a failure in
+            # the computation span.
+            print(format_failure(err, engine.events), file=sys.stderr)
+            return err.exit_code
 
     # ---- computation span: all BFS + objective + argmin (main.cu:301-400).
     # MSBFS_PROFILE_DIR captures a jax.profiler trace of the span (tracing
@@ -656,71 +776,87 @@ def main(argv: Optional[List[str]] = None) -> int:
     # failure).  Works with any engine; chunk via MSBFS_CHECKPOINT_CHUNK.
     stats = None
     level_rows = None
-    with Span() as comp:
-        with profiler_trace():
-            if ckpt_path:
-                from .utils.checkpoint import CheckpointedRunner
+    try:
+        with Span() as comp:
+            with profiler_trace():
+                if ckpt_path:
+                    from .utils.checkpoint import CheckpointedRunner
 
-                runner = CheckpointedRunner(
-                    engine, ckpt_path, chunk=ckpt_chunk, stats=stats_mode
-                )
-                try:
-                    f_arr, _ = runner.run(
-                        graph.n, graph.num_directed_edges, np.asarray(padded)
+                    runner = CheckpointedRunner(
+                        engine, ckpt_path, chunk=ckpt_chunk, stats=stats_mode
                     )
-                except ValueError as exc:  # stale/foreign journal: fail loud
-                    print(f"Checkpoint error: {exc}", file=sys.stderr)
-                    return 1
-                if (
-                    stats_mode
-                    and padded.shape[0]
-                    and runner.last_stats is not None
-                    and (runner.last_stats[0] >= 0).any()
-                ):
-                    # -1 rows are F-only entries resumed from a stats-less
-                    # journal; the selection below derives from stats[2].
-                    stats = (*runner.last_stats, f_arr)
-                else:
+                    try:
+                        f_arr, _ = runner.run(
+                            graph.n,
+                            graph.num_directed_edges,
+                            np.asarray(padded),
+                        )
+                    except MsbfsError:
+                        raise
+                    except ValueError as exc:
+                        # stale/foreign journal: fail loud
+                        print(f"Checkpoint error: {exc}", file=sys.stderr)
+                        return 1
                     if (
                         stats_mode
                         and padded.shape[0]
                         and runner.last_stats is not None
+                        and (runner.last_stats[0] >= 0).any()
                     ):
-                        # Engine supports stats but every row came from a
-                        # stats-less (pre-round-4) journal: say THAT, not
-                        # "engine doesn't support stats".
-                        sys.stderr.write(
-                            "MSBFS_STATS: the resumed journal predates "
-                            "stats journaling (F-only rows); delete it to "
-                            "recompute with stats\n"
+                        # -1 rows are F-only entries resumed from a
+                        # stats-less journal; the selection below derives
+                        # from stats[2].
+                        stats = (*runner.last_stats, f_arr)
+                    else:
+                        if (
+                            stats_mode
+                            and padded.shape[0]
+                            and runner.last_stats is not None
+                        ):
+                            # Engine supports stats but every row came from
+                            # a stats-less (pre-round-4) journal: say THAT,
+                            # not "engine doesn't support stats".
+                            sys.stderr.write(
+                                "MSBFS_STATS: the resumed journal predates "
+                                "stats journaling (F-only rows); delete it "
+                                "to recompute with stats\n"
+                            )
+                            stats_mode = False  # suppress the generic note
+                        from .ops.objective import select_best_jit
+                        import jax.numpy as jnp
+
+                        arr = jnp.asarray(f_arr)
+                        min_f, min_k = (
+                            int(x) for x in select_best_jit(arr, arr >= 0)
                         )
-                        stats_mode = False  # suppress the generic note
+                elif stats_mode and padded.shape[0]:
+                    # One BFS pass serves both the report and the stats
+                    # table: stats include the F values, so selection
+                    # derives from them.
+                    if stats_level:
+                        levels, reached, f, lvl_counts, lvl_secs = (
+                            engine.level_stats(np.asarray(padded))
+                        )
+                        stats = (levels, reached, f)
+                        level_rows = (lvl_counts, lvl_secs)
+                    else:
+                        stats = engine.query_stats(np.asarray(padded))
+                if stats is not None:
                     from .ops.objective import select_best_jit
                     import jax.numpy as jnp
 
-                    arr = jnp.asarray(f_arr)
+                    f = jnp.asarray(stats[2])
                     min_f, min_k = (
-                        int(x) for x in select_best_jit(arr, arr >= 0)
+                        int(x) for x in select_best_jit(f, f >= 0)
                     )
-            elif stats_mode and padded.shape[0]:
-                # One BFS pass serves both the report and the stats table:
-                # stats include the F values, so selection derives from them.
-                if stats_level:
-                    levels, reached, f, lvl_counts, lvl_secs = (
-                        engine.level_stats(np.asarray(padded))
-                    )
-                    stats = (levels, reached, f)
-                    level_rows = (lvl_counts, lvl_secs)
-                else:
-                    stats = engine.query_stats(np.asarray(padded))
-            if stats is not None:
-                from .ops.objective import select_best_jit
-                import jax.numpy as jnp
-
-                f = jnp.asarray(stats[2])
-                min_f, min_k = (int(x) for x in select_best_jit(f, f >= 0))
-            elif not ckpt_path:
-                min_f, min_k = engine.best(np.asarray(padded))
+                elif not ckpt_path:
+                    min_f, min_k = engine.best(np.asarray(padded))
+    except MsbfsError as err:
+        # The supervisor's recovery budget (retries, ladder rungs, mesh
+        # rebuilds) ran out: one-line report, documented exit code
+        # (docs/RESILIENCE.md), no traceback spray.
+        print(format_failure(err, engine.events), file=sys.stderr)
+        return err.exit_code
 
     if stats is not None:
         # Per-query diagnostics to stderr (stdout stays reference-exact).

@@ -440,7 +440,7 @@ def bitbell_run_chunked(
     return carry[2], carry[3], carry[4]
 
 
-def stepped_level_trace(engine, queries, step):
+def stepped_level_trace(engine, queries, step, k=None):
     """Shared MSBFS_STATS=2 host-driven per-level trace for the bit-plane
     engines (bitbell, stencil): one dispatch per level so each level is
     individually timed.  ``step(visited, frontier) -> (visited', frontier',
@@ -454,10 +454,16 @@ def stepped_level_trace(engine, queries, step):
     dispatch per level, so this is a diagnostic mode, not the performance
     path.  Warms the pack+step programs once per shape so the timed rows
     measure execution, not XLA compilation (the warm executes one real
-    level; an empty dummy could never warm the step program)."""
+    level; an empty dummy could never warm the step program).
+
+    Callers that already padded (to size their step's budget) pass the
+    padded array plus the real query count ``k``; padding is idempotent
+    but not free — the second pass re-checks/copies the whole (K, S)
+    array — so it runs at most once per trace (ADVICE r5)."""
     import time
 
-    queries, k = engine._pad_queries(queries)
+    if k is None:
+        queries, k = engine._pad_queries(queries)
     pack = partial(_pack_queries_jit, engine.graph.n)
     if queries.shape not in engine._level_warm_shapes:
         warm = pack(queries)
@@ -808,12 +814,13 @@ class BitBellEngine(FusedBestEngine):
         step materializes the full merged per-level gather and can OOM on
         exactly the wide-plane shapes (RMAT-24 x K=256) that the
         production path streams within budget (ADVICE r4)."""
-        padded, _ = self._pad_queries(queries)
+        padded, k = self._pad_queries(queries)
         slot_budget = self._slot_budget_for(padded.shape[0] // WORD_BITS)
         return stepped_level_trace(
             self,
-            queries,
+            padded,
             lambda v, fr: bitbell_step(
                 self.graph, v, fr, self.sparse_budget, slot_budget
             ),
+            k=k,
         )

@@ -304,6 +304,10 @@ class DistributedEngine(QueryEngineBase):
     ):
         self.mesh = mesh
         self.w = mesh.shape[QUERY_AXIS]
+        # Host graph retained for survivor resharding (without_ranks):
+        # rebuilding on a smaller mesh re-places the graph from host, so
+        # nothing ties the new engine to the lost device's buffers.
+        self._host_graph = graph if isinstance(graph, CSRGraph) else None
         replicated = NamedSharding(mesh, P())
         if backend == "bitbell":
             if expand is not graph_expand or query_chunk is not None:
@@ -356,6 +360,46 @@ class DistributedEngine(QueryEngineBase):
             # callers (the CLI's MSBFS_STATS=2 route) can probe support with
             # callable(getattr(engine, "level_stats", None)).
             self.level_stats = None
+
+    def without_ranks(self, failed_ranks) -> "DistributedEngine":
+        """Rebuild this engine on the mesh's surviving devices (simulated
+        or real chip loss, runtime.supervisor recovery).  The lost ranks'
+        query groups land on survivors via the same cyclic layout
+        (``scheduler.reassign`` states the redistribution; the cyclic
+        grid over W-|failed| shards realizes it), so the merged
+        (F, argmin) results are bit-identical to the fault-free run —
+        each query's F value never depends on which rank computed it.
+
+        Raises :class:`..runtime.supervisor.DeviceError` when recovery is
+        impossible (no survivors, or the engine was built from device
+        arrays that died with the mesh)."""
+        from ..runtime.supervisor import DeviceError
+        from .mesh import make_mesh
+
+        failed = {int(r) for r in failed_ranks}
+        devices = list(np.asarray(self.mesh.devices).reshape(-1))
+        survivors = [d for r, d in enumerate(devices) if r not in failed]
+        if not survivors:
+            raise DeviceError(
+                f"no surviving devices (failed ranks {sorted(failed)})",
+                failed_ranks=failed,
+            )
+        if self._host_graph is None:
+            raise DeviceError(
+                "cannot reshard onto survivors: engine was built from "
+                "device arrays (pass the host CSRGraph to enable recovery)",
+                failed_ranks=failed,
+            )
+        mesh = make_mesh(num_query_shards=len(survivors), devices=survivors)
+        kwargs = dict(
+            max_levels=self.max_levels,
+            backend=self.backend,
+            level_chunk=self.level_chunk,
+        )
+        if self.backend == "csr":
+            # These knobs are rejected by the bitbell constructor.
+            kwargs.update(query_chunk=self.query_chunk, expand=self.expand)
+        return DistributedEngine(mesh, self._host_graph, **kwargs)
 
     def _bitbell_merged(self, sharded, k, k_pad):
         if self.level_chunk:

@@ -29,6 +29,33 @@ def cyclic_assignment(k: int, w: int) -> List[List[int]]:
     return [list(range(r, k, w)) for r in range(w)]
 
 
+def reassign(k: int, w: int, failed_ranks) -> List[List[int]]:
+    """Degrade-to-survivors rescheduling: the cyclic assignment for ``w``
+    shards with ``failed_ranks`` lost, their query groups redistributed
+    cyclically over the survivors (the same round-robin the reference
+    uses for the initial assignment, main.cu:303-307, applied to the
+    orphaned ids in ascending order).
+
+    Returns a length-``w`` list: failed rows are empty, each survivor
+    keeps its original ids plus its cyclic share of the orphans.
+    Deterministic in (k, w, failed_ranks), so the supervisor's recovery
+    trace replays exactly; the merged (F, argmin) result is bit-identical
+    to the fault-free run because each query's F value depends only on
+    the query, never on which rank computed it (scheduler merge
+    semantics, :func:`merge_local_f`).  Raises when no rank survives —
+    that loss is unrecoverable and must surface as a DeviceError."""
+    failed = {int(r) for r in failed_ranks if 0 <= int(r) < w}
+    survivors = [r for r in range(w) if r not in failed]
+    if not survivors:
+        raise ValueError(f"no surviving ranks (w={w}, failed={sorted(failed)})")
+    base = cyclic_assignment(k, w)
+    out = [list(base[r]) if r in set(survivors) else [] for r in range(w)]
+    orphans = sorted(g for r in failed for g in base[r])
+    for i, gid in enumerate(orphans):
+        out[survivors[i % len(survivors)]].append(gid)
+    return out
+
+
 def cyclic_grid(
     queries: np.ndarray, w: int, min_j_multiple: int = 1
 ) -> Tuple[np.ndarray, np.ndarray, int]:
@@ -59,10 +86,13 @@ def shard_queries(
     Returns (sharded (W, J, S) grid, k, k_pad, chunk) — the common prologue
     of every distributed engine.
     """
+    from ..utils.faults import trip
+
     w = mesh.shape[QUERY_AXIS]
     k = queries.shape[0]
     chunk = query_chunk or max(1, -(-k // w))
     grid, _, k_pad = cyclic_grid(np.asarray(queries), w, min_j_multiple=chunk)
+    trip("device_put")  # fault seam: upload failures are injectable here
     sharded = jax.device_put(grid, NamedSharding(mesh, P(QUERY_AXIS)))
     return sharded, k, k_pad, chunk
 

@@ -9,4 +9,15 @@ works unbuilt.  Build with ``make native`` at the repo root.
 
 from . import native_loader
 
-__all__ = ["native_loader"]
+__all__ = ["native_loader", "supervisor"]
+
+
+def __getattr__(name):
+    # ``supervisor`` loads lazily (PEP 562): it imports the jax-backed
+    # engine base, and the native loader path must stay importable in
+    # tools that never touch jax.
+    if name == "supervisor":
+        from . import supervisor
+
+        return supervisor
+    raise AttributeError(name)

@@ -756,10 +756,13 @@ int msbfs_gr_scan(const char* path, int64_t* n_out, int64_t* arcs_out) {
         const unsigned char* end = d + size;
         while (q < end && (*q == ' ' || *q == '\t')) ++q;
         while (q < end && *q != ' ' && *q != '\t' && *q != '\n') ++q;  // tag
-        int64_t nv = -1, mv = -1;
+        // n must be a WHOLE token ("p sp 12x3 9" fails like Python's
+        // int("12x3")); m is never read by either parser — the Python
+        // loop is `n = int(parts[2])` — so "p sp <n>" with m absent is
+        // a valid header on both paths (ADVICE r5).
+        int64_t nv = -1;
         const unsigned char* r = gr_parse_uint(q, end, &nv);
-        if (r) r = gr_parse_uint(r, end, &mv);
-        if (r && nv >= 0) {
+        if (r && gr_at_token_boundary(r, end) && nv >= 0) {
           header_off[t] = p;
           header_val[t] = nv;
         }

@@ -1,0 +1,314 @@
+"""Resilient chunk execution: watchdog, typed taxonomy, retry, degrade.
+
+The reference aborts the whole job on any failure (main.cu:95-99,
+EXIT_FAILURE on the first bad fread); the CLI used to mirror that with
+blanket ``except ValueError`` nets.  This module is the runtime layer
+between the CLI and any engine that makes a batch *finish* when parts of
+the system misbehave:
+
+* a typed error taxonomy (:class:`MsbfsError` and subclasses) with
+  documented CLI exit codes (docs/RESILIENCE.md) replacing blanket
+  exception nets — :func:`classify` maps raw Python/XLA errors onto it;
+* :func:`call_with_watchdog` — a wall-clock timeout around a dispatch
+  (XLA offers no cancellation, so a hung dispatch is detected by running
+  it on a worker thread and abandoning it on timeout);
+* :class:`ChunkSupervisor` — wraps an engine's ``f_values`` /
+  ``query_stats`` / ``best`` / ``compile`` with the watchdog, bounded
+  retry with exponential backoff + seeded jitter for transient errors,
+  a degradation ladder for capacity errors (wide-plane -> level-chunked
+  -> streamed, the same routing ladder the CLI picks from up front), and
+  survivor resharding for device errors (the engine's ``without_ranks``
+  rebuilds the mesh over the survivors; the lost rank's query groups are
+  redistributed cyclically — ``parallel.scheduler.reassign`` — with
+  bit-identical final (F, argmin) results, since every merge is
+  deterministic in the query ids, not the rank count).
+
+The supervisor subclasses ``QueryEngineBase`` and delegates unknown
+attributes to the wrapped engine, so it drops into every existing seam —
+including ``utils.checkpoint.CheckpointedRunner``, which journals after
+each supervised chunk: a retried or degraded chunk lands in the journal
+like any other, and recovery resumes rather than recomputes.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..ops.engine import QueryEngineBase
+from ..utils import faults
+
+__all__ = [
+    "MsbfsError",
+    "InputError",
+    "CapacityError",
+    "DeviceError",
+    "TransientError",
+    "classify",
+    "RetryPolicy",
+    "call_with_watchdog",
+    "ChunkSupervisor",
+]
+
+
+class MsbfsError(Exception):
+    """Root of the typed failure taxonomy.  ``exit_code`` is the CLI
+    contract (docs/RESILIENCE.md): 1 input, 3 capacity, 4 device,
+    5 transient, 6 unclassified.  (0 success and -1 usage are the
+    reference's own codes, main.cu:204-212.)"""
+
+    exit_code = 6
+
+
+class InputError(MsbfsError):
+    """Bad input data: unreadable/corrupt graph or query files, malformed
+    knobs.  Exit 1 — the reference's EXIT_FAILURE on a bad load
+    (main.cu:95-99), kept bit-compatible."""
+
+    exit_code = 1
+
+
+class CapacityError(MsbfsError):
+    """The device ran out of memory (RESOURCE_EXHAUSTED).  Recoverable by
+    stepping down the routing ladder to a smaller-footprint config."""
+
+    exit_code = 3
+
+
+class DeviceError(MsbfsError):
+    """A device failed or disappeared.  Recoverable on a multi-chip mesh
+    by resharding onto the survivors."""
+
+    exit_code = 4
+
+    def __init__(self, msg: str, failed_ranks=()):
+        super().__init__(msg)
+        self.failed_ranks = frozenset(int(r) for r in failed_ranks)
+
+
+class TransientError(MsbfsError):
+    """A fault that plausibly clears on retry: hung/timed-out dispatch
+    (watchdog), UNAVAILABLE / DEADLINE_EXCEEDED runtime errors, dropped
+    connections."""
+
+    exit_code = 5
+
+
+_CAPACITY_MARKS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY", "ALLOCATION FAILURE")
+_TRANSIENT_MARKS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "CONNECTION RESET",
+                    "WATCHDOG", "TIMED OUT")
+_DEVICE_MARKS = ("DEVICE LOST", "CHIP LOST", "CHIP LOSS", "HALTED")
+
+
+def classify(exc: BaseException) -> MsbfsError:
+    """Map a raw exception onto the taxonomy (idempotent on taxonomy
+    instances).  Message marks are checked before the broad isinstance
+    nets: XLA runtime errors are plain RuntimeErrors distinguished only
+    by their status-name prefix, and an injected simulated error carries
+    the same mark as the real one (utils.faults)."""
+    if isinstance(exc, MsbfsError):
+        return exc
+    failed = getattr(exc, "failed_ranks", None)
+    if failed:
+        return DeviceError(str(exc), failed_ranks=failed)
+    msg = str(exc)
+    up = msg.upper()
+    if isinstance(exc, MemoryError) or any(m in up for m in _CAPACITY_MARKS):
+        return CapacityError(msg)
+    if isinstance(exc, TimeoutError) or any(m in up for m in _TRANSIENT_MARKS):
+        return TransientError(msg)
+    if any(m in up for m in _DEVICE_MARKS):
+        return DeviceError(msg)
+    if isinstance(exc, (IOError, OSError, ValueError, IndexError, KeyError)):
+        return InputError(f"{type(exc).__name__}: {msg}")
+    return MsbfsError(f"{type(exc).__name__}: {msg}")
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded retry with exponential backoff + seeded jitter.
+
+    ``delays()`` yields the full deterministic schedule for one
+    supervised call: ``base_delay * multiplier^i``, each scaled by a
+    uniform jitter in ``[1 - jitter, 1 + jitter]`` drawn from a
+    ``random.Random(seed)`` stream — replayable, and never synchronized
+    across workers that were given different seeds (the thundering-herd
+    reason jitter exists)."""
+
+    max_retries: int = 2
+    base_delay: float = 0.1
+    multiplier: float = 2.0
+    jitter: float = 0.5
+    max_delay: float = 30.0
+    seed: int = 0
+
+    def delays(self):
+        rng = random.Random(self.seed)
+        d = self.base_delay
+        for _ in range(self.max_retries):
+            yield min(self.max_delay, d * (1.0 + self.jitter * (2.0 * rng.random() - 1.0)))
+            d *= self.multiplier
+
+
+def call_with_watchdog(fn: Callable[[], object], timeout: Optional[float]):
+    """Run ``fn()`` with a wall-clock deadline.  ``timeout`` None/0
+    disables (direct call, no thread).  On expiry raises
+    :class:`TransientError`; the worker thread cannot be cancelled (XLA
+    dispatches have no cancellation API) so it is abandoned as a daemon —
+    acceptable for a dispatch that is presumed hung, and the retry path
+    re-dispatches independently."""
+    if not timeout:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            box["value"] = fn()
+        except BaseException as exc:  # delivered to the caller below
+            box["error"] = exc
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=_run, name="msbfs-dispatch", daemon=True)
+    worker.start()
+    if not done.wait(timeout):
+        raise TransientError(
+            f"dispatch watchdog: no completion within {timeout:g}s"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+class ChunkSupervisor(QueryEngineBase):
+    """Wraps any engine's per-chunk dispatch surface with the recovery
+    policy.  Drop-in: quacks like the engine (unknown attributes
+    delegate), so the CLI, the checkpoint runner and the stats paths all
+    work unchanged on a supervised engine.
+
+    ``ladder``: ``(label, factory)`` pairs, tried in order on
+    :class:`CapacityError` — each factory builds the next
+    smaller-footprint engine (e.g. wide-plane -> level-chunked ->
+    streamed).  ``plan`` defaults to the process-wide active fault plan;
+    every supervised call trips the ``"dispatch"`` site exactly once per
+    attempt, inside the watchdog, so injected hangs stall the worker
+    thread like a real hung dispatch would.
+
+    ``events`` records every recovery action (retry/degrade/reshard) for
+    the CLI's failure report and the resilience tests.
+    """
+
+    def __init__(
+        self,
+        engine,
+        policy: Optional[RetryPolicy] = None,
+        watchdog: Optional[float] = None,
+        ladder: Sequence[Tuple[str, Callable[[], object]]] = (),
+        plan: Optional[faults.FaultPlan] = None,
+        max_rebuilds: Optional[int] = None,
+    ):
+        self.engine = engine
+        self.policy = policy or RetryPolicy()
+        self.watchdog = watchdog
+        self.ladder: List[Tuple[str, Callable[[], object]]] = list(ladder)
+        self.plan = plan
+        self.max_rebuilds = max_rebuilds
+        self.events: List[dict] = []
+        self._rebuilds = 0
+
+    def __getattr__(self, name):
+        # Only called for attributes missing on the supervisor itself;
+        # guard the bootstrap so a half-constructed instance cannot
+        # recurse (self.engine is always in __dict__ after __init__).
+        if name == "engine":
+            raise AttributeError(name)
+        return getattr(self.engine, name)
+
+    # ---- supervised dispatch surface --------------------------------------
+    def f_values(self, queries):
+        return self._supervised("f_values", queries)
+
+    def query_stats(self, queries):
+        return self._supervised("query_stats", queries)
+
+    def best(self, queries):
+        return self._supervised("best", queries)
+
+    def compile(self, *args, **kwargs):
+        # Warm compiles are supervised too: OOM strikes first at compile
+        # time, and degrading there keeps the failure out of the timed
+        # computation span entirely.
+        return self._supervised("compile", *args, **kwargs)
+
+    # ---- internals --------------------------------------------------------
+    def _dispatch(self, method, args, kwargs):
+        plan = self.plan if self.plan is not None else faults.active_plan()
+        if plan is not None:
+            plan.trip("dispatch")
+        return getattr(self.engine, method)(*args, **kwargs)
+
+    def _supervised(self, method, *args, **kwargs):
+        delays = self.policy.delays()
+        attempt = 0
+        while True:
+            try:
+                return call_with_watchdog(
+                    lambda: self._dispatch(method, args, kwargs),
+                    self.watchdog,
+                )
+            except Exception as exc:
+                err = classify(exc)
+                if isinstance(err, TransientError):
+                    delay = next(delays, None)
+                    if delay is not None:
+                        attempt += 1
+                        self.events.append({
+                            "action": "retry",
+                            "method": method,
+                            "attempt": attempt,
+                            "delay": delay,
+                            "error": str(err),
+                        })
+                        time.sleep(delay)
+                        continue
+                elif isinstance(err, CapacityError) and self.ladder:
+                    label, factory = self.ladder.pop(0)
+                    self.engine = factory()
+                    self.events.append({
+                        "action": "degrade",
+                        "method": method,
+                        "to": label,
+                        "error": str(err),
+                    })
+                    continue
+                elif (
+                    isinstance(err, DeviceError)
+                    and err.failed_ranks
+                    and hasattr(self.engine, "without_ranks")
+                ):
+                    cap = (
+                        self.max_rebuilds
+                        if self.max_rebuilds is not None
+                        else int(getattr(self.engine, "w", 1))
+                    )
+                    if self._rebuilds < cap:
+                        self._rebuilds += 1
+                        survivors = self.engine.without_ranks(
+                            err.failed_ranks
+                        )
+                        self.events.append({
+                            "action": "reshard",
+                            "method": method,
+                            "failed_ranks": sorted(err.failed_ranks),
+                            "survivor_shards": int(
+                                getattr(survivors, "w", 0)
+                            ),
+                            "error": str(err),
+                        })
+                        self.engine = survivors
+                        continue
+                raise err from exc

@@ -1,0 +1,254 @@
+"""Deterministic fault injection for the resilient execution runtime.
+
+The reference treats every failure as fatal (a corrupt byte or a lost
+rank kills the whole batch, main.cu:95-99); growing toward a production
+service needs every recovery path in :mod:`..runtime.supervisor` to be
+*testable* — on the 8-device virtual CPU mesh, on every CI run, with no
+real hardware misbehaving on cue.  This module is that test harness's
+only moving part: a seeded, replayable plan of injected faults that the
+runtime's seams consult at well-known sites.
+
+Grammar (``MSBFS_FAULTS`` / :meth:`FaultPlan.parse`)::
+
+    MSBFS_FAULTS="<kind>:<site>:<n>[,<kind>:<site>:<n>...]"
+
+Each spec arms one fault that fires exactly once, on the ``n``-th trip
+(1-based) of its site.  Sites are plain strings named by the seams:
+``load_graph`` / ``load_query`` (the binary loaders, utils/io.py),
+``device_put`` (query upload, parallel/scheduler.py) and ``dispatch``
+(every supervised engine call, runtime/supervisor.py).  Kinds:
+
+``io``         raise ``IOError`` at the site (unreadable file, lost NFS).
+``corrupt``    raise ``ValueError`` (corrupt bytes past the header checks).
+``oom``        raise a simulated ``RESOURCE_EXHAUSTED`` runtime error —
+               classified as ``CapacityError`` so the supervisor steps
+               down the routing ladder exactly as on a real TPU OOM.
+``transient``  raise a simulated ``UNAVAILABLE`` error — classified as
+               ``TransientError`` and retried with backoff.
+``hang``       stall the site for ``MSBFS_FAULT_HANG`` seconds (default
+               60) so the dispatch watchdog fires; the stalled thread
+               then raises ``UNAVAILABLE`` and exits.
+``chip``       site must be ``rank<r>``; trips on ``dispatch`` and raises
+               a simulated chip loss carrying ``failed_ranks={r}`` —
+               classified as ``DeviceError``, triggering survivor
+               resharding.
+
+Example: ``MSBFS_FAULTS="io:load_graph:1,oom:dispatch:2,hang:dispatch:3,
+chip:rank1:1"``.  Trip counters are plain per-site integers, so a given
+plan replays identically for a given call sequence; ``MSBFS_FAULT_SEED``
+seeds the supervisor's backoff jitter (not this module) so whole
+recovery traces replay too.  See docs/RESILIENCE.md.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+KINDS = ("io", "corrupt", "oom", "transient", "hang", "chip")
+
+_RANK_RE = re.compile(r"rank(\d+)\Z")
+
+
+class SimulatedResourceExhausted(RuntimeError):
+    """Stands in for the XLA runtime's RESOURCE_EXHAUSTED error (the
+    message carries the status name, which is what classification keys
+    on — same as the real error's repr)."""
+
+
+class SimulatedUnavailable(RuntimeError):
+    """Stands in for a transient runtime error (UNAVAILABLE /
+    DEADLINE_EXCEEDED family): succeeds if simply tried again."""
+
+
+class SimulatedChipLoss(RuntimeError):
+    """A virtual mesh rank disappearing mid-batch.  Carries the failed
+    rank set so recovery can reshard onto the survivors."""
+
+    def __init__(self, msg: str, failed_ranks):
+        super().__init__(msg)
+        self.failed_ranks = frozenset(int(r) for r in failed_ranks)
+
+
+@dataclass
+class FaultSpec:
+    kind: str
+    site: str
+    at: int  # fires on the at-th trip of trip_site, 1-based
+    rank: Optional[int] = None  # chip faults only
+    fired: bool = False
+
+    @property
+    def trip_site(self) -> str:
+        # Chips die during dispatches; the spec's site names WHICH rank.
+        return "dispatch" if self.kind == "chip" else self.site
+
+
+class FaultPlan:
+    """An armed set of :class:`FaultSpec`, with per-site trip counters.
+
+    Thread-safe: the dispatch seam runs inside the supervisor's watchdog
+    worker thread, so counter updates take a lock (the fire itself —
+    sleep + raise — happens outside it).
+    """
+
+    def __init__(self, specs, hang_seconds: float = 60.0):
+        self.specs: List[FaultSpec] = list(specs)
+        self.hang_seconds = float(hang_seconds)
+        self.counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # ---- construction -----------------------------------------------------
+    @classmethod
+    def parse(cls, text: str, hang_seconds: float = 60.0) -> "FaultPlan":
+        """Parse the ``kind:site:n`` grammar; malformed specs fail loud
+        (a typo'd fault plan silently arming nothing would make every
+        "recovery works" test vacuous)."""
+        specs = []
+        for raw in text.split(","):
+            raw = raw.strip()
+            if not raw:
+                continue
+            parts = raw.split(":")
+            if len(parts) != 3:
+                raise ValueError(
+                    f"fault spec {raw!r}: want <kind>:<site>:<n>"
+                )
+            kind, site, n = parts
+            if kind not in KINDS:
+                raise ValueError(
+                    f"fault spec {raw!r}: unknown kind {kind!r} "
+                    f"(one of {', '.join(KINDS)})"
+                )
+            try:
+                at = int(n)
+            except ValueError:
+                raise ValueError(f"fault spec {raw!r}: trip count {n!r} "
+                                 "is not an integer") from None
+            if at < 1:
+                raise ValueError(f"fault spec {raw!r}: trip count must be >= 1")
+            rank = None
+            if kind == "chip":
+                m = _RANK_RE.match(site)
+                if not m:
+                    raise ValueError(
+                        f"fault spec {raw!r}: chip faults need site "
+                        "rank<r> (e.g. chip:rank1:1)"
+                    )
+                rank = int(m.group(1))
+            specs.append(FaultSpec(kind=kind, site=site, at=at, rank=rank))
+        return cls(specs, hang_seconds=hang_seconds)
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        """Plan from ``MSBFS_FAULTS`` (+ ``MSBFS_FAULT_HANG``), or None
+        when unset/empty (the normal no-faults case)."""
+        raw = os.environ.get("MSBFS_FAULTS", "").strip()
+        if not raw:
+            return None
+        hang = 60.0
+        env = os.environ.get("MSBFS_FAULT_HANG", "")
+        if env:
+            try:
+                hang = float(env)
+            except ValueError:
+                pass  # malformed knob falls back, file-wide convention
+        return cls.parse(raw, hang_seconds=hang)
+
+    # ---- execution --------------------------------------------------------
+    def reset(self) -> None:
+        """Re-arm every spec and zero the counters (replay)."""
+        with self._lock:
+            self.counters.clear()
+            for s in self.specs:
+                s.fired = False
+
+    def trip(self, site: str) -> None:
+        """One execution of ``site``: increments its counter and fires
+        any spec due at this count.  No-op when nothing is due."""
+        with self._lock:
+            count = self.counters.get(site, 0) + 1
+            self.counters[site] = count
+            due = [
+                s
+                for s in self.specs
+                if s.trip_site == site and s.at == count and not s.fired
+            ]
+            for s in due:
+                s.fired = True
+        for s in due:  # outside the lock: hangs sleep, fires raise
+            self._fire(s)
+
+    def pending(self) -> List[FaultSpec]:
+        with self._lock:
+            return [s for s in self.specs if not s.fired]
+
+    def _fire(self, s: FaultSpec) -> None:
+        where = f"at {s.site} (trip {s.at})"
+        if s.kind == "io":
+            raise IOError(f"injected io fault {where}")
+        if s.kind == "corrupt":
+            raise ValueError(f"injected corrupt input {where}")
+        if s.kind == "oom":
+            raise SimulatedResourceExhausted(
+                f"RESOURCE_EXHAUSTED: injected oom {where}"
+            )
+        if s.kind == "transient":
+            raise SimulatedUnavailable(
+                f"UNAVAILABLE: injected transient fault {where}"
+            )
+        if s.kind == "hang":
+            time.sleep(self.hang_seconds)
+            raise SimulatedUnavailable(
+                f"UNAVAILABLE: injected hang {where} released after "
+                f"{self.hang_seconds:g}s"
+            )
+        if s.kind == "chip":
+            raise SimulatedChipLoss(
+                f"injected chip loss: rank {s.rank} {where}", {s.rank}
+            )
+        raise AssertionError(f"unreachable kind {s.kind!r}")
+
+
+# ---- process-wide active plan (the seams' lookup point) -------------------
+_active: Optional[FaultPlan] = None
+
+
+def activate(plan: Optional[FaultPlan]) -> None:
+    """Install ``plan`` as the process-wide plan (None clears).  The CLI
+    installs a fresh plan from the environment on every ``main()`` call,
+    so repeated in-process runs never see a stale half-fired plan."""
+    global _active
+    _active = plan
+    if plan is not None:
+        plan.reset()
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _active
+
+
+def trip(site: str) -> None:
+    """Seam entry point: near-free when no plan is active."""
+    if _active is not None:
+        _active.trip(site)
+
+
+class injected:
+    """``with injected(plan):`` — scoped activation for tests."""
+
+    def __init__(self, plan: Optional[FaultPlan]):
+        self.plan = plan
+        self._prev: Optional[FaultPlan] = None
+
+    def __enter__(self) -> Optional[FaultPlan]:
+        self._prev = _active
+        activate(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        activate(self._prev)

@@ -37,6 +37,9 @@ def load_graph_bin(path: str | os.PathLike, native: Optional[bool] = None) -> CS
     ``native=True`` forces the C++ runtime loader, ``False`` the NumPy path,
     ``None`` auto-selects (native when the shared library is built).
     """
+    from .faults import trip
+
+    trip("load_graph")  # fault seam (utils.faults): injectable load failure
     if native is None or native:
         from ..runtime import native_loader
 
@@ -52,6 +55,19 @@ def load_graph_bin(path: str | os.PathLike, native: Optional[bool] = None) -> CS
         if len(header) < GRAPH_HEADER.size:
             raise IOError(f"truncated graph header in {path}")
         n, m = GRAPH_HEADER.unpack(header)
+        # Validate the counts against the actual file size BEFORE
+        # allocating: a bit-flipped header can claim billions of edges,
+        # and np.fromfile would try to allocate them all (a corrupt
+        # 1 KiB file must never turn into a 288 GiB MemoryError —
+        # fuzz-found; the native loader's rc=3 size check, mirrored).
+        if n < 0 or m < 0:
+            raise IOError(f"corrupt graph header in {path}: n={n}, m={m}")
+        remaining = os.fstat(f.fileno()).st_size - GRAPH_HEADER.size
+        if remaining < 8 * m:
+            raise IOError(
+                f"truncated edge list in {path}: header claims {m} edges "
+                f"({8 * m} bytes), file has {remaining}"
+            )
         edges = np.fromfile(f, dtype=np.int32, count=2 * m)
     if edges.size != 2 * m:
         raise IOError(f"truncated edge list in {path}: wanted {2*m} ints, got {edges.size}")
@@ -70,6 +86,9 @@ def save_graph_bin(path: str | os.PathLike, n: int, edges: np.ndarray) -> None:
 
 def load_query_bin(path: str | os.PathLike) -> List[np.ndarray]:
     """Load the reference query format -> list of K int32 arrays (ragged)."""
+    from .faults import trip
+
+    trip("load_query")  # fault seam (utils.faults)
     with open(path, "rb") as f:
         data = f.read()
     if len(data) < 1:

@@ -27,3 +27,20 @@ def format_report(
         f"Preprocessing time: {preprocessing_time:.9f} s\n"
         f"Computation time: {computation_time:.9f} s\n"
     )
+
+
+def format_failure(err, recovery_events=()) -> str:
+    """One-line failure report for the typed taxonomy (stderr; stdout
+    stays reference-exact).  ``<class>: <msg> (exit <code>)`` plus a
+    recovery-attempt count when the supervisor tried before giving up —
+    docs/RESILIENCE.md documents the exit-code table."""
+    tried = (
+        f" after {len(recovery_events)} recovery attempt"
+        f"{'s' if len(recovery_events) != 1 else ''}"
+        if recovery_events
+        else ""
+    )
+    return (
+        f"msbfs: {type(err).__name__}: {err}{tried} "
+        f"(exit {getattr(err, 'exit_code', 1)})\n"
+    )

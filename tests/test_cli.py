@@ -202,6 +202,31 @@ def test_single_chip_hbm_warning(tmp_path, capsys, monkeypatch):
     assert captured.out.splitlines()[:5] == want.splitlines()[:5]
 
 
+def test_single_chip_hbm_explicit_unbounded_chunk_clamped(
+    tmp_path, capsys, monkeypatch
+):
+    """MSBFS_LEVEL_CHUNK=0 (explicit unbounded) on an over-HBM graph is
+    exactly the unchunked wide-plane dispatch the streamed route exists
+    to avoid (the documented TPU worker crash, raw_r5): the CLI must
+    clamp it to the streamed bound — loudly — not honor it (ADVICE r5)."""
+    n, edges = generators.gnm_edges(60, 180, seed=323)
+    g, q = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
+    save_graph_bin(g, n, edges)
+    save_query_bin(q, [[0], [7], [3, 9]])
+    rc = main(["main.py", "-g", g, "-q", q, "-gn", "1"])
+    want = capsys.readouterr().out
+    assert rc == 0
+    monkeypatch.setenv("MSBFS_HBM_BYTES", "4096")
+    monkeypatch.setenv("MSBFS_LEVEL_CHUNK", "0")
+    rc = main(["main.py", "-g", g, "-q", q, "-gn", "1"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "clamping to 8 levels/dispatch" in captured.err
+    assert "8 levels/dispatch" in captured.err
+    assert "unbounded levels/dispatch" not in captured.err
+    assert captured.out.splitlines()[:5] == want.splitlines()[:5]
+
+
 @pytest.fixture(scope="module")
 def road_files(tmp_path_factory):
     """A path graph (diameter ~240): road-class degree profile, so the CLI

@@ -283,3 +283,114 @@ def test_fuzz_sharded_push_matches_oracle(seed):
     got = np.asarray(eng.f_values(padded))
     want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
     np.testing.assert_array_equal(got, want, err_msg=f"seed={seed}")
+
+
+# ---------------------------------------------------------------------------
+# Loader corruption fuzz: truncated/bit-flipped binaries through BOTH the
+# Python and native loaders must land in the same taxonomy class
+# (runtime.supervisor.classify -> InputError), never diverge, never crash
+# the process (docs/RESILIENCE.md).
+# ---------------------------------------------------------------------------
+
+
+def _graph_load_outcome(path, native):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (
+        MsbfsError,
+        classify,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_graph_bin,
+    )
+
+    try:
+        g = load_graph_bin(path, native=native)
+        return ("ok", g.n, g.num_directed_edges)
+    except Exception as exc:
+        err = classify(exc)
+        assert isinstance(err, MsbfsError)
+        return ("err", type(err).__name__)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_corrupt_graph_bin_loader_parity(seed, tmp_path):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime import (
+        native_loader,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_graph_bin,
+    )
+
+    if not native_loader.available():
+        pytest.skip("native loader not built (make native)")
+    rng = np.random.default_rng(9200 + seed)
+    n, edges = generators.gnm_edges(40, 100, seed=9300 + seed)
+    good = tmp_path / "good.bin"
+    save_graph_bin(str(good), n, edges)
+    blob = bytearray(good.read_bytes())
+    for case in range(12):
+        bad = bytearray(blob)
+        mode = case % 3
+        if mode == 0:  # truncate anywhere, header included
+            bad = bad[: int(rng.integers(0, len(bad)))]
+        elif mode == 1:  # flip bytes in the count header
+            for _ in range(int(rng.integers(1, 4))):
+                bad[int(rng.integers(0, min(8, len(bad))))] = int(
+                    rng.integers(0, 256)
+                )
+        else:  # flip bytes anywhere in the payload
+            for _ in range(int(rng.integers(1, 8))):
+                bad[int(rng.integers(0, len(bad)))] = int(rng.integers(0, 256))
+        p = tmp_path / f"bad_{seed}_{case}.bin"
+        p.write_bytes(bytes(bad))
+        got_py = _graph_load_outcome(str(p), native=False)
+        got_nat = _graph_load_outcome(str(p), native=True)
+        assert got_py == got_nat, (
+            f"loader divergence on seed={seed} case={case}: "
+            f"python={got_py} native={got_nat}"
+        )
+
+
+def test_fuzz_truncated_query_bin_is_input_error(tmp_path):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (
+        InputError,
+        classify,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_query_bin,
+        save_query_bin,
+    )
+
+    good = tmp_path / "q.bin"
+    save_query_bin(str(good), [np.array([1, 2], dtype=np.int32)])
+    blob = good.read_bytes()
+    for cut in range(len(blob)):
+        p = tmp_path / f"q_{cut}.bin"
+        p.write_bytes(blob[:cut])
+        with pytest.raises(Exception) as ei:
+            load_query_bin(str(p))
+        assert isinstance(classify(ei.value), InputError)
+
+
+def test_gr_header_parity_malformed_n_and_absent_m(tmp_path):
+    """Both .gr parsers agree on the two header edge cases: a non-integer
+    n token fails loud on both paths (Python's int() raise), and a
+    header with m absent loads on both (neither parser reads m)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime import (
+        native_loader,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_dimacs_gr,
+    )
+
+    natives = [False, True] if native_loader.available() else [False]
+    bad = tmp_path / "bad.gr"
+    bad.write_text("p sp 12x3 9\na 1 2 7\n")
+    for native in natives:
+        with pytest.raises(ValueError):
+            load_dimacs_gr(str(bad), native=native)
+    ok = tmp_path / "ok.gr"
+    ok.write_text("p sp 100\na 1 2 7\n")
+    for native in natives:
+        got_n, got_edges = load_dimacs_gr(str(ok), native=native)
+        assert got_n == 100
+        assert got_edges.tolist() == [[0, 1]]

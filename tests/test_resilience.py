@@ -1,0 +1,529 @@
+"""Fault-tolerant execution tests (docs/RESILIENCE.md): deterministic
+fault injection (utils.faults), the typed error taxonomy + exit codes,
+retry with backoff, dispatch watchdog, capacity degradation down the
+routing ladder, and degrade-to-survivors resharding on the 8-device
+virtual CPU mesh — every recovery path the runtime promises, rehearsed
+with injected faults, ending in bit-identical (F, argmin) results.
+"""
+
+import re
+import time
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+    main,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.scheduler import (
+    cyclic_assignment,
+    reassign,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (
+    CapacityError,
+    ChunkSupervisor,
+    DeviceError,
+    InputError,
+    MsbfsError,
+    RetryPolicy,
+    TransientError,
+    call_with_watchdog,
+    classify,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils import (
+    faults,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.faults import (
+    FaultPlan,
+    SimulatedChipLoss,
+    SimulatedResourceExhausted,
+    SimulatedUnavailable,
+    injected,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+    save_graph_bin,
+    save_query_bin,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+FAST = RetryPolicy(max_retries=2, base_delay=0.001, max_delay=0.01)
+
+REPORT_TAIL_RE = re.compile(
+    r"Query number \(k\) with minimum F value: (?P<mink>-?\d+)\n"
+    r"Minimum F value: (?P<minf>-?\d+)\n"
+)
+
+
+# ---------------------------------------------------------------------------
+# Fault-plan grammar and replay
+# ---------------------------------------------------------------------------
+
+
+def test_plan_parse_grammar():
+    plan = FaultPlan.parse(
+        "io:load_graph:1, oom:dispatch:2 ,hang:dispatch:3,chip:rank1:1"
+    )
+    kinds = [s.kind for s in plan.specs]
+    assert kinds == ["io", "oom", "hang", "chip"]
+    chip = plan.specs[-1]
+    assert chip.rank == 1 and chip.site == "rank1"
+    # Chips die during dispatches: the spec's counter is the dispatch one.
+    assert chip.trip_site == "dispatch"
+    assert plan.specs[0].trip_site == "load_graph"
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "io:load_graph",  # missing count
+        "nope:dispatch:1",  # unknown kind
+        "io:load_graph:zero",  # non-integer count
+        "io:load_graph:0",  # counts are 1-based
+        "chip:dispatch:1",  # chip faults need rank<r>
+    ],
+)
+def test_plan_malformed_fails_loud(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(bad)
+
+
+def test_plan_fires_once_on_nth_trip_and_replays():
+    plan = FaultPlan.parse("transient:dispatch:2")
+    plan.trip("dispatch")  # 1st: not due yet
+    with pytest.raises(SimulatedUnavailable):
+        plan.trip("dispatch")  # 2nd: fires
+    plan.trip("dispatch")  # 3rd: spent, no-op
+    assert plan.pending() == []
+    plan.reset()  # replay: identical trace
+    plan.trip("dispatch")
+    with pytest.raises(SimulatedUnavailable):
+        plan.trip("dispatch")
+
+
+def test_plan_sites_are_independent():
+    plan = FaultPlan.parse("io:load_graph:1")
+    plan.trip("dispatch")  # other sites never advance this spec
+    plan.trip("load_query")
+    with pytest.raises(IOError):
+        plan.trip("load_graph")
+
+
+def test_active_plan_seam():
+    plan = FaultPlan.parse("corrupt:load_query:1")
+    with injected(plan):
+        assert faults.active_plan() is plan
+        with pytest.raises(ValueError):
+            faults.trip("load_query")
+    assert faults.active_plan() is None
+    faults.trip("load_query")  # no active plan: free no-op
+
+
+# ---------------------------------------------------------------------------
+# Taxonomy and exit codes
+# ---------------------------------------------------------------------------
+
+
+def test_classify_taxonomy_and_exit_codes():
+    oom = classify(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    assert isinstance(oom, CapacityError) and oom.exit_code == 3
+    gone = classify(RuntimeError("UNAVAILABLE: socket closed"))
+    assert isinstance(gone, TransientError) and gone.exit_code == 5
+    assert isinstance(classify(TimeoutError("deadline")), TransientError)
+    chip = classify(SimulatedChipLoss("rank down", {3}))
+    assert isinstance(chip, DeviceError) and chip.exit_code == 4
+    assert chip.failed_ranks == frozenset({3})
+    bad = classify(ValueError("truncated file"))
+    assert isinstance(bad, InputError) and bad.exit_code == 1
+    other = classify(RuntimeError("weird"))
+    assert type(other) is MsbfsError and other.exit_code == 6
+    # Idempotent on taxonomy instances (exception chains re-classify).
+    assert classify(oom) is oom
+
+
+def test_exit_codes_are_distinct():
+    codes = [
+        e.exit_code
+        for e in (MsbfsError, InputError, CapacityError, DeviceError,
+                  TransientError)
+    ]
+    assert len(set(codes)) == len(codes)
+    assert 0 not in codes and -1 not in codes  # success/usage stay theirs
+
+
+# ---------------------------------------------------------------------------
+# Retry policy and watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_retry_backoff_deterministic_and_bounded():
+    a = list(RetryPolicy(max_retries=4, base_delay=0.1, seed=7).delays())
+    b = list(RetryPolicy(max_retries=4, base_delay=0.1, seed=7).delays())
+    c = list(RetryPolicy(max_retries=4, base_delay=0.1, seed=8).delays())
+    assert a == b  # replayable for a given MSBFS_FAULT_SEED
+    assert a != c  # jitter decorrelates differently-seeded workers
+    assert len(a) == 4
+    assert all(d <= 30.0 for d in a)
+    # Exponential growth survives the +/-50% jitter between steps of 2x.
+    assert a[2] > a[0] and a[3] > a[1]
+
+
+def test_watchdog_passes_results_and_errors_through():
+    assert call_with_watchdog(lambda: 41 + 1, None) == 42
+    assert call_with_watchdog(lambda: "ok", 5.0) == "ok"
+    with pytest.raises(KeyError):
+        call_with_watchdog(lambda: {}["x"], 5.0)
+
+
+def test_watchdog_kills_hung_dispatch():
+    t0 = time.perf_counter()
+    with pytest.raises(TransientError, match="watchdog"):
+        call_with_watchdog(lambda: time.sleep(5.0), 0.2)
+    assert time.perf_counter() - t0 < 2.0  # did not wait out the hang
+
+
+# ---------------------------------------------------------------------------
+# Degrade-to-survivors rescheduling
+# ---------------------------------------------------------------------------
+
+
+def test_reassign_redistributes_orphans_cyclically():
+    w, k = 4, 11
+    out = reassign(k, w, failed_ranks={1})
+    assert out[1] == []  # the dead rank owns nothing
+    base = cyclic_assignment(k, w)
+    orphans = base[1]  # [1, 5, 9]
+    survivors = [0, 2, 3]
+    for i, gid in enumerate(orphans):
+        assert gid in out[survivors[i % 3]]
+    # Exact cover: every query id exactly once across all ranks.
+    flat = sorted(g for row in out for g in row)
+    assert flat == list(range(k))
+
+
+def test_reassign_multi_failure_and_no_survivors():
+    out = reassign(8, 4, failed_ranks={0, 2})
+    assert out[0] == [] and out[2] == []
+    assert sorted(g for row in out for g in row) == list(range(8))
+    with pytest.raises(ValueError):
+        reassign(8, 4, failed_ranks={0, 1, 2, 3})
+
+
+# ---------------------------------------------------------------------------
+# ChunkSupervisor recovery loop (toy engine: no jax dispatch needed)
+# ---------------------------------------------------------------------------
+
+
+class ToyEngine:
+    """Minimal engine: f_values is base + queries' row sums."""
+
+    def __init__(self, tag=0):
+        self.tag = tag
+        self.calls = 0
+
+    def f_values(self, queries):
+        self.calls += 1
+        return np.asarray(queries).sum(axis=1)
+
+    def best(self, queries):
+        f = self.f_values(queries)
+        return int(f.min()), int(f.argmin())
+
+
+def test_supervisor_transient_retry_bit_identical():
+    q = np.arange(12, dtype=np.int32).reshape(4, 3)
+    want = ToyEngine().f_values(q)
+    plan = FaultPlan.parse("transient:dispatch:1")
+    sup = ChunkSupervisor(ToyEngine(), policy=FAST, plan=plan)
+    got = sup.f_values(q)
+    assert np.array_equal(got, want)
+    assert [e["action"] for e in sup.events] == ["retry"]
+    assert sup.engine.calls == 1  # attempt 1 died before the engine ran
+
+
+def test_supervisor_retry_budget_exhausts_to_transient_error():
+    plan = FaultPlan.parse(
+        "transient:dispatch:1,transient:dispatch:2,transient:dispatch:3"
+    )
+    sup = ChunkSupervisor(
+        ToyEngine(),
+        policy=RetryPolicy(max_retries=2, base_delay=0.001),
+        plan=plan,
+    )
+    with pytest.raises(TransientError):
+        sup.f_values(np.zeros((2, 2), dtype=np.int32))
+    assert len(sup.events) == 2  # both retries recorded before giving up
+
+
+def test_supervisor_capacity_degrades_down_ladder():
+    class OomAlways:
+        def f_values(self, queries):
+            raise SimulatedResourceExhausted("RESOURCE_EXHAUSTED: injected")
+
+    q = np.ones((3, 2), dtype=np.int32)
+    sup = ChunkSupervisor(
+        OomAlways(),
+        policy=FAST,
+        ladder=[("level-chunked", OomAlways), ("streamed", ToyEngine)],
+    )
+    got = sup.f_values(q)
+    assert np.array_equal(got, ToyEngine().f_values(q))
+    assert [e["action"] for e in sup.events] == ["degrade", "degrade"]
+    assert [e["to"] for e in sup.events] == ["level-chunked", "streamed"]
+    # Ladder exhausted: the next capacity fault is terminal.
+    sup2 = ChunkSupervisor(OomAlways(), policy=FAST, ladder=[])
+    with pytest.raises(CapacityError):
+        sup2.f_values(q)
+
+
+def test_supervisor_watchdog_retry_recovers():
+    plan = FaultPlan.parse("hang:dispatch:1")
+    plan.hang_seconds = 1.0
+    q = np.arange(6, dtype=np.int32).reshape(2, 3)
+    sup = ChunkSupervisor(ToyEngine(), policy=FAST, watchdog=0.2, plan=plan)
+    t0 = time.perf_counter()
+    got = sup.f_values(q)
+    assert np.array_equal(got, ToyEngine().f_values(q))
+    assert time.perf_counter() - t0 < 3.0
+    assert sup.events[0]["action"] == "retry"
+    assert "watchdog" in sup.events[0]["error"]
+
+
+def test_supervisor_unrecoverable_device_error():
+    class Doomed:
+        def f_values(self, queries):
+            raise SimulatedChipLoss("rank 1 gone", {1})
+
+    sup = ChunkSupervisor(Doomed(), policy=FAST)  # no without_ranks
+    with pytest.raises(DeviceError) as ei:
+        sup.f_values(np.zeros((1, 1), dtype=np.int32))
+    assert ei.value.failed_ranks == frozenset({1})
+
+
+def test_supervisor_delegates_unknown_attributes():
+    toy = ToyEngine(tag=9)
+    sup = ChunkSupervisor(toy, policy=FAST)
+    assert sup.tag == 9
+    with pytest.raises(AttributeError):
+        sup.nonexistent_attr
+
+
+# ---------------------------------------------------------------------------
+# Chip loss on the 8-device virtual mesh: reshard, bit-identical results
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh_engine():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
+        DistributedEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        pad_queries,
+    )
+
+    n, edges = generators.gnm_edges(72, 210, seed=11)
+    graph = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 10, max_group=4, seed=12)
+    padded = pad_queries(queries)
+    mesh = make_mesh(num_query_shards=8, devices=jax.devices()[:8])
+    engine = DistributedEngine(mesh, graph)
+    return engine, np.asarray(padded)
+
+
+def test_chip_loss_resharding_is_bit_identical(mesh_engine):
+    engine, padded = mesh_engine
+    want = np.asarray(engine.f_values(padded))
+    plan = FaultPlan.parse("chip:rank2:1")
+    sup = ChunkSupervisor(engine, policy=FAST, plan=plan)
+    got = np.asarray(sup.f_values(padded))
+    assert np.array_equal(got, want)  # bit-identical F after resharding
+    assert [e["action"] for e in sup.events] == ["reshard"]
+    assert sup.events[0]["failed_ranks"] == [2]
+    assert sup.events[0]["survivor_shards"] == 7
+    assert sup.engine is not engine and sup.engine.w == 7
+
+
+def test_repeated_chip_loss_until_no_survivors(mesh_engine):
+    engine, padded = mesh_engine
+    want_best = engine.best(padded)
+    plan = FaultPlan.parse("chip:rank0:1,chip:rank1:2,chip:rank2:3")
+    sup = ChunkSupervisor(engine, policy=FAST, plan=plan)
+    assert sup.best(padded) == want_best
+    assert [e["action"] for e in sup.events] == ["reshard"] * 3
+    assert sup.engine.w == 5
+
+
+def test_without_ranks_rejects_total_loss(mesh_engine):
+    engine, _ = mesh_engine
+    with pytest.raises(DeviceError):
+        engine.without_ranks(set(range(engine.w)))
+
+
+def test_device_put_fault_seam_retried(mesh_engine):
+    """The query-upload seam (parallel.scheduler.shard_queries) consults
+    the process-wide plan; an injected transient there is retried like
+    any dispatch fault and the batch still lands bit-identical."""
+    engine, padded = mesh_engine
+    want = np.asarray(engine.f_values(padded))
+    plan = FaultPlan.parse("transient:device_put:1")
+    sup = ChunkSupervisor(engine, policy=FAST, plan=plan)
+    with injected(plan):
+        got = np.asarray(sup.f_values(padded))
+    assert np.array_equal(got, want)
+    assert [e["action"] for e in sup.events] == ["retry"]
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end: fault plans through main(), documented exit codes
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cli_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("resilience_cli")
+    n, edges = generators.gnm_edges(80, 240, seed=31)
+    queries = generators.random_queries(n, 8, max_group=4, seed=32)
+    gpath, qpath = str(d / "g.bin"), str(d / "q.bin")
+    save_graph_bin(gpath, n, edges)
+    save_query_bin(qpath, queries)
+    want = oracle_best([oracle_f(oracle_bfs(n, edges, q)) for q in queries])
+    return gpath, qpath, want
+
+
+def run_cli(argv, capsys):
+    rc = main(argv)
+    out = capsys.readouterr()
+    return rc, out.out, out.err
+
+
+def _check_report(out, want):
+    min_f, min_k = want
+    m = REPORT_TAIL_RE.search(out)
+    assert m, f"no report in {out!r}"
+    assert int(m["mink"]) == min_k + 1 and int(m["minf"]) == min_f
+
+
+def test_cli_transient_fault_retried_to_success(cli_files, capsys, monkeypatch):
+    gpath, qpath, want = cli_files
+    monkeypatch.setenv("MSBFS_FAULTS", "transient:dispatch:1")
+    monkeypatch.setenv("MSBFS_BACKOFF", "0.001")
+    rc, out, _ = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"],
+                         capsys)
+    assert rc == 0  # retried behind the scenes, batch finished
+    _check_report(out, want)
+
+
+def test_cli_oom_degrades_without_dying(cli_files, capsys, monkeypatch):
+    gpath, qpath, want = cli_files
+    monkeypatch.setenv("MSBFS_FAULTS", "oom:dispatch:1")
+    monkeypatch.setenv("MSBFS_BACKOFF", "0.001")
+    rc, out, _ = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"],
+                         capsys)
+    assert rc == 0  # stepped down the ladder, same answer
+    _check_report(out, want)
+
+
+def test_cli_chip_loss_recovers_on_survivors(cli_files, capsys, monkeypatch):
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    gpath, qpath, want = cli_files
+    monkeypatch.setenv("MSBFS_FAULTS", "chip:rank1:1")
+    monkeypatch.setenv("MSBFS_BACKOFF", "0.001")
+    rc, out, _ = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "8"],
+                         capsys)
+    assert rc == 0
+    _check_report(out, want)
+
+
+def test_cli_hung_dispatch_watchdog_exit_code(cli_files, capsys, monkeypatch):
+    gpath, qpath, _ = cli_files
+    monkeypatch.setenv("MSBFS_FAULTS", "hang:dispatch:1")
+    monkeypatch.setenv("MSBFS_FAULT_HANG", "2.0")
+    monkeypatch.setenv("MSBFS_WATCHDOG", "0.2")
+    monkeypatch.setenv("MSBFS_RETRIES", "0")
+    rc, out, err = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"],
+                           capsys)
+    assert rc == TransientError.exit_code == 5
+    assert "msbfs: TransientError" in err and "watchdog" in err
+    assert "Minimum F value" not in out  # stdout contract: no half-report
+
+
+def test_cli_io_fault_keeps_reference_exit(cli_files, capsys, monkeypatch):
+    gpath, qpath, _ = cli_files
+    monkeypatch.setenv("MSBFS_FAULTS", "io:load_graph:1")
+    rc, _, err = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"],
+                         capsys)
+    assert rc == InputError.exit_code == 1  # reference EXIT_FAILURE
+    assert "Could not open graph file" in err
+    assert "msbfs: InputError" in err
+
+
+def test_cli_malformed_fault_plan_fails_loud(cli_files, capsys, monkeypatch):
+    gpath, qpath, _ = cli_files
+    monkeypatch.setenv("MSBFS_FAULTS", "bogus")
+    rc, _, err = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"],
+                         capsys)
+    assert rc == 1
+    assert "msbfs: InputError" in err
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integration: supervised chunks land in the journal
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_journals_supervised_chunks(tmp_path):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+        BitBellEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.checkpoint import (
+        CheckpointedRunner,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        pad_queries,
+    )
+
+    n, edges = generators.gnm_edges(60, 150, seed=41)
+    graph = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 6, max_group=3, seed=42)
+    padded = np.asarray(pad_queries(queries))
+    engine = BitBellEngine(BellGraph.from_host(graph))
+    want = np.asarray(engine.f_values(padded))
+
+    path = str(tmp_path / "journal.bin")
+    plan = FaultPlan.parse("transient:dispatch:2")
+    sup = ChunkSupervisor(engine, policy=FAST, plan=plan)
+    runner = CheckpointedRunner(sup, path, chunk=2)
+    f_arr, computed = runner.run(n, graph.num_directed_edges, padded)
+    assert np.array_equal(np.asarray(f_arr), want)
+    assert computed == padded.shape[0]
+    assert any(e["action"] == "retry" for e in sup.events)
+
+    # The retried chunk is in the journal like any other: a resumed run
+    # recomputes nothing.
+    runner2 = CheckpointedRunner(engine, path, chunk=2)
+    f_arr2, computed2 = runner2.run(n, graph.num_directed_edges, padded)
+    assert computed2 == 0
+    assert np.array_equal(np.asarray(f_arr2), want)

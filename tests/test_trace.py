@@ -119,6 +119,31 @@ def test_level_stats_match_query_stats(problem):
             assert lvl_counts[d, i] == int((dist == d).sum())
 
 
+def test_level_stats_pads_queries_once(problem, monkeypatch):
+    """level_stats pads to size its slot budget and hands the PADDED array
+    to stepped_level_trace — the trace must not pad a second time
+    (idempotent but re-copies the whole (K, S) array; ADVICE r5)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        BitBellEngine,
+    )
+
+    n, edges, queries, padded = problem
+    eng = BitBellEngine(BellGraph.from_host(CSRGraph.from_edges(n, edges)))
+    calls = []
+    inner = eng._pad_queries
+
+    def counting_pad(qs):
+        calls.append(1)
+        return inner(qs)
+
+    monkeypatch.setattr(eng, "_pad_queries", counting_pad)
+    eng.level_stats(padded)
+    assert len(calls) == 1
+
+
 def test_level_stats_respects_max_levels(problem):
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
         BellGraph,

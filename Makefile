@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience
+.PHONY: native clean test resilience serve
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -19,5 +19,10 @@ clean:
 resilience: native
 	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_resilience.py -x -q
 
-test: native resilience
+# Serving-runtime smoke (docs/SERVING.md): daemon up on a unix socket,
+# 3 client queries (one result-cache hit), stats verb asserted.
+serve: native
+	JAX_PLATFORMS=cpu python -m $(PKG).serve.smoke
+
+test: native resilience serve
 	python -m pytest tests/ -x -q

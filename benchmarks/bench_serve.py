@@ -1,0 +1,191 @@
+"""Serving-runtime latency/throughput benchmark (docs/SERVING.md).
+
+Boots the daemon in-process on a unix socket with a fabricated graph,
+then measures the three costs the runtime is built to separate:
+
+* cold  — first query of a shape bucket (pays the XLA compile);
+* warm  — repeat same-bucket queries with distinct payloads
+          (executable-cache hit, full BFS execution) → p50/p95/p99;
+* cached — exact repeat payload (result-cache hit, no execution);
+
+plus closed-loop throughput from several concurrent client
+connections, exercising the micro-batcher's coalescing path.
+
+Emits one line of JSON per metric on stdout in the BENCH_*.json style
+({"metric", "value", "unit", "vs_baseline", "detail"});
+``vs_baseline`` on the warm metric is the cold/warm ratio — the
+amortisation the daemon exists to deliver.
+
+Run::
+
+    JAX_PLATFORMS=cpu python benchmarks/bench_serve.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WARM_QUERIES = int(os.environ.get("BENCH_SERVE_WARM", "60"))
+CACHED_QUERIES = int(os.environ.get("BENCH_SERVE_CACHED", "30"))
+CLIENTS = int(os.environ.get("BENCH_SERVE_CLIENTS", "4"))
+PER_CLIENT = int(os.environ.get("BENCH_SERVE_PER_CLIENT", "25"))
+N_VERTICES = int(os.environ.get("BENCH_SERVE_N", "20000"))
+N_EDGES = int(os.environ.get("BENCH_SERVE_M", "80000"))
+K, S = 8, 4  # per-request groups x ids: bucket 8x4 once coalesced
+
+
+def _percentiles(samples_ms):
+    xs = sorted(samples_ms)
+
+    def pct(p):
+        return xs[min(len(xs) - 1, int(round(p / 100.0 * len(xs) + 0.5)) - 1)]
+
+    return {"p50_ms": pct(50), "p95_ms": pct(95), "p99_ms": pct(99)}
+
+
+def main() -> int:
+    import numpy as np
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (  # noqa: E501
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (  # noqa: E501
+        MsbfsClient,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (  # noqa: E501
+        MsbfsServer,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (  # noqa: E501
+        save_graph_bin,
+    )
+
+    tmp = tempfile.TemporaryDirectory(prefix="msbfs_bench_serve_")
+    gpath = os.path.join(tmp.name, "g.bin")
+    n, edges = generators.gnm_edges(N_VERTICES, N_EDGES, seed=13)
+    save_graph_bin(gpath, n, edges)
+    addr = f"unix:{os.path.join(tmp.name, 'msbfs.sock')}"
+    server = MsbfsServer(listen=addr, graphs={"bench": gpath})
+    server.start()
+    rng = np.random.default_rng(17)
+
+    def fresh_query():
+        return [[int(v) for v in rng.integers(0, n, size=S)] for _ in range(K)]
+
+    try:
+        with MsbfsClient(addr) as client:
+            t0 = time.perf_counter()
+            first = client.query(fresh_query(), graph="bench")
+            cold_ms = (time.perf_counter() - t0) * 1e3
+            assert first["compiled"], "first query must compile its bucket"
+
+            warm_ms = []
+            for _ in range(WARM_QUERIES):
+                t0 = time.perf_counter()
+                r = client.query(fresh_query(), graph="bench")
+                warm_ms.append((time.perf_counter() - t0) * 1e3)
+                assert not r["compiled"], "warm bucket must not recompile"
+
+            repeat = fresh_query()
+            client.query(repeat, graph="bench")  # populate the result cache
+            cached_ms = []
+            for _ in range(CACHED_QUERIES):
+                t0 = time.perf_counter()
+                r = client.query(repeat, graph="bench")
+                cached_ms.append((time.perf_counter() - t0) * 1e3)
+                assert r["cached"], "repeat payload must hit the result cache"
+
+        # Closed-loop throughput: CLIENTS concurrent connections, each
+        # issuing PER_CLIENT distinct queries back-to-back.  Concurrent
+        # same-bucket arrivals coalesce inside the batching window.
+        payloads = [[fresh_query() for _ in range(PER_CLIENT)]
+                    for _ in range(CLIENTS)]
+        batched_with = []
+        errors = []
+
+        def run_client(idx):
+            try:
+                with MsbfsClient(addr) as c:
+                    for q in payloads[idx]:
+                        batched_with.append(
+                            c.query(q, graph="bench")["batched_with"]
+                        )
+            except Exception as exc:  # noqa: BLE001 — report, don't hang
+                errors.append(repr(exc))
+
+        threads = [
+            threading.Thread(target=run_client, args=(i,), daemon=True)
+            for i in range(CLIENTS)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_s = time.perf_counter() - t0
+        if errors:
+            print(f"bench_serve: client errors: {errors[:3]}", file=sys.stderr)
+            return 1
+        qps = (CLIENTS * PER_CLIENT) / wall_s
+
+        with MsbfsClient(addr) as client:
+            stats = client.stats()
+    finally:
+        server.stop()
+        tmp.cleanup()
+
+    warm = _percentiles(warm_ms)
+    cached = _percentiles(cached_ms)
+    graph_tag = f"G(n={n}, m={len(edges)}), K={K}, S={S}"
+    print(json.dumps({
+        "metric": f"serve warm-bucket query latency p50, {graph_tag}",
+        "value": round(warm["p50_ms"], 3),
+        "unit": "ms",
+        "vs_baseline": round(cold_ms / max(warm["p50_ms"], 1e-9), 4),
+        "detail": {
+            "baseline": "cold first query of the bucket (includes the XLA "
+                        "compile the warm path amortises)",
+            "cold_ms": round(cold_ms, 3),
+            **{k: round(v, 3) for k, v in warm.items()},
+            "queries": WARM_QUERIES,
+        },
+    }))
+    print(json.dumps({
+        "metric": f"serve result-cache hit latency p50, {graph_tag}",
+        "value": round(cached["p50_ms"], 3),
+        "unit": "ms",
+        "vs_baseline": round(warm["p50_ms"] / max(cached["p50_ms"], 1e-9), 4),
+        "detail": {
+            "baseline": "warm-bucket executed query (p50)",
+            **{k: round(v, 3) for k, v in cached.items()},
+            "queries": CACHED_QUERIES,
+        },
+    }))
+    print(json.dumps({
+        "metric": f"serve closed-loop throughput, {CLIENTS} clients, "
+                  f"{graph_tag}",
+        "value": round(qps, 2),
+        "unit": "queries/s",
+        "detail": {
+            "clients": CLIENTS,
+            "queries": CLIENTS * PER_CLIENT,
+            "wall_s": round(wall_s, 3),
+            "coalesced_mean": round(
+                sum(batched_with) / max(len(batched_with), 1), 3
+            ),
+            "compiles_total": stats["compiles_total"],
+            "result_cache": stats["result_cache"],
+            "queue_rejected": stats["queue"]["rejected"],
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,7 +42,16 @@ for _sub in (
     "parallel.sharded_csr",
     "runtime",
     "runtime.native_loader",
+    "runtime.supervisor",
+    "serve",
+    "serve.protocol",
+    "serve.caches",
+    "serve.registry",
+    "serve.batcher",
+    "serve.server",
+    "serve.client",
     "utils",
+    "utils.faults",
     "utils.checkpoint",
     "utils.io",
     "utils.report",

@@ -198,6 +198,20 @@ def _bitbell_ladder(graph, level_chunk):
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv if argv is None else argv)
+    # Serving-runtime subcommands (docs/SERVING.md) dispatch BEFORE the
+    # reference argv contract: ``serve`` runs the persistent daemon,
+    # ``query`` the thin client.  Neither word collides with the
+    # reference grammar (whose post-program tokens are -g/-q/-gn flag
+    # pairs, main.cu:216-224), so the batch path below stays
+    # reference-exact for every existing invocation.
+    if len(argv) > 1 and argv[1] == "serve":
+        from .serve.server import serve_main
+
+        return serve_main(argv[2:])
+    if len(argv) > 1 and argv[1] == "query":
+        from .serve.client import query_main
+
+        return query_main(argv[2:])
     if len(argv) < 5:  # argc < 5, reference main.cu:204-212
         print(
             f"Usage: python {argv[0] if argv else 'main.py'} "

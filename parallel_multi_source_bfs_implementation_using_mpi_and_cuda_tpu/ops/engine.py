@@ -119,6 +119,20 @@ class QueryEngineBase:
             getattr(self, "level_stats", None)
         ):
             self.level_stats(dummy)
+        # Warmed-shape ledger for the serving runtime (serve/caches.py):
+        # a shape in this set has its programs in XLA's jit cache, so a
+        # same-shape dispatch is executable reuse, not a recompile.
+        # Lazily created — engines' __init__s never call up here.
+        if not hasattr(self, "warmed_shapes"):
+            self.warmed_shapes = set()
+        self.warmed_shapes.add(tuple(int(d) for d in queries_shape))
+
+    def is_warmed(self, queries_shape: Tuple[int, int]) -> bool:
+        """True when :meth:`compile` already warmed this exact shape on
+        THIS engine instance (a rebuilt engine starts cold)."""
+        return tuple(int(d) for d in queries_shape) in getattr(
+            self, "warmed_shapes", ()
+        )
 
     def query_stats(self, queries):
         """Optional diagnostic: per-query (levels, reached, F) arrays.

@@ -97,6 +97,38 @@ def shard_queries(
     return sharded, k, k_pad, chunk
 
 
+def pack_padded_requests(
+    blocks: List[np.ndarray], k_exec: int, s_pad: int
+) -> Tuple[np.ndarray, List[int]]:
+    """Stack per-request (K_i, S_i) -1-padded query blocks into one
+    (k_exec, s_pad) batch; returns (batch, offsets) with ``offsets`` of
+    length len(blocks)+1 so request i owns rows [offsets[i], offsets[i+1]).
+
+    The serving micro-batcher's packing step (serve/batcher.py): requests
+    in the same shape bucket coalesce into one dispatch, and the -1 fill
+    rows past the last request are inert exactly like the reference's
+    out-of-range source ids (main.cu:46-51) and this scheduler's own
+    cyclic-grid padding rows.  Fails loud on a bucket-policy violation
+    (block wider than s_pad, or more rows than k_exec) — a silent
+    truncation would return wrong F values for the clipped queries.
+    """
+    offsets = [0]
+    for b in blocks:
+        if b.ndim != 2 or b.shape[1] > s_pad:
+            raise ValueError(
+                f"request block {b.shape} does not fit group width {s_pad}"
+            )
+        offsets.append(offsets[-1] + int(b.shape[0]))
+    if offsets[-1] > k_exec:
+        raise ValueError(
+            f"{offsets[-1]} packed rows exceed the {k_exec}-row bucket"
+        )
+    batch = np.full((k_exec, s_pad), -1, dtype=np.int32)
+    for b, lo in zip(blocks, offsets):
+        batch[lo : lo + b.shape[0], : b.shape[1]] = b
+    return batch, offsets
+
+
 def merge_local_f(f_local: jax.Array, j: int, w: int, k: int, k_pad: int, axes):
     """Merge one shard's (J,) F values into the replicated (k_pad,) result.
 

@@ -47,6 +47,7 @@ __all__ = [
     "CapacityError",
     "DeviceError",
     "TransientError",
+    "BackpressureError",
     "classify",
     "RetryPolicy",
     "call_with_watchdog",
@@ -95,6 +96,17 @@ class TransientError(MsbfsError):
     connections."""
 
     exit_code = 5
+
+
+class BackpressureError(MsbfsError):
+    """The serving daemon's admission queue is full (docs/SERVING.md):
+    the request was rejected WITHOUT being executed — safe to retry with
+    client-side backoff.  Deliberately not a TransientError: the
+    supervisor must never burn its retry budget re-submitting into a
+    full queue, and clients must be able to tell load shedding from
+    infrastructure faults."""
+
+    exit_code = 7
 
 
 _CAPACITY_MARKS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY", "ALLOCATION FAILURE")
@@ -219,6 +231,14 @@ class ChunkSupervisor(QueryEngineBase):
         self.max_rebuilds = max_rebuilds
         self.events: List[dict] = []
         self._rebuilds = 0
+
+    def drain_events(self) -> List[dict]:
+        """Hand off and clear the recovery-event log.  The batch CLI
+        reads ``events`` once at exit; a serving daemon supervises an
+        unbounded request stream, so its stats loop drains instead —
+        bounded memory, and each event is reported exactly once."""
+        events, self.events = self.events, []
+        return events
 
     def __getattr__(self, name):
         # Only called for attributes missing on the supervisor itself;

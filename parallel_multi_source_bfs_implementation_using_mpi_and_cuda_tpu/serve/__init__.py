@@ -1,0 +1,45 @@
+"""Persistent query-serving runtime (docs/SERVING.md).
+
+The batch CLI pays full process startup, graph load and XLA compilation
+on every invocation; this subpackage turns the engines, scheduler and
+supervisor into an always-on daemon that amortizes all three:
+
+* :mod:`.registry` — load-once, device-resident graphs keyed by
+  name + content hash, versioned so caches invalidate on reload;
+* :mod:`.protocol` — length-prefixed JSON frames over a unix or TCP
+  socket (the wire contract, shared by server and client);
+* :mod:`.batcher` — dynamic micro-batching into power-of-two shape
+  buckets, so concurrent requests coalesce into one dispatch and every
+  bucket hits the compiled-executable cache instead of recompiling;
+* :mod:`.caches` — the LRU result cache and the executable/compile
+  bookkeeping behind the ``stats`` verb;
+* :mod:`.server` — the daemon (``msbfs-tpu serve`` / ``python main.py
+  serve``): admission control with typed backpressure, every dispatch
+  wrapped in the PR-1 :class:`~..runtime.supervisor.ChunkSupervisor`
+  so faults degrade per-request instead of killing the process;
+* :mod:`.client` — the importable Python client and the thin CLI
+  (``msbfs-tpu query --connect ...``);
+* :mod:`.smoke` — the ``make serve`` end-to-end smoke.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "MsbfsClient",
+    "MsbfsServer",
+    "ServerError",
+]
+
+
+def __getattr__(name):
+    # Lazy re-exports: importing the package must stay cheap (the CLI
+    # imports it only to dispatch subcommands; jax loads on first use).
+    if name == "MsbfsServer":
+        from .server import MsbfsServer
+
+        return MsbfsServer
+    if name in ("MsbfsClient", "ServerError"):
+        from . import client
+
+        return getattr(client, name)
+    raise AttributeError(name)

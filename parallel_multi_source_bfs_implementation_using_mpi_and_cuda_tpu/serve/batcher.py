@@ -1,0 +1,235 @@
+"""Dynamic micro-batching into power-of-two shape buckets.
+
+Every distinct (K, S) query shape is a distinct XLA program; serving raw
+request shapes would compile per request.  Instead (docs/SERVING.md):
+
+* each request's group width S is padded to the next power of two
+  (``s_pad``) — semantics-preserving, -1 padding is dropped by the BFS
+  source init exactly like the reference's bounds check (main.cu:46-51);
+* requests for the same (graph, s_pad) that arrive within the batching
+  window coalesce into one batch; the combined row count K is padded to
+  the next power of two (``k_exec``);
+* the execution shape (k_exec, s_pad) is the *bucket* — a small,
+  log-bounded set of shapes, each compiled once and reused
+  (fixed-shape padded batching is the tensor-BFS playbook, BLEST-style;
+  PAPERS.md).
+
+Admission control: the queue is bounded (``MSBFS_SERVE_QUEUE``); a full
+queue rejects immediately with the typed
+:class:`~..runtime.supervisor.BackpressureError` rather than queueing
+unboundedly — a loaded daemon degrades by shedding, not by growing
+until the OOM killer picks a victim.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..runtime.supervisor import BackpressureError, MsbfsError
+
+DEFAULT_QUEUE_CAPACITY = 64
+DEFAULT_WINDOW_S = 0.002
+# One execution's row bound: coalescing stops before k_exec would exceed
+# this (the per-level intermediates are O(K * E); a runaway coalesce must
+# not assemble a batch the chip cannot hold).
+DEFAULT_MAX_ROWS = 1024
+
+
+def pow2_pad(x: int) -> int:
+    """Smallest power of two >= max(x, 1)."""
+    return 1 << max(0, (max(1, int(x)) - 1).bit_length())
+
+
+def bucket_label(graph_key: str, k_exec: int, s_pad: int) -> str:
+    """Stable stats key for one executable bucket."""
+    return f"{graph_key}:{k_exec}x{s_pad}"
+
+
+@dataclass
+class QueryRequest:
+    """One admitted query batch: padded rows + a completion event.
+
+    ``rows`` is the request's (K, s_pad) int32 -1-padded array; the
+    batcher may execute it inside a larger coalesced batch.  Exactly one
+    of ``result`` / ``error`` is set before ``done`` fires.
+    """
+
+    graph_key: str
+    graph_name: str
+    version: int
+    rows: np.ndarray  # (K, s_pad) int32, -1 padded
+    s_pad: int
+    submitted: float
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[dict] = None
+    error: Optional[MsbfsError] = None
+
+    @property
+    def k(self) -> int:
+        return int(self.rows.shape[0])
+
+
+class MicroBatcher:
+    """Single-consumer bounded queue with windowed same-bucket coalescing.
+
+    ``execute(requests, k_exec, s_pad)`` is the server's dispatch
+    callback; it must set result/error on every request and fire their
+    events.  The worker is one thread by design: JAX dispatch is
+    serialized per device anyway, and a single consumer makes the
+    coalescing window deterministic.
+    """
+
+    def __init__(
+        self,
+        execute: Callable[[List[QueryRequest], int, int], None],
+        capacity: Optional[int] = None,
+        window_s: Optional[float] = None,
+        max_rows: Optional[int] = None,
+    ):
+        if capacity is None:
+            capacity = _env_int("MSBFS_SERVE_QUEUE", DEFAULT_QUEUE_CAPACITY)
+        if window_s is None:
+            window_s = _env_float("MSBFS_SERVE_WINDOW", DEFAULT_WINDOW_S)
+        if max_rows is None:
+            max_rows = _env_int("MSBFS_SERVE_MAX_ROWS", DEFAULT_MAX_ROWS)
+        self.execute = execute
+        self.capacity = max(1, int(capacity))
+        self.window_s = max(0.0, float(window_s))
+        self.max_rows = max(1, int(max_rows))
+        self.rejected = 0
+        self.batches = 0
+        self.coalesced = 0
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+        self._gate = threading.Event()  # tests hold() this to fill the queue
+        self._gate.set()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="msbfs-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        with self._lock:
+            self._stop = True
+            self._ready.notify_all()
+        self._gate.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def hold(self) -> None:
+        """Pause the consumer (tests: fill the queue deterministically to
+        rehearse backpressure)."""
+        self._gate.clear()
+
+    def release(self) -> None:
+        self._gate.set()
+
+    # ---- producer side ----------------------------------------------------
+    def submit(self, request: QueryRequest) -> None:
+        """Admit or reject-now.  Rejection is the typed BackpressureError
+        (wire exit code 7) and counts in stats."""
+        with self._lock:
+            if self._stop:
+                raise MsbfsError("server is shutting down")
+            if len(self._queue) >= self.capacity:
+                self.rejected += 1
+                raise BackpressureError(
+                    f"admission queue full ({self.capacity} pending); "
+                    "retry with backoff"
+                )
+            self._queue.append(request)
+            self._ready.notify()
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    # ---- consumer side ----------------------------------------------------
+    def _pop_batch(self) -> Optional[List[QueryRequest]]:
+        """Block for a first request, wait out the window, then drain
+        every queued request in the same (graph key+version, s_pad)
+        bucket up to the row bound.  FIFO across buckets: only requests
+        *behind* a different-bucket head wait for its batch."""
+        with self._lock:
+            # The hold() gate is honored HERE, before popping: the worker
+            # parks inside this wait loop between batches, so a gate that
+            # was only checked in _run would let one held request through
+            # (tests fill the queue under hold() to rehearse
+            # backpressure; 0.1 s polling bounds the release latency).
+            while (
+                not self._queue or not self._gate.is_set()
+            ) and not self._stop:
+                self._ready.wait(0.1)
+            if self._stop and not self._queue:
+                return None
+            head = self._queue.popleft()
+        if self.window_s:
+            time.sleep(self.window_s)
+        batch = [head]
+        rows = head.k
+        with self._lock:
+            keep: deque = deque()
+            while self._queue:
+                req = self._queue.popleft()
+                same = (
+                    req.graph_key == head.graph_key
+                    and req.s_pad == head.s_pad
+                )
+                if same and rows + req.k <= self.max_rows:
+                    batch.append(req)
+                    rows += req.k
+                else:
+                    keep.append(req)
+            # Preserve arrival order of everything not taken.
+            self._queue.extendleft(reversed(keep))
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._pop_batch()
+            if batch is None:
+                return
+            k_total = sum(r.k for r in batch)
+            k_exec = pow2_pad(k_total)
+            try:
+                self.execute(batch, k_exec, batch[0].s_pad)
+            except BaseException as exc:  # noqa: BLE001 — daemon must survive
+                # The execute callback classifies and answers per-request
+                # itself; anything escaping it is a server bug — fail the
+                # batch typed rather than killing the consumer thread.
+                from ..runtime.supervisor import classify
+
+                err = classify(exc)
+                for req in batch:
+                    if not req.done.is_set():
+                        req.error = err
+                        req.done.set()
+            self.batches += 1
+            self.coalesced += len(batch) - 1
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default

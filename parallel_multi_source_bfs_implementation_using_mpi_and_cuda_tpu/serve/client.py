@@ -1,0 +1,202 @@
+"""Client side of the serving protocol: importable API + thin CLI.
+
+Importable::
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client \
+        import MsbfsClient
+    with MsbfsClient("unix:/tmp/msbfs.sock") as c:
+        out = c.query([[0, 5], [17]])          # -> response dict
+        print(out["min_f"], out["min_k"], out["cached"])
+
+CLI (``python main.py query ...`` / ``msbfs-tpu query ...``)::
+
+    python main.py query --connect unix:/tmp/msbfs.sock -q query.bin
+    python main.py query --connect unix:/tmp/msbfs.sock --stats
+
+The query verb prints the reference report's two selection lines on
+stdout (the serving analog of main.cu:403-414; there are no process
+timing spans to report — that is the point of the daemon) and serving
+metadata (bucket, cache/batch status, latency) on stderr.  Server-side
+failures raise :class:`ServerError` carrying the taxonomy class name
+and documented exit code, which the CLI uses as its own exit code —
+the same contract as the batch CLI (docs/RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional, Sequence
+
+from . import protocol
+
+
+class ServerError(Exception):
+    """A typed ``ok: false`` response (server-side taxonomy on the wire)."""
+
+    def __init__(self, type_name: str, message: str, exit_code: int):
+        super().__init__(f"{type_name}: {message}")
+        self.type_name = type_name
+        self.exit_code = int(exit_code)
+
+
+class MsbfsClient:
+    """One connection to a serving daemon; context-managed.
+
+    Thread-compatible, not thread-safe: frames on one connection are
+    strictly request/response ordered, so share a client across threads
+    only with external locking (or open one client per thread — unix
+    socket connects are microseconds).
+    """
+
+    def __init__(self, address: str, timeout: Optional[float] = 300.0):
+        self.address = address
+        self._sock = protocol.connect(address, timeout=timeout)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "MsbfsClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- request plumbing -------------------------------------------------
+    def call(self, request: dict) -> dict:
+        """Send one request object, return the ``ok: true`` response or
+        raise :class:`ServerError`."""
+        protocol.send_frame(self._sock, request)
+        response = protocol.recv_frame(self._sock)
+        if response is None:
+            raise ConnectionError(
+                f"server at {self.address} closed the connection"
+            )
+        if not response.get("ok"):
+            err = response.get("error") or {}
+            raise ServerError(
+                err.get("type", "MsbfsError"),
+                err.get("message", "unspecified server error"),
+                err.get("exit_code", 6),
+            )
+        return response
+
+    # ---- verbs ------------------------------------------------------------
+    def ping(self) -> bool:
+        return bool(self.call({"op": "ping"}).get("ok"))
+
+    def load(self, path: str, graph: str = "default") -> dict:
+        return self.call({"op": "load", "graph": graph, "path": path})
+
+    def reload(self, graph: str = "default") -> dict:
+        return self.call({"op": "reload", "graph": graph})
+
+    def query(
+        self, queries: Sequence[Sequence[int]], graph: str = "default"
+    ) -> dict:
+        qs = [[int(v) for v in group] for group in queries]
+        return self.call({"op": "query", "graph": graph, "queries": qs})
+
+    def stats(self) -> dict:
+        return self.call({"op": "stats"})["stats"]
+
+    def shutdown(self) -> dict:
+        return self.call({"op": "shutdown"})
+
+
+def _queries_from_file(path: str) -> List[List[int]]:
+    """Reference-format query.bin -> wire lists (utils/io.py loader, so
+    the thin client accepts exactly the batch CLI's -q files)."""
+    from ..utils.io import load_query_bin
+
+    return [[int(v) for v in group] for group in load_query_bin(path)]
+
+
+def query_main(argv: Optional[List[str]] = None) -> int:
+    """``msbfs-tpu query`` / ``python main.py query`` entry point."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="msbfs-tpu query",
+        description="Thin client for the serving daemon (docs/SERVING.md)",
+    )
+    ap.add_argument(
+        "--connect",
+        required=True,
+        metavar="ADDR",
+        help="daemon address: unix:<path> or <host>:<port>",
+    )
+    ap.add_argument("-q", "--query-file", default=None,
+                    help="reference-format query .bin to run")
+    ap.add_argument("--graph", default="default",
+                    help="registered graph name (default 'default')")
+    ap.add_argument("--load", default=None, metavar="PATH",
+                    help="register PATH under --graph before querying")
+    ap.add_argument("--stats", action="store_true",
+                    help="print the daemon's stats report")
+    ap.add_argument("--ping", action="store_true", help="liveness check")
+    ap.add_argument("--shutdown", action="store_true",
+                    help="ask the daemon to exit")
+    args = ap.parse_args(argv)
+    if not (args.query_file or args.stats or args.ping or args.shutdown
+            or args.load):
+        ap.error("nothing to do: give -q, --load, --stats, --ping or "
+                 "--shutdown")
+    try:
+        client = MsbfsClient(args.connect)
+    except (OSError, ValueError) as exc:
+        print(f"msbfs query: cannot reach {args.connect}: {exc}",
+              file=sys.stderr)
+        return 5  # TransientError's code: the daemon may just be starting
+    with client:
+        try:
+            if args.ping:
+                client.ping()
+                print("pong", file=sys.stderr)
+            if args.load:
+                info = client.load(args.load, graph=args.graph)["graph"]
+                print(
+                    f"loaded {info['name']} v{info['version']} "
+                    f"({info['n']} vertices, {info['directed_edges']} "
+                    f"directed edges, hash {info['hash']})",
+                    file=sys.stderr,
+                )
+            if args.query_file:
+                out = client.query(
+                    _queries_from_file(args.query_file), graph=args.graph
+                )
+                # The reference report's selection lines, 1-based winner
+                # (main.cu:409) — stdout carries results only.
+                sys.stdout.write(
+                    f"Query number (k) with minimum F value: "
+                    f"{out['min_k'] + 1}\n"
+                    f"Minimum F value: {out['min_f']}\n"
+                )
+                k_exec, s_pad = out["bucket"]
+                if out["cached"]:
+                    # compiled/latency in a cached response describe the
+                    # original computation, not this round trip.
+                    note = "result-cache hit"
+                else:
+                    note = (
+                        f"computed"
+                        f"{' (compiled)' if out.get('compiled') else ''}; "
+                        f"latency {out.get('latency_ms', 0)} ms"
+                    )
+                print(f"bucket {k_exec}x{s_pad}; {note}", file=sys.stderr)
+            if args.stats:
+                from ..utils.report import format_server_stats
+
+                sys.stdout.write(format_server_stats(client.stats()))
+            if args.shutdown:
+                client.shutdown()
+                print("daemon shutting down", file=sys.stderr)
+        except ServerError as err:
+            print(f"msbfs query: {err}", file=sys.stderr)
+            return err.exit_code
+        except (protocol.ProtocolError, ConnectionError, OSError) as exc:
+            print(f"msbfs query: {exc}", file=sys.stderr)
+            return 5
+    return 0

@@ -1,0 +1,156 @@
+"""Wire protocol: length-prefixed JSON frames (docs/SERVING.md).
+
+One frame = a 4-byte big-endian unsigned length followed by that many
+bytes of UTF-8 JSON encoding a single object.  Both directions use the
+same framing; a connection carries any number of request/response pairs
+in order (no pipelining guarantees beyond FIFO per connection).
+
+Requests are objects with an ``op`` field (``ping`` / ``load`` /
+``reload`` / ``query`` / ``stats`` / ``shutdown``); responses carry
+``ok: true`` plus op-specific fields, or ``ok: false`` with a typed
+``error`` object mirroring the supervisor taxonomy
+(``{"type", "message", "exit_code"}`` — docs/RESILIENCE.md exit-code
+table).  Query ids and F values are plain JSON numbers: F fits in
+int64 and JSON numbers are exact through 2^53, far beyond any sum of
+n hop-distances this system can hold in HBM.
+
+The length prefix is bounded (:data:`MAX_FRAME_BYTES`,
+``MSBFS_SERVE_MAX_FRAME`` overrides): a corrupt or hostile prefix must
+never turn into a multi-GiB allocation — the same fail-before-allocate
+posture as the binary graph loader (utils/io.py header checks).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+from typing import Optional
+
+_LEN = struct.Struct("!I")
+
+# 64 MiB default: a 255-group x 255-source query batch plus its response
+# is < 1 MiB of JSON, so this bounds damage, not capability.
+MAX_FRAME_BYTES = 64 << 20
+
+
+class ProtocolError(ValueError):
+    """Malformed frame (oversized prefix, truncated body, non-JSON,
+    non-object payload).  Classified as InputError at the server seam."""
+
+
+def max_frame_bytes() -> int:
+    """The active bound (env-overridable, malformed values fall back —
+    the repo-wide knob convention)."""
+    raw = os.environ.get("MSBFS_SERVE_MAX_FRAME", "")
+    if raw:
+        try:
+            v = int(raw)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    return MAX_FRAME_BYTES
+
+
+def encode_frame(obj: dict) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(body) > max_frame_bytes():
+        raise ProtocolError(
+            f"frame of {len(body)} bytes exceeds the "
+            f"{max_frame_bytes()}-byte bound"
+        )
+    return _LEN.pack(len(body)) + body
+
+
+def send_frame(sock: socket.socket, obj: dict) -> None:
+    sock.sendall(encode_frame(obj))
+
+
+def _read_exact(sock: socket.socket, count: int) -> Optional[bytes]:
+    """Read exactly ``count`` bytes, or None on clean EOF at a frame
+    boundary (mid-frame EOF is a ProtocolError: the peer vanished)."""
+    chunks = []
+    got = 0
+    while got < count:
+        chunk = sock.recv(min(count - got, 1 << 20))
+        if not chunk:
+            if got == 0:
+                return None
+            raise ProtocolError(
+                f"connection closed mid-frame ({got}/{count} bytes)"
+            )
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> Optional[dict]:
+    """One frame -> dict, or None on clean EOF (peer done)."""
+    header = _read_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > max_frame_bytes():
+        raise ProtocolError(
+            f"frame prefix claims {length} bytes, bound is "
+            f"{max_frame_bytes()}"
+        )
+    body = _read_exact(sock, length) if length else b""
+    if body is None:
+        raise ProtocolError("connection closed between prefix and body")
+    try:
+        obj = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise ProtocolError(f"frame body is not JSON: {exc}") from None
+    if not isinstance(obj, dict):
+        raise ProtocolError(
+            f"frame body must be a JSON object, got {type(obj).__name__}"
+        )
+    return obj
+
+
+def error_body(err) -> dict:
+    """Typed error -> the wire's ``error`` object (taxonomy class name,
+    message, documented exit code — docs/RESILIENCE.md)."""
+    return {
+        "ok": False,
+        "error": {
+            "type": type(err).__name__,
+            "message": str(err),
+            "exit_code": int(getattr(err, "exit_code", 6)),
+        },
+    }
+
+
+def parse_address(addr: str):
+    """``unix:<path>`` or ``<host>:<port>`` -> (family, target).
+
+    The unix form is the default deployment (single host, no TCP
+    exposure); TCP is opt-in for multi-host clients.
+    """
+    if addr.startswith("unix:"):
+        path = addr[len("unix:"):]
+        if not path:
+            raise ValueError("unix: address needs a socket path")
+        return socket.AF_UNIX, path
+    host, sep, port = addr.rpartition(":")
+    if not sep or not host:
+        raise ValueError(
+            f"address {addr!r}: want unix:<path> or <host>:<port>"
+        )
+    try:
+        return socket.AF_INET, (host, int(port))
+    except ValueError:
+        raise ValueError(f"address {addr!r}: port {port!r} is not an "
+                         "integer") from None
+
+
+def connect(addr: str, timeout: Optional[float] = None) -> socket.socket:
+    family, target = parse_address(addr)
+    sock = socket.socket(family, socket.SOCK_STREAM)
+    if timeout is not None:
+        sock.settimeout(timeout)
+    sock.connect(target)
+    return sock

@@ -1,0 +1,213 @@
+"""Graph registry: load-once, device-resident, versioned (docs/SERVING.md).
+
+The reference re-reads and re-uploads the graph on every process run
+(main.cu:235-298); a serving daemon must pay that once.  Each registered
+graph is keyed by *name + content hash*: registering the same file under
+the same name is a no-op (load-once), registering different bytes under
+an existing name is refused (an operator must say ``reload`` to mean
+replacement — silent content swaps under a live name would poison the
+result cache's mental model).  ``reload`` re-reads the file, rebuilds
+the engine and bumps the integer *version*; every cache key downstream
+includes the version, so stale results are unreachable by construction.
+
+Engines are built through the CLI's own single-chip routing policy
+(level-chunk bound, bitbell default with the capacity-degradation
+ladder) and wrapped in the PR-1 :class:`ChunkSupervisor` — a fault
+during a served request degrades or fails that request, not the daemon.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..runtime.supervisor import ChunkSupervisor, InputError, RetryPolicy
+from ..utils.io import load_graph_bin
+
+
+def content_hash(path: str) -> str:
+    """Streaming sha256 of the graph file (hex, 12 chars — enough to
+    distinguish operator mistakes; this is an identity label, not a
+    security boundary)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()[:12]
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def build_supervised_engine(graph) -> ChunkSupervisor:
+    """The serving engine route: the CLI's single-chip policy (bounded
+    level loop, bitbell default + degradation ladder, MSBFS_BACKEND=
+    "vmap"/"csr" honored for the per-query CSR pull) under the
+    supervisor with the same env knobs as the batch path
+    (docs/RESILIENCE.md).  The daemon serves one process's devices; the
+    multi-chip mesh routes stay with the batch CLI for now
+    (docs/SERVING.md scopes this)."""
+    from ..cli import _bitbell_ladder, _level_chunk_policy
+
+    level_chunk = _level_chunk_policy(graph)
+    backend = os.environ.get("MSBFS_BACKEND", "auto")
+    ladder = []
+    if backend in ("vmap", "csr"):
+        from ..ops.engine import Engine
+
+        engine = Engine(graph.to_device(), level_chunk=level_chunk)
+    else:
+        from ..models.bell import BellGraph
+        from ..ops.bitbell import BitBellEngine
+
+        engine = BitBellEngine(
+            BellGraph.from_host(graph), level_chunk=level_chunk
+        )
+        ladder = _bitbell_ladder(graph, level_chunk)
+    return ChunkSupervisor(
+        engine,
+        policy=RetryPolicy(
+            max_retries=_env_int("MSBFS_RETRIES", 2),
+            base_delay=_env_float("MSBFS_BACKOFF", 0.1),
+            seed=_env_int("MSBFS_FAULT_SEED", 0),
+        ),
+        watchdog=_env_float("MSBFS_WATCHDOG", 0.0) or None,
+        ladder=ladder,
+    )
+
+
+@dataclass
+class GraphEntry:
+    """One registered graph: host CSR + supervised device engine."""
+
+    name: str
+    path: str
+    hash: str
+    version: int
+    graph: object
+    supervisor: ChunkSupervisor
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    @property
+    def key(self) -> str:
+        """Cache-key stem: name, content hash AND version — reload (same
+        name, new bytes, bumped version) can never collide with entries
+        cached before it."""
+        return f"{self.name}@{self.hash}/v{self.version}"
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "path": self.path,
+            "hash": self.hash,
+            "version": self.version,
+            "n": int(self.graph.n),
+            "directed_edges": int(self.graph.num_directed_edges),
+        }
+
+
+class GraphRegistry:
+    """Named, versioned graph store behind the daemon's verbs."""
+
+    def __init__(self):
+        self._entries: Dict[str, GraphEntry] = {}
+        self._lock = threading.Lock()
+
+    def load(self, name: str, path: str) -> GraphEntry:
+        """Register ``path`` under ``name`` (load-once).  Same name +
+        same bytes: returns the existing device-resident entry without
+        touching the device.  Same name + different bytes: InputError
+        (use :meth:`reload`)."""
+        digest = content_hash(path)
+        with self._lock:
+            have = self._entries.get(name)
+            if have is not None:
+                if have.hash == digest:
+                    return have
+                raise InputError(
+                    f"graph {name!r} is already registered with different "
+                    f"content (have {have.hash}, file is {digest}); use "
+                    "reload to replace it"
+                )
+        graph = load_graph_bin(path)
+        entry = GraphEntry(
+            name=name,
+            path=path,
+            hash=digest,
+            version=1,
+            graph=graph,
+            supervisor=build_supervised_engine(graph),
+        )
+        with self._lock:
+            # Lost-race rule: first registration wins, identical content
+            # from the racer is a benign no-op hit.
+            have = self._entries.get(name)
+            if have is not None and have.hash == digest:
+                return have
+            if have is not None:
+                raise InputError(
+                    f"graph {name!r} was concurrently registered with "
+                    "different content"
+                )
+            self._entries[name] = entry
+        return entry
+
+    def reload(self, name: str) -> GraphEntry:
+        """Re-read the entry's path, rebuild the engine, bump version.
+        The new entry replaces the old atomically; in-flight requests
+        against the old entry finish on the old engine (its arrays stay
+        alive until the last reference drops)."""
+        with self._lock:
+            have = self._entries.get(name)
+        if have is None:
+            raise InputError(f"no graph registered as {name!r}")
+        digest = content_hash(have.path)
+        graph = load_graph_bin(have.path)
+        entry = GraphEntry(
+            name=name,
+            path=have.path,
+            hash=digest,
+            version=have.version + 1,
+            graph=graph,
+            supervisor=build_supervised_engine(graph),
+        )
+        with self._lock:
+            self._entries[name] = entry
+        return entry
+
+    def get(self, name: str) -> GraphEntry:
+        with self._lock:
+            entry = self._entries.get(name)
+            have = sorted(self._entries)
+        if entry is None:
+            raise InputError(
+                f"no graph registered as {name!r} "
+                f"(have: {', '.join(have) or 'none'})"
+            )
+        return entry
+
+    def maybe_get(self, name: str) -> Optional[GraphEntry]:
+        with self._lock:
+            return self._entries.get(name)
+
+    def describe(self) -> dict:
+        with self._lock:
+            entries = list(self._entries.values())
+        return {e.name: e.describe() for e in entries}

@@ -1,0 +1,547 @@
+"""The serving daemon: socket front end, supervised dispatch, stats.
+
+``python main.py serve --listen unix:/tmp/msbfs.sock -g graph.bin``
+holds registered graphs device-resident (serve/registry.py), coalesces
+concurrent queries into power-of-two shape buckets (serve/batcher.py),
+fronts execution with an LRU result cache and an executable/compile
+ledger (serve/caches.py), and answers over length-prefixed JSON frames
+(serve/protocol.py).  Every dispatch runs under the PR-1
+:class:`ChunkSupervisor`: retries, the capacity ladder and the watchdog
+all apply per-request, and an exhausted recovery budget fails THAT
+request typed (docs/RESILIENCE.md exit codes on the wire) while the
+daemon keeps serving.  docs/SERVING.md is the operator manual.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime.supervisor import (
+    BackpressureError,
+    InputError,
+    MsbfsError,
+    TransientError,
+    classify,
+)
+from ..utils import faults
+from . import protocol
+from .batcher import MicroBatcher, QueryRequest, bucket_label, pow2_pad
+from .caches import ExecutableCache, LRUCache
+from .registry import GraphEntry, GraphRegistry
+
+DEFAULT_RESULT_CACHE = 1024
+# A request parked behind a full pipeline must eventually fail typed
+# rather than hold its connection forever.
+DEFAULT_REQUEST_TIMEOUT_S = 300.0
+
+# Query-shape sanity bounds, the reference's own format limits: K and
+# group size are uint8 on disk (main.cu:143-152).  The wire accepts more
+# (a service is not bound to the file format) but still bounds both so a
+# hostile frame cannot demand a terabyte batch.
+MAX_WIRE_QUERIES = 4096
+MAX_WIRE_GROUP = 4096
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class _BucketStats:
+    """Per-bucket latency/throughput ledger (bounded reservoir)."""
+
+    __slots__ = ("requests", "batches", "rows", "cache_hits", "samples_ms")
+
+    MAX_SAMPLES = 1024
+
+    def __init__(self):
+        self.requests = 0
+        self.batches = 0
+        self.rows = 0
+        self.cache_hits = 0
+        self.samples_ms: List[float] = []
+
+    def record(self, latency_ms: float) -> None:
+        self.requests += 1
+        if len(self.samples_ms) >= self.MAX_SAMPLES:
+            # Keep the freshest window: percentile reports should track
+            # current behavior, not the cold-start tail forever.
+            self.samples_ms.pop(0)
+        self.samples_ms.append(latency_ms)
+
+    def snapshot(self) -> dict:
+        s = sorted(self.samples_ms)
+        return {
+            "requests": self.requests,
+            "batches": self.batches,
+            "rows": self.rows,
+            "p50_ms": round(_percentile(s, 0.50), 3),
+            "p95_ms": round(_percentile(s, 0.95), 3),
+            "p99_ms": round(_percentile(s, 0.99), 3),
+        }
+
+
+class MsbfsServer:
+    """One process-wide serving runtime; embeddable (tests run it
+    in-process on a unix socket) or daemonized via :func:`serve_main`."""
+
+    def __init__(
+        self,
+        listen: str,
+        graphs: Optional[Dict[str, str]] = None,
+        queue_capacity: Optional[int] = None,
+        window_s: Optional[float] = None,
+        result_cache_size: Optional[int] = None,
+        request_timeout_s: Optional[float] = None,
+    ):
+        self.listen = listen
+        self.registry = GraphRegistry()
+        self.result_cache = LRUCache(
+            result_cache_size
+            if result_cache_size is not None
+            else _env_int("MSBFS_SERVE_RESULT_CACHE", DEFAULT_RESULT_CACHE)
+        )
+        self.executables = ExecutableCache()
+        self.batcher = MicroBatcher(
+            self._execute_batch, capacity=queue_capacity, window_s=window_s
+        )
+        self.request_timeout_s = (
+            request_timeout_s
+            if request_timeout_s is not None
+            else _env_float("MSBFS_SERVE_TIMEOUT", DEFAULT_REQUEST_TIMEOUT_S)
+        )
+        self.started = time.time()
+        self._stats_lock = threading.Lock()
+        self._buckets: Dict[str, _BucketStats] = {}
+        self._recovery_events: List[dict] = []
+        self._failed_requests = 0
+        self._requests_total = 0
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+        for name, path in (graphs or {}).items():
+            self.registry.load(name, path)
+
+    # ---- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        """Bind, arm the fault plan, start batcher + acceptor.  Returns
+        once the socket accepts connections (callers/tests need no
+        poll-until-up loop)."""
+        # Same bring-up order as the batch CLI (cli.py): the fault plan
+        # first so every later seam sees it, then the persistent XLA
+        # cache so warm compiles can land on disk and survive restarts.
+        plan = faults.FaultPlan.from_env()
+        faults.activate(plan)
+        from ..utils.xla_cache import configure_compilation_cache
+
+        configure_compilation_cache()
+        family, target = protocol.parse_address(self.listen)
+        if family == socket.AF_UNIX and isinstance(target, str):
+            try:
+                os.unlink(target)
+            except FileNotFoundError:
+                pass
+        self._sock = socket.socket(family, socket.SOCK_STREAM)
+        if family == socket.AF_INET:
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(target)
+        self._sock.listen(64)
+        self.batcher.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="msbfs-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        self.batcher.stop()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        family, target = protocol.parse_address(self.listen)
+        if family == socket.AF_UNIX and isinstance(target, str):
+            try:
+                os.unlink(target)
+            except FileNotFoundError:
+                pass
+
+    def wait(self) -> None:
+        """Block until stop() (the daemon's main-thread parking spot)."""
+        self._stopping.wait()
+
+    # ---- socket front end -------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(
+                target=self._serve_connection,
+                args=(conn,),
+                name="msbfs-conn",
+                daemon=True,
+            ).start()
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stopping.is_set():
+                try:
+                    request = protocol.recv_frame(conn)
+                except protocol.ProtocolError as exc:
+                    # Answer if the socket still writes, then drop the
+                    # connection: framing is unrecoverable mid-stream.
+                    try:
+                        protocol.send_frame(
+                            conn, protocol.error_body(InputError(str(exc)))
+                        )
+                    except OSError:
+                        pass
+                    return
+                except OSError:
+                    return
+                if request is None:
+                    return
+                response = self.handle(request)
+                try:
+                    protocol.send_frame(conn, response)
+                except OSError:
+                    return
+                if request.get("op") == "shutdown":
+                    self.stop()
+                    return
+
+    # ---- verbs ------------------------------------------------------------
+    def handle(self, request: dict) -> dict:
+        """One request object -> one response object (transport-free:
+        the tests may call this directly; the wire path goes through
+        :meth:`_serve_connection`)."""
+        op = request.get("op")
+        try:
+            if op == "ping":
+                return {"ok": True, "op": "ping"}
+            if op == "load":
+                return self._op_load(request)
+            if op == "reload":
+                return self._op_reload(request)
+            if op == "query":
+                return self._op_query(request)
+            if op == "stats":
+                return {"ok": True, "op": "stats", "stats": self.stats()}
+            if op == "shutdown":
+                return {"ok": True, "op": "shutdown"}
+            raise InputError(f"unknown op {op!r}")
+        except MsbfsError as err:
+            return protocol.error_body(err)
+        except Exception as exc:  # noqa: BLE001 — daemon must answer typed
+            return protocol.error_body(classify(exc))
+
+    def _op_load(self, request: dict) -> dict:
+        path = request.get("path")
+        if not isinstance(path, str) or not path:
+            raise InputError("load needs a 'path' string")
+        name = request.get("graph", "default")
+        entry = self.registry.load(name, path)
+        return {"ok": True, "op": "load", "graph": entry.describe()}
+
+    def _op_reload(self, request: dict) -> dict:
+        name = request.get("graph", "default")
+        old = self.registry.get(name)
+        entry = self.registry.reload(name)
+        # Version bump already unreaches old entries; drop them eagerly
+        # so a reloaded daemon's cache is not half full of dead weight.
+        dropped = self.result_cache.drop_where(
+            lambda k: isinstance(k, tuple) and k[0] == old.key
+        )
+        self.executables.drop_where(
+            lambda k: isinstance(k, tuple) and k[0] == old.key
+        )
+        return {
+            "ok": True,
+            "op": "reload",
+            "graph": entry.describe(),
+            "invalidated_results": dropped,
+        }
+
+    def _parse_queries(self, request: dict) -> np.ndarray:
+        """Wire queries (list of lists of ints) -> (K, s_pad) int32 array
+        padded to the power-of-two group-width bucket."""
+        raw = request.get("queries")
+        if not isinstance(raw, list) or not raw:
+            raise InputError("query needs 'queries': a non-empty list of "
+                             "vertex-id lists")
+        if len(raw) > MAX_WIRE_QUERIES:
+            raise InputError(
+                f"{len(raw)} query groups exceed the {MAX_WIRE_QUERIES} "
+                "per-request bound"
+            )
+        widest = 0
+        for i, group in enumerate(raw):
+            if not isinstance(group, list) or not group:
+                raise InputError(f"query group {i} must be a non-empty list")
+            if len(group) > MAX_WIRE_GROUP:
+                raise InputError(
+                    f"query group {i} has {len(group)} sources, bound is "
+                    f"{MAX_WIRE_GROUP}"
+                )
+            widest = max(widest, len(group))
+        s_pad = pow2_pad(widest)
+        rows = np.full((len(raw), s_pad), -1, dtype=np.int32)
+        for i, group in enumerate(raw):
+            try:
+                rows[i, : len(group)] = np.asarray(group, dtype=np.int32)
+            except (ValueError, OverflowError):
+                raise InputError(
+                    f"query group {i} has a non-int32 vertex id"
+                ) from None
+        return rows
+
+    def _op_query(self, request: dict) -> dict:
+        name = request.get("graph", "default")
+        entry = self.registry.get(name)
+        rows = self._parse_queries(request)
+        s_pad = int(rows.shape[1])
+        with self._stats_lock:
+            self._requests_total += 1
+        cache_key = (entry.key, rows.shape, rows.tobytes())
+        cached = self.result_cache.get(cache_key)
+        if cached is not None:
+            out = dict(cached)
+            out["cached"] = True
+            return out
+        req = QueryRequest(
+            graph_key=entry.key,
+            graph_name=name,
+            version=entry.version,
+            rows=rows,
+            s_pad=s_pad,
+            submitted=time.time(),
+        )
+        self.batcher.submit(req)  # raises BackpressureError when full
+        if not req.done.wait(self.request_timeout_s):
+            with self._stats_lock:
+                self._failed_requests += 1
+            raise TransientError(
+                f"request timed out after {self.request_timeout_s:g}s in "
+                "the serving pipeline"
+            )
+        if req.error is not None:
+            with self._stats_lock:
+                self._failed_requests += 1
+            raise req.error
+        response = req.result
+        self.result_cache.put(cache_key, response)
+        out = dict(response)
+        out["cached"] = False
+        return out
+
+    # ---- execution (batcher consumer thread) ------------------------------
+    def _execute_batch(
+        self, requests: List[QueryRequest], k_exec: int, s_pad: int
+    ) -> None:
+        """Run one coalesced bucket: warm-once, dispatch supervised,
+        scatter per-request results; a typed failure answers every
+        request in the batch and the daemon moves on."""
+        from ..parallel.scheduler import pack_padded_requests
+
+        entry = self.registry.maybe_get(requests[0].graph_name)
+        label = bucket_label(requests[0].graph_key, k_exec, s_pad)
+        try:
+            if entry is None or entry.key != requests[0].graph_key:
+                # Graph was reloaded after admission: the old engine may
+                # already be released — fail typed, client retries
+                # against the new version.
+                raise TransientError(
+                    f"graph {requests[0].graph_name!r} was reloaded while "
+                    "the request was queued; retry"
+                )
+            batch, offsets = pack_padded_requests(
+                [r.rows for r in requests], k_exec, s_pad
+            )
+            supervisor = entry.supervisor
+            exec_key = (requests[0].graph_key, k_exec, s_pad)
+            compiled = self.executables.warm(
+                exec_key,
+                label,
+                lambda: supervisor.compile((k_exec, s_pad)),
+            )
+            f = np.asarray(supervisor.f_values(batch)).astype(np.int64)
+        except Exception as exc:  # noqa: BLE001 — typed per-request failure
+            err = classify(exc)
+            self._note_recovery(entry)
+            # _op_query counts the failure when it re-raises req.error —
+            # counting here too would double-book every failed request.
+            for req in requests:
+                req.error = err
+                req.done.set()
+            return
+        self._note_recovery(entry)
+        now = time.time()
+        with self._stats_lock:
+            stats = self._buckets.setdefault(label, _BucketStats())
+            stats.batches += 1
+            stats.rows += k_exec
+        for req, lo in zip(requests, offsets):
+            f_req = f[lo : lo + req.k]
+            # Reference selection semantics (ops/objective.select_best):
+            # valid entries are F >= 0, ties break to the lowest index,
+            # none valid -> (-1, -1).
+            valid = f_req >= 0
+            if valid.any():
+                min_k = int(np.argmin(np.where(valid, f_req, np.iinfo(np.int64).max)))
+                min_f = int(f_req[min_k])
+            else:
+                min_f, min_k = -1, -1
+            latency_ms = (now - req.submitted) * 1000.0
+            with self._stats_lock:
+                stats.record(latency_ms)
+            req.result = {
+                "ok": True,
+                "op": "query",
+                "graph": req.graph_name,
+                "version": req.version,
+                "f_values": [int(x) for x in f_req],
+                "min_f": min_f,
+                "min_k": min_k,
+                "bucket": [k_exec, s_pad],
+                "compiled": bool(compiled),
+                "batched_with": len(requests) - 1,
+                "latency_ms": round(latency_ms, 3),
+            }
+            req.done.set()
+
+    def _note_recovery(self, entry: Optional[GraphEntry]) -> None:
+        """Drain the supervisor's recovery log into server stats
+        (bounded — each event reported once, docs/RESILIENCE.md)."""
+        if entry is None:
+            return
+        events = entry.supervisor.drain_events()
+        if events:
+            with self._stats_lock:
+                self._recovery_events.extend(events)
+                del self._recovery_events[:-_BucketStats.MAX_SAMPLES]
+
+    # ---- stats ------------------------------------------------------------
+    def stats(self) -> dict:
+        with self._stats_lock:
+            buckets = {k: v.snapshot() for k, v in self._buckets.items()}
+            recovery = list(self._recovery_events)
+            failed = self._failed_requests
+            total = self._requests_total
+        return {
+            "uptime_s": round(time.time() - self.started, 3),
+            "graphs": self.registry.describe(),
+            "queue": {
+                "depth": self.batcher.depth(),
+                "capacity": self.batcher.capacity,
+                "rejected": self.batcher.rejected,
+                "batches": self.batcher.batches,
+                "coalesced": self.batcher.coalesced,
+            },
+            "result_cache": self.result_cache.snapshot(),
+            "compiles": self.executables.compiles(),
+            "compiles_total": self.executables.total_compiles(),
+            "buckets": buckets,
+            "requests_total": total,
+            "requests_failed": failed,
+            "recovery_events": recovery,
+        }
+
+
+def serve_main(argv: Optional[List[str]] = None) -> int:
+    """``msbfs-tpu serve`` / ``python main.py serve`` entry point."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="msbfs-tpu serve",
+        description="Persistent multi-source-BFS query daemon "
+        "(docs/SERVING.md)",
+    )
+    ap.add_argument(
+        "--listen",
+        default=os.environ.get("MSBFS_SERVE_LISTEN", "unix:/tmp/msbfs.sock"),
+        help="unix:<path> or <host>:<port> (default unix:/tmp/msbfs.sock)",
+    )
+    ap.add_argument(
+        "-g",
+        "--graph",
+        action="append",
+        default=[],
+        metavar="[NAME=]PATH",
+        help="register a graph at startup (repeatable; bare PATH registers "
+        "as 'default')",
+    )
+    ap.add_argument(
+        "--queue", type=int, default=None,
+        help="admission queue capacity (default MSBFS_SERVE_QUEUE or 64)",
+    )
+    ap.add_argument(
+        "--window-ms", type=float, default=None,
+        help="micro-batch coalescing window in ms (default "
+        "MSBFS_SERVE_WINDOW*1000 or 2)",
+    )
+    ap.add_argument(
+        "--result-cache", type=int, default=None,
+        help="LRU result-cache capacity, 0 disables (default "
+        "MSBFS_SERVE_RESULT_CACHE or 1024)",
+    )
+    args = ap.parse_args(argv)
+    graphs: Dict[str, str] = {}
+    for spec in args.graph:
+        name, sep, path = spec.partition("=")
+        if not sep:
+            name, path = "default", spec
+        graphs[name] = path
+    try:
+        server = MsbfsServer(
+            listen=args.listen,
+            graphs=graphs,
+            queue_capacity=args.queue,
+            window_s=None if args.window_ms is None else args.window_ms / 1000.0,
+            result_cache_size=args.result_cache,
+        )
+        server.start()
+    except MsbfsError as err:
+        from ..utils.report import format_failure
+
+        print(format_failure(err), file=sys.stderr)
+        return err.exit_code
+    except ValueError as exc:
+        print(f"msbfs serve: {exc}", file=sys.stderr)
+        return 1
+    names = ", ".join(sorted(graphs)) or "none (use the load verb)"
+    print(
+        f"msbfs serve: listening on {args.listen}; graphs: {names}",
+        file=sys.stderr,
+    )
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0

@@ -18,6 +18,21 @@ from __future__ import annotations
 import hashlib
 import os
 import platform
+import sys
+
+_noticed = False
+
+
+def _notice(reason: str) -> None:
+    """One line, once per process, on stderr: an operator debugging cold
+    compiles on every daemon restart needs to SEE that the persistent
+    cache is off and why (docs/SERVING.md ops runbook); repeating it per
+    configure call would spam in-process test suites."""
+    global _noticed
+    if _noticed:
+        return
+    _noticed = True
+    print(f"persistent XLA cache disabled: {reason}", file=sys.stderr)
 
 
 def _host_fingerprint() -> str:
@@ -43,6 +58,10 @@ def configure_compilation_cache() -> None:
     # seconds), not CPU-sized.  The accelerator path keeps the cache —
     # that is where the reference's nvcc-precompiled analogy matters.
     if jax.default_backend() == "cpu":
+        _notice(
+            "cpu backend (AOT executable (de)serialization is unsafe "
+            "here; compiles are per-process)"
+        )
         return
 
     cache_dir = os.environ.get(
@@ -55,11 +74,13 @@ def configure_compilation_cache() -> None:
         ),
     )
     if not cache_dir:
+        _notice("MSBFS_CACHE_DIR is set empty")
         return
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except (OSError, AttributeError):
-        pass  # unwritable cache dir or older jax: compile every run
+    except (OSError, AttributeError) as exc:
+        # Unwritable cache dir or older jax: compile every run.
+        _notice(f"{cache_dir} unusable ({exc})")

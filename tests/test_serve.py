@@ -1,0 +1,489 @@
+"""Serving-runtime tests (docs/SERVING.md): protocol framing, shape
+bucketing, the graph registry, micro-batch coalescing, the
+compiled-executable and result caches (hit/invalidate on reload),
+backpressure rejection, and fault-injected requests failing typed while
+the daemon keeps serving — all against an in-process server on a real
+unix socket.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.scheduler import (
+    pack_padded_requests,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (
+    BackpressureError,
+    MsbfsError,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve import (
+    protocol,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.batcher import (
+    pow2_pad,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.caches import (
+    ExecutableCache,
+    LRUCache,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (
+    MsbfsClient,
+    ServerError,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.registry import (
+    GraphRegistry,
+    content_hash,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (
+    MsbfsServer,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils import (
+    faults,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+    save_graph_bin,
+)
+
+from oracle import oracle_bfs, oracle_f
+
+
+# ---------------------------------------------------------------------------
+# Pure units: framing, bucketing, packing, caches
+# ---------------------------------------------------------------------------
+
+
+def test_frame_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    with a, b:
+        protocol.send_frame(a, {"op": "ping", "n": 3})
+        assert protocol.recv_frame(b) == {"op": "ping", "n": 3}
+        a.close()
+        assert protocol.recv_frame(b) is None  # clean EOF
+
+
+def test_frame_rejects_oversized_prefix():
+    a, b = socket.socketpair()
+    with a, b:
+        a.sendall(struct.pack("!I", protocol.max_frame_bytes() + 1))
+        with pytest.raises(protocol.ProtocolError, match="bound"):
+            protocol.recv_frame(b)
+
+
+def test_frame_rejects_non_object_and_mid_frame_eof():
+    a, b = socket.socketpair()
+    with a, b:
+        body = b"[1,2,3]"
+        a.sendall(struct.pack("!I", len(body)) + body)
+        with pytest.raises(protocol.ProtocolError, match="object"):
+            protocol.recv_frame(b)
+    a, b = socket.socketpair()
+    with b:
+        with a:
+            a.sendall(struct.pack("!I", 10) + b"tru")
+        with pytest.raises(protocol.ProtocolError, match="mid-frame"):
+            protocol.recv_frame(b)
+
+
+def test_parse_address_forms():
+    assert protocol.parse_address("unix:/tmp/x.sock") == (
+        socket.AF_UNIX,
+        "/tmp/x.sock",
+    )
+    assert protocol.parse_address("127.0.0.1:9999") == (
+        socket.AF_INET,
+        ("127.0.0.1", 9999),
+    )
+    for bad in ("unix:", "nohost", "host:notaport"):
+        with pytest.raises(ValueError):
+            protocol.parse_address(bad)
+
+
+def test_pow2_bucketing_policy():
+    assert [pow2_pad(x) for x in (0, 1, 2, 3, 4, 5, 63, 64, 65)] == [
+        1, 1, 2, 4, 4, 8, 64, 64, 128,
+    ]
+
+
+def test_pack_padded_requests_layout_and_bounds():
+    b1 = np.array([[1, 2], [3, -1]], dtype=np.int32)
+    b2 = np.array([[7]], dtype=np.int32)
+    batch, offsets = pack_padded_requests([b1, b2], k_exec=4, s_pad=4)
+    assert batch.shape == (4, 4) and offsets == [0, 2, 3]
+    assert batch[0].tolist() == [1, 2, -1, -1]
+    assert batch[2].tolist() == [7, -1, -1, -1]
+    assert (batch[3] == -1).all()
+    with pytest.raises(ValueError, match="exceed"):
+        pack_padded_requests([b1, b1, b1], k_exec=4, s_pad=4)
+    wide = np.zeros((1, 8), dtype=np.int32)
+    with pytest.raises(ValueError, match="width"):
+        pack_padded_requests([wide], k_exec=8, s_pad=4)
+
+
+def test_lru_cache_evicts_counts_and_disables():
+    c = LRUCache(2)
+    c.put("a", 1), c.put("b", 2)
+    assert c.get("a") == 1  # refreshes a
+    c.put("c", 3)  # evicts b (LRU)
+    assert c.get("b") is None and c.get("c") == 3
+    snap = c.snapshot()
+    assert snap["evictions"] == 1 and snap["hits"] == 2 and snap["misses"] == 1
+    off = LRUCache(0)
+    off.put("a", 1)
+    assert off.get("a") is None and len(off) == 0
+
+
+def test_executable_cache_warms_once():
+    ex = ExecutableCache()
+    calls = []
+    assert ex.warm(("g", 1, 4, 4), "g:4x4", lambda: calls.append(1)) is True
+    assert ex.warm(("g", 1, 4, 4), "g:4x4", lambda: calls.append(1)) is False
+    assert ex.warm(("g", 1, 8, 4), "g:8x4", lambda: calls.append(1)) is True
+    assert calls == [1, 1]
+    assert ex.compiles() == {"g:4x4": 1, "g:8x4": 1}
+    assert ex.total_compiles() == 2
+
+
+# ---------------------------------------------------------------------------
+# Registry: load-once, content hashing, reload versioning
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def graph_files(tmp_path_factory):
+    d = tmp_path_factory.mktemp("serve_graphs")
+    n, edges = generators.gnm_edges(120, 360, seed=5)
+    n2, edges2 = generators.gnm_edges(120, 360, seed=6)
+    p1, p2 = str(d / "g1.bin"), str(d / "g2.bin")
+    save_graph_bin(p1, n, edges)
+    save_graph_bin(p2, n2, edges2)
+    return (n, edges, p1), (n2, edges2, p2)
+
+
+def test_registry_load_once_and_conflict(graph_files):
+    (n, _, p1), (_, _, p2) = graph_files
+    reg = GraphRegistry()
+    e1 = reg.load("g", p1)
+    assert e1.version == 1 and e1.graph.n == n
+    assert reg.load("g", p1) is e1  # same bytes: no reload, same entry
+    with pytest.raises(MsbfsError, match="different content"):
+        reg.load("g", p2)
+    assert "no graph registered" in str(
+        pytest.raises(MsbfsError, reg.get, "missing").value
+    )
+
+
+def test_registry_reload_bumps_version_and_key(graph_files, tmp_path):
+    (n, edges, p1), (n2, edges2, _) = graph_files
+    path = str(tmp_path / "mut.bin")
+    save_graph_bin(path, n, edges)
+    reg = GraphRegistry()
+    e1 = reg.load("g", path)
+    save_graph_bin(path, n2, edges2)  # operator swaps the file in place
+    e2 = reg.reload("g")
+    assert e2.version == 2 and e2.hash != e1.hash and e2.key != e1.key
+    assert e2.hash == content_hash(path)
+
+
+# ---------------------------------------------------------------------------
+# In-process server over a real unix socket
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def server(graph_files, tmp_path, monkeypatch):
+    """Daemon on a unix socket with fast knobs: zero-length coalescing
+    window (tests drive coalescing via hold()), tiny retry budget so
+    fault rehearsals are quick, result cache on."""
+    (_, _, p1), _ = graph_files
+    monkeypatch.setenv("MSBFS_RETRIES", "0")
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    sock = str(tmp_path / "msbfs.sock")
+    srv = MsbfsServer(
+        listen=f"unix:{sock}",
+        graphs={"default": p1},
+        queue_capacity=2,
+        window_s=0.0,
+        request_timeout_s=30.0,
+    )
+    srv.start()
+    yield srv, f"unix:{sock}"
+    faults.activate(None)
+    srv.stop()
+
+
+def _mk_queries(rng, n, k, s):
+    return [[int(v) for v in rng.integers(0, n, size=s)] for _ in range(k)]
+
+
+def test_warm_bucket_zero_new_compiles_and_cache_hit(server, graph_files):
+    """The acceptance rehearsal: a warm daemon answers a repeat
+    same-bucket query with zero new compiles AND a result-cache hit; a
+    cold different-bucket query compiles exactly once — all verified by
+    the stats verb."""
+    srv, addr = server
+    (n, edges, _), _ = graph_files
+    rng = np.random.default_rng(3)
+    qa = _mk_queries(rng, n, 3, 2)  # bucket (4, 2)
+    qb = _mk_queries(rng, n, 3, 2)  # same bucket, different ids
+    qc = _mk_queries(rng, n, 5, 2)  # bucket (8, 2): cold
+    with MsbfsClient(addr) as client:
+        ra = client.query(qa)
+        assert ra["compiled"] and not ra["cached"]
+        assert ra["bucket"] == [4, 2]
+        rb = client.query(qb)
+        assert rb["bucket"] == ra["bucket"]
+        assert not rb["compiled"] and not rb["cached"]
+        ra2 = client.query(qa)  # repeat: result-cache hit, no dispatch
+        assert ra2["cached"] and ra2["min_f"] == ra["min_f"]
+        stats1 = client.stats()
+        assert stats1["compiles_total"] == 1
+        assert stats1["result_cache"]["hits"] == 1
+        rc = client.query(qc)
+        assert rc["compiled"] and rc["bucket"] == [8, 2]
+        rc2 = client.query(qc)
+        assert rc2["cached"]
+        stats2 = client.stats()
+    # Exactly one compile per bucket, flat across repeats.
+    assert stats2["compiles_total"] == 2
+    assert sorted(stats2["compiles"].values()) == [1, 1]
+    assert stats2["requests_failed"] == 0
+    # Results agree with the oracle (the serving path must not change
+    # semantics: same F and selection as the batch engines).
+    want = [oracle_f(oracle_bfs(n, edges, q)) for q in qa]
+    assert ra["f_values"] == want
+    assert ra["min_f"] == min(want)
+    assert ra["min_k"] == want.index(min(want))
+
+
+def test_result_cache_invalidated_on_reload(server, graph_files, tmp_path):
+    srv, addr = server
+    (n, edges, _), (n2, edges2, _) = graph_files
+    path = str(tmp_path / "mut.bin")
+    save_graph_bin(path, n, edges)
+    rng = np.random.default_rng(4)
+    q = _mk_queries(rng, min(n, n2), 2, 2)
+    with MsbfsClient(addr) as client:
+        client.load(path, graph="mut")
+        r1 = client.query(q, graph="mut")
+        assert client.query(q, graph="mut")["cached"]
+        save_graph_bin(path, n2, edges2)
+        info = client.reload(graph="mut")
+        assert info["graph"]["version"] == 2
+        assert info["invalidated_results"] >= 1
+        r2 = client.query(q, graph="mut")
+        # Fresh compute against the new content, not a stale hit.
+        assert not r2["cached"] and r2["version"] == 2
+        want = [oracle_f(oracle_bfs(n2, edges2, g)) for g in q]
+        assert r2["f_values"] == want
+    assert r1["version"] == 1
+
+
+def test_backpressure_rejects_typed_and_recovers(server, graph_files):
+    """Queue capacity 2: hold the batcher, fill the queue, and the next
+    request is rejected NOW with the typed BackpressureError (exit 7)
+    without being executed; after release the held requests complete and
+    new requests are served again."""
+    srv, addr = server
+    (n, _, _), _ = graph_files
+    rng = np.random.default_rng(5)
+    srv.batcher.hold()
+    held_results = []
+
+    def held_query(k):
+        with MsbfsClient(addr) as c:
+            held_results.append(c.query(_mk_queries(rng, n, k, 2)))
+
+    threads = [
+        threading.Thread(target=held_query, args=(k,)) for k in (2, 3)
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 10
+    while srv.batcher.depth() < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert srv.batcher.depth() == 2
+    with MsbfsClient(addr) as c:
+        with pytest.raises(ServerError, match="queue full") as exc:
+            c.query(_mk_queries(rng, n, 2, 2))
+        assert exc.value.type_name == "BackpressureError"
+        assert exc.value.exit_code == BackpressureError.exit_code == 7
+    srv.batcher.release()
+    for t in threads:
+        t.join(30)
+    assert len(held_results) == 2 and all(r["ok"] for r in held_results)
+    with MsbfsClient(addr) as c:
+        assert c.query(_mk_queries(rng, n, 2, 2))["ok"]
+        stats = c.stats()
+    assert stats["queue"]["rejected"] == 1
+    assert stats["queue"]["depth"] == 0
+
+
+def test_coalesced_batch_single_dispatch(server, graph_files):
+    """Two same-bucket requests queued together execute as ONE batch
+    (stats: coalesced >= 1) and both get correct per-request slices."""
+    srv, addr = server
+    (n, edges, _), _ = graph_files
+    rng = np.random.default_rng(6)
+    q1, q2 = _mk_queries(rng, n, 2, 2), _mk_queries(rng, n, 2, 2)
+    srv.batcher.hold()
+    results = {}
+
+    def go(tag, q):
+        with MsbfsClient(addr) as c:
+            results[tag] = c.query(q)
+
+    threads = [
+        threading.Thread(target=go, args=("a", q1)),
+        threading.Thread(target=go, args=("b", q2)),
+    ]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 10
+    while srv.batcher.depth() < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    srv.batcher.release()
+    for t in threads:
+        t.join(30)
+    assert results["a"]["batched_with"] == 1
+    assert results["b"]["batched_with"] == 1
+    # 2+2 rows -> one (4, 2) execution for both requests.
+    assert results["a"]["bucket"] == results["b"]["bucket"] == [4, 2]
+    for q, r in ((q1, results["a"]), (q2, results["b"])):
+        assert r["f_values"] == [oracle_f(oracle_bfs(n, edges, g)) for g in q]
+
+
+def test_fault_injected_request_fails_typed_daemon_survives(
+    server, graph_files
+):
+    """MSBFS_FAULTS rehearsal (satellite): with the retry budget at 0, a
+    transient dispatch fault fails exactly one request with the typed
+    TransientError (exit 5) on the wire; the daemon answers the next
+    request normally."""
+    srv, addr = server
+    (n, _, _), _ = graph_files
+    rng = np.random.default_rng(8)
+    with MsbfsClient(addr) as c:
+        assert c.query(_mk_queries(rng, n, 2, 2))["ok"]  # warm, fault-free
+        plan = faults.FaultPlan.parse("transient:dispatch:1")
+        faults.activate(plan)
+        with pytest.raises(ServerError) as exc:
+            c.query(_mk_queries(rng, n, 2, 2))
+        assert exc.value.type_name == "TransientError"
+        assert exc.value.exit_code == 5
+        faults.activate(None)
+        after = c.query(_mk_queries(rng, n, 2, 2))
+        assert after["ok"] and not after["compiled"]
+        stats = c.stats()
+    assert stats["requests_failed"] == 1
+    assert stats["graphs"]["default"]["version"] == 1  # same engine, alive
+
+
+def test_fault_plan_from_env_fires_on_nth_dispatch(
+    graph_files, tmp_path, monkeypatch
+):
+    """The daemon arms MSBFS_FAULTS at start() exactly like the batch
+    CLI: dispatches count across warm compile (1) and first query (2),
+    so a plan at trip 3 fails the second query, typed, and the third
+    succeeds."""
+    (_, _, p1), _ = graph_files
+    monkeypatch.setenv("MSBFS_RETRIES", "0")
+    monkeypatch.setenv("MSBFS_FAULTS", "transient:dispatch:3")
+    sock = str(tmp_path / "f.sock")
+    srv = MsbfsServer(
+        listen=f"unix:{sock}", graphs={"default": p1}, window_s=0.0
+    )
+    srv.start()
+    try:
+        rng = np.random.default_rng(9)
+        n = srv.registry.get("default").graph.n
+        with MsbfsClient(f"unix:{sock}") as c:
+            assert c.query(_mk_queries(rng, n, 2, 2))["ok"]
+            with pytest.raises(ServerError) as exc:
+                c.query(_mk_queries(rng, n, 2, 2))
+            assert exc.value.type_name == "TransientError"
+            assert c.query(_mk_queries(rng, n, 2, 2))["ok"]
+    finally:
+        faults.activate(None)
+        srv.stop()
+
+
+def test_wire_input_errors_are_typed(server):
+    srv, addr = server
+    with MsbfsClient(addr) as c:
+        for req, mark in (
+            ({"op": "nope"}, "unknown op"),
+            ({"op": "query", "graph": "default"}, "non-empty"),
+            ({"op": "query", "graph": "default", "queries": [[]]},
+             "non-empty"),
+            ({"op": "query", "graph": "ghost", "queries": [[1]]},
+             "no graph registered"),
+            ({"op": "load"}, "path"),
+        ):
+            with pytest.raises(ServerError, match=mark) as exc:
+                c.call(req)
+            assert exc.value.exit_code == 1  # InputError on the wire
+        # The connection survives every typed error above.
+        assert c.ping()
+
+
+def test_query_main_cli_end_to_end(server, graph_files, tmp_path, capsys):
+    """The thin client CLI: reference-style selection lines on stdout,
+    exit 0; --stats renders the report; server errors map to exit
+    codes."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+        main,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_query_bin,
+    )
+
+    srv, addr = server
+    (n, edges, _), _ = graph_files
+    qpath = str(tmp_path / "q.bin")
+    queries = generators.random_queries(n, 3, max_group=2, seed=13)
+    save_query_bin(qpath, queries)
+    rc = main(["main.py", "query", "--connect", addr, "-q", qpath])
+    out = capsys.readouterr()
+    assert rc == 0
+    want = [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+    assert f"Minimum F value: {min(want)}" in out.out
+    assert f"minimum F value: {want.index(min(want)) + 1}" in out.out
+    rc = main(["main.py", "query", "--connect", addr, "--stats"])
+    out = capsys.readouterr()
+    assert rc == 0 and "result cache:" in out.out
+    rc = main(
+        ["main.py", "query", "--connect", addr, "--graph", "ghost",
+         "-q", qpath]
+    )
+    out = capsys.readouterr()
+    assert rc == 1 and "no graph registered" in out.err
+
+
+def test_batch_cli_contract_untouched(graph_files, tmp_path, capsys):
+    """The reference argv contract survives the subcommand dispatch:
+    plain -g/-q/-gn runs the batch path and short argv still usages."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+        main,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_query_bin,
+    )
+
+    (n, edges, p1), _ = graph_files
+    qpath = str(tmp_path / "q.bin")
+    queries = generators.random_queries(n, 2, max_group=2, seed=14)
+    save_query_bin(qpath, queries)
+    rc = main(["main.py", "-g", p1, "-q", qpath, "-gn", "1"])
+    out = capsys.readouterr()
+    assert rc == 0 and "Minimum F value:" in out.out
+    rc = main(["main.py", "-g", "x"])
+    out = capsys.readouterr()
+    assert rc == -1 and "Usage:" in out.err

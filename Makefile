@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve
+.PHONY: native clean test resilience serve lifecycle
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -24,5 +24,11 @@ resilience: native
 serve: native
 	JAX_PLATFORMS=cpu python -m $(PKG).serve.smoke
 
-test: native resilience serve
+# Crash-safe lifecycle smoke (docs/SERVING.md "Crash recovery & probes"):
+# journal replay after kill -9, graceful drain, health probe, poison
+# quarantine — the in-process fast subset of tests/test_lifecycle.py.
+lifecycle: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_lifecycle.py -x -q -m "not slow"
+
+test: native resilience serve lifecycle
 	python -m pytest tests/ -x -q

@@ -212,6 +212,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .serve.client import query_main
 
         return query_main(argv[2:])
+    if len(argv) > 1 and argv[1] == "health":
+        # Probe alias: ``msbfs health --connect ...`` is the external
+        # health check's whole command line (docs/SERVING.md).
+        from .serve.client import query_main
+
+        return query_main(argv[2:] + ["--health"])
     if len(argv) < 5:  # argc < 5, reference main.cu:204-212
         print(
             f"Usage: python {argv[0] if argv else 'main.py'} "

@@ -48,6 +48,7 @@ __all__ = [
     "DeviceError",
     "TransientError",
     "BackpressureError",
+    "PoisonQueryError",
     "classify",
     "RetryPolicy",
     "call_with_watchdog",
@@ -107,6 +108,18 @@ class BackpressureError(MsbfsError):
     infrastructure faults."""
 
     exit_code = 7
+
+
+class PoisonQueryError(MsbfsError):
+    """A query whose content deterministically fails its dispatch: the
+    serving daemon's quarantine bisected a failed batch down to this
+    request and it still failed alone (docs/SERVING.md "Poison-query
+    quarantine").  NOT retryable — resubmitting the same payload fails
+    the same way; the batch-mates were re-executed and answered
+    normally.  Exit 8 so scripting can tell "my query is bad" from load
+    shedding (7) and infrastructure faults (3/4/5)."""
+
+    exit_code = 8
 
 
 _CAPACITY_MARKS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY", "ALLOCATION FAILURE")
@@ -231,6 +244,11 @@ class ChunkSupervisor(QueryEngineBase):
         self.max_rebuilds = max_rebuilds
         self.events: List[dict] = []
         self._rebuilds = 0
+        # Optional drain signal (serve/lifecycle.py): while set, backoff
+        # sleeps are capped so retries cannot out-sleep the daemon's
+        # drain deadline, and an unset->set transition wakes a sleeping
+        # retry immediately.  None (the batch CLI) keeps plain sleeps.
+        self.drain_signal: Optional[threading.Event] = None
 
     def drain_events(self) -> List[dict]:
         """Hand off and clear the recovery-event log.  The batch CLI
@@ -239,6 +257,12 @@ class ChunkSupervisor(QueryEngineBase):
         bounded memory, and each event is reported exactly once."""
         events, self.events = self.events, []
         return events
+
+    def record_event(self, action: str, **fields) -> None:
+        """External recovery actions (the serving daemon's poison-query
+        quarantine) land in the same event log as retries/degrades, so
+        one stats stream reports every recovery mechanism."""
+        self.events.append({"action": action, **fields})
 
     def __getattr__(self, name):
         # Only called for attributes missing on the supervisor itself;
@@ -268,8 +292,23 @@ class ChunkSupervisor(QueryEngineBase):
     def _dispatch(self, method, args, kwargs):
         plan = self.plan if self.plan is not None else faults.active_plan()
         if plan is not None:
-            plan.trip("dispatch")
+            # The first positional arg is the dispatched payload (the
+            # query batch for f_values/query_stats/best, the shape tuple
+            # for compile) — data-dependent faults (poison) key on it.
+            plan.trip("dispatch", args[0] if args else None)
         return getattr(self.engine, method)(*args, **kwargs)
+
+    def _backoff(self, delay: float) -> None:
+        """One retry backoff, drain-aware: while the daemon drains, cap
+        the sleep so the retry finishes inside the drain deadline; a
+        drain starting mid-sleep wakes the retry immediately."""
+        sig = self.drain_signal
+        if sig is None:
+            time.sleep(delay)
+        elif sig.is_set():
+            time.sleep(min(delay, 0.05))
+        else:
+            sig.wait(delay)
 
     def _supervised(self, method, *args, **kwargs):
         delays = self.policy.delays()
@@ -293,7 +332,7 @@ class ChunkSupervisor(QueryEngineBase):
                             "delay": delay,
                             "error": str(err),
                         })
-                        time.sleep(delay)
+                        self._backoff(delay)
                         continue
                 elif isinstance(err, CapacityError) and self.ladder:
                     label, factory = self.ladder.pop(0)

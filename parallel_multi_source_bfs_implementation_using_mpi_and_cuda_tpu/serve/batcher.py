@@ -67,6 +67,10 @@ class QueryRequest:
     rows: np.ndarray  # (K, s_pad) int32, -1 padded
     s_pad: int
     submitted: float
+    # Absolute wall-clock time after which the client has given up; the
+    # server sheds the request instead of computing an unwanted answer
+    # (None = no client deadline on the wire).
+    deadline: Optional[float] = None
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[dict] = None
     error: Optional[MsbfsError] = None
@@ -112,6 +116,9 @@ class MicroBatcher:
         self._gate = threading.Event()  # tests hold() this to fill the queue
         self._gate.set()
         self._stop = False
+        self._draining = False
+        self._busy = False  # worker is mid-execute (drain must wait it out)
+        self._idle = threading.Condition(self._lock)
         self._thread: Optional[threading.Thread] = None
 
     # ---- lifecycle --------------------------------------------------------
@@ -137,6 +144,42 @@ class MicroBatcher:
     def release(self) -> None:
         self._gate.set()
 
+    # ---- graceful drain ----------------------------------------------------
+    def begin_drain(self) -> None:
+        """Refuse new admissions; already-queued and in-flight requests
+        keep flowing (the drain's whole point: finish what we accepted)."""
+        with self._lock:
+            self._draining = True
+            self._ready.notify_all()
+        self._gate.set()  # a held gate must not deadlock a drain
+
+    def drain(self, deadline_s: float) -> bool:
+        """Block until the queue is empty and the worker is idle, or
+        ``deadline_s`` elapses.  True = fully drained."""
+        limit = time.time() + max(0.0, deadline_s)
+        with self._lock:
+            while self._queue or self._busy:
+                if self._stop:  # forced stop outranks the drain deadline
+                    return not (self._queue or self._busy)
+                remaining = limit - time.time()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(min(remaining, 0.1))
+        return True
+
+    def fail_pending(self, error: MsbfsError) -> int:
+        """Fail every still-queued request typed (drain deadline expired:
+        the responses must go out before the process does)."""
+        with self._lock:
+            pending = list(self._queue)
+            self._queue.clear()
+            self._idle.notify_all()
+        for req in pending:
+            if not req.done.is_set():
+                req.error = error
+                req.done.set()
+        return len(pending)
+
     # ---- producer side ----------------------------------------------------
     def submit(self, request: QueryRequest) -> None:
         """Admit or reject-now.  Rejection is the typed BackpressureError
@@ -144,6 +187,12 @@ class MicroBatcher:
         with self._lock:
             if self._stop:
                 raise MsbfsError("server is shutting down")
+            if self._draining:
+                from ..runtime.supervisor import TransientError
+
+                raise TransientError(
+                    "server is draining; retry against another instance"
+                )
             if len(self._queue) >= self.capacity:
                 self.rejected += 1
                 raise BackpressureError(
@@ -176,6 +225,7 @@ class MicroBatcher:
             if self._stop and not self._queue:
                 return None
             head = self._queue.popleft()
+            self._busy = True  # drain() must wait out this batch
         if self.window_s:
             time.sleep(self.window_s)
         batch = [head]
@@ -217,6 +267,10 @@ class MicroBatcher:
                     if not req.done.is_set():
                         req.error = err
                         req.done.set()
+            finally:
+                with self._lock:
+                    self._busy = False
+                    self._idle.notify_all()
             self.batches += 1
             self.coalesced += len(batch) - 1
 

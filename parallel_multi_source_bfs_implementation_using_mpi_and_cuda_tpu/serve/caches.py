@@ -120,6 +120,12 @@ class ExecutableCache:
         with self._lock:
             return dict(self._compiles)
 
+    def warmed_count(self) -> int:
+        """Currently-warm bucket count (the health verb's
+        ``warm_buckets``: live set size, unlike the compile odometer)."""
+        with self._lock:
+            return len(self._warmed)
+
     def total_compiles(self) -> int:
         with self._lock:
             return sum(self._compiles.values())

@@ -11,7 +11,7 @@ Importable::
 CLI (``python main.py query ...`` / ``msbfs-tpu query ...``)::
 
     python main.py query --connect unix:/tmp/msbfs.sock -q query.bin
-    python main.py query --connect unix:/tmp/msbfs.sock --stats
+    python main.py query --connect unix:/tmp/msbfs.sock --health
 
 The query verb prints the reference report's two selection lines on
 stdout (the serving analog of main.cu:403-414; there are no process
@@ -20,23 +20,50 @@ metadata (bucket, cache/batch status, latency) on stderr.  Server-side
 failures raise :class:`ServerError` carrying the taxonomy class name
 and documented exit code, which the CLI uses as its own exit code —
 the same contract as the batch CLI (docs/RESILIENCE.md).
+
+Resilience (docs/SERVING.md "Crash recovery & probes"): a lost
+connection mid-call is wrapped in the same typed :class:`ServerError`
+taxonomy (``TransientError``, exit 5) rather than leaking raw socket
+errors to scripts; *idempotent* verbs (ping/health/stats/query/load —
+load is load-once on the server, so re-sending it is safe) additionally
+reconnect with the PR-1 bounded backoff schedule before giving up.
+``query`` accepts a per-call ``deadline_s`` propagated on the wire (the
+server sheds work whose client has stopped waiting) and an optional
+``hedge_after_s``: if the primary connection has not answered by then,
+the same query races on a second connection and the first answer wins —
+the classic tail-latency hedge, safe precisely because query is
+idempotent and results are deterministic.
 """
 
 from __future__ import annotations
 
 import sys
+import threading
+import time
 from typing import List, Optional, Sequence
 
+from ..runtime.supervisor import RetryPolicy
 from . import protocol
 
 
 class ServerError(Exception):
-    """A typed ``ok: false`` response (server-side taxonomy on the wire)."""
+    """A typed failure with the wire taxonomy's class name + exit code —
+    raised both for ``ok: false`` responses (server-side taxonomy) and
+    for transport failures (wrapped as ``TransientError``, exit 5, so
+    scripting sees one stable contract either way)."""
 
     def __init__(self, type_name: str, message: str, exit_code: int):
         super().__init__(f"{type_name}: {message}")
         self.type_name = type_name
         self.exit_code = int(exit_code)
+
+
+def _transport_error(address: str, exc: BaseException) -> ServerError:
+    return ServerError(
+        "TransientError",
+        f"connection to {address} failed: {exc}",
+        5,
+    )
 
 
 class MsbfsClient:
@@ -45,18 +72,40 @@ class MsbfsClient:
     Thread-compatible, not thread-safe: frames on one connection are
     strictly request/response ordered, so share a client across threads
     only with external locking (or open one client per thread — unix
-    socket connects are microseconds).
+    socket connects are microseconds).  The hedged-query path honors
+    this by racing on a *separate* connection.
     """
 
-    def __init__(self, address: str, timeout: Optional[float] = 300.0):
+    def __init__(
+        self,
+        address: str,
+        timeout: Optional[float] = 300.0,
+        retry: Optional[RetryPolicy] = None,
+    ):
         self.address = address
+        self.timeout = timeout
+        # Bounded reconnect schedule for idempotent calls; PR-1's policy
+        # so backoff behavior is one story repo-wide.
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_retries=2, base_delay=0.05, max_delay=2.0
+        )
         self._sock = protocol.connect(address, timeout=timeout)
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._drop_sock()
+
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _ensure_sock(self):
+        if self._sock is None:
+            self._sock = protocol.connect(self.address, timeout=self.timeout)
+        return self._sock
 
     def __enter__(self) -> "MsbfsClient":
         return self
@@ -65,11 +114,9 @@ class MsbfsClient:
         self.close()
 
     # ---- request plumbing -------------------------------------------------
-    def call(self, request: dict) -> dict:
-        """Send one request object, return the ``ok: true`` response or
-        raise :class:`ServerError`."""
-        protocol.send_frame(self._sock, request)
-        response = protocol.recv_frame(self._sock)
+    def _call_once(self, sock, request: dict) -> dict:
+        protocol.send_frame(sock, request)
+        response = protocol.recv_frame(sock)
         if response is None:
             raise ConnectionError(
                 f"server at {self.address} closed the connection"
@@ -83,27 +130,123 @@ class MsbfsClient:
             )
         return response
 
+    def call(self, request: dict, idempotent: bool = False) -> dict:
+        """Send one request object, return the ``ok: true`` response or
+        raise :class:`ServerError`.  Transport failures are wrapped
+        typed; when ``idempotent`` they first retry on a fresh
+        connection per the bounded backoff schedule."""
+        delays = list(self.retry.delays()) if idempotent else []
+        attempt = 0
+        while True:
+            try:
+                return self._call_once(self._ensure_sock(), request)
+            except ServerError:
+                raise  # the server answered; nothing to reconnect from
+            except (protocol.ProtocolError, OSError) as exc:
+                # One dead socket must not poison later calls either way.
+                self._drop_sock()
+                if attempt >= len(delays):
+                    raise _transport_error(self.address, exc) from exc
+                time.sleep(delays[attempt])
+                attempt += 1
+
     # ---- verbs ------------------------------------------------------------
     def ping(self) -> bool:
-        return bool(self.call({"op": "ping"}).get("ok"))
+        return bool(self.call({"op": "ping"}, idempotent=True).get("ok"))
+
+    def health(self) -> dict:
+        """The daemon's readiness report (docs/SERVING.md probe table)."""
+        return self.call({"op": "health"}, idempotent=True)
 
     def load(self, path: str, graph: str = "default") -> dict:
-        return self.call({"op": "load", "graph": graph, "path": path})
+        # Idempotent by the registry's load-once rule: same bytes under
+        # the same name is a no-op hit, so re-sending after a lost
+        # connection cannot double-register.
+        return self.call(
+            {"op": "load", "graph": graph, "path": path}, idempotent=True
+        )
 
     def reload(self, graph: str = "default") -> dict:
+        # NOT idempotent: each reload bumps the version; blind re-send
+        # after an ambiguous failure could bump twice.
         return self.call({"op": "reload", "graph": graph})
 
     def query(
-        self, queries: Sequence[Sequence[int]], graph: str = "default"
+        self,
+        queries: Sequence[Sequence[int]],
+        graph: str = "default",
+        deadline_s: Optional[float] = None,
+        hedge_after_s: Optional[float] = None,
     ) -> dict:
         qs = [[int(v) for v in group] for group in queries]
-        return self.call({"op": "query", "graph": graph, "queries": qs})
+        request = {"op": "query", "graph": graph, "queries": qs}
+        if deadline_s is not None:
+            request["deadline_s"] = float(deadline_s)
+        if hedge_after_s is None:
+            return self.call(request, idempotent=True)
+        return self._hedged_call(request, float(hedge_after_s))
 
     def stats(self) -> dict:
-        return self.call({"op": "stats"})["stats"]
+        return self.call({"op": "stats"}, idempotent=True)["stats"]
 
     def shutdown(self) -> dict:
         return self.call({"op": "shutdown"})
+
+    # ---- hedged retry -----------------------------------------------------
+    def _hedged_call(self, request: dict, hedge_after_s: float) -> dict:
+        """Race the primary connection against a late-started spare;
+        first answer wins.  If the spare wins, the primary socket is
+        dropped (its response is still in flight and would desynchronize
+        the frame stream), so the next call reconnects cleanly."""
+        outcome: dict = {}
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def settle(source: str, result=None, error=None) -> bool:
+            with lock:
+                if outcome:
+                    return False
+                outcome.update(
+                    {"source": source, "result": result, "error": error}
+                )
+            done.set()
+            return True
+
+        def primary() -> None:
+            try:
+                result = self.call(request, idempotent=True)
+            except BaseException as exc:  # noqa: BLE001 — relayed to caller
+                settle("primary", error=exc)
+                return
+            settle("primary", result=result)
+
+        def spare() -> None:
+            try:
+                with MsbfsClient(
+                    self.address, timeout=self.timeout, retry=self.retry
+                ) as second:
+                    result = second.call(request, idempotent=True)
+            except BaseException as exc:  # noqa: BLE001 — loser may fail
+                settle("hedge", error=exc)
+                return
+            settle("hedge", result=result)
+
+        t_primary = threading.Thread(
+            target=primary, name="msbfs-hedge-primary", daemon=True
+        )
+        t_primary.start()
+        if not done.wait(hedge_after_s):
+            threading.Thread(
+                target=spare, name="msbfs-hedge-spare", daemon=True
+            ).start()
+        done.wait()
+        if outcome["source"] == "hedge" and t_primary.is_alive():
+            self._drop_sock()  # abandon the in-flight primary exchange
+        if outcome["error"] is not None:
+            raise outcome["error"]
+        result = dict(outcome["result"])
+        result["hedged"] = outcome["source"] == "hedge"
+        return result
 
 
 def _queries_from_file(path: str) -> List[List[int]]:
@@ -134,16 +277,25 @@ def query_main(argv: Optional[List[str]] = None) -> int:
                     help="registered graph name (default 'default')")
     ap.add_argument("--load", default=None, metavar="PATH",
                     help="register PATH under --graph before querying")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-query deadline; the server sheds the "
+                    "request once it expires")
+    ap.add_argument("--hedge-ms", type=float, default=None,
+                    help="hedge the query on a second connection after "
+                    "this many ms without an answer")
     ap.add_argument("--stats", action="store_true",
                     help="print the daemon's stats report")
     ap.add_argument("--ping", action="store_true", help="liveness check")
+    ap.add_argument("--health", action="store_true",
+                    help="readiness probe (exit 0 only when the daemon "
+                    "reports ready)")
     ap.add_argument("--shutdown", action="store_true",
                     help="ask the daemon to exit")
     args = ap.parse_args(argv)
-    if not (args.query_file or args.stats or args.ping or args.shutdown
-            or args.load):
-        ap.error("nothing to do: give -q, --load, --stats, --ping or "
-                 "--shutdown")
+    if not (args.query_file or args.stats or args.ping or args.health
+            or args.shutdown or args.load):
+        ap.error("nothing to do: give -q, --load, --stats, --ping, "
+                 "--health or --shutdown")
     try:
         client = MsbfsClient(args.connect)
     except (OSError, ValueError) as exc:
@@ -155,6 +307,20 @@ def query_main(argv: Optional[List[str]] = None) -> int:
             if args.ping:
                 client.ping()
                 print("pong", file=sys.stderr)
+            if args.health:
+                h = client.health()
+                ready = bool(h.get("ready"))
+                print(
+                    f"pid {h.get('pid')}; "
+                    f"{'ready' if ready else 'NOT ready'}"
+                    f"{' (draining)' if h.get('draining') else ''}; "
+                    f"{h.get('graphs_warm', 0)} graph(s), "
+                    f"{h.get('warm_buckets', 0)} warm bucket(s); "
+                    f"queue depth {h.get('queue_depth', 0)}",
+                    file=sys.stderr,
+                )
+                if not ready:
+                    return 5  # probe contract: non-zero until ready
             if args.load:
                 info = client.load(args.load, graph=args.graph)["graph"]
                 print(
@@ -165,7 +331,16 @@ def query_main(argv: Optional[List[str]] = None) -> int:
                 )
             if args.query_file:
                 out = client.query(
-                    _queries_from_file(args.query_file), graph=args.graph
+                    _queries_from_file(args.query_file),
+                    graph=args.graph,
+                    deadline_s=(
+                        None if args.deadline_ms is None
+                        else args.deadline_ms / 1000.0
+                    ),
+                    hedge_after_s=(
+                        None if args.hedge_ms is None
+                        else args.hedge_ms / 1000.0
+                    ),
                 )
                 # The reference report's selection lines, 1-based winner
                 # (main.cu:409) — stdout carries results only.
@@ -185,6 +360,8 @@ def query_main(argv: Optional[List[str]] = None) -> int:
                         f"{' (compiled)' if out.get('compiled') else ''}; "
                         f"latency {out.get('latency_ms', 0)} ms"
                     )
+                if out.get("hedged"):
+                    note += "; answered by the hedge connection"
                 print(f"bucket {k_exec}x{s_pad}; {note}", file=sys.stderr)
             if args.stats:
                 from ..utils.report import format_server_stats

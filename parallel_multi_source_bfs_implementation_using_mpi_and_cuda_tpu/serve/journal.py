@@ -1,0 +1,203 @@
+"""Append-only daemon state journal (docs/SERVING.md "Crash recovery").
+
+The serving daemon's durable state is tiny — which graphs are registered
+(name, path, content hash) and which executable buckets have been warmed
+— but losing it on a crash means every client must re-``load`` and every
+first query re-compiles.  This module journals that state as JSON lines,
+fsync'd per append, so a ``kill -9`` loses at most the line being
+written (a torn tail is detected and dropped on replay, never
+propagated).
+
+Record grammar (one JSON object per line)::
+
+    {"op": "load",   "name": ..., "path": ..., "hash": ...}
+    {"op": "reload", "name": ..., "path": ..., "hash": ...}
+    {"op": "warm",   "name": ..., "hash": ..., "k_exec": ..., "s_pad": ...}
+
+:meth:`StateJournal.replay` folds the line stream into the reconciled
+end state — last registration per name wins, warm records survive only
+while their (name, hash) still matches the live registration — and
+:meth:`StateJournal.compact` atomically rewrites the file down to that
+state (temp file + fsync + rename), so the journal stays proportional
+to the live state, not to the daemon's lifetime.
+
+Fault sites ``journal_append`` / ``journal_replay`` (utils/faults.py)
+let the ``crash`` kind kill the process mid-journal deterministically —
+the recovery tests' stand-in for a real power cut.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..utils import faults
+
+_OPS = ("load", "reload", "warm")
+
+
+@dataclass
+class JournalState:
+    """The reconciled end state of a journal replay."""
+
+    # name -> (path, hash) of the live registration
+    graphs: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    # (name, hash, k_exec, s_pad) warmed buckets for live registrations
+    warm: Set[Tuple[str, str, int, int]] = field(default_factory=set)
+    replayed: int = 0  # records applied
+    dropped: int = 0  # malformed/torn/stale lines skipped
+
+    def records(self) -> List[dict]:
+        """The state as a minimal record list (compaction's payload)."""
+        out: List[dict] = [
+            {"op": "load", "name": n, "path": p, "hash": h}
+            for n, (p, h) in sorted(self.graphs.items())
+        ]
+        out.extend(
+            {"op": "warm", "name": n, "hash": h, "k_exec": k, "s_pad": s}
+            for n, h, k, s in sorted(self.warm)
+        )
+        return out
+
+
+class StateJournal:
+    """One journal file; append is thread-safe only under the caller's
+    serialization (the server appends from its verb handlers and the
+    single batcher thread, both already funneled through server locks
+    for the state being journaled)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    # ---- append side ------------------------------------------------------
+    def append(self, record: dict) -> None:
+        """Durably append one record: write + flush + fsync, so the
+        record survives a process kill the moment append returns.  A
+        failed append is reported once to stderr and swallowed — journal
+        loss degrades restart warmth, it must never fail a request."""
+        faults.trip("journal_append")
+        line = json.dumps(record, separators=(",", ":"), sort_keys=True)
+        try:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as exc:
+            print(
+                f"msbfs serve: journal append to {self.path} failed: {exc}"
+                " (restart will not restore this state)",
+                file=sys.stderr,
+            )
+
+    # ---- replay side ------------------------------------------------------
+    def replay(self) -> JournalState:
+        """Read and reconcile the journal.  Missing file = empty state
+        (first boot).  A torn final line — the crash-mid-append case —
+        is dropped silently; a malformed line elsewhere is dropped with
+        a stderr note (something other than a crash corrupted the file,
+        the operator should know)."""
+        faults.trip("journal_replay")
+        state = JournalState()
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return state
+        lines = raw.split("\n")
+        torn_tail = bool(lines) and lines[-1] != ""
+        if not torn_tail and lines:
+            lines.pop()  # the empty split artifact after the final \n
+        for i, line in enumerate(lines):
+            if not line:
+                continue
+            record = self._parse(line)
+            if record is None:
+                state.dropped += 1
+                if not (torn_tail and i == len(lines) - 1):
+                    print(
+                        f"msbfs serve: journal {self.path} line {i + 1} "
+                        "is not a valid record; skipping it",
+                        file=sys.stderr,
+                    )
+                continue
+            if self._apply(state, record):
+                state.replayed += 1
+        return state
+
+    @staticmethod
+    def _parse(line: str) -> Optional[dict]:
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            return None
+        if not isinstance(record, dict) or record.get("op") not in _OPS:
+            return None
+        return record
+
+    @staticmethod
+    def _apply(state: JournalState, record: dict) -> bool:
+        """Fold one record into ``state``; False = dropped (stale warm,
+        missing fields), which counts as dropped, never as replayed."""
+        op = record["op"]
+        name = str(record.get("name", "default"))
+        if op in ("load", "reload"):
+            path, digest = record.get("path"), record.get("hash")
+            if not isinstance(path, str) or not isinstance(digest, str):
+                state.dropped += 1
+                return False
+            state.graphs[name] = (path, digest)
+            # A re-registration with new content strands the old warms.
+            state.warm = {
+                w for w in state.warm if not (w[0] == name and w[1] != digest)
+            }
+            return True
+        # op == "warm"
+        digest = record.get("hash")
+        live = state.graphs.get(name)
+        if live is None or not isinstance(digest, str):
+            state.dropped += 1
+            return False
+        if live[1] != digest:
+            state.dropped += 1  # warm for content no longer registered
+            return False
+        try:
+            k_exec, s_pad = int(record["k_exec"]), int(record["s_pad"])
+        except (KeyError, TypeError, ValueError):
+            state.dropped += 1
+            return False
+        state.warm.add((name, digest, k_exec, s_pad))
+        return True
+
+    # ---- compaction -------------------------------------------------------
+    def compact(self, state: JournalState) -> None:
+        """Atomically rewrite the journal to the reconciled state: temp
+        file in the same directory, fsync, rename — a crash at any point
+        leaves either the old journal or the new one, never a mix."""
+        directory = os.path.dirname(os.path.abspath(self.path)) or "."
+        fd, tmp = tempfile.mkstemp(
+            prefix=".journal.", suffix=".tmp", dir=directory
+        )
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                for record in state.records():
+                    f.write(
+                        json.dumps(record, separators=(",", ":"),
+                                   sort_keys=True) + "\n"
+                    )
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError as exc:
+            print(
+                f"msbfs serve: journal compaction failed: {exc}; keeping "
+                "the uncompacted journal",
+                file=sys.stderr,
+            )
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

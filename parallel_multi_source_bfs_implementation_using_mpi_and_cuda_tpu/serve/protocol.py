@@ -5,14 +5,19 @@ bytes of UTF-8 JSON encoding a single object.  Both directions use the
 same framing; a connection carries any number of request/response pairs
 in order (no pipelining guarantees beyond FIFO per connection).
 
-Requests are objects with an ``op`` field (``ping`` / ``load`` /
-``reload`` / ``query`` / ``stats`` / ``shutdown``); responses carry
-``ok: true`` plus op-specific fields, or ``ok: false`` with a typed
-``error`` object mirroring the supervisor taxonomy
+Requests are objects with an ``op`` field (``ping`` / ``health`` /
+``load`` / ``reload`` / ``query`` / ``stats`` / ``shutdown``);
+responses carry ``ok: true`` plus op-specific fields, or ``ok: false``
+with a typed ``error`` object mirroring the supervisor taxonomy
 (``{"type", "message", "exit_code"}`` — docs/RESILIENCE.md exit-code
-table).  Query ids and F values are plain JSON numbers: F fits in
-int64 and JSON numbers are exact through 2^53, far beyond any sum of
-n hop-distances this system can hold in HBM.
+table).  ``ping`` answers with the daemon's ``pid`` (the stale-socket
+probe and "already running" diagnostics key on it); ``health`` is the
+readiness report (docs/SERVING.md probe table).  ``query`` accepts an
+optional ``deadline_s`` number — a client-relative budget the server
+uses to shed requests whose caller has already given up.  Query ids
+and F values are plain JSON numbers: F fits in int64 and JSON numbers
+are exact through 2^53, far beyond any sum of n hop-distances this
+system can hold in HBM.
 
 The length prefix is bounded (:data:`MAX_FRAME_BYTES`,
 ``MSBFS_SERVE_MAX_FRAME`` overrides): a corrupt or hostile prefix must

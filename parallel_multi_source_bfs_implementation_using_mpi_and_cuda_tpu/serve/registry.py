@@ -21,6 +21,7 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -103,6 +104,7 @@ class GraphEntry:
     version: int
     graph: object
     supervisor: ChunkSupervisor
+    loaded_at: float = field(default_factory=time.time)
     lock: threading.Lock = field(default_factory=threading.Lock)
 
     @property
@@ -120,6 +122,7 @@ class GraphEntry:
             "version": self.version,
             "n": int(self.graph.n),
             "directed_edges": int(self.graph.num_directed_edges),
+            "loaded_at": round(self.loaded_at, 3),
         }
 
 

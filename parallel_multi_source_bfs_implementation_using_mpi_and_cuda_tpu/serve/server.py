@@ -10,6 +10,25 @@ ledger (serve/caches.py), and answers over length-prefixed JSON frames
 all apply per-request, and an exhausted recovery budget fails THAT
 request typed (docs/RESILIENCE.md exit codes on the wire) while the
 daemon keeps serving.  docs/SERVING.md is the operator manual.
+
+Crash safety (this layer's PR-3 additions, docs/SERVING.md "Crash
+recovery & probes"):
+
+* registered graphs and warmed buckets journal to an append-only state
+  file (serve/journal.py); startup replays it, so ``kill -9`` + restart
+  restores the registry and re-warms executables with no client help;
+* SIGTERM/SIGINT request a graceful drain (serve/lifecycle.py): stop
+  accepting, finish queued + in-flight batches within the drain
+  deadline, flush responses, exit 0;
+* the ``health`` verb reports readiness (replay done, graphs warm,
+  queue depth, last-batch age) for external probes — ``ping`` stays a
+  bare "the socket answers";
+* a failing multi-request batch is bisected to isolate the offending
+  query: only the poisoned request(s) fail, typed
+  :class:`PoisonQueryError` (exit 8), survivors get bit-identical
+  results to a clean run;
+* clients send optional per-call deadlines; the server sheds work whose
+  client has already given up before spending device time on it.
 """
 
 from __future__ import annotations
@@ -23,23 +42,36 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+import weakref
+
 from ..runtime.supervisor import (
     BackpressureError,
     InputError,
     MsbfsError,
+    PoisonQueryError,
     TransientError,
     classify,
 )
 from ..utils import faults
-from . import protocol
+from . import lifecycle, protocol
 from .batcher import MicroBatcher, QueryRequest, bucket_label, pow2_pad
 from .caches import ExecutableCache, LRUCache
+from .journal import StateJournal
 from .registry import GraphEntry, GraphRegistry
 
 DEFAULT_RESULT_CACHE = 1024
 # A request parked behind a full pipeline must eventually fail typed
 # rather than hold its connection forever.
 DEFAULT_REQUEST_TIMEOUT_S = 300.0
+# Graceful-drain budget: queued + in-flight batches get this long to
+# finish before the remainder fails typed and the process exits.
+DEFAULT_DRAIN_S = 10.0
+
+# Leak ledger for the test suite's session teardown (tests/conftest.py):
+# every started server registers here and deregisters on stop(), so a
+# test that forgets to stop its daemon fails the whole run loudly.
+_LIVE_SERVERS: "weakref.WeakSet" = weakref.WeakSet()
+_BOUND_PATHS: set = set()
 
 # Query-shape sanity bounds, the reference's own format limits: K and
 # group size are uint8 on disk (main.cu:143-152).  The wire accepts more
@@ -117,6 +149,8 @@ class MsbfsServer:
         window_s: Optional[float] = None,
         result_cache_size: Optional[int] = None,
         request_timeout_s: Optional[float] = None,
+        journal_path: Optional[str] = None,
+        drain_deadline_s: Optional[float] = None,
     ):
         self.listen = listen
         self.registry = GraphRegistry()
@@ -134,23 +168,67 @@ class MsbfsServer:
             if request_timeout_s is not None
             else _env_float("MSBFS_SERVE_TIMEOUT", DEFAULT_REQUEST_TIMEOUT_S)
         )
+        if journal_path is None:
+            journal_path = os.environ.get("MSBFS_SERVE_JOURNAL", "") or None
+        self.journal = StateJournal(journal_path) if journal_path else None
+        self.drain_deadline_s = (
+            drain_deadline_s
+            if drain_deadline_s is not None
+            else _env_float("MSBFS_SERVE_DRAIN", DEFAULT_DRAIN_S)
+        )
         self.started = time.time()
         self._stats_lock = threading.Lock()
         self._buckets: Dict[str, _BucketStats] = {}
         self._recovery_events: List[dict] = []
         self._failed_requests = 0
         self._requests_total = 0
+        self._shed_requests = 0
+        self._quarantined_requests = 0
+        self._last_batch_ts: Optional[float] = None
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
+        self._warm_thread: Optional[threading.Thread] = None
         self._stopping = threading.Event()
+        self._wake = threading.Event()  # wait() wakes on stop OR drain
+        self._draining = False
+        self._drain_signal = threading.Event()  # caps supervisor backoffs
+        self._active_requests = 0  # connections mid handle/send
+        self._active_zero = threading.Condition(self._stats_lock)
+        self._replayed = threading.Event()  # registry restored from journal
+        self._ready = threading.Event()  # replay AND re-warm finished
+        self._journal_stats = {"replayed": 0, "dropped": 0}
         for name, path in (graphs or {}).items():
-            self.registry.load(name, path)
+            self._register(name, path)
+
+    # ---- registration (journal-aware) -------------------------------------
+    def _register(self, name: str, path: str) -> GraphEntry:
+        """registry.load + drain-signal hookup + journal append.  Every
+        registration path (CLI -g, the load verb, journal replay) funnels
+        through here so none can silently skip the journal."""
+        known = self.registry.maybe_get(name)
+        entry = self.registry.load(name, path)
+        entry.supervisor.drain_signal = self._drain_signal
+        if self.journal is not None and (known is None or known is not entry):
+            self.journal.append(
+                {"op": "load", "name": name, "path": path,
+                 "hash": entry.hash}
+            )
+        return entry
 
     # ---- lifecycle --------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def stopping(self) -> bool:
+        return self._stopping.is_set()
+
     def start(self) -> None:
-        """Bind, arm the fault plan, start batcher + acceptor.  Returns
-        once the socket accepts connections (callers/tests need no
-        poll-until-up loop)."""
+        """Bind, arm the fault plan, start batcher + acceptor, kick off
+        journal replay.  Returns once the socket accepts connections
+        (callers/tests need no poll-until-up loop); replay + re-warm run
+        on a background thread — ``health`` reports when they finish."""
         # Same bring-up order as the batch CLI (cli.py): the fault plan
         # first so every later seam sees it, then the persistent XLA
         # cache so warm compiles can land on disk and survive restarts.
@@ -159,25 +237,147 @@ class MsbfsServer:
         from ..utils.xla_cache import configure_compilation_cache
 
         configure_compilation_cache()
+        # A pre-existing unix socket is either a live daemon (refuse,
+        # typed) or a crash leftover (reclaim) — never blind-unlinked.
+        lifecycle.reclaim_stale_socket(self.listen)
         family, target = protocol.parse_address(self.listen)
-        if family == socket.AF_UNIX and isinstance(target, str):
-            try:
-                os.unlink(target)
-            except FileNotFoundError:
-                pass
         self._sock = socket.socket(family, socket.SOCK_STREAM)
         if family == socket.AF_INET:
             self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(target)
         self._sock.listen(64)
+        # Closing a socket does NOT wake a thread blocked in accept() on
+        # Linux; a short accept timeout bounds how long the acceptor can
+        # outlive stop() (the leak check in tests/conftest.py watches).
+        self._sock.settimeout(0.2)
+        if family == socket.AF_UNIX and isinstance(target, str):
+            _BOUND_PATHS.add(target)
+        _LIVE_SERVERS.add(self)
         self.batcher.start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="msbfs-accept", daemon=True
         )
         self._accept_thread.start()
+        if self.journal is None:
+            self._replayed.set()
+            self._ready.set()
+        else:
+            self._warm_thread = threading.Thread(
+                target=self._replay_journal, name="msbfs-warm", daemon=True
+            )
+            self._warm_thread.start()
+
+    def _replay_journal(self) -> None:
+        """Restore registered graphs, then re-warm journaled buckets.
+        Stateful verbs wait on ``_replayed`` (registry restored) so a
+        client query racing the restart sees the pre-crash registry, not
+        an empty one; ``_ready`` additionally waits out the warm-up
+        compiles and is what external probes should gate traffic on."""
+        try:
+            state = self.journal.replay()
+            with self._stats_lock:
+                self._journal_stats = {
+                    "replayed": state.replayed,
+                    "dropped": state.dropped,
+                }
+            for name, (path, digest) in sorted(state.graphs.items()):
+                if self._stopping.is_set():
+                    return
+                try:
+                    entry = self._register(name, path)
+                except (MsbfsError, OSError, ValueError) as exc:
+                    print(
+                        f"msbfs serve: journal replay cannot restore "
+                        f"graph {name!r} from {path}: {exc}",
+                        file=sys.stderr,
+                    )
+                    continue
+                if entry.hash != digest:
+                    print(
+                        f"msbfs serve: graph {name!r} content changed "
+                        f"since the journal ({digest} -> {entry.hash}); "
+                        "serving the current file",
+                        file=sys.stderr,
+                    )
+            self._replayed.set()
+            for name, digest, k_exec, s_pad in sorted(state.warm):
+                if self._stopping.is_set() or self._draining:
+                    return
+                entry = self.registry.maybe_get(name)
+                if entry is None or entry.hash != digest:
+                    continue
+                self._warm_bucket(entry, k_exec, s_pad)
+            # Replay folded the history; rewrite the journal down to the
+            # reconciled state so it cannot grow without bound.
+            self.journal.compact(state)
+        finally:
+            self._replayed.set()  # never leave verbs gated by a crash here
+            self._ready.set()
+
+    def _warm_bucket(self, entry: GraphEntry, k_exec: int, s_pad: int) -> None:
+        label = bucket_label(entry.key, k_exec, s_pad)
+        try:
+            self.executables.warm(
+                (entry.key, k_exec, s_pad),
+                label,
+                lambda: entry.supervisor.compile((k_exec, s_pad)),
+            )
+        except Exception as exc:  # noqa: BLE001 — warmth is best-effort
+            print(
+                f"msbfs serve: re-warm of bucket {label} failed: "
+                f"{classify(exc)}",
+                file=sys.stderr,
+            )
+
+    def request_drain(self) -> None:
+        """Flip into drain mode: refuse new stateful work, stop
+        accepting connections, cap supervisor backoff sleeps.  Safe from
+        signal handlers (only sets flags/events); the blocking part is
+        :meth:`drain`, run by the thread parked in :meth:`wait`."""
+        self._draining = True
+        self._drain_signal.set()
+        self.batcher.begin_drain()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._wake.set()
+
+    def drain(self, deadline_s: Optional[float] = None) -> bool:
+        """Finish queued + in-flight batches within the deadline, flush
+        connection handlers, then stop.  True = everything completed;
+        False = the deadline expired and the remainder failed typed."""
+        if not self._draining:
+            self.request_drain()
+        deadline_s = (
+            self.drain_deadline_s if deadline_s is None else deadline_s
+        )
+        clean = self.batcher.drain(deadline_s)
+        if not clean:
+            failed = self.batcher.fail_pending(
+                TransientError(
+                    f"server drained away before this request ran "
+                    f"(deadline {deadline_s:g}s); retry elsewhere"
+                )
+            )
+            print(
+                f"msbfs serve: drain deadline ({deadline_s:g}s) expired; "
+                f"failed {failed} queued request(s) typed",
+                file=sys.stderr,
+            )
+        # Let connection threads flush the responses they now hold.
+        flush_limit = time.time() + 5.0
+        with self._active_zero:
+            while self._active_requests > 0 and time.time() < flush_limit:
+                self._active_zero.wait(0.05)
+        self.stop()
+        return clean
 
     def stop(self) -> None:
         self._stopping.set()
+        self._drain_signal.set()
+        self._wake.set()
         self.batcher.stop()
         if self._sock is not None:
             try:
@@ -190,18 +390,28 @@ class MsbfsServer:
                 os.unlink(target)
             except FileNotFoundError:
                 pass
+            _BOUND_PATHS.discard(target)
+        _LIVE_SERVERS.discard(self)
 
-    def wait(self) -> None:
-        """Block until stop() (the daemon's main-thread parking spot)."""
-        self._stopping.wait()
+    def wait(self) -> str:
+        """Block until stop() or request_drain() (the daemon's
+        main-thread parking spot).  Returns ``"stop"`` or ``"drain"`` so
+        :func:`serve_main` knows whether a drain still has to run."""
+        self._wake.wait()
+        return "stop" if self._stopping.is_set() else "drain"
 
     # ---- socket front end -------------------------------------------------
     def _accept_loop(self) -> None:
         while not self._stopping.is_set():
             try:
                 conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue  # periodic stop-flag check
             except OSError:
                 return  # listener closed
+            # Accepted sockets inherit the listener's timeout; connection
+            # handlers must block indefinitely between frames instead.
+            conn.settimeout(None)
             threading.Thread(
                 target=self._serve_connection,
                 args=(conn,),
@@ -228,11 +438,22 @@ class MsbfsServer:
                     return
                 if request is None:
                     return
-                response = self.handle(request)
+                # The handle+send pair counts as "active": drain() waits
+                # for this window so a completed batch's response cannot
+                # be lost in the exit race.
+                with self._active_zero:
+                    self._active_requests += 1
                 try:
-                    protocol.send_frame(conn, response)
-                except OSError:
-                    return
+                    response = self.handle(request)
+                    try:
+                        protocol.send_frame(conn, response)
+                    except OSError:
+                        return
+                finally:
+                    with self._active_zero:
+                        self._active_requests -= 1
+                        if self._active_requests == 0:
+                            self._active_zero.notify_all()
                 if request.get("op") == "shutdown":
                     self.stop()
                     return
@@ -245,7 +466,23 @@ class MsbfsServer:
         op = request.get("op")
         try:
             if op == "ping":
-                return {"ok": True, "op": "ping"}
+                return {"ok": True, "op": "ping", "pid": os.getpid()}
+            if op == "health":
+                return self._op_health()
+            if op in ("load", "reload", "query"):
+                if self._draining:
+                    raise TransientError(
+                        "server is draining; retry against another "
+                        "instance"
+                    )
+                # Stateful verbs see the post-replay registry: a query
+                # racing a crash-restart must not observe the window
+                # where journaled graphs are still being restored.
+                if not self._replayed.wait(self.request_timeout_s):
+                    raise TransientError(
+                        "journal replay still running after "
+                        f"{self.request_timeout_s:g}s; retry"
+                    )
             if op == "load":
                 return self._op_load(request)
             if op == "reload":
@@ -262,18 +499,54 @@ class MsbfsServer:
         except Exception as exc:  # noqa: BLE001 — daemon must answer typed
             return protocol.error_body(classify(exc))
 
+    def _op_health(self) -> dict:
+        """Readiness probe, deliberately richer than ``ping``: a load
+        balancer should admit traffic on ``ready``, not on "the socket
+        answers" (a daemon mid-replay answers pings)."""
+        with self._stats_lock:
+            journal_stats = dict(self._journal_stats)
+            last_batch = self._last_batch_ts
+        warm = self.executables.warmed_count()
+        return {
+            "ok": True,
+            "op": "health",
+            "pid": os.getpid(),
+            "ready": self._ready.is_set(),
+            "draining": self._draining,
+            "uptime_s": round(time.time() - self.started, 3),
+            "graphs": sorted(self.registry.describe()),
+            "graphs_warm": len(self.registry.describe()),
+            "warm_buckets": warm,
+            "queue_depth": self.batcher.depth(),
+            "last_batch_age_s": (
+                None if last_batch is None
+                else round(time.time() - last_batch, 3)
+            ),
+            "journal": {
+                "path": self.journal.path if self.journal else None,
+                "replay_done": self._ready.is_set(),
+                **journal_stats,
+            },
+        }
+
     def _op_load(self, request: dict) -> dict:
         path = request.get("path")
         if not isinstance(path, str) or not path:
             raise InputError("load needs a 'path' string")
         name = request.get("graph", "default")
-        entry = self.registry.load(name, path)
+        entry = self._register(name, path)
         return {"ok": True, "op": "load", "graph": entry.describe()}
 
     def _op_reload(self, request: dict) -> dict:
         name = request.get("graph", "default")
         old = self.registry.get(name)
         entry = self.registry.reload(name)
+        entry.supervisor.drain_signal = self._drain_signal
+        if self.journal is not None:
+            self.journal.append(
+                {"op": "reload", "name": name, "path": entry.path,
+                 "hash": entry.hash}
+            )
         # Version bump already unreaches old entries; drop them eagerly
         # so a reloaded daemon's cache is not half full of dead weight.
         dropped = self.result_cache.drop_where(
@@ -335,6 +608,18 @@ class MsbfsServer:
             out = dict(cached)
             out["cached"] = True
             return out
+        deadline = None
+        raw_deadline = request.get("deadline_s")
+        if raw_deadline is not None:
+            try:
+                deadline_s = float(raw_deadline)
+            except (TypeError, ValueError):
+                raise InputError(
+                    f"deadline_s must be a number, got {raw_deadline!r}"
+                ) from None
+            if deadline_s <= 0:
+                raise InputError("deadline_s must be positive")
+            deadline = time.time() + deadline_s
         req = QueryRequest(
             graph_key=entry.key,
             graph_name=name,
@@ -342,6 +627,7 @@ class MsbfsServer:
             rows=rows,
             s_pad=s_pad,
             submitted=time.time(),
+            deadline=deadline,
         )
         self.batcher.submit(req)  # raises BackpressureError when full
         if not req.done.wait(self.request_timeout_s):
@@ -362,39 +648,94 @@ class MsbfsServer:
         return out
 
     # ---- execution (batcher consumer thread) ------------------------------
+    def _shed_expired(
+        self, requests: List[QueryRequest]
+    ) -> List[QueryRequest]:
+        """Fail requests whose client deadline has already passed before
+        spending device time on them; returns the still-live remainder."""
+        now = time.time()
+        live: List[QueryRequest] = []
+        for req in requests:
+            if req.deadline is not None and now > req.deadline:
+                with self._stats_lock:
+                    self._shed_requests += 1
+                req.error = TransientError(
+                    "request deadline expired before dispatch "
+                    "(client gave up); work shed"
+                )
+                req.done.set()
+            else:
+                live.append(req)
+        return live
+
+    def _dispatch_group(
+        self,
+        entry: GraphEntry,
+        requests: List[QueryRequest],
+        k_exec: int,
+        s_pad: int,
+    ):
+        """Pack, warm-once, dispatch one group of requests under the
+        supervisor.  Returns ``(f, offsets, compiled)``; raises on an
+        exhausted recovery budget (the caller decides blanket-fail vs
+        bisection).  First-time compiles journal their bucket so a
+        restart re-warms it."""
+        from ..parallel.scheduler import pack_padded_requests
+
+        batch, offsets = pack_padded_requests(
+            [r.rows for r in requests], k_exec, s_pad
+        )
+        supervisor = entry.supervisor
+        label = bucket_label(entry.key, k_exec, s_pad)
+        compiled = self.executables.warm(
+            (entry.key, k_exec, s_pad),
+            label,
+            lambda: supervisor.compile((k_exec, s_pad)),
+        )
+        if compiled and self.journal is not None:
+            self.journal.append(
+                {"op": "warm", "name": entry.name, "hash": entry.hash,
+                 "k_exec": k_exec, "s_pad": s_pad}
+            )
+        f = np.asarray(supervisor.f_values(batch)).astype(np.int64)
+        return f, offsets, compiled
+
     def _execute_batch(
         self, requests: List[QueryRequest], k_exec: int, s_pad: int
     ) -> None:
-        """Run one coalesced bucket: warm-once, dispatch supervised,
-        scatter per-request results; a typed failure answers every
-        request in the batch and the daemon moves on."""
-        from ..parallel.scheduler import pack_padded_requests
-
+        """Run one coalesced bucket: shed expired work, dispatch
+        supervised, scatter per-request results.  A failed
+        *multi-request* batch is bisected (:meth:`_quarantine`) so one
+        poisoned query cannot take its batchmates down with it; a failed
+        singleton keeps its classified error — there is nothing left to
+        isolate."""
         entry = self.registry.maybe_get(requests[0].graph_name)
-        label = bucket_label(requests[0].graph_key, k_exec, s_pad)
+        if entry is None or entry.key != requests[0].graph_key:
+            # Graph was reloaded after admission: the old engine may
+            # already be released — fail typed, client retries against
+            # the new version.
+            err = TransientError(
+                f"graph {requests[0].graph_name!r} was reloaded while "
+                "the request was queued; retry"
+            )
+            for req in requests:
+                req.error = err
+                req.done.set()
+            return
+        requests = self._shed_expired(requests)
+        if not requests:
+            return
+        k_exec = pow2_pad(sum(r.k for r in requests))
         try:
-            if entry is None or entry.key != requests[0].graph_key:
-                # Graph was reloaded after admission: the old engine may
-                # already be released — fail typed, client retries
-                # against the new version.
-                raise TransientError(
-                    f"graph {requests[0].graph_name!r} was reloaded while "
-                    "the request was queued; retry"
-                )
-            batch, offsets = pack_padded_requests(
-                [r.rows for r in requests], k_exec, s_pad
+            f, offsets, compiled = self._dispatch_group(
+                entry, requests, k_exec, s_pad
             )
-            supervisor = entry.supervisor
-            exec_key = (requests[0].graph_key, k_exec, s_pad)
-            compiled = self.executables.warm(
-                exec_key,
-                label,
-                lambda: supervisor.compile((k_exec, s_pad)),
-            )
-            f = np.asarray(supervisor.f_values(batch)).astype(np.int64)
         except Exception as exc:  # noqa: BLE001 — typed per-request failure
             err = classify(exc)
             self._note_recovery(entry)
+            if len(requests) > 1:
+                self._quarantine(entry, requests, s_pad, err)
+                return
             # _op_query counts the failure when it re-raises req.error —
             # counting here too would double-book every failed request.
             for req in requests:
@@ -402,11 +743,72 @@ class MsbfsServer:
                 req.done.set()
             return
         self._note_recovery(entry)
+        self._finish_batch(requests, f, offsets, compiled, k_exec, s_pad)
+
+    def _quarantine(
+        self,
+        entry: GraphEntry,
+        requests: List[QueryRequest],
+        s_pad: int,
+        batch_err: MsbfsError,
+    ) -> None:
+        """Bisect a failed multi-request batch to isolate the poison.
+
+        Each half re-dispatches under the same supervisor (retries and
+        all); halves that succeed answer normally — bit-identical to a
+        clean run, since the dispatch math is deterministic for a given
+        (k_exec, s_pad) bucket and row content.  A half that fails keeps
+        splitting; a *singleton* that fails is the poison and gets the
+        typed :class:`PoisonQueryError` (exit 8).  Cost: O(log K) extra
+        dispatches per poisoned row, paid only on the failure path.
+        """
+        mid = len(requests) // 2
+        for group in (requests[:mid], requests[mid:]):
+            if not group:
+                continue
+            group = self._shed_expired(group)
+            if not group:
+                continue
+            k_exec = pow2_pad(sum(r.k for r in group))
+            try:
+                f, offsets, compiled = self._dispatch_group(
+                    entry, group, k_exec, s_pad
+                )
+            except Exception as exc:  # noqa: BLE001 — keep bisecting
+                err = classify(exc)
+                self._note_recovery(entry)
+                if len(group) == 1:
+                    req = group[0]
+                    with self._stats_lock:
+                        self._quarantined_requests += 1
+                    req.error = PoisonQueryError(
+                        "query quarantined: its batch failed and "
+                        f"bisection isolated this request ({err})"
+                    )
+                    req.done.set()
+                else:
+                    self._quarantine(entry, group, s_pad, err)
+                continue
+            self._note_recovery(entry)
+            self._finish_batch(group, f, offsets, compiled, k_exec, s_pad)
+
+    def _finish_batch(
+        self,
+        requests: List[QueryRequest],
+        f: np.ndarray,
+        offsets,
+        compiled: bool,
+        k_exec: int,
+        s_pad: int,
+    ) -> None:
+        """Scatter one successful dispatch back to its requests."""
+        label = bucket_label(requests[0].graph_key, k_exec, s_pad)
         now = time.time()
         with self._stats_lock:
             stats = self._buckets.setdefault(label, _BucketStats())
             stats.batches += 1
             stats.rows += k_exec
+            self._last_batch_ts = now
         for req, lo in zip(requests, offsets):
             f_req = f[lo : lo + req.k]
             # Reference selection semantics (ops/objective.select_best):
@@ -454,8 +856,13 @@ class MsbfsServer:
             recovery = list(self._recovery_events)
             failed = self._failed_requests
             total = self._requests_total
+            shed = self._shed_requests
+            quarantined = self._quarantined_requests
         return {
             "uptime_s": round(time.time() - self.started, 3),
+            "ready": self._ready.is_set(),
+            "draining": self._draining,
+            "journal": self.journal.path if self.journal else None,
             "graphs": self.registry.describe(),
             "queue": {
                 "depth": self.batcher.depth(),
@@ -470,6 +877,8 @@ class MsbfsServer:
             "buckets": buckets,
             "requests_total": total,
             "requests_failed": failed,
+            "requests_shed": shed,
+            "requests_quarantined": quarantined,
             "recovery_events": recovery,
         }
 
@@ -511,6 +920,17 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         help="LRU result-cache capacity, 0 disables (default "
         "MSBFS_SERVE_RESULT_CACHE or 1024)",
     )
+    ap.add_argument(
+        "--journal", default=None, metavar="PATH",
+        help="append-only state journal; restart replays it to restore "
+        "registered graphs and re-warm buckets (default "
+        "MSBFS_SERVE_JOURNAL or no journal)",
+    )
+    ap.add_argument(
+        "--drain-s", type=float, default=None,
+        help="graceful-drain deadline on SIGTERM/SIGINT in seconds "
+        "(default MSBFS_SERVE_DRAIN or 10)",
+    )
     args = ap.parse_args(argv)
     graphs: Dict[str, str] = {}
     for spec in args.graph:
@@ -525,6 +945,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             queue_capacity=args.queue,
             window_s=None if args.window_ms is None else args.window_ms / 1000.0,
             result_cache_size=args.result_cache,
+            journal_path=args.journal,
+            drain_deadline_s=args.drain_s,
         )
         server.start()
     except MsbfsError as err:
@@ -535,13 +957,20 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     except ValueError as exc:
         print(f"msbfs serve: {exc}", file=sys.stderr)
         return 1
+    lifecycle.install_signal_handlers(server)
     names = ", ".join(sorted(graphs)) or "none (use the load verb)"
     print(
-        f"msbfs serve: listening on {args.listen}; graphs: {names}",
+        f"msbfs serve: listening on {args.listen}; graphs: {names}; "
+        f"journal: {server.journal.path if server.journal else 'off'}",
         file=sys.stderr,
     )
     try:
-        server.wait()
+        reason = server.wait()
     except KeyboardInterrupt:
-        server.stop()
+        # Belt-and-braces: the SIGINT handler normally converts this
+        # into a drain request before the exception can surface.
+        reason = "drain"
+    if reason == "drain" and not server.stopping:
+        server.drain()
+        print("msbfs serve: drained; exiting", file=sys.stderr)
     return 0

@@ -1,11 +1,13 @@
 """``make serve`` smoke: daemon up, three client queries, stats asserts.
 
 End to end over a real unix socket: start the daemon on a fabricated
-graph, run three client queries — two distinct (the second in the same
-shape bucket as the first) and a repeat of the first (a result-cache
-hit) — then assert the ``stats`` verb shows exactly one compile for the
-bucket, one cache hit, and zero failed requests.  Exit 0 on success,
-1 with a reason on stderr otherwise; wired into ``make test``.
+graph, probe ``health``, run three client queries — two distinct (the
+second in the same shape bucket as the first) and a repeat of the first
+(a result-cache hit) — then assert the ``stats`` verb shows exactly one
+compile for the bucket, one cache hit, and zero failed requests.  Exit
+0 on success, 1 with a reason on stderr otherwise; wired into ``make
+test``.  The daemon is torn down in a ``finally`` — a failed smoke
+never leaves a listener behind.
 
 Run directly::
 
@@ -29,24 +31,32 @@ def run_smoke() -> int:
     from .server import MsbfsServer
 
     tmp = tempfile.TemporaryDirectory(prefix="msbfs_serve_smoke_")
-    gpath = f"{tmp.name}/g.bin"
-    n, edges = generators.gnm_edges(200, 600, seed=7)
-    save_graph_bin(gpath, n, edges)
-    sock = f"{tmp.name}/msbfs.sock"
-    server = MsbfsServer(listen=f"unix:{sock}", graphs={"default": gpath})
-    server.start()
     failures = []
+    # Everything from construction on sits inside the try: a daemon that
+    # came up half-way (socket bound, batcher running) before an assert
+    # or an exception must still be torn down — `make serve` failures
+    # must never orphan a listener.
+    server = None
 
     def check(cond, what):
         if not cond:
             failures.append(what)
 
     try:
+        gpath = f"{tmp.name}/g.bin"
+        n, edges = generators.gnm_edges(200, 600, seed=7)
+        save_graph_bin(gpath, n, edges)
+        sock = f"{tmp.name}/msbfs.sock"
+        server = MsbfsServer(listen=f"unix:{sock}", graphs={"default": gpath})
+        server.start()
         rng = np.random.default_rng(11)
         q1 = [[int(v) for v in rng.integers(0, n, size=3)] for _ in range(4)]
         q2 = [[int(v) for v in rng.integers(0, n, size=3)] for _ in range(4)]
         with MsbfsClient(f"unix:{sock}") as client:
             check(client.ping(), "ping answered")
+            health = client.health()
+            check(health.get("ready"), "health reports ready")
+            check(health.get("pid"), "health carries the daemon pid")
             r1 = client.query(q1)
             check(r1["compiled"], "first query compiles its bucket")
             check(not r1["cached"], "first query is not cached")
@@ -69,8 +79,11 @@ def run_smoke() -> int:
         check(stats["requests_total"] == 3,
               f"three requests, got {stats['requests_total']}")
         sys.stderr.write(format_server_stats(stats))
+    except BaseException as exc:  # noqa: BLE001 — report, then teardown
+        failures.append(f"unexpected exception: {exc!r}")
     finally:
-        server.stop()
+        if server is not None:
+            server.stop()
         tmp.cleanup()
     if failures:
         for f in failures:

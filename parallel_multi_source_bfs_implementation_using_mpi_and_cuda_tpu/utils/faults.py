@@ -13,10 +13,13 @@ Grammar (``MSBFS_FAULTS`` / :meth:`FaultPlan.parse`)::
     MSBFS_FAULTS="<kind>:<site>:<n>[,<kind>:<site>:<n>...]"
 
 Each spec arms one fault that fires exactly once, on the ``n``-th trip
-(1-based) of its site.  Sites are plain strings named by the seams:
+(1-based) of its site (``poison`` is the one data-dependent exception,
+below).  Sites are plain strings named by the seams:
 ``load_graph`` / ``load_query`` (the binary loaders, utils/io.py),
-``device_put`` (query upload, parallel/scheduler.py) and ``dispatch``
-(every supervised engine call, runtime/supervisor.py).  Kinds:
+``device_put`` (query upload, parallel/scheduler.py), ``dispatch``
+(every supervised engine call, runtime/supervisor.py) and
+``journal_append`` / ``journal_replay`` (the serving daemon's state
+journal, serve/journal.py).  Kinds:
 
 ``io``         raise ``IOError`` at the site (unreadable file, lost NFS).
 ``corrupt``    raise ``ValueError`` (corrupt bytes past the header checks).
@@ -32,6 +35,16 @@ Each spec arms one fault that fires exactly once, on the ``n``-th trip
                a simulated chip loss carrying ``failed_ranks={r}`` —
                classified as ``DeviceError``, triggering survivor
                resharding.
+``crash``      call ``os._exit(137)`` at the site — a hard process death
+               with no cleanup, byte-for-byte what ``kill -9`` looks like
+               to the serving daemon's journal and to a restarted
+               process (docs/SERVING.md "Crash recovery & probes").
+``poison``     site must be ``vertex<v>``; trips on ``dispatch`` and
+               fires on EVERY dispatch whose query batch contains vertex
+               id ``v``, from the ``n``-th such dispatch on — a
+               data-dependent, deterministic failure that follows the
+               poisoned row through batch bisection (the serving
+               daemon's quarantine rehearsal, serve/server.py).
 
 Example: ``MSBFS_FAULTS="io:load_graph:1,oom:dispatch:2,hang:dispatch:3,
 chip:rank1:1"``.  Trip counters are plain per-site integers, so a given
@@ -49,9 +62,11 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
-KINDS = ("io", "corrupt", "oom", "transient", "hang", "chip")
+KINDS = ("io", "corrupt", "oom", "transient", "hang", "chip", "crash",
+         "poison")
 
 _RANK_RE = re.compile(r"rank(\d+)\Z")
+_VERTEX_RE = re.compile(r"vertex(\d+)\Z")
 
 
 class SimulatedResourceExhausted(RuntimeError):
@@ -74,18 +89,29 @@ class SimulatedChipLoss(RuntimeError):
         self.failed_ranks = frozenset(int(r) for r in failed_ranks)
 
 
+class SimulatedPoison(RuntimeError):
+    """A query whose content deterministically kills its dispatch —
+    retrying or resizing the batch never helps, only removing the row
+    does.  Deliberately carries NO taxonomy mark: it classifies as the
+    unrecoverable base ``MsbfsError``, which is exactly the shape of a
+    real poison query (an XLA assert, a pathological input)."""
+
+
 @dataclass
 class FaultSpec:
     kind: str
     site: str
     at: int  # fires on the at-th trip of trip_site, 1-based
     rank: Optional[int] = None  # chip faults only
+    vertex: Optional[int] = None  # poison faults only
     fired: bool = False
+    matches: int = 0  # poison: dispatches that contained the vertex
 
     @property
     def trip_site(self) -> str:
-        # Chips die during dispatches; the spec's site names WHICH rank.
-        return "dispatch" if self.kind == "chip" else self.site
+        # Chips die during dispatches, and poison is a property of the
+        # dispatched data; both specs' sites name WHICH rank/vertex.
+        return "dispatch" if self.kind in ("chip", "poison") else self.site
 
 
 class FaultPlan:
@@ -132,6 +158,7 @@ class FaultPlan:
             if at < 1:
                 raise ValueError(f"fault spec {raw!r}: trip count must be >= 1")
             rank = None
+            vertex = None
             if kind == "chip":
                 m = _RANK_RE.match(site)
                 if not m:
@@ -140,7 +167,16 @@ class FaultPlan:
                         "rank<r> (e.g. chip:rank1:1)"
                     )
                 rank = int(m.group(1))
-            specs.append(FaultSpec(kind=kind, site=site, at=at, rank=rank))
+            if kind == "poison":
+                m = _VERTEX_RE.match(site)
+                if not m:
+                    raise ValueError(
+                        f"fault spec {raw!r}: poison faults need site "
+                        "vertex<v> (e.g. poison:vertex7:1)"
+                    )
+                vertex = int(m.group(1))
+            specs.append(FaultSpec(kind=kind, site=site, at=at, rank=rank,
+                                   vertex=vertex))
         return cls(specs, hang_seconds=hang_seconds)
 
     @classmethod
@@ -166,20 +202,57 @@ class FaultPlan:
             self.counters.clear()
             for s in self.specs:
                 s.fired = False
+                s.matches = 0
 
-    def trip(self, site: str) -> None:
+    @staticmethod
+    def _poison_match(spec: FaultSpec, context) -> bool:
+        """True when the dispatched payload contains the poisoned vertex.
+        Only 2-D integer arrays are query batches; anything else at the
+        dispatch seam (a compile's shape tuple, say) cannot be poisoned."""
+        if context is None:
+            return False
+        try:
+            import numpy as np
+
+            arr = np.asarray(context)
+        except Exception:  # noqa: BLE001 — non-array payloads never match
+            return False
+        return (
+            arr.ndim == 2
+            and arr.dtype.kind in "iu"
+            and bool((arr == spec.vertex).any())
+        )
+
+    def trip(self, site: str, context=None) -> None:
         """One execution of ``site``: increments its counter and fires
-        any spec due at this count.  No-op when nothing is due."""
+        any spec due at this count.  ``context`` is the site's payload
+        (the dispatched query batch at ``dispatch``) — only the
+        data-dependent ``poison`` kind reads it.  No-op when nothing is
+        due.  ``poison`` specs fire on every matching dispatch from
+        their ``at``-th match on (never marked fired): the fault must
+        follow the poisoned row through batch bisection."""
         with self._lock:
             count = self.counters.get(site, 0) + 1
             self.counters[site] = count
             due = [
                 s
                 for s in self.specs
-                if s.trip_site == site and s.at == count and not s.fired
+                if s.kind != "poison"
+                and s.trip_site == site
+                and s.at == count
+                and not s.fired
             ]
             for s in due:
                 s.fired = True
+            for s in self.specs:
+                if (
+                    s.kind == "poison"
+                    and s.trip_site == site
+                    and self._poison_match(s, context)
+                ):
+                    s.matches += 1
+                    if s.matches >= s.at:
+                        due.append(s)
         for s in due:  # outside the lock: hangs sleep, fires raise
             self._fire(s)
 
@@ -211,6 +284,15 @@ class FaultPlan:
             raise SimulatedChipLoss(
                 f"injected chip loss: rank {s.rank} {where}", {s.rank}
             )
+        if s.kind == "crash":
+            # kill -9 semantics: no atexit, no finally, no flushes — the
+            # journal must already be durable for the restart to recover.
+            os._exit(137)
+        if s.kind == "poison":
+            raise SimulatedPoison(
+                f"injected poison query: batch contains vertex "
+                f"{s.vertex} {where}"
+            )
         raise AssertionError(f"unreachable kind {s.kind!r}")
 
 
@@ -232,10 +314,11 @@ def active_plan() -> Optional[FaultPlan]:
     return _active
 
 
-def trip(site: str) -> None:
-    """Seam entry point: near-free when no plan is active."""
+def trip(site: str, context=None) -> None:
+    """Seam entry point: near-free when no plan is active.  ``context``
+    carries the site's payload for data-dependent kinds (poison)."""
     if _active is not None:
-        _active.trip(site)
+        _active.trip(site, context)
 
 
 class injected:

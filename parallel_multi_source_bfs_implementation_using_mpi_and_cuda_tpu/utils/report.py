@@ -53,9 +53,15 @@ def format_server_stats(stats: dict) -> str:
     )
     lines.append(
         f"requests: {stats.get('requests_total', 0)} total, "
-        f"{stats.get('requests_failed', 0)} failed; "
+        f"{stats.get('requests_failed', 0)} failed, "
+        f"{stats.get('requests_shed', 0)} shed, "
+        f"{stats.get('requests_quarantined', 0)} quarantined; "
         f"compiles: {stats.get('compiles_total', 0)}"
     )
+    if stats.get("draining"):
+        lines.append("state: DRAINING (refusing new work)")
+    if stats.get("journal"):
+        lines.append(f"journal: {stats['journal']}")
     for label, b in sorted(stats.get("buckets", {}).items()):
         lines.append(
             f"bucket {label}: {b['requests']} requests in {b['batches']} "

@@ -55,6 +55,57 @@ def pytest_configure(config):
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _no_stray_servers():
+    """Fail the whole run if any test leaves serving-daemon state behind:
+    a live MsbfsServer (start() without stop()), a still-bound unix
+    socket path, or a lingering server thread.  A leaked daemon keeps a
+    socket and an acceptor alive across the rest of the session — later
+    tests then flake on address reuse or cross-talk, far from the guilty
+    test.  Checked once at session teardown so the failure names the
+    leak class loudly instead of surfacing as unrelated noise.
+    (``msbfs-dispatch`` watchdog workers are excluded: the supervisor
+    parks one per abandoned hung dispatch by design — PR 1's watchdog
+    semantics — and they are daemon threads with no external state.)"""
+    yield
+    import threading as _threading
+    import time as _time
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve import (  # noqa: E501
+        server as _server,
+    )
+
+    # A test that stopped its daemon microseconds ago may still have the
+    # acceptor mid-exit; give shutdown a short grace before judging.
+    deadline = _time.time() + 5.0
+    leak_threads = []
+    while _time.time() < deadline:
+        leak_threads = [
+            t.name
+            for t in _threading.enumerate()
+            if t.is_alive()
+            and t.name.startswith(("msbfs-accept", "msbfs-batcher",
+                                   "msbfs-conn"))
+        ]
+        if not leak_threads and not _server._LIVE_SERVERS:
+            break
+        _time.sleep(0.1)
+    problems = []
+    live = [s.listen for s in _server._LIVE_SERVERS]
+    if live:
+        problems.append(f"servers never stopped: {sorted(live)}")
+    if _server._BOUND_PATHS:
+        problems.append(
+            f"unix sockets still bound: {sorted(_server._BOUND_PATHS)}"
+        )
+    if leak_threads:
+        problems.append(f"server threads still running: {sorted(leak_threads)}")
+    assert not problems, (
+        "serving-daemon state leaked past session teardown — some test "
+        "started a server it never stopped: " + "; ".join(problems)
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _drop_cpu_programs_between_modules():
     """XLA:CPU's JIT segfaults compiling yet another mesh-engine program

@@ -1,0 +1,565 @@
+"""Crash-safe serving lifecycle (docs/SERVING.md "Crash recovery &
+probes"): the state journal (append/replay/torn tail/compaction),
+kill-and-restart registry restore with bucket re-warm, graceful drain
+with in-flight completion, the health verb, poison-query quarantine
+with bit-identical survivors, client deadline shedding, typed transport
+errors, reconnect-with-backoff, hedged queries, and stale-socket
+reclaim.  Mostly in-process servers on real unix sockets; one
+subprocess test drives the real thing — an injected ``crash`` fault
+(os._exit mid-dispatch, SIGKILL semantics), a journal-replay restart
+over the stale socket, and a SIGTERM drain to exit 0.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from virtual_cpu import virtual_cpu_env  # noqa: E402
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (  # noqa: E402
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (  # noqa: E402
+    MsbfsError,
+    PoisonQueryError,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (  # noqa: E402
+    MsbfsClient,
+    ServerError,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.journal import (  # noqa: E402
+    StateJournal,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.lifecycle import (  # noqa: E402
+    probe_socket,
+    reclaim_stale_socket,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (  # noqa: E402
+    MsbfsServer,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils import (  # noqa: E402
+    faults,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (  # noqa: E402
+    save_graph_bin,
+)
+
+
+# ---------------------------------------------------------------------------
+# Journal units (no server, no device)
+# ---------------------------------------------------------------------------
+
+
+def test_journal_replay_reconciles_and_survives_torn_tail(tmp_path):
+    j = StateJournal(str(tmp_path / "state.journal"))
+    assert j.replay().graphs == {}  # first boot: no file, empty state
+    j.append({"op": "load", "name": "g", "path": "/p", "hash": "aaa"})
+    j.append({"op": "warm", "name": "g", "hash": "aaa", "k_exec": 4,
+              "s_pad": 2})
+    j.append({"op": "warm", "name": "g", "hash": "aaa", "k_exec": 8,
+              "s_pad": 2})
+    # Reload with new content strands the old hash's warm records.
+    j.append({"op": "reload", "name": "g", "path": "/p", "hash": "bbb"})
+    j.append({"op": "warm", "name": "g", "hash": "bbb", "k_exec": 4,
+              "s_pad": 2})
+    state = j.replay()
+    assert state.graphs == {"g": ("/p", "bbb")}
+    assert state.warm == {("g", "bbb", 4, 2)}
+    assert state.replayed == 5 and state.dropped == 0
+    # A crash mid-append leaves a torn final line: dropped, not fatal.
+    with open(j.path, "a", encoding="utf-8") as f:
+        f.write('{"op":"warm","name"')
+    torn = j.replay()
+    assert torn.graphs == state.graphs and torn.warm == state.warm
+    assert torn.dropped == 1
+    # Compaction folds history down to the reconciled state, atomically.
+    j.compact(torn)
+    compacted = j.replay()
+    assert compacted.graphs == state.graphs
+    assert compacted.warm == state.warm
+    assert compacted.replayed == 2 and compacted.dropped == 0
+
+
+def test_journal_drops_malformed_and_stale_records(tmp_path, capsys):
+    j = StateJournal(str(tmp_path / "state.journal"))
+    j.append({"op": "load", "name": "g", "path": "/p", "hash": "aaa"})
+    with open(j.path, "a", encoding="utf-8") as f:
+        f.write("not json at all\n")
+        f.write('{"op": "fly"}\n')  # unknown op
+    # Warm for a graph that was never registered, and for a stale hash.
+    j.append({"op": "warm", "name": "ghost", "hash": "x", "k_exec": 4,
+              "s_pad": 2})
+    j.append({"op": "warm", "name": "g", "hash": "OLD", "k_exec": 4,
+              "s_pad": 2})
+    state = j.replay()
+    assert state.graphs == {"g": ("/p", "aaa")}
+    assert state.warm == set()
+    assert state.dropped == 4 and state.replayed == 1
+    assert "skipping" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# In-process servers
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def graph_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("lifecycle_graphs")
+    n, edges = generators.gnm_edges(120, 360, seed=5)
+    path = str(d / "g.bin")
+    save_graph_bin(path, n, edges)
+    return n, path
+
+
+def _start_server(tmp_path, graph_path, **kwargs):
+    sock = str(tmp_path / f"s{len(os.listdir(tmp_path))}.sock")
+    srv = MsbfsServer(
+        listen=f"unix:{sock}",
+        graphs={"default": graph_path} if graph_path else {},
+        window_s=0.0,
+        request_timeout_s=60.0,
+        **kwargs,
+    )
+    srv.start()
+    return srv, f"unix:{sock}"
+
+
+@pytest.fixture()
+def server(graph_file, tmp_path, monkeypatch):
+    _, path = graph_file
+    monkeypatch.setenv("MSBFS_RETRIES", "0")
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    srv, addr = _start_server(tmp_path, path)
+    yield srv, addr
+    faults.activate(None)
+    srv.stop()
+
+
+def test_health_verb_reports_readiness(server):
+    srv, addr = server
+    with MsbfsClient(addr) as c:
+        h = c.health()
+        assert h["ready"] is True and h["draining"] is False
+        assert h["pid"] == os.getpid()
+        assert h["graphs"] == ["default"] and h["graphs_warm"] == 1
+        assert h["warm_buckets"] == 0  # nothing dispatched yet
+        assert h["last_batch_age_s"] is None
+        assert h["journal"]["path"] is None  # fixture runs journal-less
+        c.query([[1, 2], [3, 4]])
+        h2 = c.health()
+        assert h2["warm_buckets"] == 1
+        assert isinstance(h2["last_batch_age_s"], float)
+        # ping is the bare liveness check and now names the pid too.
+        assert c.call({"op": "ping"})["pid"] == os.getpid()
+
+
+def test_restart_with_journal_restores_registry_and_rewarns(
+    graph_file, tmp_path, monkeypatch
+):
+    """The in-process half of acceptance (a): server A journals its
+    registrations and warm buckets; a fresh server B pointed at the same
+    journal restores the graph WITHOUT any client load and answers the
+    same-bucket query without compiling.  (The real-SIGKILL version of
+    this runs in the subprocess test below; A.stop() never touches the
+    journal, so from the journal's point of view stop IS a crash.)"""
+    _, path = graph_file
+    monkeypatch.setenv("MSBFS_RETRIES", "0")
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    journal = str(tmp_path / "state.journal")
+    srv_a, addr_a = _start_server(tmp_path, path, journal_path=journal)
+    try:
+        with MsbfsClient(addr_a) as c:
+            r = c.query([[1, 2], [3, 4]])
+            assert r["compiled"] is True  # cold bucket, journaled warm
+            f_before = r["f_values"]
+    finally:
+        srv_a.stop()
+    srv_b, addr_b = _start_server(tmp_path, None, journal_path=journal)
+    try:
+        assert srv_b._ready.wait(120), "journal replay never finished"
+        with MsbfsClient(addr_b) as c:
+            h = c.health()
+            assert h["ready"] and h["graphs"] == ["default"]
+            assert h["warm_buckets"] == 1  # re-warmed from the journal
+            assert h["journal"]["replayed"] >= 2  # load + warm records
+            r = c.query([[1, 2], [3, 4]])  # NO load verb issued
+            assert r["compiled"] is False  # the re-warm paid the compile
+            assert r["f_values"] == f_before
+    finally:
+        srv_b.stop()
+    # Replay compacts: the journal now holds exactly the live state.
+    state = StateJournal(journal).replay()
+    assert sorted(state.graphs) == ["default"]
+    assert len(state.warm) == 1
+
+
+def test_graceful_drain_completes_inflight_and_refuses_new(
+    server, graph_file
+):
+    """Acceptance (b), in-process: with a request admitted and held, a
+    drain finishes it successfully, refuses new stateful work typed, and
+    stops the daemon; ping keeps answering throughout."""
+    srv, addr = server
+    srv.batcher.hold()
+    outcome = {}
+
+    def inflight():
+        try:
+            with MsbfsClient(addr) as c:
+                outcome["result"] = c.query([[5, 6], [7, 8]])
+        except BaseException as exc:  # noqa: BLE001
+            outcome["error"] = exc
+
+    t = threading.Thread(target=inflight)
+    t.start()
+    deadline = time.time() + 10
+    while srv.batcher.depth() < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    assert srv.batcher.depth() == 1
+    late = MsbfsClient(addr)  # connected before the listener closes
+    try:
+        srv.request_drain()
+        assert srv.draining
+        assert late.ping()  # liveness stays up while draining
+        with pytest.raises(ServerError, match="draining") as exc:
+            late.query([[9, 10]])
+        assert exc.value.type_name == "TransientError"
+        assert srv.drain(deadline_s=60) is True
+    finally:
+        late.close()
+    t.join(30)
+    assert "error" not in outcome, outcome.get("error")
+    assert outcome["result"]["ok"] is True  # in-flight work completed
+    assert srv.stopping
+    with pytest.raises(OSError):
+        MsbfsClient(addr)  # listener is gone
+
+
+def test_quarantine_isolates_poisoned_row_bit_identical(
+    graph_file, tmp_path, monkeypatch
+):
+    """Acceptance (c): three requests coalesce into one batch whose
+    dispatch fails on a data-dependent poison fault; bisection fails
+    ONLY the poisoned request with the typed PoisonQueryError (exit 8)
+    while both survivors get results bit-identical to a clean run."""
+    _, path = graph_file
+    monkeypatch.setenv("MSBFS_RETRIES", "0")
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    # Result cache OFF: the clean verification queries must re-dispatch,
+    # not echo the quarantine run's entries back at us.
+    srv, addr = _start_server(tmp_path, path, result_cache_size=0)
+    try:
+        qa = [[1, 2], [3, 4]]
+        qb = [[7, 5]]  # the poisoned row: contains vertex 7
+        qc = [[9, 10], [11, 3]]
+        srv.batcher.hold()
+        results, errors = {}, {}
+
+        def go(tag, q):
+            try:
+                with MsbfsClient(addr) as c:
+                    results[tag] = c.query(q)
+            except ServerError as exc:
+                errors[tag] = exc
+
+        threads = [
+            threading.Thread(target=go, args=(tag, q))
+            for tag, q in (("a", qa), ("b", qb), ("c", qc))
+        ]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 10
+        while srv.batcher.depth() < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert srv.batcher.depth() == 3
+        faults.activate(faults.FaultPlan.parse("poison:vertex7:1"))
+        srv.batcher.release()
+        for t in threads:
+            t.join(60)
+        faults.activate(None)
+        # Exactly the poisoned request failed, typed.
+        assert set(errors) == {"b"}
+        assert errors["b"].type_name == "PoisonQueryError"
+        assert errors["b"].exit_code == PoisonQueryError.exit_code == 8
+        assert "quarantined" in str(errors["b"])
+        # Survivors answered from the SAME poisoned batch...
+        assert results["a"]["ok"] and results["c"]["ok"]
+        # ...bit-identical to a clean run of the same queries.
+        with MsbfsClient(addr) as c:
+            assert c.query(qa)["f_values"] == results["a"]["f_values"]
+            assert c.query(qc)["f_values"] == results["c"]["f_values"]
+            stats = c.stats()
+        assert stats["requests_quarantined"] == 1
+        assert stats["requests_failed"] == 1
+    finally:
+        faults.activate(None)
+        srv.stop()
+
+
+def test_single_poisoned_request_fails_typed_daemon_survives(
+    server, graph_file
+):
+    """A poison fault on a batch of ONE has nothing to bisect: the
+    request fails with the classified error (unrecoverable MsbfsError,
+    exit 6) and the daemon keeps serving."""
+    srv, addr = server
+    with MsbfsClient(addr) as c:
+        assert c.query([[1, 2]])["ok"]  # warm the bucket fault-free
+        faults.activate(faults.FaultPlan.parse("poison:vertex7:1"))
+        with pytest.raises(ServerError, match="poison") as exc:
+            c.query([[7, 5]])
+        assert exc.value.type_name == "MsbfsError"
+        assert exc.value.exit_code == MsbfsError.exit_code == 6
+        faults.activate(None)
+        assert c.query([[1, 2], [3, 4]])["ok"]  # daemon alive and well
+
+
+def test_expired_deadline_sheds_request_before_dispatch(server):
+    srv, addr = server
+    srv.batcher.hold()
+    outcome = {}
+
+    def go():
+        try:
+            with MsbfsClient(addr) as c:
+                outcome["result"] = c.query([[1, 2]], deadline_s=0.15)
+        except ServerError as exc:
+            outcome["error"] = exc
+
+    t = threading.Thread(target=go)
+    t.start()
+    deadline = time.time() + 10
+    while srv.batcher.depth() < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.3)  # the client's 150 ms budget expires in the queue
+    srv.batcher.release()
+    t.join(30)
+    assert "result" not in outcome
+    assert outcome["error"].type_name == "TransientError"
+    assert "shed" in str(outcome["error"])
+    with MsbfsClient(addr) as c:
+        assert c.stats()["requests_shed"] == 1
+
+
+def test_client_wraps_transport_errors_typed(tmp_path):
+    """Satellite: a dead connection surfaces as the typed ServerError
+    (TransientError, exit 5), never a raw socket exception — for both
+    the no-retry (non-idempotent) and retry-then-fail paths."""
+    path = str(tmp_path / "dead.sock")
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(path)
+    listener.listen(1)
+    killer = threading.Thread(
+        target=lambda: listener.accept()[0].close(), daemon=True
+    )
+    killer.start()
+    client = MsbfsClient(f"unix:{path}")
+    try:
+        # reload is non-idempotent: wrapped immediately, no reconnect.
+        with pytest.raises(ServerError) as exc:
+            client.reload()
+        assert exc.value.type_name == "TransientError"
+        assert exc.value.exit_code == 5
+        listener.close()
+        os.unlink(path)
+        # ping IS idempotent: reconnects per the backoff schedule, every
+        # attempt refused, still ends in the same typed wrapper.
+        with pytest.raises(ServerError) as exc:
+            client.ping()
+        assert exc.value.type_name == "TransientError"
+        assert exc.value.exit_code == 5
+    finally:
+        client.close()
+        killer.join(5)
+
+
+def test_client_reconnects_after_connection_drop(server):
+    srv, addr = server
+    with MsbfsClient(addr) as c:
+        assert c.ping()
+        c._sock.close()  # simulate the connection dying under us
+        assert c.ping()  # idempotent verb reconnects transparently
+        r1 = c.query([[1, 2], [3, 4]])
+        c._sock.close()
+        r2 = c.query([[1, 2], [3, 4]])  # reconnect + result-cache hit
+        assert r2["f_values"] == r1["f_values"] and r2["cached"]
+
+
+def test_hedged_query_returns_one_result_and_keeps_socket_sane(server):
+    srv, addr = server
+    with MsbfsClient(addr) as c:
+        slow = c.query([[1, 2], [3, 4]], hedge_after_s=30.0)
+        assert slow["hedged"] is False  # primary answered well inside 30s
+        fast = c.query([[5, 6], [7, 8]], hedge_after_s=0.0)
+        assert fast["ok"] and isinstance(fast["hedged"], bool)
+        # Whoever won, the client's frame stream stays request/response
+        # aligned for subsequent calls.
+        assert c.ping()
+        again = c.query([[5, 6], [7, 8]])
+        assert again["f_values"] == fast["f_values"]
+
+
+def test_stale_socket_reclaimed_and_live_socket_refused(
+    graph_file, tmp_path, monkeypatch
+):
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    _, path = graph_file
+    stale = str(tmp_path / "stale.sock")
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.bind(stale)
+    s.close()  # the bound file outlives the (dead) owner: a crash relic
+    assert os.path.exists(stale)
+    assert probe_socket(stale) is None
+    reclaim_stale_socket(f"unix:{stale}")
+    assert not os.path.exists(stale)  # reclaimed
+    # A server happily starts over the previously-stale path...
+    srv, addr = _start_server(tmp_path, None)
+    try:
+        live_path = addr[len("unix:"):]
+        assert probe_socket(live_path) == os.getpid()
+        # ...and a second daemon on the LIVE path is refused, typed,
+        # naming the owner.
+        rival = MsbfsServer(listen=addr)
+        with pytest.raises(MsbfsError, match="already running") as exc:
+            rival.start()
+        assert str(os.getpid()) in str(exc.value)
+        assert exc.value.exit_code == 1  # InputError: operator mistake
+        # The refusal must not have disturbed the live daemon.
+        with MsbfsClient(addr) as c:
+            assert c.ping()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# The real thing: one daemon process crashed, restarted, drained
+# ---------------------------------------------------------------------------
+
+
+def _wait_for_daemon(addr, proc, log_path, timeout_s=240, want_ready=False):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            with open(log_path) as f:
+                pytest.fail(
+                    f"daemon exited rc={proc.returncode} during startup:\n"
+                    f"{f.read()[-3000:]}"
+                )
+        try:
+            with MsbfsClient(addr, timeout=10) as c:
+                if not want_ready:
+                    if c.ping():
+                        return
+                elif c.health().get("ready"):
+                    return
+        except (ServerError, OSError, ValueError):
+            pass
+        time.sleep(0.3)
+    proc.kill()
+    with open(log_path) as f:
+        pytest.fail(f"daemon never came up:\n{f.read()[-3000:]}")
+
+
+def test_crash_restart_replay_and_sigterm_drain_subprocess(tmp_path):
+    """Acceptance (a) and (b) against real processes: daemon 1 dies on
+    an injected ``crash`` fault mid-dispatch (os._exit(137) — SIGKILL
+    semantics, no cleanup); daemon 2 starts over the stale socket it
+    left behind, replays the journal (registry + warm bucket restored,
+    no client load), and answers the same query without compiling; a
+    SIGTERM with an admitted in-flight request then completes that
+    request and exits 0."""
+    n, edges = generators.gnm_edges(80, 240, seed=21)
+    gpath = str(tmp_path / "g.bin")
+    save_graph_bin(gpath, n, edges)
+    sock = str(tmp_path / "d.sock")
+    addr = f"unix:{sock}"
+    journal = str(tmp_path / "state.journal")
+    base_env = virtual_cpu_env(4)
+    base_env["MSBFS_RETRIES"] = "0"
+    base_cmd = [
+        sys.executable, "main.py", "serve", "--listen", addr,
+        "--journal", journal, "--drain-s", "30",
+    ]
+
+    # --- phase 1: crash mid-dispatch (dispatch 1 = warm compile, which
+    # journals the bucket; dispatch 2 = the query's execution).
+    env1 = dict(base_env)
+    env1["MSBFS_FAULTS"] = "crash:dispatch:2"
+    log1 = str(tmp_path / "d1.log")
+    with open(log1, "w") as lf:
+        p1 = subprocess.Popen(
+            base_cmd + ["-g", gpath], env=env1, cwd=REPO,
+            stdout=lf, stderr=lf,
+        )
+    try:
+        _wait_for_daemon(addr, p1, log1)
+        with pytest.raises((ServerError, OSError)):
+            # Generous socket timeout: the dispatch that crashes sits
+            # behind the bucket's cold compile.
+            with MsbfsClient(addr, timeout=180) as c:
+                c.query([[1, 2], [3, 4]])
+        assert p1.wait(timeout=60) == 137  # os._exit(137): kill -9 shape
+    finally:
+        if p1.poll() is None:
+            p1.kill()
+    assert os.path.exists(sock)  # the crash left its socket behind
+    state = StateJournal(journal).replay()
+    assert "default" in state.graphs and len(state.warm) == 1
+
+    # --- phase 2: restart on the same socket + journal; NO -g flag and
+    # no client load — the journal alone must restore serving state.
+    env2 = dict(base_env)
+    env2.pop("MSBFS_FAULTS", None)
+    log2 = str(tmp_path / "d2.log")
+    with open(log2, "w") as lf:
+        p2 = subprocess.Popen(
+            base_cmd + ["--window-ms", "700"], env=env2, cwd=REPO,
+            stdout=lf, stderr=lf,
+        )
+    try:
+        _wait_for_daemon(addr, p2, log2, want_ready=True)
+        with MsbfsClient(addr, timeout=60) as c:
+            h = c.health()
+            assert h["graphs"] == ["default"]
+            assert h["warm_buckets"] == 1
+            r = c.query([[1, 2], [3, 4]])
+            assert r["ok"] and r["compiled"] is False  # journal re-warm
+
+        # --- phase 3: SIGTERM with an admitted in-flight request; the
+        # 700 ms coalescing window guarantees a visible in-flight phase.
+        outcome = {}
+
+        def inflight():
+            try:
+                with MsbfsClient(addr, timeout=60) as c2:
+                    outcome["result"] = c2.query([[5, 6], [7, 8]])
+            except BaseException as exc:  # noqa: BLE001
+                outcome["error"] = exc
+
+        t = threading.Thread(target=inflight)
+        t.start()
+        with MsbfsClient(addr, timeout=60) as c3:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if c3.stats()["requests_total"] >= 2:
+                    break  # the in-flight query is admitted
+                time.sleep(0.02)
+        p2.send_signal(signal.SIGTERM)
+        t.join(120)
+        assert "error" not in outcome, outcome.get("error")
+        assert outcome["result"]["ok"] is True  # drained, not dropped
+        assert p2.wait(timeout=120) == 0  # graceful drain exits 0
+    finally:
+        if p2.poll() is None:
+            p2.kill()
+    assert not os.path.exists(sock)  # clean exit removed its socket

@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve lifecycle
+.PHONY: native clean test resilience serve lifecycle perf-smoke
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -30,5 +30,12 @@ serve: native
 lifecycle: native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_lifecycle.py -x -q -m "not slow"
 
-test: native resilience serve lifecycle
+# Dispatch-budget regression guard (docs/PERF_NOTES.md "Dispatch diet"):
+# scaled-down configs 1 and 4 at K=16 on CPU; asserts megachunk fusion
+# keeps >= 2x dispatch reduction and pinned absolute budgets hold.
+# Dispatch counts are platform-independent, so this pins the TPU cadence.
+perf-smoke:
+	JAX_PLATFORMS=cpu python benchmarks/perf_smoke.py
+
+test: native resilience serve lifecycle perf-smoke
 	python -m pytest tests/ -x -q

@@ -28,7 +28,9 @@ parsable record; it never inherits a silent hang.
 
 Env knobs: BENCH_SCALE (default 20), BENCH_EDGE_FACTOR (16), BENCH_K (64),
 BENCH_CHUNK (8), BENCH_REPEATS (3), BENCH_MAX_S (64),
-BENCH_ENGINE (bitbell|bell|packed|vmap|dense|pallas|push, default bitbell),
+BENCH_ENGINE (bitbell|bell|packed|vmap|dense|pallas|push|stencil|streamed,
+default bitbell; "streamed" is the round-6 host-resident double-buffered
+over-HBM route, ops.streamed),
 BENCH_EDGE_CHUNKS (packed engine HBM knob, default 1),
 BENCH_SPARSE (bitbell hybrid budget; empty=auto, 0=pure pull, no dedup CSR),
 BENCH_LEVEL_CHUNK (bitbell levels per dispatch; empty=unchunked, "auto"=the
@@ -39,7 +41,7 @@ detail.extra_metrics, default "256" — the engine's throughput sweet spot,
 BASELINE.md; empty disables), BENCH_WAIT_S (device-probe budget, default
 420), BENCH_RUN_S (workload hard deadline, default 1500),
 BENCH_GRAPH (rmat|road — road builds the config-4 grid at side 2^(scale/2)),
-BENCH_CONFIGS (comma list of BASELINE config ids, DEFAULT "2,2c,4,1": sweep
+BENCH_CONFIGS (comma list of BASELINE config ids, DEFAULT "2,2c,4,1,5": sweep
 mode — each config runs in its own deadline-bounded child and gets its own
 value/error in detail.sweep; the cumulative record re-emits after every
 config so a partial outage cannot zero what was already measured; the
@@ -172,6 +174,23 @@ def _bench_level_chunk(auto_value: int):
     return auto_value
 
 
+def _bench_megachunk():
+    """Mirror of the CLI's round-6 megachunk policy for the bench child:
+    an explicit positive BENCH_LEVEL_CHUNK is a deliberate per-dispatch
+    bound and is honored exactly (megachunk=1); empty/"auto"/fallback
+    bounds may be megachunk-fused (None -> the engine resolves
+    MSBFS_MEGACHUNK / the auto factor, ops.bitbell.resolve_megachunk) —
+    the benched row must pay exactly the dispatch cadence the product
+    pays."""
+    chunk_env = os.environ.get("BENCH_LEVEL_CHUNK", "")
+    if not chunk_env or chunk_env == "auto":
+        return None
+    try:
+        return 1 if int(chunk_env) > 0 else None
+    except ValueError:
+        return None
+
+
 def run_workload() -> None:
     """The actual benchmark (child process; assumes a live backend)."""
     scale = _env_int("BENCH_SCALE", 20)
@@ -208,6 +227,10 @@ def run_workload() -> None:
     )
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
         pad_queries,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (
+        dispatch_count,
+        reset_dispatch_count,
     )
 
     t0 = time.perf_counter()
@@ -273,10 +296,27 @@ def run_workload() -> None:
             level_chunk = _bench_level_chunk(AUTO_STENCIL_LEVEL_CHUNK)
             try:
                 return StencilEngine(
-                    StencilGraph.from_host(g), level_chunk=level_chunk
+                    StencilGraph.from_host(g),
+                    level_chunk=level_chunk,
+                    megachunk=_bench_megachunk(),
                 )
             except ValueError as e:
                 sys.exit(f"BENCH_ENGINE=stencil: {e}")
+        if engine_kind == "streamed":
+            from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+                BellGraph,
+            )
+            from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.streamed import (
+                StreamedBitBellEngine,
+            )
+
+            # Host-resident forest (device=False) + double-buffered level
+            # streaming: the over-HBM route (RMAT-25-class).  Slot budget
+            # and prefetch depth ride the product env knobs
+            # (MSBFS_SLOT_BUDGET / MSBFS_STREAM_PREFETCH).
+            return StreamedBitBellEngine(
+                BellGraph.from_host(g, keep_sparse=False, device=False)
+            )
         if engine_kind == "bitbell":
             from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
                 BellGraph,
@@ -303,6 +343,7 @@ def run_workload() -> None:
                 BellGraph.from_host(g, keep_sparse=sparse_budget != 0),
                 sparse_budget=sparse_budget,
                 level_chunk=level_chunk,
+                megachunk=_bench_megachunk(),
             )
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
             PackedEngine,
@@ -327,15 +368,42 @@ def run_workload() -> None:
         engine.compile(queries.shape)  # compile outside the timed span
         compile_s = time.perf_counter() - t0
         times = []
+        dispatches = None
         for _ in range(repeats):
+            # MEASURED dispatch count (round 6): every host-blocking
+            # commit in the timed span rides utils.timing.record_dispatch,
+            # so this is the ground truth the n_dispatches estimate below
+            # is checked against (and what benchmarks/perf_smoke.py
+            # budgets).  Reset per repeat; repeats are identical programs,
+            # so the last repeat's count is THE count.
+            reset_dispatch_count()
             t0 = time.perf_counter()
             min_f, min_k = engine.best(queries)
             times.append(time.perf_counter() - t0)
+            dispatches = dispatch_count()
         best_s = min(times)
         teps = num_queries * e_directed / best_s
-        return teps, best_s, times, compile_s, int(min_f), int(min_k), queries
+        return (
+            teps,
+            best_s,
+            times,
+            compile_s,
+            int(min_f),
+            int(min_k),
+            queries,
+            dispatches,
+        )
 
-    teps, best_s, times, compile_s, min_f, min_k, queries = measure(k)
+    (
+        teps,
+        best_s,
+        times,
+        compile_s,
+        min_f,
+        min_k,
+        queries,
+        measured_dispatches,
+    ) = measure(k)
 
     # --- Untimed diagnostics for the model/utilization fields ------------
     # Per-query level counts drive the per-config reference model; one
@@ -390,9 +458,16 @@ def run_workload() -> None:
     # init and select_best dispatches are gone.  An estimate from the
     # level counts; other engines report only the floor.
     n_dispatches = None
-    if engine_kind in ("bitbell", "stencil") and levels_max is not None:
+    if (
+        engine_kind in ("bitbell", "stencil", "streamed")
+        and levels_max is not None
+    ):
         lc = getattr(engine, "level_chunk", None)
-        n_dispatches = 1 if not lc else -(-max(levels_max, 1) // lc)
+        # Megachunk fusion (round 6) multiplies the levels per dispatch:
+        # the driver still hands the while_loop a chunk-sized bound, but
+        # megachunk of them run back-to-back inside ONE program.
+        mc = getattr(engine, "megachunk", 1) or 1
+        n_dispatches = 1 if not lc else -(-max(levels_max, 1) // (lc * mc))
 
     # Gather-rows utilization (VERDICT r4 item 6): rows the reduction
     # forest gathers per second, against the measured v5e ceiling.  An
@@ -401,16 +476,31 @@ def run_workload() -> None:
     rows_per_s = pct_of_roofline = None
     stream_bytes_per_s = pct_of_hbm = None
     g_dev = getattr(engine, "graph", None)
-    if (
-        levels_max is not None
-        and g_dev is not None
-        and hasattr(g_dev, "level_cols")
-    ):
+    slots_total = None
+    if g_dev is not None and hasattr(g_dev, "level_cols"):
         slots_total = sum(int(f.shape[-1]) for f in g_dev.level_cols) + int(
             g_dev.final_slot.shape[0]
         )
+    elif hasattr(engine, "slots_total"):
+        # The streamed engine snapshots the forest host-side; it exposes
+        # the same slot totals the device-resident BellGraph would.
+        slots_total = int(engine.slots_total) + int(
+            engine.final_slot.shape[0]
+        )
+    if levels_max is not None and slots_total is not None:
         rows_per_s = round(levels_max * slots_total / best_s)
         pct_of_roofline = round(rows_per_s / ROOFLINE_ROWS_PER_S, 4)
+        # Round 6: the forest traversal stated as an HBM/PCIe stream —
+        # per level, every slot moves one int32 index plus W gathered
+        # plane words, and ~6 plane-sized carries (visited/new/counts
+        # plumbing) stream besides.  For the host-streamed engine this
+        # models the host->device upload the double-buffer must hide, so
+        # pct_of_hbm_roofline reads as "fraction of the interconnect the
+        # pipeline sustains" for the RMAT-25-class rows.
+        w_words = -(-k // 32)
+        per_level = slots_total * (4 + 4 * w_words) + 6 * n * w_words * 4
+        stream_bytes_per_s = round(levels_max * per_level / best_s)
+        pct_of_hbm = round(stream_bytes_per_s / HBM_BYTES_PER_S, 4)
     elif (
         levels_max is not None
         and engine_kind == "stencil"
@@ -473,6 +563,10 @@ def run_workload() -> None:
                 "dispatch": {
                     "floor_s": round(dispatch_floor_s, 6),
                     "n_dispatches": n_dispatches,
+                    # Ground truth from utils.timing.record_dispatch: the
+                    # host-blocking commits one timed best() actually paid
+                    # (n_dispatches above stays the level-count MODEL).
+                    "measured_count": measured_dispatches,
                     "floor_total_s": floor_total,
                     # Lower bound: the floor is a SERIALIZED no-op
                     # round-trip median, while a real run's dispatches can
@@ -509,7 +603,7 @@ def run_workload() -> None:
     for xk in extra_ks:
         if xk == k:
             continue
-        x_teps, x_best, _, x_compile, _, _, _ = measure(xk)
+        x_teps, x_best, _, x_compile, _, _, _, x_dispatches = measure(xk)
         extra_metrics.append(
             {
                 "metric": _metric_name(xk, scale, graph_kind),
@@ -519,6 +613,7 @@ def run_workload() -> None:
                 "vs_flat_1g5": round(x_teps / ESTIMATED_REFERENCE_TEPS, 4),
                 "computation_s": round(x_best, 6),
                 "compile_s": round(x_compile, 3),
+                "dispatch_count": x_dispatches,
             }
         )
     if extra_metrics:
@@ -557,6 +652,22 @@ CONFIG_PRESETS = {
     "4g": {"BENCH_GRAPH": "road", "BENCH_ENGINE": "bitbell",
            "BENCH_SCALE": "20", "BENCH_K": "16", "BENCH_MAX_S": "8",
            "BENCH_LEVEL_CHUNK": "auto", "BENCH_EXTRA_KS": ""},
+    # Config 5 (round 6): the over-HBM frontier — RMAT-25 through the
+    # host-streamed double-buffered engine (ops.streamed; forest stays
+    # host-resident, levels prefetch via jax.device_put while the device
+    # computes).  The row's stream_bytes_per_s / pct_of_hbm_roofline
+    # state how much of the interconnect the pipeline sustains.
+    "5": {"BENCH_GRAPH": "rmat", "BENCH_ENGINE": "streamed",
+          "BENCH_SCALE": "25", "BENCH_K": "64", "BENCH_SPARSE": "0",
+          "MSBFS_SLOT_BUDGET": "33554432", "BENCH_REPEATS": "1",
+          "BENCH_EXTRA_KS": ""},
+    # 5g: the certified round-5 gather route for the same workload
+    # (device-resident slot-budget-segmented bitbell, BENCH_LEVEL_CHUNK=2
+    # — the 0.56 GTEPS row), kept for the streamed-vs-resident shootout.
+    "5g": {"BENCH_GRAPH": "rmat", "BENCH_ENGINE": "bitbell",
+           "BENCH_SCALE": "25", "BENCH_K": "64", "BENCH_SPARSE": "0",
+           "BENCH_LEVEL_CHUNK": "2", "MSBFS_SLOT_BUDGET": "33554432",
+           "BENCH_REPEATS": "1", "BENCH_EXTRA_KS": ""},
 }
 
 
@@ -730,7 +841,7 @@ def main() -> int:
     # (all the BENCH_* knobs below then apply directly).
     configs = [
         c.strip()
-        for c in os.environ.get("BENCH_CONFIGS", "2,2c,4,1").split(",")
+        for c in os.environ.get("BENCH_CONFIGS", "2,2c,4,1,5").split(",")
         if c.strip()
     ]
     if configs:

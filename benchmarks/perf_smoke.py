@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Dispatch-budget regression guard (round 6) — fast enough for `make test`.
+
+The v5e "axon tunnel" on this platform charges ~100 ms per host-blocking
+dispatch, so the number of dispatches IS the latency model for the
+latency-bound configs (BASELINE.md configs 1 and 4; docs/PERF_NOTES.md
+"Dispatch diet").  This smoke replays scaled-down config-1 (RMAT / bitbell)
+and config-4 (road grid / stencil) workloads at K=16 on the CPU backend —
+dispatch COUNTS are platform-independent, so a CPU run pins the TPU
+cadence — and asserts, per workload:
+
+  1. megachunk fusion (ops.bitbell.resolve_megachunk) cuts the chunked
+     level loop's dispatch count by >= 2x vs the same bound unfused, and
+  2. the fused count stays at/below a pinned absolute budget,
+
+using the ground-truth counter every blocking commit rides
+(utils.timing.record_dispatch).  A refactor that quietly re-introduces
+per-level host syncs — an eager scalar in the drive loop, a lost
+status-packing fetch, a dropped megachunk resolve — fails this guard
+long before a TPU session re-measures the rows.
+
+Exit 0 on pass; exits 1 with a per-workload report on any violation.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (  # noqa: E402
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (  # noqa: E402
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (  # noqa: E402
+    CSRGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (  # noqa: E402
+    BitBellEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (  # noqa: E402
+    StencilEngine,
+    StencilGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (  # noqa: E402
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (  # noqa: E402
+    dispatch_count,
+    reset_dispatch_count,
+)
+
+K = 16  # both guarded configs run K=16 (config 4's preset; config 1 scaled)
+
+# Absolute budgets for the FUSED (product-default) route, in blocking
+# dispatches per best() call: ceil(levels / (level_chunk * megachunk))
+# chunk commits + one convergence-observing commit + one fused-select
+# fetch, with one spare for an extra convergence probe.  These are pins,
+# not aspirations — the measured counts today are well below (see the
+# report this script prints); raise them only with a PERF_NOTES entry
+# explaining which new blocking commit became load-bearing.
+BUDGET = {"config1-rmat-bitbell": 4, "config4-road-stencil": 6}
+
+
+def _count(engine, queries) -> int:
+    engine.compile(queries.shape)  # cold compile must not count
+    reset_dispatch_count()
+    engine.best(queries)
+    return dispatch_count()
+
+
+def run_config1():
+    """Config-1 class: RMAT power-law graph, bitbell gather engine, a
+    deliberately small level bound so the unfused loop pays one dispatch
+    per couple of levels (RMAT-10 runs ~5-7 BFS levels)."""
+    n, edges = generators.rmat_edges(10, edge_factor=8, seed=42)
+    g = BellGraph.from_host(CSRGraph.from_edges(n, edges))
+    queries = pad_queries(
+        generators.random_queries(n, K, max_group=4, seed=43), pad_to=4
+    )
+    unfused = _count(
+        BitBellEngine(g, level_chunk=1, megachunk=1), queries
+    )
+    fused = _count(
+        BitBellEngine(g, level_chunk=1, megachunk=None), queries
+    )
+    return "config1-rmat-bitbell", unfused, fused
+
+
+def run_config4():
+    """Config-4 class: road grid (high diameter — the workload the
+    chunked safety bound exists for), stencil engine."""
+    n, edges = generators.road_edges(48, 48, seed=46)
+    g = StencilGraph.from_host(CSRGraph.from_edges(n, edges))
+    queries = pad_queries(
+        generators.random_queries(n, K, max_group=8, seed=43), pad_to=8
+    )
+    unfused = _count(
+        StencilEngine(g, level_chunk=8, megachunk=1), queries
+    )
+    fused = _count(
+        StencilEngine(g, level_chunk=8, megachunk=None), queries
+    )
+    return "config4-road-stencil", unfused, fused
+
+
+def main() -> int:
+    failures = []
+    for run in (run_config1, run_config4):
+        name, unfused, fused = run()
+        budget = BUDGET[name]
+        ratio = unfused / max(fused, 1)
+        line = (
+            f"{name}: unfused={unfused} fused={fused} "
+            f"reduction={ratio:.1f}x budget<={budget}"
+        )
+        ok = fused * 2 <= unfused and fused <= budget
+        print(("PASS " if ok else "FAIL ") + line)
+        if not ok:
+            failures.append(line)
+    if failures:
+        print(
+            "perf-smoke: dispatch budget regression — see "
+            "docs/PERF_NOTES.md 'Dispatch diet'",
+            file=sys.stderr,
+        )
+        return 1
+    print("perf-smoke: dispatch budgets hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,7 @@ _SINGLE_CHIP_ONLY_BACKENDS = (
     "packed",
     "ppush",
     "stencil",
+    "streamed",
 )
 # Backends whose HBM footprint the bitbell estimate does not model — the
 # single-chip capacity warning stays quiet for these.
@@ -173,6 +174,7 @@ def _bitbell_ladder(graph, level_chunk):
     Factories are lazy: a rung's layout is built only when reached."""
     from .models.bell import BellGraph
     from .ops.bitbell import BitBellEngine
+    from .ops.streamed import StreamedBitBellEngine
 
     rungs = []
     if not level_chunk:
@@ -188,6 +190,21 @@ def _bitbell_ladder(graph, level_chunk):
             BellGraph.from_host(graph, keep_sparse=False),
             sparse_budget=0,
             level_chunk=min(level_chunk or 8, 8),
+            # Deliberate safety bound — never megachunk-multiplied.
+            megachunk=1,
+            slot_budget=(
+                1 << 25 if not os.environ.get("MSBFS_SLOT_BUDGET") else None
+            ),
+        ),
+    ))
+    # Last rung (round 6): the forest never enters HBM at all — host-
+    # resident cols streamed through the device with double-buffered
+    # uploads (ops.streamed).  Slower per level, but survives graphs
+    # whose in-HBM streamed layout still exhausts memory.
+    rungs.append((
+        "host-streamed",
+        lambda: StreamedBitBellEngine(
+            BellGraph.from_host(graph, keep_sparse=False, device=False),
             slot_budget=(
                 1 << 25 if not os.environ.get("MSBFS_SLOT_BUDGET") else None
             ),
@@ -279,7 +296,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .parallel.mesh import make_mesh
     from .utils.io import load_graph_bin, load_query_bin, pad_queries
     from .utils.report import format_report
-    from .utils.timing import Span
+    from .utils.timing import (
+        Span,
+        dispatch_count,
+        record_dispatch,
+        reset_dispatch_count,
+    )
 
     # ---- preprocessing span: load + device placement (+ XLA compile),
     # the analog of main.cu:235-298 (load + MPI broadcast + H2D upload).
@@ -340,6 +362,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         explicit_chunk = _explicit_level_chunk()
         level_chunk = _level_chunk_policy(graph, explicit_chunk)
         road_class = _road_class(graph)
+        # Megachunk policy (round 6): an EXPLICIT MSBFS_LEVEL_CHUNK is a
+        # deliberate per-dispatch bound — honor it exactly (one chunk per
+        # dispatch).  The AUTO bound exists only so no dispatch performs
+        # unbounded work; the fused engines may fold several chunks into
+        # one dispatch with an on-device early exit, amortizing the
+        # ~100 ms tunnel floor (ops.bitbell.resolve_megachunk; None =
+        # auto / MSBFS_MEGACHUNK override).
+        megachunk = (
+            1 if (explicit_chunk is not None and explicit_chunk > 0) else None
+        )
 
         def announce_chunk():
             # Printed ONLY when the selected engine actually applies the
@@ -575,7 +607,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "MSBFS_STENCIL=0 disables)",
                         file=sys.stderr,
                     )
-                    engine = StencilEngine(sg, level_chunk=stencil_chunk)
+                    engine = StencilEngine(
+                        sg, level_chunk=stencil_chunk, megachunk=megachunk
+                    )
             use_dense = backend == "dense"
             if backend == "auto" and is_tpu_backend():
                 threshold = _env_int("MSBFS_DENSE_THRESHOLD", 8192)
@@ -640,6 +674,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                 except ValueError as exc:
                     print(str(exc), file=sys.stderr)
                     return 1
+            elif backend == "streamed":
+                # Host-resident BELL forest, streamed through the device
+                # per BFS level with double-buffered uploads
+                # (ops.streamed).  The forest never occupies HBM — the
+                # opt-in route for graphs beyond even the slot-budget
+                # streamed layout (the auto over-HBM path below reaches
+                # it via the degradation ladder).
+                from .models.bell import BellGraph
+                from .ops.streamed import StreamedBitBellEngine
+
+                engine = StreamedBitBellEngine(
+                    BellGraph.from_host(
+                        graph, keep_sparse=False, device=False
+                    )
+                )
             elif backend == "packed":
                 # Coalesced query-major (n, K) engine over the flat CSR.
                 # MSBFS_EDGE_CHUNKS bounds the per-level (E/chunks, K)
@@ -710,6 +759,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         BellGraph.from_host(graph, keep_sparse=False),
                         sparse_budget=0,
                         level_chunk=streamed_chunk,
+                        # The streamed chunk IS a deliberate safety bound
+                        # (an unchunked wide-plane dispatch crashed the
+                        # worker): never megachunk-multiply it.
+                        megachunk=1,
                         slot_budget=(
                             1 << 25
                             if not os.environ.get("MSBFS_SLOT_BUDGET")
@@ -719,7 +772,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 else:
                     announce_chunk()
                     engine = BitBellEngine(
-                        BellGraph.from_host(graph), level_chunk=level_chunk
+                        BellGraph.from_host(graph),
+                        level_chunk=level_chunk,
+                        megachunk=megachunk,
                     )
                     ladder_rungs = _bitbell_ladder(graph, level_chunk)
 
@@ -796,6 +851,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # failure).  Works with any engine; chunk via MSBFS_CHECKPOINT_CHUNK.
     stats = None
     level_rows = None
+    # The dispatch counter scopes to the computation span: warm-up/compile
+    # dispatches are the preprocessing span's business (utils.timing).
+    reset_dispatch_count()
     try:
         with Span() as comp:
             with profiler_trace():
@@ -845,10 +903,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         from .ops.objective import select_best_jit
                         import jax.numpy as jnp
 
+                        # One device_get for both scalars: sequential
+                        # int() reads each pay their own blocking
+                        # round-trip on this platform.
                         arr = jnp.asarray(f_arr)
-                        min_f, min_k = (
-                            int(x) for x in select_best_jit(arr, arr >= 0)
+                        min_f, min_k = jax.device_get(
+                            select_best_jit(arr, arr >= 0)
                         )
+                        record_dispatch()
+                        min_f, min_k = int(min_f), int(min_k)
                 elif stats_mode and padded.shape[0]:
                     # One BFS pass serves both the report and the stats
                     # table: stats include the F values, so selection
@@ -865,10 +928,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     from .ops.objective import select_best_jit
                     import jax.numpy as jnp
 
+                    # One device_get for both scalars (see the checkpoint
+                    # branch above).
                     f = jnp.asarray(stats[2])
-                    min_f, min_k = (
-                        int(x) for x in select_best_jit(f, f >= 0)
+                    min_f, min_k = jax.device_get(
+                        select_best_jit(f, f >= 0)
                     )
+                    record_dispatch()
+                    min_f, min_k = int(min_f), int(min_k)
                 elif not ckpt_path:
                     min_f, min_k = engine.best(np.asarray(padded))
     except MsbfsError as err:
@@ -878,6 +945,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(format_failure(err, engine.events), file=sys.stderr)
         return err.exit_code
 
+    if stats_mode:
+        # Blocking device commits in the computation span: the dispatch-
+        # floor budget the perf smoke pins (benchmarks/perf_smoke.py).
+        sys.stderr.write(f"dispatch_count: {dispatch_count()}\n")
     if stats is not None:
         # Per-query diagnostics to stderr (stdout stays reference-exact).
         from .utils.trace import format_level_stats, format_query_stats

@@ -285,6 +285,7 @@ class BellGraph:
         dedup: bool = True,
         min_bucket_rows: Optional[int] = None,
         keep_sparse: bool = True,
+        device: bool = True,
     ) -> "BellGraph":
         """Build the layout.  ``dedup`` drops duplicate neighbors and
         self-loops per vertex: the per-level hit is a *set* predicate ("is
@@ -297,7 +298,12 @@ class BellGraph:
 
         ``keep_sparse`` also uploads the dedup CSR itself (int32; skipped
         when E >= 2^31), enabling the hybrid engine's frontier-sparse
-        levels; pass False to save the extra E+2n ints of HBM."""
+        levels; pass False to save the extra E+2n ints of HBM.
+
+        ``device=False`` keeps every array host-side (NumPy, sparse
+        dropped): the layout for the host-streamed engine
+        (ops.streamed), whose forest must NEVER be committed to device
+        memory — it is built precisely because it does not fit there."""
         n = g.n
         e = int(g.num_directed_edges)
 
@@ -318,7 +324,7 @@ class BellGraph:
 
         item_count_0 = item_count
         sparse = None
-        if keep_sparse and n and item_vals.shape[0] < (1 << 31):
+        if device and keep_sparse and n and item_vals.shape[0] < (1 << 31):
             sparse = (
                 jnp.asarray(item_start.astype(np.int32)),
                 jnp.asarray(item_count.astype(np.int32)),
@@ -365,7 +371,11 @@ class BellGraph:
                 )
             walk.append((rows_per_owner, first_row))
             level_rows = sum(r for r, _ in shapes)
-            level_cols.append(jnp.asarray(flat))
+            level_cols.append(
+                jnp.asarray(flat)
+                if device
+                else np.asarray(flat, dtype=np.int32)
+            )
             level_shapes.append(shapes)
             level_sizes.append(level_rows)
             padded_slots += sum(r * w for r, w in shapes)
@@ -398,7 +408,11 @@ class BellGraph:
         return BellGraph(
             level_cols=level_cols,
             level_shapes=level_shapes,
-            final_slot=jnp.asarray(final_slot.astype(np.int32)),
+            final_slot=(
+                jnp.asarray(final_slot.astype(np.int32))
+                if device
+                else final_slot.astype(np.int32)
+            ),
             n=n,
             n_pad=n,
             level_sizes=level_sizes,

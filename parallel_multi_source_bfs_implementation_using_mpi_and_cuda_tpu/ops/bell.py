@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..models.bell import BellGraph
+from ..utils.donation import donating_jit
 from .bfs import distance_chunk, host_chunked_loop, validate_level_chunk
 from .objective import f_of_u
 from .packed import (
@@ -188,8 +189,10 @@ def bell_distances(
     return dist
 
 
-@partial(jax.jit, static_argnames=("chunk", "max_levels"))
+@donating_jit(donate_argnums=(1,), static_argnames=("chunk", "max_levels"))
 def _bell_chunk(graph, carry, chunk, max_levels):
+    """Carry DONATED: the host driver rebinds it every step, so the
+    (n, K) distance state is updated in place (utils.donation)."""
     return distance_chunk(
         carry,
         lambda d, lvl: bell_expand_packed(d, lvl, graph),

@@ -205,9 +205,17 @@ def host_chunked_loop(carry, advance, max_levels, level_ix=1, updated_ix=2):
     all-padding dummy (whose initial ``updated`` is already false).
     ``updated`` may be a scalar (plane engines) or a per-query array (the
     vmapped generic engine); a converged query's carry is a fixed point, so
-    extra dispatches for its lane are harmless no-ops."""
+    extra dispatches for its lane are harmless no-ops.
+
+    ``advance`` may donate the carry it is passed (utils.donation): the
+    loop rebinds ``carry`` before touching device state again, so the
+    donated buffers are never re-read.  Each iteration's fetch is ONE
+    blocking commit, recorded for the dispatch-count telemetry."""
+    from ..utils.timing import record_dispatch
+
     while True:
         carry = advance(carry)
+        record_dispatch()
         active = np.asarray(carry[updated_ix])
         if max_levels is not None:
             active = active & (np.asarray(carry[level_ix]) < max_levels)

@@ -33,6 +33,8 @@ import numpy as np
 from jax import lax
 
 from ..models.bell import BellGraph
+from ..utils.donation import donating_jit
+from ..utils.timing import record_dispatch
 from .bfs import host_chunked_loop, validate_level_chunk
 from .bell import forest_hits
 from .objective import select_best
@@ -41,6 +43,44 @@ from .push import compact_indices
 
 WORD_BITS = 32
 _SHIFTS = tuple(range(WORD_BITS))
+
+# Megachunk fusion (round 6): fold this many level-chunks into ONE dispatch.
+# The in-dispatch while_loop already exits the moment a level discovers
+# nothing (its ``updated`` predicate), so a fused dispatch never does more
+# WORK than the BFS needs — it only raises the per-dispatch level BOUND, so
+# a run that needed ceil(levels/chunk) tunnel round-trips now needs
+# ceil(levels/(chunk*megachunk)).  8 keeps the auto bounds defensible
+# against the documented unbounded-dispatch crash (docs/PERF_NOTES.md
+# "Push-engine TPU status"): bitbell's auto 128-level chunk fuses to 1024
+# levels/dispatch, already proven safe as the stencil auto bound.
+_AUTO_MEGACHUNK = 8
+
+
+def resolve_megachunk(megachunk, level_chunk) -> int:
+    """Resolve the per-dispatch chunk multiplier an engine will use.
+
+    ``None`` = auto: honor ``MSBFS_MEGACHUNK`` when set, else
+    ``_AUTO_MEGACHUNK``.  Callers whose ``level_chunk`` is an EXPLICIT
+    bound (operator's ``MSBFS_LEVEL_CHUNK``, the streamed over-HBM arm's
+    memory-safety chunk) pass ``megachunk=1`` so fusion never silently
+    multiplies a bound someone chose on purpose — the CLI encodes that
+    policy.  Unchunked engines (``level_chunk`` falsy) have nothing to
+    fuse: always 1."""
+    if not level_chunk:
+        return 1
+    if megachunk is None:
+        env = os.environ.get("MSBFS_MEGACHUNK", "")
+        if env:
+            try:
+                megachunk = int(env)
+            except ValueError:
+                megachunk = None
+    if megachunk is None:
+        megachunk = _AUTO_MEGACHUNK
+    megachunk = int(megachunk)
+    if megachunk <= 0:
+        raise ValueError(f"megachunk must be positive (got {megachunk})")
+    return megachunk
 
 
 def _or_fold(x: jax.Array, axis: int) -> jax.Array:
@@ -393,12 +433,17 @@ def _bitbell_init_carry(graph: BellGraph, queries: jax.Array):
     return bit_level_init(frontier0, unpack_counts(frontier0))
 
 
-@partial(
-    jax.jit, static_argnames=("max_levels", "sparse_budget", "slot_budget")
+@donating_jit(
+    donate_argnums=(1,),
+    static_argnames=("max_levels", "sparse_budget", "slot_budget"),
 )
 def _bitbell_chunk(
     graph, carry, chunk, max_levels, sparse_budget, slot_budget=None
 ):
+    """One bounded dispatch.  The carry (bit planes + counters) is DONATED:
+    the host driver rebinds it every step, so XLA reuses the plane buffers
+    in place instead of allocating a fresh output carry per chunk
+    (utils.donation)."""
     return bit_level_chunk(
         carry,
         _bitbell_expand(graph, sparse_budget, slot_budget),
@@ -414,6 +459,7 @@ def bitbell_run_chunked(
     max_levels: Optional[int] = None,
     sparse_budget: int = 0,
     slot_budget: Optional[int] = None,
+    megachunk: int = 1,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """:func:`bitbell_run` with per-dispatch work bounded to ``level_chunk``
     levels: a host loop re-dispatches :func:`bit_level_chunk` with the carry
@@ -422,13 +468,21 @@ def bitbell_run_chunked(
     thousands-of-levels while_loop in ONE dispatch is the pattern that
     crashed the TPU worker (docs/PERF_NOTES.md "Push-engine TPU status");
     on ~10-level power-law graphs the single-dispatch ``bitbell_run`` is
-    preferred (no host syncs at all)."""
+    preferred (no host syncs at all).
+
+    ``megachunk`` fuses that many chunks into one dispatch by multiplying
+    the traced level bound (the in-dispatch while_loop still early-exits on
+    convergence, so only the bound grows, never the work).  The bound is a
+    TRACED np.int32 operand — it rides the dispatch like any host buffer,
+    and changing it never recompiles (an eager jnp scalar here would be its
+    own device commit; a static argument would recompile per bound)."""
+    bound = np.int32(int(level_chunk) * int(megachunk))
     carry = host_chunked_loop(
         _bitbell_init_carry(graph, queries),
         lambda c: _bitbell_chunk(
             graph,
             c,
-            jnp.int32(level_chunk),
+            bound,
             max_levels,
             sparse_budget,
             slot_budget,
@@ -472,6 +526,7 @@ def stepped_level_trace(engine, queries, step, k=None):
     t0 = time.perf_counter()
     frontier = pack(queries)
     counts = np.asarray(unpack_counts(frontier))
+    record_dispatch()
     dt = time.perf_counter() - t0
     visited = frontier
     level_counts = [counts]
@@ -485,6 +540,7 @@ def stepped_level_trace(engine, queries, step, k=None):
         t0 = time.perf_counter()
         visited, frontier, c = step(visited, frontier)
         counts = np.asarray(c)
+        record_dispatch()
         level_seconds.append(time.perf_counter() - t0)
         level_counts.append(counts)
     lc = np.stack(level_counts)  # (L, Kpad)
@@ -590,15 +646,19 @@ def _bitbell_start_chunk_best(
     )
 
 
-@partial(
-    jax.jit, static_argnames=("max_levels", "sparse_budget", "slot_budget")
+@donating_jit(
+    donate_argnums=(1,),
+    static_argnames=("max_levels", "sparse_budget", "slot_budget"),
 )
 def _bitbell_chunk_best(
     graph, carry, k, chunk, max_levels, sparse_budget, slot_budget=None
 ):
     """Continuation dispatch for deep graphs: one more level chunk + the
     (cheap, (K,)-sized) selection over the F counters so far.  Only the
-    LAST dispatch's (minF, minK) is read by the host."""
+    LAST dispatch's (minF, minK) is read by the host.  The 7-tuple carry
+    is DONATED (the driver rebinds it every step); the start program is
+    NOT — its argnum 1 is the caller's query array, which must survive
+    the call."""
     return _chunk_best_tail(
         graph, carry, k, chunk, max_levels, sparse_budget, slot_budget
     )
@@ -612,9 +672,14 @@ def fused_best_drive(c8, advance, max_levels) -> Tuple[int, int]:
     PRE-checked — the start program already advanced one chunk, so a
     converged BFS pays no extra dispatch.  Exactly one buffer fetch per
     chunk serves the continue-check and, on the last chunk, IS the
-    answer."""
+    answer.  ``advance`` may donate the 7-tuple carry (c8[:7]); the status
+    buffer is fetched BEFORE the next advance, so donation never
+    invalidates a pending read.  Each fetch is one blocking commit,
+    recorded for the dispatch telemetry."""
     while True:
-        level, updated, min_f, min_k = (int(x) for x in np.asarray(c8[7]))
+        status = np.asarray(c8[7])
+        record_dispatch()
+        level, updated, min_f, min_k = (int(x) for x in status)
         if not updated:
             break
         if max_levels is not None and level >= max_levels:
@@ -652,6 +717,7 @@ class FusedBestEngine(PackedEngineBase):
         kk = np.int32(k)
         if not self.level_chunk:
             min_f, min_k = np.asarray(self._fused_full(queries, kk))
+            record_dispatch()
             return int(min_f), int(min_k)
         return fused_best_drive(
             self._fused_chunk(queries, kk, first=True),
@@ -695,7 +761,11 @@ class BitBellEngine(FusedBestEngine):
     run an unbounded dispatch (:func:`bitbell_run_chunked`); the CLI
     auto-enables it for every graph (round 4 — the chunked loop exits on
     convergence, so shallow BFS pays one host sync; measured cost <= 0,
-    benchmarks/exp_chunk_cost.py)."""
+    benchmarks/exp_chunk_cost.py).
+
+    ``megachunk``: level-chunks fused per dispatch
+    (:func:`resolve_megachunk`; None = auto / MSBFS_MEGACHUNK).  Callers
+    whose ``level_chunk`` is a deliberate bound pass 1."""
 
     k_align = WORD_BITS
 
@@ -706,6 +776,7 @@ class BitBellEngine(FusedBestEngine):
         sparse_budget: Optional[int] = None,
         level_chunk: Optional[int] = None,
         slot_budget: Optional[int] = None,
+        megachunk: Optional[int] = None,
     ):
         self.graph = graph
         self.max_levels = max_levels
@@ -714,6 +785,7 @@ class BitBellEngine(FusedBestEngine):
             sparse_budget = default_sparse_budget(e) if e else 0
         self.sparse_budget = int(sparse_budget)
         self.level_chunk = validate_level_chunk(level_chunk)
+        self.megachunk = resolve_megachunk(megachunk, self.level_chunk)
         # Gather-segment budget (slots) for the wide-plane HBM ceiling
         # (forest_hits).  None = auto per run (:meth:`_slot_budget_for`);
         # 0 = never segment; an int forces it.  MSBFS_SLOT_BUDGET mirrors
@@ -759,6 +831,7 @@ class BitBellEngine(FusedBestEngine):
                 self.max_levels,
                 self.sparse_budget,
                 slot_budget,
+                megachunk=self.megachunk,
             )
         return bitbell_run(
             self.graph,
@@ -785,14 +858,17 @@ class BitBellEngine(FusedBestEngine):
 
     def _fused_chunk(self, state, k, first):
         # W (packed words) from the padded queries on the first dispatch,
-        # from the carry's visited planes on continuations.
+        # from the carry's visited planes on continuations.  The fused
+        # level bound is a TRACED np.int32 (rides the dispatch; an eager
+        # jnp scalar would be its own device commit, and a static arg
+        # would recompile per bound).
         w = state.shape[0] // WORD_BITS if first else state[0].shape[1]
         fn = _bitbell_start_chunk_best if first else _bitbell_chunk_best
         return fn(
             self.graph,
             state,
             k,
-            jnp.int32(self.level_chunk),
+            np.int32(self.level_chunk * self.megachunk),
             self.max_levels,
             self.sparse_budget,
             self._slot_budget_for(w),

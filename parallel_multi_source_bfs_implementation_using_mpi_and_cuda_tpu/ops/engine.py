@@ -18,6 +18,8 @@ import numpy as np
 from jax import lax
 
 from ..models.csr import DeviceCSR
+from ..utils.donation import donating_jit
+from ..utils.timing import record_dispatch
 from .bfs import (
     distance_carry_init,
     distance_chunk,
@@ -60,10 +62,15 @@ def _carry_init_batch(graph, queries):
     )(queries)
 
 
-@partial(jax.jit, static_argnames=("chunk", "max_levels", "expand"))
+@donating_jit(
+    donate_argnums=(1,), static_argnames=("chunk", "max_levels", "expand")
+)
 def _advance_batch(graph, carry, chunk, max_levels, expand):
     """One bounded dispatch: each of the J queries advances by <= ``chunk``
-    levels (converged lanes are fixed points)."""
+    levels (converged lanes are fixed points).  The carry is DONATED: the
+    host driver rebinds it every step, so XLA updates the (J, n_pad)
+    distance state in place instead of round-tripping it through fresh
+    allocations (utils.donation)."""
     return jax.vmap(
         lambda c: distance_chunk(
             c, lambda d, lvl: expand(d, lvl, graph), chunk, max_levels
@@ -92,10 +99,16 @@ class QueryEngineBase:
 
     def best(self, queries) -> Tuple[int, int]:
         """Run all groups; return (minF, minK) — reference main.cu:309-397."""
-        f = self.f_values(jnp.asarray(queries))
+        # Queries pass through UNCONVERTED: an eager jnp.asarray here
+        # would commit host queries to device before f_values' own
+        # host-side padding (ops.packed._pad_queries / _chunk_grid) gets
+        # to keep the whole batch riding the jitted program's argument
+        # upload — re-introducing the dispatch the padding avoids.
+        f = self.f_values(queries)
         # One transfer for both scalars (sequential int() reads each pay
         # a tunnel round-trip on this platform).
         min_f, min_k = jax.device_get(select_best_jit(f, f >= 0))
+        record_dispatch()
         return int(min_f), int(min_k)
 
     def compile(
@@ -166,7 +179,25 @@ class Engine(QueryEngineBase):
         self.level_chunk = validate_level_chunk(level_chunk)
 
     def _chunk_grid(self, queries) -> Tuple[jax.Array, int]:
-        """Pad K to the chunk multiple and reshape to (C, chunk, S)."""
+        """Pad K to the chunk multiple and reshape to (C, chunk, S).
+
+        Host-side NumPy padding whenever the input is host data (the CLI,
+        bench and serve paths all pass NumPy): an eager jnp.concatenate
+        here would be its own dispatched device program — a whole ~100 ms
+        tunnel round-trip per query batch on this platform (the round-5
+        "dispatch diet" fixed the packed engines' twin in
+        PackedEngineBase._pad_queries; this is the generic engine's
+        straggler, round-6 sweep)."""
+        if not isinstance(queries, jax.Array):
+            queries = np.asarray(queries, dtype=np.int32)
+            K, S = queries.shape
+            chunk = self.query_chunk or max(K, 1)
+            pad = (-K) % chunk
+            if pad:
+                queries = np.concatenate(
+                    [queries, np.full((pad, S), -1, dtype=np.int32)], axis=0
+                )
+            return queries.reshape((K + pad) // chunk, chunk, S), K
         queries = jnp.asarray(queries, dtype=jnp.int32)
         K, S = queries.shape
         chunk = self.query_chunk or max(K, 1)

@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 
 from ..models.csr import DeviceCSR
+from ..utils.donation import donating_jit
 from .bfs import (
     distance_chunk,
     host_chunked_loop,
@@ -182,8 +183,13 @@ def packed_carry_init(graph, queries):
     return dist0, jnp.int32(0), jnp.any(dist0 == 0)
 
 
-@partial(jax.jit, static_argnames=("chunk", "max_levels", "edge_chunks"))
+@donating_jit(
+    donate_argnums=(1,),
+    static_argnames=("chunk", "max_levels", "edge_chunks"),
+)
 def _packed_chunk(graph, carry, chunk, max_levels, edge_chunks):
+    """Carry DONATED: the host driver rebinds it every step, so the
+    (n, K) distance state is updated in place (utils.donation)."""
     return distance_chunk(
         carry,
         lambda d, lvl: _packed_expand(d, lvl, graph, edge_chunks),

@@ -45,6 +45,8 @@ import numpy as np
 from jax import lax
 
 from ..models.csr import CSRGraph
+from ..utils.donation import donating_jit
+from ..utils.timing import record_dispatch
 from .engine import QueryEngineBase
 
 DEFAULT_MAX_WIDTH = 64
@@ -224,8 +226,12 @@ def _push_init_batch(adj, queries, capacity):
     return jax.vmap(partial(_push_init, adj, capacity=capacity))(queries)
 
 
-@partial(jax.jit, static_argnames=("capacity", "max_levels"))
+@donating_jit(
+    donate_argnums=(1,), static_argnames=("capacity", "max_levels")
+)
 def _push_chunk_batch(adj, carry, capacity, chunk, max_levels):
+    """Carry DONATED: every driver (push_run, the stepped trace) rebinds
+    it before reading device state again (utils.donation)."""
     return jax.vmap(
         lambda c: _push_chunk(adj, c, capacity, chunk, max_levels)
     )(carry)
@@ -243,7 +249,9 @@ def _push_init_grid(adj, grid, capacity):
     )
 
 
-@partial(jax.jit, static_argnames=("capacity", "max_levels"))
+@donating_jit(
+    donate_argnums=(1,), static_argnames=("capacity", "max_levels")
+)
 def _push_chunk_grid(adj, carry, capacity, chunk, max_levels):
     return jax.vmap(
         jax.vmap(lambda c: _push_chunk(adj, c, capacity, chunk, max_levels))
@@ -289,10 +297,14 @@ def push_run(
     place."""
     if chunk is None:
         chunk = default_push_chunk()
+    # np.int32 OUTSIDE the loop: an eager jnp scalar would commit its own
+    # device buffer on every iteration (round-6 dispatch sweep).
+    bound = np.int32(chunk)
     carry = init_fn(adj, queries, capacity)
     while True:
-        carry = chunk_fn(adj, carry, capacity, jnp.int32(chunk), max_levels)
+        carry = chunk_fn(adj, carry, capacity, bound, max_levels)
         updated = np.asarray(carry[6])
+        record_dispatch()
         if not updated.any():
             break
         if max_levels is not None and int(np.asarray(carry[5]).max()) >= max_levels:
@@ -359,7 +371,7 @@ class PushEngine(QueryEngineBase):
 
     def _trace_chunk(self, carry):
         return _push_chunk_batch(
-            self.graph, carry, self.capacity, jnp.int32(1), self.max_levels
+            self.graph, carry, self.capacity, np.int32(1), self.max_levels
         )
 
     def _to_query_order(self, x) -> np.ndarray:

@@ -53,6 +53,7 @@ from .bitbell import (
     unpack_byte_planes,
     unpack_counts,
 )
+from ..utils.donation import donating_jit
 from .push import (
     PaddedAdjacency,
     PushEngine,
@@ -87,12 +88,16 @@ def _packed_init_batch(adj: PaddedAdjacency, queries: jax.Array, capacity):
     )
 
 
-@partial(jax.jit, static_argnames=("capacity", "max_levels"))
+@donating_jit(
+    donate_argnums=(1,), static_argnames=("capacity", "max_levels")
+)
 def _packed_chunk_batch(
     adj: PaddedAdjacency, carry, capacity: int, chunk, max_levels
 ):
     """Advance the union-frontier BFS by <= ``chunk`` levels (or to
-    ``max_levels``/convergence) in one dispatch."""
+    ``max_levels``/convergence) in one dispatch.  Carry DONATED: the
+    drivers (push_run, the stepped trace) rebind it before reading device
+    state again (utils.donation)."""
     n = adj.n
     start = carry[5]
 
@@ -188,7 +193,7 @@ class PackedPushEngine(PushEngine):
 
     def _trace_chunk(self, carry):
         return _packed_chunk_batch(
-            self.graph, carry, self.capacity, jnp.int32(1), self.max_levels
+            self.graph, carry, self.capacity, np.int32(1), self.max_levels
         )
 
     def _to_query_order(self, x) -> np.ndarray:

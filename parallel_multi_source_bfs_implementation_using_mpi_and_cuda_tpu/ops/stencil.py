@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..utils.donation import donating_jit
 from .bfs import host_chunked_loop, validate_level_chunk
 from .bitbell import (
     WORD_BITS,
@@ -48,6 +49,7 @@ from .bitbell import (
     fused_select,
     pack_byte_planes,
     pack_queries,
+    resolve_megachunk,
     stepped_level_trace,
     unpack_byte_planes,
     unpack_counts,
@@ -257,11 +259,13 @@ def detect_stencil(
 
 def _shift_planes(planes: jax.Array, d: int) -> jax.Array:
     """Flat-id shift: out[i + d] = planes[i], zero fill (rows sliding past
-    either end drop — their edges do not exist by mask construction)."""
+    either end drop — their edges do not exist by mask construction).
+    Works on (n, W) word planes and on the flat (n,) single-word plane of
+    the W == 1 lane-squeeze path."""
     n = planes.shape[0]
     if d == 0 or abs(d) >= n:
         return jnp.zeros_like(planes) if d else planes
-    pad = jnp.zeros((abs(d), planes.shape[1]), dtype=planes.dtype)
+    pad = jnp.zeros((abs(d),) + planes.shape[1:], dtype=planes.dtype)
     if d > 0:
         return jnp.concatenate([pad, planes[: n - d]], axis=0)
     return jnp.concatenate([planes[-d:], pad], axis=0)
@@ -269,9 +273,14 @@ def _shift_planes(planes: jax.Array, d: int) -> jax.Array:
 
 def stencil_hits(frontier: jax.Array, graph: StencilGraph) -> jax.Array:
     """(n, W) uint32 frontier planes -> (n, W) per-vertex hit planes via
-    masked shifts + the compact residual segment-OR."""
+    masked shifts + the compact residual segment-OR.  A flat (n,) frontier
+    (the W == 1 lane-squeeze path) yields flat (n,) hits."""
+    flat = frontier.ndim == 1
     hits = jnp.zeros_like(frontier)
-    mask_bits = graph.mask_bits[:, None]  # (n, 1), broadcasts over W
+    # (n, 1) broadcasts over W on the plane path; the flat path uses the
+    # (n,) word directly — a trailing dim of 1 would put the whole level
+    # on a single TPU lane (see stencil_new).
+    mask_bits = graph.mask_bits if flat else graph.mask_bits[:, None]
     for i, d in enumerate(graph.offsets):
         masked = jnp.where(
             (mask_bits >> jnp.uint32(i)) & jnp.uint32(1) != 0,
@@ -284,8 +293,11 @@ def stencil_hits(frontier: jax.Array, graph: StencilGraph) -> jax.Array:
         # Compact residual: O(R) gather + byte-lane segment-OR into the
         # U unique destinations, then one O(U) row merge — no n-sized
         # temporaries (the round-4 formulation zeroed and re-packed a
-        # full (n, K) byte matrix every level).
-        src_words = jnp.take(frontier, graph.res_src, axis=0)  # (R, W)
+        # full (n, K) byte matrix every level).  The residual is O(R),
+        # not O(n): viewing the flat plane as (R, 1) words here costs
+        # nothing plane-sized.
+        planes2 = frontier[:, None] if flat else frontier
+        src_words = jnp.take(planes2, graph.res_src, axis=0)  # (R, W)
         src_bytes = unpack_byte_planes(src_words)  # (R, K) 0/1
         seg = jax.ops.segment_max(
             src_bytes,
@@ -294,14 +306,43 @@ def stencil_hits(frontier: jax.Array, graph: StencilGraph) -> jax.Array:
             indices_are_sorted=True,
         )
         upd = pack_byte_planes(seg)  # (U, W)
+        if flat:
+            upd = upd[:, 0]
         u = graph.res_dst_unique
         hits = hits.at[u].set(jnp.take(hits, u, axis=0) | upd)
     return hits
 
 
+def stencil_new(visited, frontier, graph: StencilGraph):
+    """Fused expansion: newly-reached planes in one pass over the plane
+    streams.  The unvisited mask is computed ONCE and folded into the hit
+    accumulation, so the level's output is produced without re-streaming a
+    separate full-size ``hits`` array through an extra AND pass — the
+    round-6 roofline push (docs/PERF_NOTES.md round 6): every word the
+    level streams is either a shift-pass operand or the final ``new``."""
+    return stencil_hits(frontier, graph) & ~visited
+
+
+def _stencil_counts(new: jax.Array) -> jax.Array:
+    """Per-query discovery counts for (n, W) planes or the flat (n,)
+    W == 1 plane (same popcount math either way — the (n, 1) view is
+    transient and O(n), folded into the count reduction)."""
+    return unpack_counts(new if new.ndim == 2 else new[:, None])
+
+
+def _maybe_flat(planes: jax.Array) -> jax.Array:
+    """W == 1 lane squeeze (round 6): a (n, 1) uint32 plane leaves 127 of
+    128 TPU lanes idle in every shift/mask/OR pass — the measured 29%-of-
+    roofline shape at padded K = 32.  Running the level loop on the flat
+    (n,) word instead lets XLA tile the minor dimension across the full
+    lane width.  Shape-driven (trace-time static), so no extra jit
+    arguments: wider batches keep the (n, W) layout unchanged."""
+    return planes[:, 0] if planes.shape[1] == 1 else planes
+
+
 def _stencil_expand(graph: StencilGraph):
     def expand(visited, frontier):
-        return stencil_hits(frontier, graph) & ~visited
+        return stencil_new(visited, frontier, graph)
 
     return expand
 
@@ -314,21 +355,34 @@ def stencil_run(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(K, S) queries (K % 32 == 0) -> per-query (f, levels, reached),
     whole BFS in one dispatch."""
-    frontier0 = pack_queries(graph.n, queries)
+    frontier0 = _maybe_flat(pack_queries(graph.n, queries))
     return bit_level_loop(
-        frontier0, unpack_counts(frontier0), _stencil_expand(graph), max_levels
+        frontier0,
+        _stencil_counts(frontier0),
+        _stencil_expand(graph),
+        max_levels,
+        counts_of=_stencil_counts,
     )
 
 
 @jax.jit
 def _stencil_init_carry(graph: StencilGraph, queries: jax.Array):
-    frontier0 = pack_queries(graph.n, queries)
-    return bit_level_init(frontier0, unpack_counts(frontier0))
+    frontier0 = _maybe_flat(pack_queries(graph.n, queries))
+    return bit_level_init(frontier0, _stencil_counts(frontier0))
 
 
-@partial(jax.jit, static_argnames=("max_levels",))
+@donating_jit(donate_argnums=(1,), static_argnames=("max_levels",))
 def _stencil_chunk(graph, carry, chunk, max_levels):
-    return bit_level_chunk(carry, _stencil_expand(graph), chunk, max_levels)
+    """One bounded dispatch; the carry is DONATED — the host driver
+    rebinds it every step, so the plane buffers are reused in place
+    (utils.donation)."""
+    return bit_level_chunk(
+        carry,
+        _stencil_expand(graph),
+        chunk,
+        max_levels,
+        counts_of=_stencil_counts,
+    )
 
 
 @jax.jit
@@ -351,21 +405,29 @@ def stencil_best_fused(
 
 
 def _stencil_best_tail(graph, carry, k, chunk, max_levels):
-    carry = bit_level_chunk(carry, _stencil_expand(graph), chunk, max_levels)
+    carry = bit_level_chunk(
+        carry,
+        _stencil_expand(graph),
+        chunk,
+        max_levels,
+        counts_of=_stencil_counts,
+    )
     return carry + (_pack_status(carry, k),)
 
 
 @partial(jax.jit, static_argnames=("max_levels",))
 def _stencil_start_chunk_best(graph, queries, k, chunk, max_levels):
-    """Packing + init + first level chunk + selection, one dispatch."""
+    """Packing + init + first level chunk + selection, one dispatch.
+    NOT donated: argnum 1 is the caller's query array."""
     return _stencil_best_tail(
         graph, _stencil_init_carry(graph, queries), k, chunk, max_levels
     )
 
 
-@partial(jax.jit, static_argnames=("max_levels",))
+@donating_jit(donate_argnums=(1,), static_argnames=("max_levels",))
 def _stencil_chunk_best(graph, carry, k, chunk, max_levels):
-    """Continuation dispatch for BFS deeper than one chunk."""
+    """Continuation dispatch for BFS deeper than one chunk; the 7-tuple
+    carry is DONATED (the driver rebinds it every step)."""
     return _stencil_best_tail(graph, carry, k, chunk, max_levels)
 
 
@@ -385,7 +447,10 @@ class StencilEngine(FusedBestEngine):
     The bit-plane loop, counters and query padding are shared with
     ops.bitbell (bit_level_loop and friends); only the per-level expansion
     differs.  ``level_chunk`` bounds levels per dispatch
-    (AUTO_STENCIL_LEVEL_CHUNK when the CLI routes here)."""
+    (AUTO_STENCIL_LEVEL_CHUNK when the CLI routes here); ``megachunk``
+    fuses that many chunks into one dispatch
+    (ops.bitbell.resolve_megachunk; callers whose chunk is a deliberate
+    bound pass 1)."""
 
     k_align = WORD_BITS
 
@@ -394,20 +459,25 @@ class StencilEngine(FusedBestEngine):
         graph: StencilGraph,
         max_levels: Optional[int] = None,
         level_chunk: Optional[int] = None,
+        megachunk: Optional[int] = None,
     ):
         self.graph = graph
         self.max_levels = max_levels
         self.level_chunk = validate_level_chunk(level_chunk)
+        self.megachunk = resolve_megachunk(megachunk, self.level_chunk)
         self._level_warm_shapes = set()
 
     def _run(self, queries):
         if self.level_chunk:
+            # np.int32 traced bound: rides the dispatch (an eager jnp
+            # scalar would be its own device commit).
+            bound = np.int32(self.level_chunk * self.megachunk)
             carry = host_chunked_loop(
                 _stencil_init_carry(self.graph, queries),
                 lambda c: _stencil_chunk(
                     self.graph,
                     c,
-                    jnp.int32(self.level_chunk),
+                    bound,
                     self.max_levels,
                 ),
                 self.max_levels,
@@ -423,7 +493,11 @@ class StencilEngine(FusedBestEngine):
     def _fused_chunk(self, state, k, first):
         fn = _stencil_start_chunk_best if first else _stencil_chunk_best
         return fn(
-            self.graph, state, k, jnp.int32(self.level_chunk), self.max_levels
+            self.graph,
+            state,
+            k,
+            np.int32(self.level_chunk * self.megachunk),
+            self.max_levels,
         )
 
     def f_values(self, queries) -> jax.Array:

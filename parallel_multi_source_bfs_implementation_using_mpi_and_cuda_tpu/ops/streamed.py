@@ -1,0 +1,303 @@
+"""Double-buffered streamed BELL engine: host-resident forest, pipelined
+HBM uploads.
+
+The bit-plane BELL engine (ops.bitbell) assumes the whole reduction
+forest lives in HBM.  At RMAT-25 the forest's flat col arrays alone are
+~2x a v5e's 16 GB, so the certified configuration (docs/PERF_NOTES.md
+round 5) runs the SPARSE CSR fallback (BENCH_SPARSE=0 + slot budget) and
+lands at 0.56 GTEPS — bounded by re-gathering through a layout that was
+never built for it.
+
+This engine keeps the forest on the HOST (plain NumPy), streams it
+through the device per BFS level in bounded segments, and overlaps the
+NEXT segment's host->device transfer with the CURRENT segment's
+gather/OR compute — classic double buffering, generalized to a
+``prefetch``-deep rotation (MSBFS_STREAM_PREFETCH, default 2):
+
+    level l:   upload seg s+1, s+2   ||   gather/OR-reduce seg s
+    final:     H = V_cat[final_slot]
+
+``jax.device_put`` is asynchronous on TPU, so the upload of segment
+s+1 proceeds on the DMA engines while XLA executes segment s's fused
+gather+reduce program; the steady state is transfer-bound OR
+compute-bound, whichever is larger — never their sum.  Segment shapes
+come from the same static partition the in-HBM engine uses for its
+gather intermediates (ops.bell._slot_segments), so each (pieces,)
+signature compiles exactly one XLA program, reused every BFS level.
+
+Semantics are BitBellEngine's exactly: the 7-tuple bit-plane carry
+(ops.bitbell.bit_level_init/bit_level_body), K padded to multiples of
+32, level-synchronous expansion until a level discovers nothing
+(reference main.cu:16-73).  The per-level continue check costs ONE
+blocking status fetch (counted via utils.timing.record_dispatch); the
+carry update is donated, so visited/f/levels/reached planes are updated
+in place (utils.donation).
+
+The engine snapshots the forest cols to host at construction and keeps
+NO reference to the device-resident BellGraph arrays — a caller fitting
+an over-HBM graph builds the BellGraph, constructs this engine, then
+drops the BellGraph so only ``final_slot`` ((n,) int32) stays on device.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.bell import BellGraph
+from ..utils.donation import donating_jit
+from ..utils.timing import record_dispatch
+from .bell import _slot_segments
+from .bitbell import (
+    WORD_BITS,
+    _or_fold,
+    bit_level_init,
+    fused_select,
+    pack_queries,
+    unpack_counts,
+)
+from .packed import PackedEngineBase
+
+
+def _env_int(name: str, default: int) -> int:
+    env = os.environ.get(name, "")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    return default
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _stream_init(n: int, queries: jax.Array):
+    """Padded (Kpad, S) queries -> the shared 7-tuple bit-plane carry."""
+    planes0 = pack_queries(n, queries)
+    return bit_level_init(planes0, unpack_counts(planes0))
+
+
+@jax.jit
+def _stream_status(level, updated):
+    """(2,) int32 [level, updated]: both continue-check scalars in ONE
+    buffer so the per-level host sync is a single blocking fetch."""
+    return jnp.stack([level, updated.astype(jnp.int32)])
+
+
+@jax.jit
+def _extend(planes: jax.Array) -> jax.Array:
+    """Append the sentinel zero row (slot id n / "no parent")."""
+    zero = jnp.zeros((1, planes.shape[1]), dtype=planes.dtype)
+    return jnp.concatenate([planes, zero], axis=0)
+
+
+@partial(jax.jit, static_argnames=("pieces",))
+def _segment_or(v_prev_ext, cols, pieces):
+    """One streamed segment: gather the uploaded ``cols`` slice out of the
+    sentinel-extended previous-level value planes and OR-fold each bucket
+    piece's fixed width.  ``pieces`` = ((rows, width), ...) is static, so
+    every segment signature is one compiled program reused per level."""
+    g = jnp.take(v_prev_ext, cols, axis=0)
+    parts = []
+    off = 0
+    for rc, wb in pieces:
+        seg = lax.slice_in_dim(g, off, off + rc * wb, axis=0)
+        parts.append(_or_fold(seg.reshape(rc, wb, g.shape[1]), 1))
+        off += rc * wb
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
+
+@jax.jit
+def _final_hits(final_slot, *outs):
+    """Concatenate the per-forest-level outputs (+ sentinel zero row) and
+    gather each vertex's final slot — ops.bell.forest_hits' tail."""
+    zero = jnp.zeros((1, outs[0].shape[1]), dtype=outs[0].dtype)
+    v_cat = jnp.concatenate(list(outs) + [zero], axis=0)
+    return jnp.take(v_cat, final_slot, axis=0)
+
+
+@donating_jit(donate_argnums=(0,))
+def _apply_level(carry, hits):
+    """ops.bitbell.bit_level_body with the forest pass hoisted OUT (it ran
+    as the streamed segment programs); folds the hit planes into the
+    carry.  Carry DONATED: the host loop rebinds it before reading device
+    state again (utils.donation)."""
+    visited, frontier, f, levels, reached, level, _ = carry
+    new = hits & ~visited
+    counts = unpack_counts(new)
+    found = counts > 0
+    dist = level + 1
+    return (
+        visited | new,
+        new,
+        f + counts.astype(jnp.int64) * dist.astype(jnp.int64),
+        jnp.where(found, dist + 1, levels),
+        reached + counts,
+        level + 1,
+        jnp.any(found),
+    )
+
+
+_select_jit = jax.jit(fused_select)
+
+
+class StreamedBitBellEngine(PackedEngineBase):
+    """Bit-plane BELL engine whose reduction forest streams from host RAM.
+
+    ``slot_budget`` bounds each uploaded segment (slots); None reads
+    MSBFS_SLOT_BUDGET, else streams whole forest levels (each level's
+    upload still overlaps the previous level's compute).  ``prefetch``
+    is the upload lookahead depth (None -> MSBFS_STREAM_PREFETCH -> 2):
+    1 serializes transfer and compute, 2 is classic double buffering.
+
+    The per-BFS-level host round-trip makes this strictly a large-graph
+    engine: below the HBM ceiling BitBellEngine's fused level loop wins
+    (one dispatch per level_chunk*megachunk levels vs one PER level
+    here).  Parity with BitBellEngine is pinned by the agreement matrix
+    (tests/test_engines_agree.py) and the streamed arm of
+    tests/test_dispatch_opt.py.
+    """
+
+    k_align = WORD_BITS
+
+    def __init__(
+        self,
+        graph: BellGraph,
+        max_levels: Optional[int] = None,
+        slot_budget: Optional[int] = None,
+        prefetch: Optional[int] = None,
+    ):
+        self.n = int(graph.n)
+        self.max_levels = max_levels
+        # Introspection parity with the fused engines (bench.py keys its
+        # dispatch estimate off these): the streamed loop is inherently
+        # one level per apply-dispatch.
+        self.level_chunk = 1
+        self.megachunk = 1
+        if slot_budget is None:
+            slot_budget = _env_int("MSBFS_SLOT_BUDGET", 0) or None
+        self.slot_budget = slot_budget
+        if prefetch is None:
+            prefetch = _env_int("MSBFS_STREAM_PREFETCH", 2)
+        self.prefetch = max(1, int(prefetch))
+        # (n,) int32, uploaded once (host-built graphs — from_host with
+        # device=False — arrive as NumPy; jnp.asarray is free otherwise).
+        self.final_slot = jnp.asarray(graph.final_slot)
+        self.fill = graph.fill
+        self.level_shapes = graph.level_shapes
+        # Host snapshot of the forest + the static streaming schedule:
+        # _plan[li] is the forest level's list of segment piece-signatures,
+        # _slices the matching host col slices in upload order (NumPy
+        # views of the per-level snapshot — no copies beyond the one
+        # device->host pull here).
+        plan, slices = [], []
+        for flat, shapes in zip(graph.level_cols, graph.level_shapes):
+            host = np.ascontiguousarray(np.asarray(flat, dtype=np.int32))
+            total = int(host.shape[-1])
+            segs = []
+            if total:
+                if slot_budget and total > slot_budget:
+                    for seg in _slot_segments(shapes, slot_budget):
+                        a = seg[0][0]
+                        last = seg[-1]
+                        b = last[0] + last[1] * last[2]
+                        segs.append(tuple((rc, wb) for _, rc, wb in seg))
+                        slices.append(host[a:b])
+                else:
+                    segs.append(tuple((r, w) for r, w in shapes if r))
+                    slices.append(host)
+            plan.append(segs)
+        self._plan = plan
+        self._slices = slices
+        self.level_sizes = tuple(
+            sum(rc * wb for seg in segs for rc, wb in seg) for segs in plan
+        )
+        self.slots_total = int(sum(self.level_sizes))
+        self._empty_cache = {}  # (0, W) zero planes per W for empty levels
+
+    def _empty_planes(self, w: int) -> jax.Array:
+        out = self._empty_cache.get(w)
+        if out is None:
+            out = self._empty_cache[w] = jnp.zeros((0, w), dtype=jnp.uint32)
+        return out
+
+    def _forest_pass(self, frontier: jax.Array) -> jax.Array:
+        """One BFS level's hit planes, streaming the forest through the
+        device with a ``prefetch``-deep upload pipeline."""
+        if not self._plan:  # n == 0: nothing to hit
+            return frontier
+        w = frontier.shape[1]
+        slices = self._slices
+        window = deque()
+        for i in range(min(self.prefetch, len(slices))):
+            window.append(jax.device_put(slices[i]))
+        outs = []
+        v_prev_ext = _extend(frontier)
+        si = 0
+        for segs in self._plan:
+            parts = []
+            for pieces in segs:
+                cols = window.popleft()
+                # Issue the lookahead upload BEFORE computing on the
+                # current segment: device_put is async, so the transfer
+                # overlaps the gather/OR program below.
+                nxt = si + self.prefetch
+                if nxt < len(slices):
+                    window.append(jax.device_put(slices[nxt]))
+                si += 1
+                parts.append(_segment_or(v_prev_ext, cols, pieces))
+            if not parts:
+                out = self._empty_planes(w)
+            elif len(parts) == 1:
+                out = parts[0]
+            else:
+                out = jnp.concatenate(parts, axis=0)
+            outs.append(out)
+            v_prev_ext = _extend(out)
+        return _final_hits(self.final_slot, *outs)
+
+    def _run(self, queries: jax.Array):
+        """Padded (Kpad, S) queries -> (f, levels, reached) device arrays.
+        One blocking status fetch per BFS level (counted as the level's
+        dispatch); uploads and compute inside the level are async."""
+        carry = _stream_init(self.n, queries)
+        while True:
+            status = np.asarray(_stream_status(carry[5], carry[6]))
+            record_dispatch()
+            level, updated = int(status[0]), int(status[1])
+            if not updated:
+                break
+            if self.max_levels is not None and level >= self.max_levels:
+                break
+            hits = self._forest_pass(carry[1])
+            carry = _apply_level(carry, hits)
+        return carry[2], carry[3], carry[4]
+
+    def f_values(self, queries) -> jax.Array:
+        queries, k = self._pad_queries(queries)
+        f, _, _ = self._run(queries)
+        return f[:k]
+
+    def best(self, queries) -> Tuple[int, int]:
+        queries, k = self._pad_queries(queries)
+        f, _, _ = self._run(queries)
+        # np.int32 mask bound + one two-scalar fetch, exactly like the
+        # fused engines (ops.bitbell.FusedBestEngine.best).
+        min_f, min_k = jax.device_get(_select_jit(f, np.int32(k)))
+        record_dispatch()
+        return int(min_f), int(min_k)
+
+    def query_stats(self, queries):
+        queries, k = self._pad_queries(queries)
+        f, levels, reached = self._run(queries)
+        return (
+            np.asarray(levels)[:k],
+            np.asarray(reached)[:k],
+            np.asarray(f)[:k],
+        )

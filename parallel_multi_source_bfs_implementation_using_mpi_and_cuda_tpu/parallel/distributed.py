@@ -28,6 +28,7 @@ from ..models.csr import CSRGraph, DeviceCSR
 from ..ops.bfs import graph_expand, multi_source_bfs, validate_level_chunk
 from ..ops.engine import QueryEngineBase
 from ..ops.objective import f_of_u
+from ..utils.timing import record_dispatch
 from .mesh import QUERY_AXIS, VERTEX_AXIS
 from .scheduler import merge_local_f, shard_queries
 
@@ -171,15 +172,19 @@ def _distributed_bitbell_run_chunked(
     dispatches.  The high-diameter-safe dual of
     :func:`_distributed_bitbell_run` (same results bit for bit)."""
     carry = _distributed_bitbell_init(mesh, graph, query_grid)
+    # np.int32, hoisted: an eager jnp scalar would be its own blocking
+    # device commit EVERY iteration (utils.timing documents the floor).
+    bound = np.int32(level_chunk)
     while True:
         *carry, any_up, max_level = _distributed_bitbell_chunk(
             mesh,
             graph,
             tuple(carry),
-            jnp.int32(level_chunk),
+            bound,
             max_levels,
             sparse_budget,
         )
+        record_dispatch()
         if not int(np.asarray(any_up)):
             break
         if max_levels is not None and int(np.asarray(max_level)) >= max_levels:
@@ -479,7 +484,7 @@ class DistributedEngine(QueryEngineBase):
                 self.mesh,
                 self.bell,
                 tuple(carry),
-                jnp.int32(1),
+                np.int32(1),
                 self.max_levels,
                 self.sparse_budget,
             )

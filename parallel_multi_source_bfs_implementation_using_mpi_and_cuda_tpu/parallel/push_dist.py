@@ -90,7 +90,7 @@ class DistributedPushEngine(PushEngine):
 
     def _trace_chunk(self, carry):
         return _push_chunk_grid(
-            self.graph, carry, self.capacity, jnp.int32(1), self.max_levels
+            self.graph, carry, self.capacity, np.int32(1), self.max_levels
         )
 
     def _to_query_order(self, x) -> np.ndarray:

@@ -66,6 +66,7 @@ from ..ops.bitbell import (
 )
 from .distributed import _distributed_bitbell_finish, _pad_qblock
 from .mesh import QUERY_AXIS, VERTEX_AXIS
+from ..utils.timing import record_dispatch
 from .scheduler import shard_queries
 
 
@@ -334,18 +335,22 @@ def sharded_push_run(
     overflow protocol (> cap / > bnd means this run was truncated and
     must be discarded)."""
     carry = _sharded_push_init(mesh, query_grid, block, n_pad)
+    # np.int32, hoisted: an eager jnp scalar would be its own blocking
+    # device commit EVERY iteration (utils.timing documents the floor).
+    bound = np.int32(level_chunk)
     while True:
         *carry, any_up, max_level = _sharded_push_chunk(
             mesh,
             adj,
             tuple(carry),
-            jnp.int32(level_chunk),
+            bound,
             block,
             n_pad,
             cap,
             bnd,
             max_levels,
         )
+        record_dispatch()
         if not int(np.asarray(any_up)):
             break
         if max_levels is not None and int(np.asarray(max_level)) >= max_levels:
@@ -505,7 +510,7 @@ class ShardedPushEngine(QueryEngineBase):
                     self.mesh,
                     self.adj,
                     tuple(carry),
-                    jnp.int32(1),
+                    np.int32(1),
                     self.block,
                     self.n_pad,
                     self.capacity,

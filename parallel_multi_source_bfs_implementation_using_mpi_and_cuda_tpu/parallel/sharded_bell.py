@@ -54,6 +54,7 @@ from ..ops.engine import QueryEngineBase
 from ..ops.push import compact_frontier_planes
 from .distributed import _distributed_bitbell_finish, _pad_qblock
 from .mesh import QUERY_AXIS, VERTEX_AXIS
+from ..utils.timing import record_dispatch
 from .scheduler import merge_local_f, shard_queries
 
 
@@ -628,18 +629,22 @@ def _sharded_bitbell_run_chunked(
     ``level_chunk`` levels so high-diameter (road-class) graphs never run
     thousands of halo-exchange levels inside one XLA dispatch."""
     carry = _sharded_bitbell_init(mesh, forest, query_grid, block)
+    # np.int32, hoisted: an eager jnp scalar would be its own blocking
+    # device commit EVERY iteration (utils.timing documents the floor).
+    bound = np.int32(level_chunk)
     while True:
         *carry, any_up, max_level = _sharded_bitbell_chunk(
             mesh,
             forest,
             push,
             tuple(carry),
-            jnp.int32(level_chunk),
+            bound,
             block,
             max_levels,
             halo_budget,
             push_budget,
         )
+        record_dispatch()
         if not int(np.asarray(any_up)):
             break
         if max_levels is not None and int(np.asarray(max_level)) >= max_levels:
@@ -831,7 +836,7 @@ class ShardedBellEngine(QueryEngineBase):
                 self.forest,
                 self.push,
                 tuple(carry),
-                jnp.int32(1),
+                np.int32(1),
                 self.block,
                 self.max_levels,
                 self.halo_budget,

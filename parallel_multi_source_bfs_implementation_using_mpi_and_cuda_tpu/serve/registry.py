@@ -65,9 +65,20 @@ def build_supervised_engine(graph) -> ChunkSupervisor:
     (docs/RESILIENCE.md).  The daemon serves one process's devices; the
     multi-chip mesh routes stay with the batch CLI for now
     (docs/SERVING.md scopes this)."""
-    from ..cli import _bitbell_ladder, _level_chunk_policy
+    from ..cli import (
+        _bitbell_ladder,
+        _explicit_level_chunk,
+        _level_chunk_policy,
+    )
 
-    level_chunk = _level_chunk_policy(graph)
+    explicit_chunk = _explicit_level_chunk()
+    level_chunk = _level_chunk_policy(graph, explicit_chunk)
+    # Same megachunk policy as the batch CLI (round 6): a deliberate
+    # MSBFS_LEVEL_CHUNK bound is honored exactly; the auto bound may be
+    # megachunk-fused per dispatch (ops.bitbell.resolve_megachunk).
+    megachunk = (
+        1 if (explicit_chunk is not None and explicit_chunk > 0) else None
+    )
     backend = os.environ.get("MSBFS_BACKEND", "auto")
     ladder = []
     if backend in ("vmap", "csr"):
@@ -79,7 +90,9 @@ def build_supervised_engine(graph) -> ChunkSupervisor:
         from ..ops.bitbell import BitBellEngine
 
         engine = BitBellEngine(
-            BellGraph.from_host(graph), level_chunk=level_chunk
+            BellGraph.from_host(graph),
+            level_chunk=level_chunk,
+            megachunk=megachunk,
         )
         ladder = _bitbell_ladder(graph, level_chunk)
     return ChunkSupervisor(

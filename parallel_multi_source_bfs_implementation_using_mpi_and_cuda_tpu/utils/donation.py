@@ -1,0 +1,82 @@
+"""Buffer donation for the chunked level loops (round 6).
+
+Every chunked dispatch advances a device-resident carry — bit planes,
+distance matrices, counters — and before this round each dispatch
+round-tripped that full state through FRESH HBM allocations: XLA wrote the
+output carry next to the input one and freed the input afterwards, doubling
+the loop state's peak footprint and its allocator traffic.  With
+``donate_argnums`` the input carry's buffers are handed to XLA for reuse,
+so a chunk step updates the planes in place (shapes/dtypes match
+elementwise between the carry in and the carry out, which is exactly the
+donation-matching rule).
+
+The one cost of donation is a debugging hazard: a donated array is dead
+after the call, and re-reading it raises.  Callers here never do — the
+chunk drivers (ops.bfs.host_chunked_loop, ops.bitbell.fused_best_drive)
+replace the carry binding on every step — but to keep that PROVABLE the
+wrapper compiles BOTH variants of every program and selects at call time:
+
+* ``set_donation(False)`` flips the process to the non-donated twin, which
+  tests/test_dispatch_opt.py uses to pin the donated path bit-identical to
+  the non-donated one for every engine in the agreement matrix;
+* ``MSBFS_DONATE=0`` is the operator kill switch (default on).
+
+Both variants share one Python callable, so jit caching, static argnames
+and tracing behave exactly as before.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+import jax
+
+_enabled = os.environ.get("MSBFS_DONATE", "1").lower() not in (
+    "0",
+    "off",
+    "false",
+)
+
+
+def donation_enabled() -> bool:
+    return _enabled
+
+
+def set_donation(on: bool) -> bool:
+    """Flip donation process-wide; returns the previous setting (callers
+    restore it in a finally:)."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(on)
+    return prev
+
+
+class DonatingJit:
+    """``jax.jit`` twin-compile: donated and plain executables of the same
+    function, selected per call by the process flag."""
+
+    def __init__(self, fn: Callable, donate_argnums, **jit_kwargs):
+        self._plain = jax.jit(fn, **jit_kwargs)
+        self._donating = jax.jit(
+            fn, donate_argnums=donate_argnums, **jit_kwargs
+        )
+        self.__wrapped__ = fn
+        self.__name__ = getattr(fn, "__name__", "donating_jit")
+        self.__doc__ = fn.__doc__
+
+    def __call__(self, *args, **kwargs):
+        fn = self._donating if _enabled else self._plain
+        return fn(*args, **kwargs)
+
+
+def donating_jit(fn=None, *, donate_argnums, **jit_kwargs):
+    """Decorator form: ``@donating_jit(donate_argnums=1, static_argnames=
+    (...))``.  Donate ONLY carry-style arguments the caller rebinds every
+    step — never the graph (argnum 0 everywhere here), which must stay
+    alive across the whole run."""
+    if fn is None:
+        return lambda f: DonatingJit(
+            f, donate_argnums=donate_argnums, **jit_kwargs
+        )
+    return DonatingJit(fn, donate_argnums=donate_argnums, **jit_kwargs)

@@ -11,6 +11,8 @@ must ``block_until_ready`` before closing a span — XLA dispatch is async.
 
 from __future__ import annotations
 
+import itertools
+import threading
 import time
 
 
@@ -28,3 +30,45 @@ class Span:
     def __exit__(self, *exc):
         self.seconds = time.perf_counter() - self._t0
         return False
+
+
+# --- Dispatch counter (round 6) ---------------------------------------------
+# Every host-blocking device commit — a fetch the host driver waits on
+# before it can issue more work — pays the ~100 ms tunnel round-trip floor
+# on this platform (docs/PERF_NOTES.md "Dispatch floor").  The chunked
+# drivers (ops.bfs.host_chunked_loop, ops.bitbell.fused_best_drive), the
+# engines' final result fetches and the streamed level loop all call
+# :func:`record_dispatch` at exactly those points, so floor elimination is
+# OBSERVABLE (MSBFS_STATS=1, bench detail.dispatch.dispatch_count, and the
+# make perf-smoke regression guard) rather than inferred from level counts.
+# A thread-safe itertools counter: serving worker threads may drive engines
+# concurrently, and a torn increment would corrupt the regression guard.
+
+_dispatch_counter = itertools.count()
+_dispatch_base = 0
+_dispatch_lock = threading.Lock()
+
+
+def record_dispatch(n: int = 1) -> None:
+    """Count ``n`` blocking device commits (round-trips the host waited on)."""
+    for _ in range(n):
+        next(_dispatch_counter)
+
+
+def dispatch_count() -> int:
+    """Blocking commits recorded since the last :func:`reset_dispatch_count`."""
+    with _dispatch_lock:
+        # Peek without consuming: count() has no read API, so advance a
+        # probe and account for it in the base.
+        global _dispatch_base
+        seen = next(_dispatch_counter)
+        _dispatch_base += 1
+        return seen - _dispatch_base + 1
+
+
+def reset_dispatch_count() -> None:
+    """Zero the counter (callers bracket a measured span with this)."""
+    global _dispatch_counter, _dispatch_base
+    with _dispatch_lock:
+        _dispatch_counter = itertools.count()
+        _dispatch_base = 0

@@ -1,0 +1,292 @@
+"""Round-6 dispatch-diet guarantees: donation, megachunk fusion, streaming.
+
+Three optimizations share one correctness contract — they must change
+WHEN work is dispatched, never WHAT is computed:
+
+  * buffer donation (utils.donation): the donated twin of every chunked
+    drive loop is bit-identical to the plain executable;
+  * megachunk fusion (ops.bitbell.resolve_megachunk): folding M
+    level-chunks into one dispatch equals running them separately;
+  * the host-streamed engine (ops.streamed): a host-resident prefetched
+    forest equals the device-resident gather, for every slot budget.
+
+Plus the accounting layer itself: utils.timing's dispatch counter (the
+ground truth behind bench.py detail.dispatch.measured_count and the
+`make perf-smoke` budget guard) and the >= 2x dispatch reduction the
+fusion exists to deliver.
+"""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+    BitBellEngine,
+    resolve_megachunk,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+    StencilEngine,
+    StencilGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.streamed import (
+    StreamedBitBellEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.donation import (
+    donation_enabled,
+    set_donation,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (
+    dispatch_count,
+    record_dispatch,
+    reset_dispatch_count,
+)
+
+
+@pytest.fixture(scope="module")
+def rmat():
+    n, edges = generators.rmat_edges(9, edge_factor=8, seed=901)
+    g = CSRGraph.from_edges(n, edges)
+    queries = pad_queries(
+        generators.random_queries(n, 12, max_group=5, seed=902)
+    )
+    return n, edges, g, queries
+
+
+@pytest.fixture(scope="module")
+def road():
+    n, edges = generators.road_edges(20, 17, seed=903)
+    g = CSRGraph.from_edges(n, edges)
+    queries = pad_queries(
+        generators.random_queries(n, 8, max_group=4, seed=904)
+    )
+    return n, edges, g, queries
+
+
+# --- donation bit-identity --------------------------------------------------
+
+
+def _engine_matrix(g, road_g):
+    """(name, builder, queries-kind) for every donated drive loop that has
+    a single-chip build."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bell import (
+        BellEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+        Engine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+        PackedEngine,
+    )
+
+    return [
+        ("vmap_chunked", lambda: Engine(g.to_device(), level_chunk=2), "rmat"),
+        ("packed", lambda: PackedEngine(g.to_device(), edge_chunks=2), "rmat"),
+        ("bell", lambda: BellEngine(BellGraph.from_host(g)), "rmat"),
+        (
+            "bitbell_chunked",
+            lambda: BitBellEngine(BellGraph.from_host(g), level_chunk=2),
+            "rmat",
+        ),
+        (
+            "stencil_chunked",
+            lambda: StencilEngine(
+                StencilGraph.from_host(road_g), level_chunk=2
+            ),
+            "road",
+        ),
+        (
+            "streamed",
+            lambda: StreamedBitBellEngine(
+                BellGraph.from_host(g, keep_sparse=False, device=False),
+                slot_budget=128,
+            ),
+            "rmat",
+        ),
+    ]
+
+
+def test_donation_bit_identity(rmat, road):
+    """MSBFS_DONATE on/off runs byte-identical F values AND identical
+    best() on every donated engine class — donation moves buffers, never
+    results."""
+    _, _, g, queries = rmat
+    _, _, road_g, road_queries = road
+    assert donation_enabled()  # default-on contract
+    for name, build, kind in _engine_matrix(g, road_g):
+        q = road_queries if kind == "road" else queries
+        try:
+            set_donation(False)
+            plain_f = np.asarray(build().f_values(q))
+            plain_best = build().best(q)
+        finally:
+            set_donation(True)
+        donated_f = np.asarray(build().f_values(q))
+        donated_best = build().best(q)
+        np.testing.assert_array_equal(donated_f, plain_f, err_msg=name)
+        assert donated_best == plain_best, name
+
+
+# --- megachunk fusion -------------------------------------------------------
+
+
+def test_resolve_megachunk_contract(monkeypatch):
+    monkeypatch.delenv("MSBFS_MEGACHUNK", raising=False)
+    assert resolve_megachunk(None, None) == 1  # unchunked: nothing to fuse
+    assert resolve_megachunk(5, None) == 1
+    assert resolve_megachunk(1, 4) == 1
+    assert resolve_megachunk(3, 4) == 3
+    assert resolve_megachunk(None, 4) == 8  # auto factor
+    monkeypatch.setenv("MSBFS_MEGACHUNK", "2")
+    assert resolve_megachunk(None, 4) == 2  # env overrides auto
+    with pytest.raises(ValueError):
+        resolve_megachunk(0, 4)
+    with pytest.raises(ValueError):
+        resolve_megachunk(-3, 4)
+
+
+def test_megachunk_fuzz_matches_unfused(rmat):
+    """Random (level_chunk, megachunk) grids on random graphs: the fused
+    loop is bit-identical to megachunk=1 — fusion only re-buckets levels
+    per dispatch, convergence and distances are invariant."""
+    rng = np.random.default_rng(905)
+    for trial in range(4):
+        scale = int(rng.integers(6, 9))
+        n, edges = generators.rmat_edges(
+            scale, edge_factor=6, seed=int(rng.integers(1 << 16))
+        )
+        g = BellGraph.from_host(CSRGraph.from_edges(n, edges))
+        queries = pad_queries(
+            generators.random_queries(
+                n, 8, max_group=4, seed=int(rng.integers(1 << 16))
+            )
+        )
+        lc = int(rng.integers(1, 4))
+        mc = int(rng.integers(2, 6))
+        base = BitBellEngine(g, level_chunk=lc, megachunk=1)
+        fused = BitBellEngine(g, level_chunk=lc, megachunk=mc)
+        np.testing.assert_array_equal(
+            np.asarray(fused.f_values(queries)),
+            np.asarray(base.f_values(queries)),
+            err_msg=f"trial {trial}: lc={lc} mc={mc} scale={scale}",
+        )
+        assert fused.best(queries) == base.best(queries)
+        stats_b = base.query_stats(queries)
+        stats_f = fused.query_stats(queries)
+        for a, b in zip(stats_b, stats_f):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_stencil_megachunk_matches_unfused(road):
+    _, _, g, queries = road
+    sg = StencilGraph.from_host(g)
+    base = StencilEngine(sg, level_chunk=3, megachunk=1)
+    fused = StencilEngine(sg, level_chunk=3, megachunk=4)
+    np.testing.assert_array_equal(
+        np.asarray(fused.f_values(queries)),
+        np.asarray(base.f_values(queries)),
+    )
+    assert fused.best(queries) == base.best(queries)
+
+
+def test_megachunk_cuts_dispatches_2x(rmat):
+    """The acceptance bar the fusion exists for: >= 2x fewer blocking
+    dispatches than the same bound unfused (configs 1/4-class; the full
+    budget pin lives in benchmarks/perf_smoke.py)."""
+    _, _, g, queries = rmat
+    bell = BellGraph.from_host(g)
+
+    def count(megachunk):
+        eng = BitBellEngine(bell, level_chunk=1, megachunk=megachunk)
+        eng.compile(queries.shape)
+        reset_dispatch_count()
+        eng.best(queries)
+        return dispatch_count()
+
+    unfused, fused = count(1), count(None)
+    assert fused * 2 <= unfused, (unfused, fused)
+
+
+# --- streamed engine parity -------------------------------------------------
+
+
+@pytest.mark.parametrize("slot_budget", [None, 64, 7])
+def test_streamed_matches_resident(rmat, slot_budget):
+    """Host-streamed double-buffered traversal == device-resident gather,
+    across whole-level and forced-split segmentations (slot_budget=7
+    splits every level; None streams each level whole)."""
+    _, _, g, queries = rmat
+    resident = BitBellEngine(BellGraph.from_host(g))
+    streamed = StreamedBitBellEngine(
+        BellGraph.from_host(g, keep_sparse=False, device=False),
+        slot_budget=slot_budget,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(streamed.f_values(queries)),
+        np.asarray(resident.f_values(queries)),
+    )
+    assert streamed.best(queries) == resident.best(queries)
+    rs = resident.query_stats(queries)
+    ss = streamed.query_stats(queries)
+    for a, b in zip(rs, ss):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_streamed_graph_stays_host_side(rmat):
+    """BellGraph.from_host(device=False) must not commit forest arrays to
+    the device — the whole point is that an over-HBM forest never
+    materializes device-side outside the streamed window."""
+    _, _, g, _ = rmat
+    host_graph = BellGraph.from_host(g, keep_sparse=False, device=False)
+    for arr in host_graph.level_cols:
+        assert isinstance(arr, np.ndarray)
+    assert isinstance(host_graph.final_slot, np.ndarray)
+
+
+def test_streamed_prefetch_env(rmat, monkeypatch):
+    """MSBFS_STREAM_PREFETCH sets the upload lookahead; results are
+    invariant to the pipeline depth."""
+    _, _, g, queries = rmat
+    host = BellGraph.from_host(g, keep_sparse=False, device=False)
+    monkeypatch.setenv("MSBFS_STREAM_PREFETCH", "1")
+    shallow = StreamedBitBellEngine(host, slot_budget=64)
+    assert shallow.prefetch == 1
+    monkeypatch.setenv("MSBFS_STREAM_PREFETCH", "5")
+    deep = StreamedBitBellEngine(host, slot_budget=64)
+    assert deep.prefetch == 5
+    np.testing.assert_array_equal(
+        np.asarray(shallow.f_values(queries)),
+        np.asarray(deep.f_values(queries)),
+    )
+
+
+# --- the dispatch counter itself -------------------------------------------
+
+
+def test_dispatch_counter_basics():
+    reset_dispatch_count()
+    assert dispatch_count() == 0
+    record_dispatch()
+    record_dispatch(3)
+    assert dispatch_count() == 4
+    reset_dispatch_count()
+    assert dispatch_count() == 0
+
+
+def test_best_counts_one_dispatch_unchunked(rmat):
+    """The r5 fused-best contract, now measurable: an unchunked bitbell
+    best() is exactly ONE blocking commit."""
+    _, _, g, queries = rmat
+    eng = BitBellEngine(BellGraph.from_host(g))
+    eng.compile(queries.shape)
+    reset_dispatch_count()
+    eng.best(queries)
+    assert dispatch_count() == 1

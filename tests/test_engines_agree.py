@@ -146,6 +146,35 @@ def _bitbell_chunked(g):
     return BitBellEngine(BellGraph.from_host(g), level_chunk=2)
 
 
+def _bitbell_megachunk(g):
+    """Round-6 fused chunk loop: 2-level bound x3 megachunk folded into
+    one dispatch per drive step."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        BitBellEngine,
+    )
+
+    return BitBellEngine(BellGraph.from_host(g), level_chunk=2, megachunk=3)
+
+
+def _streamed(g):
+    """Round-6 host-resident double-buffered engine; tiny slot budget so
+    the level-segmentation + prefetch pipeline actually splits."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.streamed import (
+        StreamedBitBellEngine,
+    )
+
+    return StreamedBitBellEngine(
+        BellGraph.from_host(g, keep_sparse=False, device=False),
+        slot_budget=256,
+    )
+
+
 def _distributed_chunked(g):
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
         DistributedEngine,
@@ -215,6 +244,8 @@ ENGINES = {
     "bell": _bell,
     "bitbell": _bitbell,
     "bitbell_chunked": _bitbell_chunked,
+    "bitbell_megachunk": _bitbell_megachunk,
+    "streamed": _streamed,
     "push": _push,
     "packed_push": _packed_push,
     "distributed": _distributed,
@@ -279,6 +310,17 @@ def _stencil_chunked(g):
     return StencilEngine(StencilGraph.from_host(g), level_chunk=2)
 
 
+def _stencil_megachunk(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+        StencilEngine,
+        StencilGraph,
+    )
+
+    return StencilEngine(
+        StencilGraph.from_host(g), level_chunk=2, megachunk=4
+    )
+
+
 # The banded-class slice of the same guarantee: the stencil engines only
 # accept banded graphs, so they get their cross-engine check on a road
 # lattice against a representative sample of the general engines (every
@@ -286,8 +328,10 @@ def _stencil_chunked(g):
 BANDED_ENGINES = {
     "stencil": _stencil,
     "stencil_chunked": _stencil_chunked,
+    "stencil_megachunk": _stencil_megachunk,
     "bitbell": _bitbell,
     "bitbell_chunked": _bitbell_chunked,
+    "streamed": _streamed,
     "push": _push,
     "distributed": _distributed,
     "sharded_bell": _sharded_bell,

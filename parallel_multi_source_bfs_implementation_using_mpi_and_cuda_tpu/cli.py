@@ -88,6 +88,7 @@ _SINGLE_CHIP_ONLY_BACKENDS = (
     "ppush",
     "stencil",
     "streamed",
+    "lowk",
 )
 # Backends whose HBM footprint the bitbell estimate does not model — the
 # single-chip capacity warning stays quiet for these.
@@ -610,6 +611,40 @@ def main(argv: Optional[List[str]] = None) -> int:
                     engine = StencilEngine(
                         sg, level_chunk=stencil_chunk, megachunk=megachunk
                     )
+            # Low-K fast path (round 7): for a handful of queries the
+            # bit-plane engines pad K to the 32-lane word and stream 4
+            # bytes/vertex to move <= 4 bits; the byte-flag engine
+            # (ops.lowk) keeps K as-is — 1 byte/vertex at K=1, the
+            # BASELINE config-1 shape — with the same hybrid pull/push
+            # and fused single-dispatch best().  Auto-only when no
+            # earlier route claimed the graph; MSBFS_LOWK=0 disables,
+            # MSBFS_BACKEND=lowk forces.  MSBFS_STATS=2 keeps the
+            # bitbell route: the per-level trace rides its stepped
+            # loop, and a trace request outranks the byte diet.
+            if engine is None and (
+                backend == "lowk"
+                or (
+                    backend == "auto"
+                    and not hbm_warn
+                    and 0 < padded.shape[0] <= _env_int("MSBFS_LOWK_MAX_K", 4)
+                    and os.environ.get("MSBFS_LOWK", "") != "0"
+                    and os.environ.get("MSBFS_STATS", "") != "2"
+                )
+            ):
+                from .models.bell import BellGraph
+                from .ops.lowk import LowKEngine
+
+                print(
+                    f"low-K fast path: byte-flag engine for "
+                    f"{padded.shape[0]} queries (MSBFS_LOWK=0 disables)",
+                    file=sys.stderr,
+                )
+                announce_chunk()
+                engine = LowKEngine(
+                    BellGraph.from_host(graph),
+                    level_chunk=level_chunk,
+                    megachunk=megachunk,
+                )
             use_dense = backend == "dense"
             if backend == "auto" and is_tpu_backend():
                 threshold = _env_int("MSBFS_DENSE_THRESHOLD", 8192)
@@ -777,6 +812,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                         megachunk=megachunk,
                     )
                     ladder_rungs = _bitbell_ladder(graph, level_chunk)
+
+        # ---- sub-batch split (round 7, K=1024 regime): past ~256 queries
+        # one program's planes outgrow the cache-friendly working set
+        # (BASELINE round 6: K=1024 6.27 vs K=256 8.05 GTEPS), so very
+        # wide batches run as ordered 256-wide sub-batches against the
+        # SAME device graph buffers (ops.packed.SubBatchEngine; strict-<
+        # winner merge keeps the first-minimum tie-break bit-identical).
+        # Single-chip only — the distributed engine shards queries its
+        # own way.  MSBFS_SUBBATCH_K resizes, 0 disables.  The
+        # degradation ladder's rungs are rebuilt engines and stay
+        # unwrapped: a degraded run trades the split for survival.
+        subbatch_k = _env_int("MSBFS_SUBBATCH_K", 256)
+        if (
+            n_chips == 1
+            and engine is not None
+            and subbatch_k > 0
+            and padded.shape[0] > subbatch_k
+        ):
+            from .ops.packed import SubBatchEngine
+
+            print(
+                f"wide batch: splitting {padded.shape[0]} queries into "
+                f"{subbatch_k}-wide sub-batches (MSBFS_SUBBATCH_K=0 "
+                "disables)",
+                file=sys.stderr,
+            )
+            engine = SubBatchEngine(engine, batch_k=subbatch_k)
 
         # ---- resilient execution (runtime.supervisor): every engine call
         # below runs supervised — watchdog, typed taxonomy, transient

@@ -180,3 +180,84 @@ def random_queries(
         size = int(rng.integers(1, max_group + 1))
         out.append(rng.integers(0, n, size=size, dtype=np.int64).astype(np.int32))
     return out
+
+
+def component_labels(
+    n: int, edges: np.ndarray, sample_cap: int = 1 << 24, seed: int = 0
+) -> np.ndarray:
+    """Per-vertex connected-component label (= min vertex id in the
+    component), Shiloach-Vishkin style hooking + pointer jumping on NumPy.
+
+    For edge lists beyond ``sample_cap`` rows a uniform edge SAMPLE is
+    labeled instead of the full list.  That under-merges — sampled labels
+    refine the true components — which is exactly the safe direction for
+    the only consumer (:func:`ensure_giant_sources`): any two vertices
+    sharing a SAMPLED label share a true component, so membership in the
+    sampled giant certifies membership in the true giant; the sweep can
+    only be more conservative, never wrong (round 7; fixture rule for
+    BASELINE "minF > 0" headline groups)."""
+    label = np.arange(n, dtype=np.int64)
+    e = np.asarray(edges, dtype=np.int64)
+    e = e[(e[:, 0] >= 0) & (e[:, 0] < n) & (e[:, 1] >= 0) & (e[:, 1] < n)]
+    if len(e) > sample_cap:
+        # With-replacement draw: duplicate edges are harmless to labeling,
+        # and a without-replacement pick would materialize an O(len(e))
+        # permutation on RMAT-25-class lists.
+        rng = np.random.default_rng(seed)
+        e = e[rng.integers(0, len(e), size=sample_cap)]
+    u, v = e[:, 0], e[:, 1]
+    while True:
+        lu, lv = label[u], label[v]
+        # Hook: every edge pulls both endpoints' labels down to the
+        # smaller one; np.minimum.at resolves colliding writes by min.
+        m = np.minimum(lu, lv)
+        before = label.copy()
+        np.minimum.at(label, u, m)
+        np.minimum.at(label, v, m)
+        # Pointer-jump to the fixed point so labels stay canonical
+        # (label[i] == label[label[i]]) before the convergence test.
+        while True:
+            nxt = label[label]
+            if np.array_equal(nxt, label):
+                break
+            label = nxt
+        if np.array_equal(label, before):
+            return label
+
+
+def ensure_giant_sources(
+    queries: List[np.ndarray],
+    n: int,
+    edges: np.ndarray,
+    seed: int = 0,
+) -> List[np.ndarray]:
+    """Fixture rule (round 7): every query group gets >= 1 source in the
+    largest connected component, by replacing source 0 of offending
+    groups with a seeded draw from the giant.
+
+    Why: a group whose sources all land in dust components reaches only
+    that dust, F(U) collapses to near zero, and ``best()`` degenerates to
+    "whichever group saw the fewest vertices" — the headline benchmark
+    then reports a minF == 0 argmin race instead of distance-to-set work
+    (BASELINE round-6 config-2/3 rows did exactly this).  Anchoring one
+    source per group in the giant makes every headline row satisfy
+    minF > 0 while keeping the other sources' dust-vs-giant mix intact.
+    Groups are modified copies; the input list is not mutated."""
+    labels = component_labels(n, edges, seed=seed)
+    ids, counts = np.unique(labels, return_counts=True)
+    giant_label = ids[np.argmax(counts)]
+    giant = np.flatnonzero(labels == giant_label).astype(np.int32)
+    rng = np.random.default_rng(seed)
+    out = []
+    for g in queries:
+        g = np.asarray(g, dtype=np.int32)
+        valid = g[(g >= 0) & (g < n)]
+        if valid.size and np.any(labels[valid] == giant_label):
+            out.append(g)
+            continue
+        fixed = g.copy()
+        if fixed.size == 0:
+            fixed = np.empty(1, dtype=np.int32)
+        fixed[0] = giant[int(rng.integers(0, len(giant)))]
+        out.append(fixed)
+    return out

@@ -313,6 +313,39 @@ def bit_level_chunk(carry, expand, chunk, max_levels, counts_of=unpack_counts):
     return lax.while_loop(cond, bit_level_body(expand, counts_of), carry)
 
 
+def blocked_level_chunk(
+    carry, expand, chunk, max_levels, counts_of=unpack_counts, block=1
+):
+    """:func:`bit_level_chunk` with ``block`` BFS levels unrolled per
+    while-loop iteration — the wavefront-blocking lever (round 7): XLA
+    fuses the unrolled expansions into one trace region, so the mask /
+    plane streams that every per-level pass re-reads are shared across the
+    block instead of round-tripping through HBM per level.  Bit-identity
+    is structural, not approximate: each unrolled step applies the SAME
+    one-level body under the SAME continue predicate the unblocked loop
+    evaluates (``lax.cond`` per step), so the carry trajectory — level
+    counter, per-query counters, F accumulation, ``max_levels`` cutoff —
+    is exactly the unblocked one, just dispatched in coarser regions
+    (tests/test_stencil.py fuzzes block 2..4 against block 1)."""
+    if block <= 1:
+        return bit_level_chunk(carry, expand, chunk, max_levels, counts_of)
+    start = carry[5]
+    body = bit_level_body(expand, counts_of)
+
+    def go(c):
+        g = jnp.logical_and(c[6], c[5] < start + chunk)
+        if max_levels is not None:
+            g = jnp.logical_and(g, c[5] < max_levels)
+        return g
+
+    def blocked_body(c):
+        for _ in range(block):
+            c = lax.cond(go(c), body, lambda x: x, c)
+        return c
+
+    return lax.while_loop(go, blocked_body, carry)
+
+
 def bit_level_loop(
     frontier0: jax.Array,  # (n, W) uint32 source planes
     counts0: jax.Array,  # (K,) per-query source counts

@@ -1,0 +1,303 @@
+"""Low-K fast path: byte-flag BFS for tiny query batches (round 7).
+
+The bit-plane engines pad K up to the 32-bit word (ops.bitbell.WORD_BITS),
+so the flagship single-query benchmark shape (BASELINE config 1: K = 1)
+streams a (n, 1) uint32 plane with 31 of its 32 lanes dead — every level
+pays 4 bytes/vertex to move one bit.  This engine keeps K AS IS
+(``k_align = 1``) and runs the level loop on an (n, K) uint8 0/1 flag
+matrix: at K = 1 that is a boolean (n,) frontier costing 1 byte/vertex,
+and the reduction-forest gather moves K bytes per slot instead of
+ceil(K/32) words.
+
+Everything else is shared machinery, deliberately: the 7-tuple carry,
+counters and chunk drivers come from ops.bitbell (bit_level_init /
+bit_level_chunk with a byte ``counts_of``), the pull side is the BELL
+reduction forest (ops.bell.forest_hits — max over bytes), and the push
+side is a byte-lane twin of ops.bitbell.sparse_hits_or: enumerate the
+<= budget edges leaving the frontier and scatter-max the source flags
+into their neighbors (elementwise max on 0/1 bytes IS the OR, and XLA's
+scatter-max absorbs colliding writes exactly like the reference kernel's
+benign race, main.cu:30-33).  Per level a ``lax.cond`` routes thin
+frontiers through the push and the rest through the forest — Beamer's
+direction optimization, byte-flag edition.  ``best()`` fuses packing +
+init + level loop + argmin into one program (FusedBestEngine), so the
+config-1 shape pays one dispatch unchunked.
+
+Bit-identity: pinned against the oracle and the bitbell engine by
+tests/test_lowk.py and the engines-agree matrix.  The CLI routes here
+automatically for K <= LOWK_MAX_K host queries (MSBFS_LOWK=0 disables);
+the engine itself is correct for any K — the cap is a routing policy,
+not a correctness bound (wide K wants bit planes, 8x denser).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.bell import BellGraph
+from ..utils.donation import donating_jit
+from .bell import bell_hits_packed
+from .bfs import host_chunked_loop, validate_level_chunk
+from .bitbell import (
+    FusedBestEngine,
+    _pack_status,
+    bit_level_chunk,
+    bit_level_init,
+    bit_level_loop,
+    default_sparse_budget,
+    fused_select,
+    resolve_megachunk,
+)
+from .push import compact_indices
+
+# Routing cap for the CLI/serve auto-route: below this many queries the
+# byte-flag layout beats the padded bit plane (<= 4 bytes/vertex vs the
+# word's fixed 4); at K > 4 the bit plane is already denser per query.
+LOWK_MAX_K = 4
+
+
+def lowk_pack(n: int, queries: jax.Array) -> jax.Array:
+    """(K, S) -1-padded queries -> (n, K) uint8 source flags, reference
+    bounds-check semantics (sources outside [0, n) dropped, main.cu:46-51)
+    via one sentinel-row scatter-max."""
+    k, s = queries.shape
+    valid = (queries >= 0) & (queries < n)
+    safe = jnp.where(valid, queries, n).astype(jnp.int32)  # sentinel row n
+    cols = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[:, None], (k, s))
+    flags = (
+        jnp.zeros((n + 1, k), jnp.uint8)
+        .at[safe.reshape(-1), cols.reshape(-1)]
+        .max(jnp.uint8(1), mode="drop")
+    )
+    return flags[:n]
+
+
+def _lowk_counts(new: jax.Array) -> jax.Array:
+    """(n, K) uint8 0/1 newly-reached flags -> (K,) int32 counts."""
+    return jnp.sum(new, axis=0, dtype=jnp.int32)
+
+
+def sparse_hits_flags(
+    frontier: jax.Array, graph: BellGraph, budget: int
+) -> jax.Array:
+    """Byte-flag twin of ops.bitbell.sparse_hits_or: (n, K) uint8 frontier
+    -> (n, K) uint8 hit flags by pushing the <= ``budget`` edges leaving
+    the frontier (cost budget-proportional, independent of |E|)."""
+    n = graph.n
+    start, count, vals = graph.sparse
+    if vals.shape[0] == 0:
+        return jnp.zeros_like(frontier)
+    active = (frontier != jnp.uint8(0)).any(axis=1)  # (n,)
+    ids = compact_indices(active, budget, fill_value=n)  # (B,) ascending
+    valid_id = ids < n
+    safe_ids = jnp.minimum(ids, n - 1)
+    deg = jnp.where(valid_id, jnp.take(count, safe_ids), 0)
+    st = jnp.where(valid_id, jnp.take(start, safe_ids), 0)
+    pos = jnp.cumsum(deg) - deg  # exclusive: edge range start per owner
+    total = pos[-1] + deg[-1]
+    own = (
+        jnp.zeros((budget,), jnp.int32)
+        .at[jnp.where(deg > 0, pos, budget)]
+        .max(jnp.arange(budget, dtype=jnp.int32), mode="drop")
+    )
+    own = lax.cummax(own)
+    j = jnp.arange(budget, dtype=jnp.int32)
+    within = j - jnp.take(pos, own)
+    valid_e = j < total
+    eidx = jnp.clip(jnp.take(st, own) + within, 0, vals.shape[0] - 1)
+    nbr = jnp.where(valid_e, jnp.take(vals, eidx), n)  # sentinel row n
+    src_rows = jnp.where(
+        valid_id[:, None],
+        jnp.take(frontier, safe_ids, axis=0),
+        jnp.uint8(0),
+    )
+    rows = jnp.take(src_rows, own, axis=0)  # (budget, K)
+    hit = jnp.zeros((n + 1, rows.shape[1]), jnp.uint8).at[nbr].max(rows)
+    return hit[:n]
+
+
+def lowk_expand(graph: BellGraph, budget: int):
+    """Hybrid pull/push expansion hook over byte flags (the
+    ops.bitbell.hybrid_expand routing, byte-lane edition)."""
+    if budget:
+        _, count, _ = graph.sparse
+
+    def expand(visited, frontier):
+        if not budget:
+            hits = bell_hits_packed(frontier, graph)
+        else:
+            active = (frontier != jnp.uint8(0)).any(axis=1)
+            cnt = jnp.sum(active, dtype=jnp.int32)
+            edges = jnp.sum(jnp.where(active, count, 0), dtype=jnp.int32)
+            pred = (cnt <= budget) & (edges <= budget)
+            hits = lax.cond(
+                pred,
+                lambda fr: sparse_hits_flags(fr, graph, budget),
+                lambda fr: bell_hits_packed(fr, graph),
+                frontier,
+            )
+        return jnp.where(visited > jnp.uint8(0), jnp.uint8(0), hits)
+
+    return expand
+
+
+@partial(jax.jit, static_argnames=("max_levels", "budget"))
+def lowk_run(
+    graph: BellGraph,
+    queries: jax.Array,
+    max_levels: Optional[int] = None,
+    budget: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(K, S) queries -> per-query (f, levels, reached), whole BFS in one
+    dispatch (shared 7-tuple loop, byte counts)."""
+    frontier0 = lowk_pack(graph.n, queries)
+    return bit_level_loop(
+        frontier0,
+        _lowk_counts(frontier0),
+        lowk_expand(graph, budget),
+        max_levels,
+        counts_of=_lowk_counts,
+    )
+
+
+@jax.jit
+def _lowk_init_carry(graph: BellGraph, queries: jax.Array):
+    frontier0 = lowk_pack(graph.n, queries)
+    return bit_level_init(frontier0, _lowk_counts(frontier0))
+
+
+@donating_jit(donate_argnums=(1,), static_argnames=("max_levels", "budget"))
+def _lowk_chunk(graph, carry, chunk, max_levels, budget):
+    """One bounded dispatch; carry DONATED (driver rebinds it)."""
+    return bit_level_chunk(
+        carry,
+        lowk_expand(graph, budget),
+        chunk,
+        max_levels,
+        counts_of=_lowk_counts,
+    )
+
+
+@partial(jax.jit, static_argnames=("max_levels", "budget"))
+def lowk_best_fused(graph, queries, k, max_levels=None, budget=0):
+    """Whole byte-flag BFS + (minF, minK) selection in one XLA program
+    returning one (2,) int64 buffer (``k`` traced; see
+    ops.bitbell.bitbell_best_fused)."""
+    f, _, _ = lowk_run(graph, queries, max_levels, budget)
+    min_f, min_k = fused_select(f, k)
+    return jnp.stack([min_f, min_k.astype(jnp.int64)])
+
+
+def _lowk_best_tail(graph, carry, k, chunk, max_levels, budget):
+    carry = bit_level_chunk(
+        carry,
+        lowk_expand(graph, budget),
+        chunk,
+        max_levels,
+        counts_of=_lowk_counts,
+    )
+    return carry + (_pack_status(carry, k),)
+
+
+@partial(jax.jit, static_argnames=("max_levels", "budget"))
+def _lowk_start_chunk_best(graph, queries, k, chunk, max_levels, budget):
+    """Packing + init + first chunk + selection, one dispatch (NOT
+    donated: argnum 1 is the caller's query array)."""
+    return _lowk_best_tail(
+        graph, _lowk_init_carry(graph, queries), k, chunk, max_levels, budget
+    )
+
+
+@donating_jit(donate_argnums=(1,), static_argnames=("max_levels", "budget"))
+def _lowk_chunk_best(graph, carry, k, chunk, max_levels, budget):
+    """Continuation dispatch (7-tuple carry DONATED)."""
+    return _lowk_best_tail(graph, carry, k, chunk, max_levels, budget)
+
+
+class LowKEngine(FusedBestEngine):
+    """Byte-flag all-queries-at-once engine over a BellGraph with NO
+    query-axis padding (``k_align = 1``): the K <= 4 fast path.
+
+    ``sparse_budget``: hybrid push threshold in edge slots (None
+    auto-sizes from the dedup CSR like BitBellEngine; 0 = pure forest
+    pulls).  ``level_chunk``/``megachunk``: per-dispatch level bound and
+    fusion factor, same contract as the other bit-plane engines."""
+
+    k_align = 1
+
+    def __init__(
+        self,
+        graph: BellGraph,
+        max_levels: Optional[int] = None,
+        sparse_budget: Optional[int] = None,
+        level_chunk: Optional[int] = None,
+        megachunk: Optional[int] = None,
+    ):
+        self.graph = graph
+        self.max_levels = max_levels
+        if sparse_budget is None:
+            e = graph.sparse[2].shape[0] if graph.sparse is not None else 0
+            sparse_budget = default_sparse_budget(e) if e else 0
+        if sparse_budget and graph.sparse is None:
+            raise ValueError(
+                "sparse_budget > 0 needs the BellGraph's dedup CSR "
+                "(BellGraph.from_host(..., keep_sparse=True))"
+            )
+        self.sparse_budget = int(sparse_budget)
+        self.level_chunk = validate_level_chunk(level_chunk)
+        self.megachunk = resolve_megachunk(megachunk, self.level_chunk)
+
+    def _run(self, queries):
+        if self.level_chunk:
+            # np.int32 traced bound: rides the dispatch (an eager jnp
+            # scalar would be its own device commit).
+            bound = np.int32(self.level_chunk * self.megachunk)
+            carry = host_chunked_loop(
+                _lowk_init_carry(self.graph, queries),
+                lambda c: _lowk_chunk(
+                    self.graph, c, bound, self.max_levels, self.sparse_budget
+                ),
+                self.max_levels,
+                level_ix=5,
+                updated_ix=6,
+            )
+            return carry[2], carry[3], carry[4]
+        return lowk_run(
+            self.graph, queries, self.max_levels, self.sparse_budget
+        )
+
+    def _fused_full(self, queries, k):
+        return lowk_best_fused(
+            self.graph, queries, k, self.max_levels, self.sparse_budget
+        )
+
+    def _fused_chunk(self, state, k, first):
+        fn = _lowk_start_chunk_best if first else _lowk_chunk_best
+        return fn(
+            self.graph,
+            state,
+            k,
+            np.int32(self.level_chunk * self.megachunk),
+            self.max_levels,
+            self.sparse_budget,
+        )
+
+    def f_values(self, queries) -> jax.Array:
+        queries, k = self._pad_queries(queries)
+        f, _, _ = self._run(queries)
+        return f[:k]
+
+    def query_stats(self, queries):
+        queries, k = self._pad_queries(queries)
+        f, levels, reached = self._run(queries)
+        return (
+            np.asarray(levels)[:k],
+            np.asarray(reached)[:k],
+            np.asarray(f)[:k],
+        )

@@ -240,6 +240,95 @@ def packed_f_values(
     return jax.vmap(f_of_u)(dist.T)
 
 
+class SubBatchEngine:
+    """Split very wide query batches into ordered ``batch_k``-wide
+    sub-batches sharing one graph residency (round 7, K = 1024 regime).
+
+    BASELINE round 6 measured the K = 1024 single-program run at 6.27
+    GTEPS vs 8.05 at K = 256 on RMAT-20: past ~256 queries the (n, W)
+    planes and (budget, K) hybrid transients outgrow the cache-friendly
+    working set, so four K = 256 programs beat one K = 1024 program even
+    paying three extra result fetches (docs/PERF_NOTES.md round 7).  This
+    wrapper is engine-agnostic: each sub-batch runs the inner engine's
+    own fused path against the SAME device graph buffers (uploaded once,
+    outside this wrapper), and only the scalar winners cross the host
+    boundary between sub-batches.
+
+    Bit-identity: sub-batches preserve query order, and the cross-batch
+    winner is accepted on STRICT improvement only, so the global result
+    is the first strict minimum exactly as one program computes it
+    (reference tie-break, main.cu:379-397) — ``min_k`` re-offset by the
+    sub-batch's start row.  Pinned by tests/test_lowk.py and the
+    engines-agree ``subbatch`` arm.
+    """
+
+    def __init__(self, inner, batch_k: int = 256):
+        if batch_k <= 0:
+            raise ValueError(f"batch_k must be positive (got {batch_k})")
+        self.inner = inner
+        self.batch_k = int(batch_k)
+
+    def __getattr__(self, name):
+        # Delegate everything not overridden (graph, max_levels, stats
+        # hooks like level_stats) to the wrapped engine.
+        return getattr(self.inner, name)
+
+    def _chunks(self, queries):
+        queries = np.asarray(queries, dtype=np.int32)
+        k = queries.shape[0]
+        for start in range(0, k, self.batch_k):
+            yield start, queries[start : start + self.batch_k]
+
+    def best(self, queries) -> Tuple[int, int]:
+        queries = np.asarray(queries, dtype=np.int32)
+        if queries.shape[0] <= self.batch_k:
+            return self.inner.best(queries)
+        best_f, best_k = -1, -1
+        for start, sub in self._chunks(queries):
+            f, kk = self.inner.best(sub)
+            if kk >= 0 and (best_k < 0 or f < best_f):
+                best_f, best_k = f, kk + start
+        return best_f, best_k
+
+    def f_values(self, queries) -> jax.Array:
+        queries = np.asarray(queries, dtype=np.int32)
+        if queries.shape[0] <= self.batch_k:
+            return self.inner.f_values(queries)
+        parts = [self.inner.f_values(sub) for _, sub in self._chunks(queries)]
+        return jnp.concatenate(parts)
+
+    def query_stats(self, queries):
+        queries = np.asarray(queries, dtype=np.int32)
+        if queries.shape[0] <= self.batch_k:
+            return self.inner.query_stats(queries)
+        parts = [
+            self.inner.query_stats(sub) for _, sub in self._chunks(queries)
+        ]
+        if parts and parts[0] is None:
+            return None
+        return tuple(
+            np.concatenate([np.asarray(p[i]) for p in parts])
+            for i in range(len(parts[0]))
+        )
+
+    def compile(self, queries_shape, **kwargs) -> None:
+        """Warm the inner engine for every sub-batch shape the split will
+        produce (one full-width shape plus at most one tail shape)."""
+        k, s = queries_shape
+        shapes = {(min(self.batch_k, k) if k else 0, s)}
+        if k > self.batch_k and k % self.batch_k:
+            shapes.add((k % self.batch_k, s))
+        for shape in shapes:
+            self.inner.compile(shape, **kwargs)
+
+    def is_warmed(self, queries_shape) -> bool:
+        k, s = queries_shape
+        shapes = {(min(self.batch_k, k) if k else 0, s)}
+        if k > self.batch_k and k % self.batch_k:
+            shapes.add((k % self.batch_k, s))
+        return all(self.inner.is_warmed(shape) for shape in shapes)
+
+
 class PackedEngine(PackedEngineBase):
     """Coalesced all-queries-at-once engine over a device CSR.
 

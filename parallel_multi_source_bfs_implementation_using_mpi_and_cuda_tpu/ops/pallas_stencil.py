@@ -1,0 +1,161 @@
+"""Chunked Pallas kernel chain for the stencil masked-shift sweep.
+
+benchmarks/pallas_stencil_probe.py proved the formulation on the real
+chip: an (R, 128) VMEM view of the flat (n,) uint32 plane, each flat
+shift decomposed into a static lane concat + two statically-shifted row
+copies + a lane-index select (13x the XLA per-level time at road-512).
+It also established the production constraint of this stack: ONLY
+gridless whole-VMEM ``pallas_call``s compile — every gridded variant
+(Blocked halo blocks, pl.Element windows) crashes the remote AOT compile
+helper with HTTP 500 (docs/PALLAS_LOG.md round 5).
+
+This module productionizes the proven kernel by doing the chunking
+MANUALLY in XLA glue (round-7 tentpole lever c): the padded plane is cut
+into row chunks small enough that each (chunk + 2*halo, 128) operand
+fits the ~2 MB single-VMEM-block budget, each chunk runs the gridless
+kernel with a max|offset|-row halo of its neighbors stitched on, and the
+halo-trimmed centers concatenate back into the full hit plane.  The halo
+makes each chunk's local zero-padded shifts see exactly the rows the
+global shift would (the plane's own ends are genuinely zero-padded), so
+the chain is bit-identical to the XLA sweep — pinned by
+tests/test_stencil.py in interpreter mode on CPU.
+
+The residual (shortcut edges) stays OUTSIDE the kernel, in the XLA
+segment-OR (ops.stencil.stencil_hits) — it is O(R) gather/scatter work,
+not plane streaming.  Routing: ``MSBFS_STENCIL_KERNEL=1`` via
+StencilEngine, with the XLA formulation as automatic fallback when this
+module fails to import (no pallas on the host) — see the guarded import
+in ops/stencil.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+LANES = 128
+# One gridless call's operand budget: (MAX_TOTAL_ROWS, 128) uint32 = 2 MB
+# per operand (frontier, mask, out) — the probe's proven whole-VMEM size.
+MAX_TOTAL_ROWS = 4096
+
+
+def flat_shift_2d(x, d, lane_idx):
+    """(R, 128) view of a flat shift by d: out_flat[i] = x_flat[i - d],
+    zero fill at the array edges.  ``d`` is a static python int; the lane
+    rotation is a static concat because pltpu.roll's shift amount lowers
+    as i64 and Mosaic rejects it (docs/PALLAS_LOG.md)."""
+    r = d % LANES  # python ints: static (nonneg also for negative d)
+    q = d // LANES  # floor division pairs with the mod above
+
+    rolled = (
+        jnp.concatenate([x[:, LANES - r :], x[:, : LANES - r]], axis=1)
+        if r
+        else x
+    )
+
+    def row_shift(arr, rows):
+        if rows == 0:
+            return arr
+        total = arr.shape[0]
+        z = jnp.zeros((abs(rows), arr.shape[1]), arr.dtype)
+        if rows > 0:
+            return jnp.concatenate([z, arr[: total - rows]], axis=0)
+        return jnp.concatenate([arr[-rows:], z], axis=0)
+
+    hi = row_shift(rolled, q)  # lanes b >= r
+    if not r:
+        return hi
+    lo = row_shift(rolled, q + 1)  # lanes b < r borrow one more row
+    return jnp.where(lane_idx >= r, hi, lo)
+
+
+def make_kernel(offsets):
+    """Fused one-VMEM-pass stencil sweep: read the frontier and mask
+    chunks once, apply every offset, write the hit chunk once."""
+
+    def kernel(f_ref, m_ref, o_ref):
+        f = f_ref[...]  # (C, 128) uint32 frontier words
+        m = m_ref[...]  # (C, 128) uint32 offset-presence words
+        lane_idx = lax.broadcasted_iota(jnp.int32, f.shape, 1)
+        hits = jnp.zeros_like(f)
+        for i, d in enumerate(offsets):
+            masked = jnp.where(
+                (m >> jnp.uint32(i)) & jnp.uint32(1) != 0, f, jnp.uint32(0)
+            )
+            hits = hits | flat_shift_2d(masked, d, lane_idx)
+        o_ref[...] = hits
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _chain_call(offsets, rows, interpret):
+    """One gridless whole-VMEM pallas_call per (offsets, chunk-rows) —
+    cached so the chain compiles at most two programs per plane (body
+    chunk + tail chunk)."""
+    import jax.experimental.pallas as pl
+
+    kwargs = {}
+    if not interpret:
+        import jax.experimental.pallas.tpu as pltpu
+
+        kwargs = dict(
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )
+    return pl.pallas_call(
+        make_kernel(offsets),
+        out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
+        interpret=interpret,
+        **kwargs,
+    )
+
+
+def halo_rows(offsets) -> int:
+    """Rows of neighbor halo a chunk needs: a flat shift by d moves
+    content by at most |d| // 128 rows plus one row of lane borrow."""
+    return max(abs(int(d)) for d in offsets) // LANES + 1
+
+
+def pallas_hits(frontier: jax.Array, mask_bits: jax.Array, offsets):
+    """(n,) uint32 flat frontier plane -> (n,) uint32 hit plane, the
+    masked-shift sweep as a chain of gridless Pallas calls (interpreter
+    mode off-TPU, so CPU CI pins bit-identity)."""
+    from ..utils.platform import is_tpu_backend
+
+    offsets = tuple(int(d) for d in offsets)
+    n = frontier.shape[0]
+    rows = -(-n // LANES)
+    halo = halo_rows(offsets)
+    block = max(MAX_TOTAL_ROWS - 2 * halo, 1)
+    interpret = not is_tpu_backend()
+
+    # Zero halo + lane-tail padding, then the (rows + 2*halo, 128) view.
+    hpad = jnp.zeros(halo * LANES, dtype=jnp.uint32)
+    tail = jnp.zeros(rows * LANES - n + halo * LANES, dtype=jnp.uint32)
+    f2 = jnp.concatenate([hpad, frontier, tail]).reshape(
+        rows + 2 * halo, LANES
+    )
+    m2 = jnp.concatenate([hpad, mask_bits, tail]).reshape(
+        rows + 2 * halo, LANES
+    )
+
+    parts = []
+    for cs in range(0, rows, block):
+        ce = min(cs + block, rows)
+        span = ce - cs + 2 * halo
+        # Output rows [cs, ce) live at padded rows [cs + halo, ce + halo);
+        # the kernel additionally sees halo rows of each neighbor chunk
+        # (or the genuine zero padding at the plane ends).
+        f_c = lax.slice_in_dim(f2, cs, cs + span, axis=0)
+        m_c = lax.slice_in_dim(m2, cs, cs + span, axis=0)
+        o = _chain_call(offsets, span, interpret)(f_c, m_c)
+        parts.append(o[halo : halo + (ce - cs)])
+    hits2 = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    return hits2.reshape(-1)[:n]

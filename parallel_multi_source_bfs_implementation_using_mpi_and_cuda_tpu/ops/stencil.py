@@ -29,6 +29,7 @@ the bitbell engine by tests/test_stencil.py.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Optional, Tuple
 
@@ -38,14 +39,15 @@ import numpy as np
 from jax import lax
 
 from ..utils.donation import donating_jit
-from .bfs import host_chunked_loop, validate_level_chunk
+from ..utils.timing import record_dispatch, record_plane_pass
+from .bfs import validate_level_chunk
 from .bitbell import (
     WORD_BITS,
     FusedBestEngine,
     _pack_status,
-    bit_level_chunk,
     bit_level_init,
     bit_level_loop,
+    blocked_level_chunk,
     fused_select,
     pack_byte_planes,
     pack_queries,
@@ -54,6 +56,13 @@ from .bitbell import (
     unpack_byte_planes,
     unpack_counts,
 )
+
+try:  # The Pallas chain is optional: XLA masked shifts are the fallback
+    # whenever pallas (or its TPU lowering) is unavailable (MSBFS_STENCIL
+    # _KERNEL routing below; docs/PALLAS_LOG.md round 7).
+    from .pallas_stencil import pallas_hits as _pallas_hits
+except Exception:  # pragma: no cover - environment-dependent
+    _pallas_hits = None
 
 # Routing defaults: at most this many distinct diffs, covering all but
 # MAX_RESIDUAL_FRAC of directed edges.  16 masked shift passes already
@@ -271,11 +280,10 @@ def _shift_planes(planes: jax.Array, d: int) -> jax.Array:
     return jnp.concatenate([planes[-d:], pad], axis=0)
 
 
-def stencil_hits(frontier: jax.Array, graph: StencilGraph) -> jax.Array:
-    """(n, W) uint32 frontier planes -> (n, W) per-vertex hit planes via
-    masked shifts + the compact residual segment-OR.  A flat (n,) frontier
-    (the W == 1 lane-squeeze path) yields flat (n,) hits."""
-    flat = frontier.ndim == 1
+def _xla_shift_hits(
+    frontier: jax.Array, graph: StencilGraph, flat: bool
+) -> jax.Array:
+    """The XLA masked-shift sweep (per-offset where + slice-pad + OR)."""
     hits = jnp.zeros_like(frontier)
     # (n, 1) broadcasts over W on the plane path; the flat path uses the
     # (n,) word directly — a trailing dim of 1 would put the whole level
@@ -288,6 +296,24 @@ def stencil_hits(frontier: jax.Array, graph: StencilGraph) -> jax.Array:
             jnp.uint32(0),
         )
         hits = hits | _shift_planes(masked, d)
+    return hits
+
+
+def stencil_hits(
+    frontier: jax.Array, graph: StencilGraph, kernel: bool = False
+) -> jax.Array:
+    """(n, W) uint32 frontier planes -> (n, W) per-vertex hit planes via
+    masked shifts + the compact residual segment-OR.  A flat (n,) frontier
+    (the W == 1 lane-squeeze path) yields flat (n,) hits.  With ``kernel``
+    (trace-time static) the masked-shift sweep runs as the chunked Pallas
+    kernel chain (ops.pallas_stencil) on the flat path; the residual stays
+    in XLA either way — it is O(R) gather/scatter work the VPU kernel has
+    no business owning."""
+    flat = frontier.ndim == 1
+    if kernel and flat and graph.offsets and _pallas_hits is not None:
+        hits = _pallas_hits(frontier, graph.mask_bits, graph.offsets)
+    else:
+        hits = _xla_shift_hits(frontier, graph, flat)
     r = graph.res_src.shape[0]
     if r:
         # Compact residual: O(R) gather + byte-lane segment-OR into the
@@ -313,14 +339,14 @@ def stencil_hits(frontier: jax.Array, graph: StencilGraph) -> jax.Array:
     return hits
 
 
-def stencil_new(visited, frontier, graph: StencilGraph):
+def stencil_new(visited, frontier, graph: StencilGraph, kernel: bool = False):
     """Fused expansion: newly-reached planes in one pass over the plane
     streams.  The unvisited mask is computed ONCE and folded into the hit
     accumulation, so the level's output is produced without re-streaming a
     separate full-size ``hits`` array through an extra AND pass — the
     round-6 roofline push (docs/PERF_NOTES.md round 6): every word the
     level streams is either a shift-pass operand or the final ``new``."""
-    return stencil_hits(frontier, graph) & ~visited
+    return stencil_hits(frontier, graph, kernel) & ~visited
 
 
 def _stencil_counts(new: jax.Array) -> jax.Array:
@@ -340,29 +366,68 @@ def _maybe_flat(planes: jax.Array) -> jax.Array:
     return planes[:, 0] if planes.shape[1] == 1 else planes
 
 
-def _stencil_expand(graph: StencilGraph):
+def _stencil_expand(graph: StencilGraph, kernel: bool = False):
     def expand(visited, frontier):
-        return stencil_new(visited, frontier, graph)
+        return stencil_new(visited, frontier, graph, kernel)
 
     return expand
 
 
-@partial(jax.jit, static_argnames=("max_levels",))
+def stencil_level_bytes(
+    num_offsets: int, rows: int, w_words: int, block: int = 1
+) -> int:
+    """Analytic full-plane-equivalent HBM bytes ONE BFS level streams over
+    ``rows`` vertices: per offset a frontier-plane read + a hits-plane
+    write (2 * W words each), the visited/new/F update streams (6 * W
+    words, round-6 fused formulation), plus the (rows,) uint32 mask word
+    re-read per offset sweep — amortised over ``block`` wavefront-blocked
+    levels, the one stream blocking actually removes (the plane operands
+    change every level; the mask never does).  At ``block == 1`` this is
+    exactly bench.py's round-5 stream model, pinned by
+    tests/test_dispatch_opt.py so the two can never drift apart.  The
+    engines feed this to utils.timing.record_plane_pass at every chunked
+    dispatch, which is what the make perf-smoke plane-pass guard and the
+    bench plane_pass detail read."""
+    plane_words = num_offsets * 2 * w_words + 6 * w_words
+    mask_words = num_offsets
+    return 4 * rows * plane_words + (4 * rows * mask_words) // max(
+        int(block), 1
+    )
+
+
+@partial(jax.jit, static_argnames=("max_levels", "block", "kernel"))
 def stencil_run(
     graph: StencilGraph,
     queries: jax.Array,
     max_levels: Optional[int] = None,
+    block: int = 1,
+    kernel: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """(K, S) queries (K % 32 == 0) -> per-query (f, levels, reached),
-    whole BFS in one dispatch."""
+    whole BFS in one dispatch.  ``block`` > 1 runs the wavefront-blocked
+    level loop (ops.bitbell.blocked_level_chunk — bit-identical carry
+    trajectory, coarser dispatch regions)."""
     frontier0 = _maybe_flat(pack_queries(graph.n, queries))
-    return bit_level_loop(
-        frontier0,
-        _stencil_counts(frontier0),
-        _stencil_expand(graph),
+    if block <= 1:
+        return bit_level_loop(
+            frontier0,
+            _stencil_counts(frontier0),
+            _stencil_expand(graph, kernel),
+            max_levels,
+            counts_of=_stencil_counts,
+        )
+    carry = bit_level_init(frontier0, _stencil_counts(frontier0))
+    # An effectively-unbounded chunk turns the blocked chunk driver into
+    # the full level loop (the per-step guard still honors max_levels).
+    carry = blocked_level_chunk(
+        carry,
+        _stencil_expand(graph, kernel),
+        jnp.int32(2**30),
         max_levels,
         counts_of=_stencil_counts,
+        block=block,
     )
+    return carry[2], carry[3], carry[4]
 
 
 @jax.jit
@@ -371,17 +436,73 @@ def _stencil_init_carry(graph: StencilGraph, queries: jax.Array):
     return bit_level_init(frontier0, _stencil_counts(frontier0))
 
 
-@donating_jit(donate_argnums=(1,), static_argnames=("max_levels",))
-def _stencil_chunk(graph, carry, chunk, max_levels):
+@donating_jit(
+    donate_argnums=(1,), static_argnames=("max_levels", "block", "kernel")
+)
+def _stencil_chunk(graph, carry, chunk, max_levels, block=1, kernel=False):
     """One bounded dispatch; the carry is DONATED — the host driver
     rebinds it every step, so the plane buffers are reused in place
     (utils.donation)."""
-    return bit_level_chunk(
+    return blocked_level_chunk(
         carry,
-        _stencil_expand(graph),
+        _stencil_expand(graph, kernel),
         chunk,
         max_levels,
         counts_of=_stencil_counts,
+        block=block,
+    )
+
+
+def _window_advance(graph, carry, wlo, chunk, max_levels, r, block, kernel):
+    """Advance the carry by <= ``chunk`` levels touching ONLY the ``r``-row
+    window starting at traced row ``wlo`` (round-7 active-window lever).
+
+    Exactness argument (asserted by tests/test_stencil.py): the caller
+    sizes the window as the current frontier band [lo, hi) plus a
+    max|offset| * chunk margin on each side (clamped to the plane), so no
+    bit can travel to within one shift of the window edge during the
+    chunk.  Inside the window the local zero-padded shifts therefore see
+    exactly the bits the global shifts would; outside it the frontier is
+    identically zero, so nothing can shift IN, and ``new`` is identically
+    zero, so visited/F/counters are untouched.  Where the window clamps to
+    a plane boundary the local zero-fill IS the global zero-fill.  The
+    window carries the residual-free precondition: a residual (shortcut)
+    edge could teleport a bit across the band, so the engine only routes
+    here when ``graph.res_src`` is empty."""
+    visited, frontier, f, levels, reached, level, updated = carry
+    vis_w = lax.dynamic_slice_in_dim(visited, wlo, r, axis=0)
+    fr_w = lax.dynamic_slice_in_dim(frontier, wlo, r, axis=0)
+    mask_w = lax.dynamic_slice_in_dim(graph.mask_bits, wlo, r, axis=0)
+    empty = jnp.zeros(0, dtype=jnp.int32)
+    local = StencilGraph(
+        r, graph.num_directed_edges, graph.offsets, mask_w, empty, empty,
+        empty,
+    )
+    lc = blocked_level_chunk(
+        (vis_w, fr_w, f, levels, reached, level, updated),
+        _stencil_expand(local, kernel),
+        chunk,
+        max_levels,
+        counts_of=_stencil_counts,
+        block=block,
+    )
+    visited = lax.dynamic_update_slice_in_dim(visited, lc[0], wlo, axis=0)
+    frontier = lax.dynamic_update_slice_in_dim(frontier, lc[1], wlo, axis=0)
+    return (visited, frontier) + lc[2:]
+
+
+@donating_jit(
+    donate_argnums=(1,),
+    static_argnames=("max_levels", "r", "block", "kernel"),
+)
+def _stencil_window_chunk(
+    graph, carry, wlo, chunk, max_levels, r, block, kernel
+):
+    """Windowed sibling of :func:`_stencil_chunk` (carry DONATED).  ``r``
+    is static (pow2-laddered by the engine so at most log2(n) programs
+    ever compile); ``wlo`` rides the dispatch as a traced np.int32."""
+    return _window_advance(
+        graph, carry, wlo, chunk, max_levels, r, block, kernel
     )
 
 
@@ -392,43 +513,99 @@ def stencil_step(graph: StencilGraph, visited, frontier):
     return visited | new, new, unpack_counts(new)
 
 
-@partial(jax.jit, static_argnames=("max_levels",))
+@partial(jax.jit, static_argnames=("max_levels", "block", "kernel"))
 def stencil_best_fused(
-    graph: StencilGraph, queries: jax.Array, k, max_levels=None
+    graph: StencilGraph,
+    queries: jax.Array,
+    k,
+    max_levels=None,
+    block=1,
+    kernel=False,
 ):
     """Whole stencil BFS + final (minF, minK) selection in one XLA
     program returning one (2,) int64 buffer (see
     ops.bitbell.bitbell_best_fused; ``k`` traced)."""
-    f, _, _ = stencil_run(graph, queries, max_levels)
+    f, _, _ = stencil_run(graph, queries, max_levels, block, kernel)
     min_f, min_k = fused_select(f, k)
     return jnp.stack([min_f, min_k.astype(jnp.int64)])
 
 
-def _stencil_best_tail(graph, carry, k, chunk, max_levels):
-    carry = bit_level_chunk(
+def _stencil_best_tail(graph, carry, k, chunk, max_levels, block, kernel):
+    carry = blocked_level_chunk(
         carry,
-        _stencil_expand(graph),
+        _stencil_expand(graph, kernel),
         chunk,
         max_levels,
         counts_of=_stencil_counts,
+        block=block,
     )
     return carry + (_pack_status(carry, k),)
 
 
-@partial(jax.jit, static_argnames=("max_levels",))
-def _stencil_start_chunk_best(graph, queries, k, chunk, max_levels):
+@partial(jax.jit, static_argnames=("max_levels", "block", "kernel"))
+def _stencil_start_chunk_best(
+    graph, queries, k, chunk, max_levels, block=1, kernel=False
+):
     """Packing + init + first level chunk + selection, one dispatch.
     NOT donated: argnum 1 is the caller's query array."""
     return _stencil_best_tail(
-        graph, _stencil_init_carry(graph, queries), k, chunk, max_levels
+        graph,
+        _stencil_init_carry(graph, queries),
+        k,
+        chunk,
+        max_levels,
+        block,
+        kernel,
     )
 
 
-@donating_jit(donate_argnums=(1,), static_argnames=("max_levels",))
-def _stencil_chunk_best(graph, carry, k, chunk, max_levels):
+@donating_jit(
+    donate_argnums=(1,), static_argnames=("max_levels", "block", "kernel")
+)
+def _stencil_chunk_best(
+    graph, carry, k, chunk, max_levels, block=1, kernel=False
+):
     """Continuation dispatch for BFS deeper than one chunk; the 7-tuple
     carry is DONATED (the driver rebinds it every step)."""
-    return _stencil_best_tail(graph, carry, k, chunk, max_levels)
+    return _stencil_best_tail(
+        graph, carry, k, chunk, max_levels, block, kernel
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("max_levels", "r", "block", "kernel")
+)
+def _stencil_window_start_best(
+    graph, queries, k, wlo, chunk, max_levels, r, block, kernel
+):
+    """Windowed fused-best START: packing + init + one windowed chunk +
+    selection in one dispatch.  NOT donated (argnum 1 is the caller's
+    query array)."""
+    carry = _window_advance(
+        graph,
+        _stencil_init_carry(graph, queries),
+        wlo,
+        chunk,
+        max_levels,
+        r,
+        block,
+        kernel,
+    )
+    return carry + (_pack_status(carry, k),)
+
+
+@donating_jit(
+    donate_argnums=(1,),
+    static_argnames=("max_levels", "r", "block", "kernel"),
+)
+def _stencil_window_chunk_best(
+    graph, carry, k, wlo, chunk, max_levels, r, block, kernel
+):
+    """Windowed fused-best CONTINUATION (7-tuple carry DONATED)."""
+    carry = _window_advance(
+        graph, carry, wlo, chunk, max_levels, r, block, kernel
+    )
+    return carry + (_pack_status(carry, k),)
 
 
 # Stencil levels stream ~#offsets * n * W words with no gather/scatter, so
@@ -450,7 +627,34 @@ class StencilEngine(FusedBestEngine):
     (AUTO_STENCIL_LEVEL_CHUNK when the CLI routes here); ``megachunk``
     fuses that many chunks into one dispatch
     (ops.bitbell.resolve_megachunk; callers whose chunk is a deliberate
-    bound pass 1)."""
+    bound pass 1).
+
+    Round-7 levers (docs/PERF_NOTES.md round 7):
+
+    ``wavefront`` (MSBFS_WAVEFRONT, default 1): BFS levels unrolled per
+    dispatch region — amortises the per-level mask-word re-read
+    (ops.bitbell.blocked_level_chunk; bit-identical by construction).
+
+    ``window`` (MSBFS_STENCIL_WINDOW, default auto, "0" disables): slice
+    every chunked dispatch to the monotone frontier band ± max|offset| *
+    chunk margin, turning per-level cost from O(n) to O(band).  Engages
+    only when the graph is RESIDUAL-FREE (a shortcut edge can teleport a
+    bit across the band — such graphs fall back to full planes, exactly)
+    and the queries are host data (the band init reads them).  Window
+    sizes ride a pow2 ladder (<= log2 n compiled programs); every chunk's
+    (entry band, window) is recorded in ``last_window_trace`` for the
+    exactness tests.
+
+    ``kernel`` (MSBFS_STENCIL_KERNEL=1): route the masked-shift sweep
+    through the chunked Pallas kernel chain (ops.pallas_stencil), with
+    the XLA formulation as automatic fallback when Pallas is unavailable.
+
+    Every chunked dispatch feeds utils.timing.record_plane_pass with the
+    analytic :func:`stencil_level_bytes` it streamed (levels advanced *
+    rows touched) — the CI-observable roofline telemetry (make perf-smoke
+    plane-pass guard).  The unchunked fused path records nothing: it
+    fetches no per-chunk level counter, and the guard drives chunked
+    engines."""
 
     k_align = WORD_BITS
 
@@ -460,37 +664,227 @@ class StencilEngine(FusedBestEngine):
         max_levels: Optional[int] = None,
         level_chunk: Optional[int] = None,
         megachunk: Optional[int] = None,
+        window: Optional[bool] = None,
+        wavefront: Optional[int] = None,
+        kernel: Optional[bool] = None,
     ):
         self.graph = graph
         self.max_levels = max_levels
         self.level_chunk = validate_level_chunk(level_chunk)
         self.megachunk = resolve_megachunk(megachunk, self.level_chunk)
         self._level_warm_shapes = set()
+        if wavefront is None:
+            wavefront = int(os.environ.get("MSBFS_WAVEFRONT", "1") or "1")
+        self.wavefront = max(1, int(wavefront))
+        if window is None:
+            window = os.environ.get("MSBFS_STENCIL_WINDOW", "") != "0"
+        self.window_requested = bool(window)
+        # Exactness precondition: windowing needs an empty residual (see
+        # _window_advance) and a chunked drive to window per-chunk.
+        self.window_active = (
+            self.window_requested
+            and int(graph.res_src.shape[0]) == 0
+            and bool(self.level_chunk)
+        )
+        self._maxd = max((abs(d) for d in graph.offsets), default=0)
+        if kernel is None:
+            kernel = os.environ.get("MSBFS_STENCIL_KERNEL", "") == "1"
+        # Fallback is automatic: without an importable Pallas chain the
+        # XLA masked shifts serve every request (ISSUE r7 routing).
+        self.kernel = bool(kernel) and _pallas_hits is not None
+        # Per-run list of (level_entered, band_lo, band_hi, wlo, rows)
+        # chunk records; rows == n means a full-plane dispatch.
+        self.last_window_trace = []
+
+    # -- round-7 drive helpers -------------------------------------------
+
+    def _band_of(self, queries):
+        """Initial frontier band [lo, hi) from host queries, or None when
+        windowing is off for this call (device-resident queries would need
+        their own blocking fetch just to size the window)."""
+        if not self.window_active or isinstance(queries, jax.Array):
+            return None
+        q = np.asarray(queries)
+        valid = (q >= 0) & (q < self.graph.n)
+        if not valid.any():
+            return [0, 0]
+        vs = q[valid]
+        return [int(vs.min()), int(vs.max()) + 1]
+
+    def _window_for(self, band, steps):
+        """(wlo, rows) window covering ``band`` + max|d| * steps margin;
+        rows is pow2-laddered and clamped so rows == n means 'use the
+        full-plane program'."""
+        n = self.graph.n
+        if band is None:
+            return 0, n
+        margin = self._maxd * int(steps)
+        lo = max(band[0] - margin, 0)
+        hi = min(band[1] + margin, n)
+        size = max(hi - lo, 1)
+        rows = 1 << (size - 1).bit_length()
+        if rows >= n:
+            return 0, n
+        return min(lo, n - rows), rows
+
+    def _account(self, band, wlo, rows, w_words, level0, advanced):
+        """Record the chunk in the window trace and its analytic streamed
+        bytes in the plane-pass counter."""
+        lo, hi = (0, self.graph.n) if band is None else (band[0], band[1])
+        self.last_window_trace.append((level0, lo, hi, int(wlo), int(rows)))
+        if advanced > 0:
+            record_plane_pass(
+                advanced
+                * stencil_level_bytes(
+                    len(self.graph.offsets), rows, w_words, self.wavefront
+                )
+            )
+
+    def _grow_band(self, band, advanced):
+        """Monotone conservative band growth: after ``advanced`` levels the
+        frontier lies within max|d| * advanced rows of where it was."""
+        if band is not None and advanced > 0:
+            band[0] = max(band[0] - self._maxd * advanced, 0)
+            band[1] = min(band[1] + self._maxd * advanced, self.graph.n)
+
+    # -- result paths ----------------------------------------------------
 
     def _run(self, queries):
-        if self.level_chunk:
-            # np.int32 traced bound: rides the dispatch (an eager jnp
-            # scalar would be its own device commit).
-            bound = np.int32(self.level_chunk * self.megachunk)
-            carry = host_chunked_loop(
-                _stencil_init_carry(self.graph, queries),
-                lambda c: _stencil_chunk(
+        if not self.level_chunk:
+            return stencil_run(
+                self.graph,
+                queries,
+                self.max_levels,
+                self.wavefront,
+                self.kernel,
+            )
+        # np.int32 traced bound: rides the dispatch (an eager jnp scalar
+        # would be its own device commit).
+        bound = np.int32(self.level_chunk * self.megachunk)
+        band = self._band_of(queries)
+        w_words = max(1, queries.shape[0] // WORD_BITS)
+        self.last_window_trace = []
+        carry = _stencil_init_carry(self.graph, queries)
+        prev_level = 0
+        while True:
+            wlo, rows = self._window_for(band, int(bound))
+            if rows >= self.graph.n:
+                carry = _stencil_chunk(
                     self.graph,
-                    c,
+                    carry,
                     bound,
                     self.max_levels,
-                ),
-                self.max_levels,
-                level_ix=5,
-                updated_ix=6,
+                    self.wavefront,
+                    self.kernel,
+                )
+            else:
+                carry = _stencil_window_chunk(
+                    self.graph,
+                    carry,
+                    np.int32(wlo),
+                    bound,
+                    self.max_levels,
+                    rows,
+                    self.wavefront,
+                    self.kernel,
+                )
+            # One buffer fetch serves the continue-check; one blocking
+            # commit per chunk, recorded (same contract as
+            # ops.bfs.host_chunked_loop).
+            level = int(np.asarray(carry[5]))
+            updated = bool(np.asarray(carry[6]))
+            record_dispatch()
+            self._account(
+                band, wlo, rows, w_words, prev_level, level - prev_level
             )
-            return carry[2], carry[3], carry[4]
-        return stencil_run(self.graph, queries, self.max_levels)
+            self._grow_band(band, level - prev_level)
+            prev_level = level
+            if not updated:
+                break
+            if self.max_levels is not None and level >= self.max_levels:
+                break
+        return carry[2], carry[3], carry[4]
+
+    def best(self, queries) -> Tuple[int, int]:
+        queries, k = self._pad_queries(queries)
+        kk = np.int32(k)
+        if not self.level_chunk:
+            min_f, min_k = np.asarray(self._fused_full(queries, kk))
+            record_dispatch()
+            return int(min_f), int(min_k)
+        # Custom fused-best drive (same convergence contract as
+        # ops.bitbell.fused_best_drive) so each chunk can pick its window
+        # and feed the plane-pass telemetry from the status level.
+        bound = np.int32(self.level_chunk * self.megachunk)
+        band = self._band_of(queries)
+        w_words = max(1, queries.shape[0] // WORD_BITS)
+        self.last_window_trace = []
+        c8 = None
+        prev_level = 0
+        while True:
+            wlo, rows = self._window_for(band, int(bound))
+            first = c8 is None
+            if rows >= self.graph.n:
+                fn = (
+                    _stencil_start_chunk_best
+                    if first
+                    else _stencil_chunk_best
+                )
+                c8 = fn(
+                    self.graph,
+                    queries if first else c8[:7],
+                    kk,
+                    bound,
+                    self.max_levels,
+                    self.wavefront,
+                    self.kernel,
+                )
+            else:
+                fn = (
+                    _stencil_window_start_best
+                    if first
+                    else _stencil_window_chunk_best
+                )
+                c8 = fn(
+                    self.graph,
+                    queries if first else c8[:7],
+                    kk,
+                    np.int32(wlo),
+                    bound,
+                    self.max_levels,
+                    rows,
+                    self.wavefront,
+                    self.kernel,
+                )
+            status = np.asarray(c8[7])
+            record_dispatch()
+            level, updated, min_f, min_k = (int(x) for x in status)
+            self._account(
+                band, wlo, rows, w_words, prev_level, level - prev_level
+            )
+            self._grow_band(band, level - prev_level)
+            prev_level = level
+            if not updated:
+                break
+            if self.max_levels is not None and level >= self.max_levels:
+                break
+        return min_f, min_k
 
     def _fused_full(self, queries, k):
-        return stencil_best_fused(self.graph, queries, k, self.max_levels)
+        return stencil_best_fused(
+            self.graph,
+            queries,
+            k,
+            self.max_levels,
+            self.wavefront,
+            self.kernel,
+        )
 
     def _fused_chunk(self, state, k, first):
+        # Full-plane chunked programs; best() drives windowed siblings
+        # itself.  compile() (FusedBestEngine) warms THESE — the windowed
+        # ladder compiles per-rung on first use, since the rung depends on
+        # the actual source band.
         fn = _stencil_start_chunk_best if first else _stencil_chunk_best
         return fn(
             self.graph,
@@ -498,6 +892,8 @@ class StencilEngine(FusedBestEngine):
             k,
             np.int32(self.level_chunk * self.megachunk),
             self.max_levels,
+            self.wavefront,
+            self.kernel,
         )
 
     def f_values(self, queries) -> jax.Array:

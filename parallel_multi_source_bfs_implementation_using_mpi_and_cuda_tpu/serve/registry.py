@@ -69,6 +69,7 @@ def build_supervised_engine(graph) -> ChunkSupervisor:
         _bitbell_ladder,
         _explicit_level_chunk,
         _level_chunk_policy,
+        _road_class,
     )
 
     explicit_chunk = _explicit_level_chunk()
@@ -81,10 +82,59 @@ def build_supervised_engine(graph) -> ChunkSupervisor:
     )
     backend = os.environ.get("MSBFS_BACKEND", "auto")
     ladder = []
-    if backend in ("vmap", "csr"):
+    engine = None
+    if backend == "stencil" or (
+        backend == "auto"
+        and _road_class(graph)
+        and os.environ.get("MSBFS_STENCIL", "") != "0"
+    ):
+        # Round 7: the served route mirrors the batch CLI's stencil
+        # probe, so a registered road/grid graph serves through the
+        # banded masked-shift engine (with the round-7 window/wavefront/
+        # kernel knobs riding StencilEngine's own env parsing) instead of
+        # silently falling back to gathers.  Auto probe failures keep the
+        # gather engines; a forced backend=stencil failure is the
+        # operator's routing error and raises.
+        from ..ops.stencil import (
+            AUTO_STENCIL_LEVEL_CHUNK,
+            StencilEngine,
+            StencilGraph,
+        )
+
+        try:
+            sg = StencilGraph.from_host(graph)
+        except ValueError:
+            if backend == "stencil":
+                raise
+            sg = None
+        if sg is not None:
+            stencil_chunk = (
+                level_chunk
+                if explicit_chunk is not None and explicit_chunk >= 0
+                else (AUTO_STENCIL_LEVEL_CHUNK if level_chunk else None)
+            )
+            engine = StencilEngine(
+                sg, level_chunk=stencil_chunk, megachunk=megachunk
+            )
+    if engine is not None:
+        pass
+    elif backend in ("vmap", "csr"):
         from ..ops.engine import Engine
 
         engine = Engine(graph.to_device(), level_chunk=level_chunk)
+    elif backend == "lowk":
+        # Explicit low-K route (ops.lowk): serving buckets queries by
+        # shape, so an operator pinning a K <= 4 workload can serve the
+        # byte-flag planes; the auto route stays with bitbell because a
+        # served graph sees arbitrary K over its lifetime.
+        from ..models.bell import BellGraph
+        from ..ops.lowk import LowKEngine
+
+        engine = LowKEngine(
+            BellGraph.from_host(graph),
+            level_chunk=level_chunk,
+            megachunk=megachunk,
+        )
     else:
         from ..models.bell import BellGraph
         from ..ops.bitbell import BitBellEngine

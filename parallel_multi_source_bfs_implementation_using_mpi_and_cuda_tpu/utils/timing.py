@@ -72,3 +72,42 @@ def reset_dispatch_count() -> None:
     with _dispatch_lock:
         _dispatch_counter = itertools.count()
         _dispatch_base = 0
+
+
+# --- Plane-pass accounting (round 7) -----------------------------------------
+# The stencil engine's level cost is a pure HBM stream: every masked-shift
+# pass reads/writes plane-sized arrays, so "full-plane-equivalent bytes per
+# level" IS its bandwidth model (docs/PERF_NOTES.md "Round-5 findings",
+# bench.py stream_bytes_per_s).  The round-7 active-window and wavefront-
+# blocked paths shrink exactly that quantity — the engines record the
+# ANALYTIC bytes each dispatched chunk streams (rows-touched x words-per-
+# vertex x levels, ops.stencil.stencil_level_bytes) at the same host sites
+# that ride record_dispatch, so the roofline diet is CI-observable on CPU
+# (make perf-smoke plane-pass guard) the way the dispatch diet is: wall
+# clock on the tunnel measures nothing, counters measure everything.
+# Thread-safe for the same reason as the dispatch counter: serving worker
+# threads may drive engines concurrently.
+
+_plane_pass_bytes = 0
+_plane_pass_lock = threading.Lock()
+
+
+def record_plane_pass(nbytes: int) -> None:
+    """Account ``nbytes`` of full-plane-equivalent stencil stream traffic
+    (one call per dispatched level chunk, analytic bytes)."""
+    global _plane_pass_bytes
+    with _plane_pass_lock:
+        _plane_pass_bytes += int(nbytes)
+
+
+def plane_pass_bytes() -> int:
+    """Bytes recorded since the last :func:`reset_plane_pass`."""
+    with _plane_pass_lock:
+        return _plane_pass_bytes
+
+
+def reset_plane_pass() -> None:
+    """Zero the plane-pass accumulator (callers bracket a measured span)."""
+    global _plane_pass_bytes
+    with _plane_pass_lock:
+        _plane_pass_bytes = 0

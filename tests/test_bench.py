@@ -199,3 +199,75 @@ def test_midrun_stall_hits_hard_deadline():
     rec = last_json_line(proc.stdout)
     assert rec["value"] is None
     assert "hard deadline" in rec["error"]
+
+
+# --- round-7 fixture rule: headline queries reach the giant component --------
+#
+# These are in-process unit tests (no subprocess): the rule itself lives in
+# models.generators and bench.measure applies it to every headline fixture,
+# so a degenerate minF=0 "win" can never be published again.
+
+
+def test_component_labels_on_known_graph():
+    import numpy as np
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (  # noqa: E501
+        generators,
+    )
+
+    # Two triangles + one isolate: labels must partition exactly.
+    edges = np.array(
+        [[0, 1], [1, 2], [2, 0], [3, 4], [4, 5], [5, 3]], dtype=np.int64
+    )
+    label = generators.component_labels(7, edges)
+    assert len(set(label[[0, 1, 2]])) == 1
+    assert len(set(label[[3, 4, 5]])) == 1
+    assert label[0] != label[3]
+    assert label[6] not in (label[0], label[3])
+
+
+def test_ensure_giant_sources_fixes_stranded_groups():
+    import numpy as np
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (  # noqa: E501
+        generators,
+    )
+
+    # Path 0-1-2-3-4 (giant) + edge 5-6 (minor) + isolate 7.
+    edges = np.array(
+        [[0, 1], [1, 2], [2, 3], [3, 4], [5, 6]], dtype=np.int64
+    )
+    n = 8
+    queries = [
+        np.array([2, 7], dtype=np.int32),  # already reaches giant: untouched
+        np.array([5, 6], dtype=np.int32),  # stranded in the minor component
+        np.array([7], dtype=np.int32),  # stranded isolate
+        np.array([-1, 9], dtype=np.int32),  # all-invalid group
+    ]
+    before = [q.copy() for q in queries]
+    fixed = generators.ensure_giant_sources(queries, n, edges, seed=7)
+    label = generators.component_labels(n, edges)
+    giant = label[0]
+    for q in fixed:
+        valid = q[(q >= 0) & (q < n)]
+        assert valid.size and (label[valid] == giant).any()
+    # The compliant group is returned as-is; inputs are never mutated.
+    np.testing.assert_array_equal(fixed[0], before[0])
+    for q, b in zip(queries, before):
+        np.testing.assert_array_equal(q, b)
+
+
+def test_reference_model_range_brackets_point_model():
+    import bench
+
+    n, e, k, levels = 1 << 16, 1 << 20, 32, 400
+    _, point = bench.reference_model(n, e, k, levels)
+    fast, slow = bench.reference_model_range(n, e, k, levels)
+    # vs_baseline_range corners: value/fast <= value/point <= value/slow.
+    assert slow <= point <= fast
+    assert bench.REF_EDGE_TEPS_RANGE[0] <= bench.REF_EDGE_TEPS <= (
+        bench.REF_EDGE_TEPS_RANGE[1]
+    )
+    assert bench.REF_LAUNCH_RANGE_S[0] <= bench.REF_LAUNCH_S <= (
+        bench.REF_LAUNCH_RANGE_S[1]
+    )

@@ -378,6 +378,7 @@ def test_hub_tail_cli_bound_engaged(tmp_path, capsys, monkeypatch):
     the unbounded dispatch path; round 4's CLI must hand level_chunk to
     the engine for EVERY graph, at -gn 1 and 8 (VERDICT r3)."""
     import parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell as bitbell_mod
+    import parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.lowk as lowk_mod
     import parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed as dist_mod
 
     tail = 2200
@@ -393,10 +394,16 @@ def test_hub_tail_cli_bound_engaged(tmp_path, capsys, monkeypatch):
 
     seen = {}
     real_bitbell, real_dist = bitbell_mod.BitBellEngine, dist_mod.DistributedEngine
+    real_lowk = lowk_mod.LowKEngine
 
     class SpyBitBell(real_bitbell):
         def __init__(self, graph, **kw):
             seen["bitbell"] = kw.get("level_chunk")
+            super().__init__(graph, **kw)
+
+    class SpyLowK(real_lowk):
+        def __init__(self, graph, **kw):
+            seen["lowk"] = kw.get("level_chunk")
             super().__init__(graph, **kw)
 
     class SpyDist(real_dist):
@@ -405,11 +412,22 @@ def test_hub_tail_cli_bound_engaged(tmp_path, capsys, monkeypatch):
             super().__init__(mesh, graph, **kw)
 
     monkeypatch.setattr(bitbell_mod, "BitBellEngine", SpyBitBell)
+    monkeypatch.setattr(lowk_mod, "LowKEngine", SpyLowK)
     monkeypatch.setattr(dist_mod, "DistributedEngine", SpyDist)
     rc, out, _ = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"], capsys)
     assert rc == 0
     _assert_report(out, want, 1)
-    assert seen.pop("bitbell") == _AUTO_LEVEL_CHUNK  # bound engaged despite the hub
+    # K=2 single-chip routes to the round-7 low-K engine; the bound must
+    # engage there just as it did on bitbell (the hub adversary is about
+    # the CLI policy, not one engine class).
+    assert seen.pop("lowk") == _AUTO_LEVEL_CHUNK  # bound engaged despite the hub
+    assert "bitbell" not in seen
+    monkeypatch.setenv("MSBFS_LOWK", "0")
+    rc, out, _ = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "1"], capsys)
+    assert rc == 0
+    _assert_report(out, want, 1)
+    assert seen.pop("bitbell") == _AUTO_LEVEL_CHUNK  # opt-out path, same bound
+    monkeypatch.delenv("MSBFS_LOWK", raising=False)
     rc, out, _ = run_cli(["main.py", "-g", gpath, "-q", qpath, "-gn", "8"], capsys)
     assert rc == 0
     _assert_report(out, want, 8)

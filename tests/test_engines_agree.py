@@ -236,8 +236,25 @@ def _sharded_push(g):
     )
 
 
+def _lowk(g):
+    """Round-7 byte-flag low-K engine (k_align=1, hybrid pull/push)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+        BellGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.lowk import (
+        LowKEngine,
+    )
+
+    return LowKEngine(BellGraph.from_host(g))
+
+
+# The lowk drive-loop variants (chunked/megachunk) and the sub-batch
+# splitter are pinned against the oracle and the bit-plane reference in
+# tests/test_lowk.py; only the base byte-flag arm needs the full
+# cross-engine fixture here.
 ENGINES = {
     "vmap": _vmap,
+    "lowk": _lowk,
     "packed": _packed,
     "dense": _dense,
     "pallas_ell": _pallas_ell,
@@ -325,10 +342,39 @@ def _stencil_megachunk(g):
 # accept banded graphs, so they get their cross-engine check on a road
 # lattice against a representative sample of the general engines (every
 # general engine runs any graph; the full matrix above covers them).
+def _stencil_window(g):
+    """Round-7 active-row-window arm: explicit small chunk so the band
+    logic drives several dispatches (window engages only on residual-free
+    lattices; on this road fixture it may fall back — the point is the
+    ROUTE is exercised either way, bit-identically)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+        StencilEngine,
+        StencilGraph,
+    )
+
+    return StencilEngine(
+        StencilGraph.from_host(g), level_chunk=2, megachunk=1, window=True
+    )
+
+
+def _stencil_blocked(g):
+    """Round-7 wavefront-blocking arm: 3 BFS levels per while-iteration."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+        StencilEngine,
+        StencilGraph,
+    )
+
+    return StencilEngine(
+        StencilGraph.from_host(g), level_chunk=2, wavefront=3
+    )
+
+
 BANDED_ENGINES = {
     "stencil": _stencil,
     "stencil_chunked": _stencil_chunked,
     "stencil_megachunk": _stencil_megachunk,
+    "stencil_window": _stencil_window,
+    "stencil_blocked": _stencil_blocked,
     "bitbell": _bitbell,
     "bitbell_chunked": _bitbell_chunked,
     "streamed": _streamed,

@@ -1,0 +1,166 @@
+"""Round-7 low-K byte-flag engine and the sub-batch splitter: oracle
+parity and bit-identity with the bit-plane reference engine."""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+    BitBellEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.lowk import (
+    LowKEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+    SubBatchEngine,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+
+@pytest.fixture(scope="module")
+def workload():
+    n, edges = generators.rmat_edges(8, edge_factor=8, seed=811)
+    g = CSRGraph.from_edges(n, edges)
+    return n, edges, BellGraph.from_host(g)
+
+
+def _oracle_f_values(n, edges, queries):
+    return [oracle_f(oracle_bfs(n, edges, q)) for q in queries]
+
+
+@pytest.mark.parametrize(
+    "k,kwargs",
+    [
+        # The fused path sweeps every supported K; the drive-loop
+        # variants run once at the widest byte plane (K=4) — chunking
+        # is K-oblivious, so the K sweep there bought no coverage.
+        (1, {}),
+        (2, {}),
+        (4, {}),
+        (4, {"level_chunk": 2}),
+        (4, {"level_chunk": 2, "megachunk": 2}),
+        (4, {"sparse_budget": 0}),  # pure forest pulls, no hybrid cond
+    ],
+    ids=["fused-k1", "fused-k2", "fused-k4", "chunked", "megachunk", "nohybrid"],
+)
+def test_lowk_matches_oracle(workload, k, kwargs):
+    n, edges, bg = workload
+    queries = generators.random_queries(n, k, max_group=4, seed=812 + k)
+    if k >= 2:
+        queries[1] = np.array([-1, n + 7], dtype=np.int32)  # bounds check
+    padded = pad_queries(queries)
+    want = _oracle_f_values(n, edges, queries)
+    eng = LowKEngine(bg, **kwargs)
+    assert np.asarray(eng.f_values(padded)).tolist() == want
+    assert eng.best(padded) == oracle_best(want)
+
+
+def test_lowk_no_query_padding(workload):
+    """k_align=1 is the engine's point: a K=1 batch runs as (n, 1) bytes,
+    no word-width padding; empty batches still answer (-1, -1)."""
+    n, edges, bg = workload
+    eng = LowKEngine(bg)
+    assert eng.k_align == 1
+    padded, k = eng._pad_queries(
+        np.array([[3, 5]], dtype=np.int32)
+    )
+    assert padded.shape == (1, 2) and k == 1
+    assert eng.best(np.zeros((0, 1), dtype=np.int32)) == (-1, -1)
+
+
+def test_lowk_query_stats_match_bitbell(workload):
+    n, edges, bg = workload
+    queries = pad_queries(
+        generators.random_queries(n, 4, max_group=5, seed=815)
+    )
+    a = LowKEngine(bg).query_stats(queries)
+    b = BitBellEngine(bg).query_stats(queries)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_lowk_compile_and_dispatch_count(workload):
+    """The fused unchunked best() pays exactly ONE recorded dispatch —
+    the config-1 latency contract the CLI low-K route exists for."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (
+        dispatch_count,
+        reset_dispatch_count,
+    )
+
+    n, edges, bg = workload
+    eng = LowKEngine(bg)
+    queries = pad_queries([np.array([5], dtype=np.int32)])
+    eng.compile(queries.shape)
+    assert eng.is_warmed(queries.shape)
+    reset_dispatch_count()
+    eng.best(queries)
+    assert dispatch_count() == 1
+
+
+def test_subbatch_bit_identical(workload):
+    n, edges, bg = workload
+    queries = generators.random_queries(n, 11, max_group=4, seed=816)
+    padded = pad_queries(queries)
+    inner = BitBellEngine(bg)
+    wrap = SubBatchEngine(BitBellEngine(bg), batch_k=4)
+    np.testing.assert_array_equal(
+        np.asarray(inner.f_values(padded)), np.asarray(wrap.f_values(padded))
+    )
+    assert wrap.best(padded) == inner.best(padded)
+    for x, y in zip(inner.query_stats(padded), wrap.query_stats(padded)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_subbatch_preserves_first_min_tie_across_chunks(workload):
+    """The reference tie-break is FIRST strict minimum (main.cu:379-397).
+    Put identical minimal groups in different sub-batches: the strict-<
+    cross-chunk merge must keep the earlier one."""
+    n, edges, bg = workload
+    f_all = _oracle_f_values(
+        n, edges, [np.array([v], dtype=np.int32) for v in range(16)]
+    )
+    win = int(np.argmin(f_all))
+    groups = [np.array([v], dtype=np.int32) for v in range(16)]
+    groups[2] = np.array([win], dtype=np.int32)
+    groups[13] = np.array([win], dtype=np.int32)  # other sub-batch
+    padded = pad_queries(groups)
+    inner = BitBellEngine(bg)
+    wrap = SubBatchEngine(BitBellEngine(bg), batch_k=5)
+    want = inner.best(padded)
+    assert wrap.best(padded) == want
+    assert want[1] == min(2, win)
+
+
+def test_subbatch_compile_warms_chunk_shapes(workload):
+    n, edges, bg = workload
+    wrap = SubBatchEngine(BitBellEngine(bg), batch_k=4)
+    wrap.compile((11, 3))  # 4-wide chunks + a 3-wide tail
+    assert wrap.is_warmed((11, 3))
+    assert wrap.inner.is_warmed((4, 3))
+    assert wrap.inner.is_warmed((3, 3))
+
+
+def test_subbatch_rejects_bad_batch():
+    with pytest.raises(ValueError, match="batch_k"):
+        SubBatchEngine(object(), batch_k=0)
+
+
+def test_subbatch_wraps_lowk(workload):
+    """Composition: the splitter is engine-agnostic."""
+    n, edges, bg = workload
+    queries = generators.random_queries(n, 7, max_group=3, seed=817)
+    padded = pad_queries(queries)
+    wrap = SubBatchEngine(LowKEngine(bg), batch_k=3)
+    want = _oracle_f_values(n, edges, queries)
+    assert np.asarray(wrap.f_values(padded)).tolist() == want
+    assert wrap.best(padded) == oracle_best(want)

@@ -210,6 +210,191 @@ def test_fused_best_matches_generic():
     assert StencilEngine(sg).best(np.zeros((0, 2), np.int32)) == (-1, -1)
 
 
+class TestActiveWindow:
+    """Round-7 active-row-window lever: [lo, hi) band slicing must be
+    byte-exact AND actually engaged (rows < n) on tall residual-free
+    lattices with clustered sources."""
+
+    def _tall_grid(self):
+        # 200x8 grid: n=1600, offsets +-1/+-8 (max|d| = 8), residual-free
+        # by construction — the window's engagement precondition.
+        return generators.grid_edges(200, 8)
+
+    def _corner_queries(self, n):
+        rng = np.random.default_rng(933)
+        return [
+            rng.integers(0, 40, size=rng.integers(1, 4)).astype(np.int32)
+            for _ in range(5)
+        ]
+
+    def test_window_engages_and_is_exact(self):
+        n, edges = self._tall_grid()
+        g = CSRGraph.from_edges(n, edges)
+        sg = StencilGraph.from_host(g)
+        queries = self._corner_queries(n)
+        padded = pad_queries(queries)
+        eng = StencilEngine(sg, level_chunk=8, megachunk=1, window=True)
+        assert eng.window_active
+        got = np.asarray(eng.f_values(padded))
+        np.testing.assert_array_equal(got, oracle_f_values(n, edges, queries))
+        trace = eng.last_window_trace
+        assert trace, "chunked run must record window decisions"
+        # Engagement: the early dispatches run on a sub-plane.
+        assert trace[0][4] < n
+        # Exactness bounds: every window covers the band grown by the
+        # dispatch's step bound, stays in-plane, pow2-or-full rows.
+        lo_prev, hi_prev = trace[0][1], trace[0][2]
+        for _, band_lo, band_hi, wlo, rows in trace:
+            assert 0 <= wlo and wlo + rows <= n
+            assert rows == n or rows & (rows - 1) == 0  # pow2 slice
+            assert wlo <= band_lo and band_hi <= wlo + rows
+            # Monotone band: frontier support only ever widens.
+            assert band_lo <= lo_prev and band_hi >= hi_prev
+            lo_prev, hi_prev = band_lo, band_hi
+
+    def test_window_best_and_plane_byte_diet(self):
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (
+            plane_pass_bytes,
+            reset_plane_pass,
+        )
+
+        # 400x8 lattice, BFS depth capped at 64: the local-query regime
+        # the window targets — the frontier band never nears the far end,
+        # so the full-plane engine streams rows the window provably skips.
+        # (A run to convergence ends with the band = the whole plane, so
+        # its tail dispatches are full-width either way; there the window
+        # saves ~1.5x, not 2x.)
+        n, edges = generators.grid_edges(400, 8)
+        g = CSRGraph.from_edges(n, edges)
+        sg = StencilGraph.from_host(g)
+        queries = self._corner_queries(n)
+        padded = pad_queries(queries)
+        windowed = StencilEngine(
+            sg, max_levels=64, level_chunk=8, megachunk=1, window=True
+        )
+        full = StencilEngine(
+            sg, max_levels=64, level_chunk=8, megachunk=1, window=False
+        )
+        assert windowed.window_active and not full.window_active
+        reset_plane_pass()
+        best_w = windowed.best(padded)
+        bytes_w = plane_pass_bytes()
+        reset_plane_pass()
+        best_f = full.best(padded)
+        bytes_f = plane_pass_bytes()
+        assert best_w == best_f
+        assert bytes_w > 0
+        # The CI proxy for the roofline claim: corner sources on a tall
+        # lattice must at least halve full-plane-equivalent stream bytes.
+        assert bytes_w * 2 <= bytes_f, (bytes_w, bytes_f)
+
+    def test_residual_graph_falls_back_to_full_plane(self):
+        # Elevated shortcut_frac guarantees residual edges; a residual can
+        # escape any row band, so the window must disengage — and results
+        # stay oracle-exact through the full-plane path.
+        n, edges = generators.road_edges(24, 24, seed=932, shortcut_frac=0.02)
+        g = CSRGraph.from_edges(n, edges)
+        sg = StencilGraph.from_host(g)
+        assert int(sg.res_src.shape[0]) > 0
+        queries = generators.random_queries(n, 5, max_group=3, seed=934)
+        padded = pad_queries(queries)
+        eng = StencilEngine(sg, level_chunk=4, window=True)
+        assert not eng.window_active
+        np.testing.assert_array_equal(
+            np.asarray(eng.f_values(padded)),
+            oracle_f_values(n, edges, queries),
+        )
+        assert all(t[4] == n for t in eng.last_window_trace)
+
+    def test_unchunked_engine_never_windows(self):
+        n, edges = self._tall_grid()
+        sg = StencilGraph.from_host(CSRGraph.from_edges(n, edges))
+        assert not StencilEngine(sg, window=True).window_active
+
+
+@pytest.mark.parametrize(
+    "name,block", [("road", 2), ("road_rect", 3), ("grid", 4)]
+)
+def test_wavefront_blocked_fuzz(name, block):
+    """Wavefront blocking (2-4 levels per while-iteration) must be
+    bit-identical to the unblocked loop, chunked and unchunked,
+    including the fused best.  Each block size is fuzzed on one lattice
+    (the full block x lattice product certified nothing extra and cost
+    3x the wall-clock)."""
+    n, edges = LATTICES[name]
+    g = CSRGraph.from_edges(n, edges)
+    sg = StencilGraph.from_host(g)
+    queries = pad_queries(
+        generators.random_queries(n, 7, max_group=4, seed=950 + block)
+    )
+    ref = StencilEngine(sg)
+    base = ref.query_stats(queries)
+    want_best = ref.best(queries)
+    for kwargs in ({}, {"level_chunk": 3, "megachunk": 1}):
+        eng = StencilEngine(sg, wavefront=block, **kwargs)
+        got = eng.query_stats(queries)
+        for x, y in zip(base, got):
+            np.testing.assert_array_equal(x, y)
+        assert eng.best(queries) == want_best
+
+
+def test_pallas_chain_parity():
+    """The chunked Pallas kernel chain (interpret mode off-TPU) must be
+    bit-identical to the XLA masked-shift sweep; skips cleanly when the
+    pallas import is unavailable on this host."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops import (
+        stencil as stencil_mod,
+    )
+
+    if stencil_mod._pallas_hits is None:
+        pytest.skip("pallas unavailable on this host")
+    n, edges = LATTICES["road"]
+    g = CSRGraph.from_edges(n, edges)
+    sg = StencilGraph.from_host(g)
+    queries = pad_queries(
+        generators.random_queries(n, 6, max_group=3, seed=935)
+    )
+    ref = StencilEngine(sg)
+    want = ref.query_stats(queries)
+    want_best = ref.best(queries)
+    for kwargs in ({}, {"level_chunk": 4}):
+        eng = StencilEngine(sg, kernel=True, **kwargs)
+        assert eng.kernel
+        got = eng.query_stats(queries)
+        for x, y in zip(want, got):
+            np.testing.assert_array_equal(x, y)
+        assert eng.best(queries) == want_best
+
+
+def test_pallas_chain_multi_chunk_parity():
+    """Force the chain to actually CHUNK (plane larger than one call's
+    row budget) by shrinking the budget, and pin bit-identity of the raw
+    hits path."""
+    import jax.numpy as jnp
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops import (
+        pallas_stencil,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+        _xla_shift_hits,
+    )
+
+    n, edges = LATTICES["road"]
+    g = CSRGraph.from_edges(n, edges)
+    sg = StencilGraph.from_host(g)
+    frontier = jnp.zeros((n,), jnp.uint32).at[jnp.arange(0, n, 37)].set(1)
+    want = np.asarray(_xla_shift_hits(frontier, sg, flat=True))
+    old = pallas_stencil.MAX_TOTAL_ROWS
+    try:
+        pallas_stencil.MAX_TOTAL_ROWS = 4  # several chunks at n=576
+        got = np.asarray(
+            pallas_stencil.pallas_hits(frontier, sg.mask_bits, sg.offsets)
+        )
+    finally:
+        pallas_stencil.MAX_TOTAL_ROWS = old
+    np.testing.assert_array_equal(want, got)
+
+
 def test_level_stats_parity():
     n, edges = LATTICES["grid"]
     g = CSRGraph.from_edges(n, edges)

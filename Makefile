@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve lifecycle perf-smoke
+.PHONY: native clean test resilience serve lifecycle perf-smoke mxu
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -37,5 +37,13 @@ lifecycle: native
 perf-smoke:
 	JAX_PLATFORMS=cpu python benchmarks/perf_smoke.py
 
-test: native resilience serve lifecycle perf-smoke
+# MXU-engine suite (ops.mxu): the FULL tensor-core matrix, including
+# the arms slow-marked out of tier-1 for wall-clock budget — rmat/road/
+# stranded parity, K sweep, Pallas tile-chain parity (interpret mode on
+# CPU), and every mxu agreement arm.
+mxu:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_mxu.py -x -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_engines_agree.py -x -q -k "mxu"
+
+test: native resilience serve lifecycle perf-smoke mxu
 	python -m pytest tests/ -x -q

@@ -28,9 +28,12 @@ parsable record; it never inherits a silent hang.
 
 Env knobs: BENCH_SCALE (default 20), BENCH_EDGE_FACTOR (16), BENCH_K (64),
 BENCH_CHUNK (8), BENCH_REPEATS (3), BENCH_MAX_S (64),
-BENCH_ENGINE (bitbell|bell|packed|vmap|dense|pallas|push|stencil|streamed,
+BENCH_ENGINE (bitbell|bell|packed|vmap|dense|pallas|push|stencil|streamed|mxu,
 default bitbell; "streamed" is the round-6 host-resident double-buffered
-over-HBM route, ops.streamed),
+over-HBM route, ops.streamed; "mxu" is the round-8 tensor-core blocked
+tile-matmul engine with density-based direction switching, ops.mxu —
+its rows carry detail.mxu: analytic tile FLOPs, zero-tile skip rate and
+the exact per-level push/matmul decisions),
 BENCH_EDGE_CHUNKS (packed engine HBM knob, default 1),
 BENCH_SPARSE (bitbell hybrid budget; empty=auto, 0=pure pull, no dedup CSR),
 BENCH_LEVEL_CHUNK (bitbell levels per dispatch; empty=unchunked, "auto"=the
@@ -41,7 +44,8 @@ detail.extra_metrics, default "256" — the engine's throughput sweet spot,
 BASELINE.md; empty disables), BENCH_WAIT_S (device-probe budget, default
 420), BENCH_RUN_S (workload hard deadline, default 1500),
 BENCH_GRAPH (rmat|road — road builds the config-4 grid at side 2^(scale/2)),
-BENCH_CONFIGS (comma list of BASELINE config ids, DEFAULT "2,2c,4,1,5": sweep
+BENCH_CONFIGS (comma list of BASELINE config ids, DEFAULT "2,2c,4,1,5,6,6r":
+sweep
 mode — each config runs in its own deadline-bounded child and gets its own
 value/error in detail.sweep; the cumulative record re-emits after every
 config so a partial outage cannot zero what was already measured; the
@@ -258,8 +262,10 @@ def run_workload() -> None:
     )
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (
         dispatch_count,
+        mxu_tile_counts,
         plane_pass_bytes,
         reset_dispatch_count,
+        reset_mxu_tiles,
         reset_plane_pass,
     )
 
@@ -332,6 +338,25 @@ def run_workload() -> None:
                 )
             except ValueError as e:
                 sys.exit(f"BENCH_ENGINE=stencil: {e}")
+        if engine_kind == "mxu":
+            from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+                _AUTO_LEVEL_CHUNK,
+            )
+            from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.mxu import (
+                MxuEngine,
+                MxuGraph,
+            )
+
+            level_chunk = _bench_level_chunk(_AUTO_LEVEL_CHUNK)
+            try:
+                return MxuEngine(
+                    MxuGraph.from_host(g),
+                    level_chunk=level_chunk,
+                    megachunk=_bench_megachunk(),
+                )
+            except ValueError as e:
+                # Tile cap / tile-size errors: fail fast like push/stencil.
+                sys.exit(f"BENCH_ENGINE=mxu: {e}")
         if engine_kind == "streamed":
             from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
                 BellGraph,
@@ -417,6 +442,7 @@ def run_workload() -> None:
             # analytic stream-traffic counter.
             reset_dispatch_count()
             reset_plane_pass()
+            reset_mxu_tiles()
             t0 = time.perf_counter()
             min_f, min_k = engine.best(queries)
             times.append(time.perf_counter() - t0)
@@ -447,6 +473,44 @@ def run_workload() -> None:
         measured_dispatches,
         measured_plane_bytes,
     ) = measure(k)
+
+    # MXU tile accounting (round 8): read the last timed repeat's counters
+    # BEFORE the untimed diagnostics below re-drive the engine.  The
+    # direction trace is the exact per-level push/matmul record (a
+    # host-stepped diagnostic drive, capped so a thousands-of-levels road
+    # row can't stall the child); FLOPs/skips are the analytic
+    # issued-if-matmul model from utils.timing.record_mxu_tiles.
+    mxu_detail = None
+    if engine_kind == "mxu":
+        mxu_flops, mxu_skipped, mxu_tiles = mxu_tile_counts()
+        mg = engine.graph
+        try:
+            trace = engine.level_direction_trace(queries, max_levels=64)
+        except Exception:
+            trace = []
+        mxu_detail = {
+            "tile_flops": mxu_flops,
+            "tile_flops_per_s": (
+                round(mxu_flops / best_s) if mxu_flops else None
+            ),
+            "tiles_nonzero": mg.nt,
+            "tiles_total": mg.tiles_total,
+            "zero_tile_skip_rate": (
+                round(1.0 - mg.nt / mg.tiles_total, 4)
+                if mg.tiles_total
+                else None
+            ),
+            "tiles_skipped_measured": mxu_skipped,
+            "tiles_accounted_measured": mxu_tiles,
+            "tile": mg.tile,
+            "switch": engine.switch,
+            "push_budget": engine.push_budget,
+            "kernel": engine.kernel,
+            # Exact per-level decisions, first 64 levels (the trace is a
+            # separate diagnostic drive, untimed).
+            "directions": [d["direction"] for d in trace],
+            "levels": trace,
+        }
 
     # --- Untimed diagnostics for the model/utilization fields ------------
     # Per-query level counts drive the per-config reference model; one
@@ -511,7 +575,7 @@ def run_workload() -> None:
     # level counts; other engines report only the floor.
     n_dispatches = None
     if (
-        engine_kind in ("bitbell", "stencil", "streamed")
+        engine_kind in ("bitbell", "stencil", "streamed", "mxu")
         and levels_max is not None
     ):
         lc = getattr(engine, "level_chunk", None)
@@ -654,6 +718,10 @@ def run_workload() -> None:
                 # (0/None for non-stencil or unchunked runs — those pay
                 # the full-plane model above).
                 "plane_pass_bytes": measured_plane_bytes,
+                # MXU engine only: analytic tile FLOPs, zero-tile skip
+                # rate and per-level push/matmul decisions (None for the
+                # other engines).
+                "mxu": mxu_detail,
                 "gather_rows_per_s": rows_per_s,
                 "pct_of_roofline": pct_of_roofline,
                 "stream_bytes_per_s": stream_bytes_per_s,
@@ -744,6 +812,23 @@ CONFIG_PRESETS = {
            "BENCH_SCALE": "25", "BENCH_K": "64", "BENCH_SPARSE": "0",
            "BENCH_LEVEL_CHUNK": "2", "MSBFS_SLOT_BUDGET": "33554432",
            "BENCH_REPEATS": "1", "BENCH_EXTRA_KS": ""},
+    # Config 6 (round 8): the tensor-core route (ops.mxu) on a
+    # moderate-n power-law graph — RMAT-14 keeps the densified tile set
+    # under the 2^15 cap at the MXU-native T=128 while the adjacency is
+    # tile-dense enough that the matmul direction carries most levels.
+    # Rows carry detail.mxu (tile FLOPs, skip rate, per-level
+    # directions).
+    "6": {"BENCH_GRAPH": "rmat", "BENCH_ENGINE": "mxu",
+          "BENCH_SCALE": "14", "BENCH_K": "64",
+          "BENCH_LEVEL_CHUNK": "auto", "BENCH_EXTRA_KS": ""},
+    # 6r: the zero-tile-skipping showcase — a banded road grid leaves
+    # ~99% of the tile grid empty, and the thin deep-BFS wavefront keeps
+    # the direction switch mostly on the push side (the trace records
+    # it).  One repeat: hundreds of levels per run.
+    "6r": {"BENCH_GRAPH": "road", "BENCH_ENGINE": "mxu",
+           "BENCH_SCALE": "14", "BENCH_K": "16", "BENCH_MAX_S": "8",
+           "BENCH_LEVEL_CHUNK": "auto", "BENCH_REPEATS": "1",
+           "BENCH_EXTRA_KS": ""},
 }
 
 
@@ -917,7 +1002,7 @@ def main() -> int:
     # (all the BENCH_* knobs below then apply directly).
     configs = [
         c.strip()
-        for c in os.environ.get("BENCH_CONFIGS", "2,2c,4,1,5").split(",")
+        for c in os.environ.get("BENCH_CONFIGS", "2,2c,4,1,5,6,6r").split(",")
         if c.strip()
     ]
     if configs:

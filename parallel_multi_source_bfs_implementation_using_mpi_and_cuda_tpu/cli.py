@@ -89,6 +89,7 @@ _SINGLE_CHIP_ONLY_BACKENDS = (
     "stencil",
     "streamed",
     "lowk",
+    "mxu",
 )
 # Backends whose HBM footprint the bitbell estimate does not model — the
 # single-chip capacity warning stays quiet for these.
@@ -680,6 +681,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                 engine = BellEngine(
                     BellGraph.from_host(graph, keep_sparse=False),
                     level_chunk=level_chunk,
+                )
+            elif backend == "mxu":
+                # Tensor-core frontier expansion (ops.mxu): adjacency
+                # packed into dense per-tile blocks (all-zero tiles
+                # skipped via a host-built index), one level = a blocked
+                # tile x frontier matmul with OR-accumulate counts, with
+                # a per-level density switch back to the gather push for
+                # thin frontiers (MSBFS_MXU_SWITCH; MSBFS_MXU_TILE sizes
+                # the tiles, MSBFS_MXU_KERNEL=1 runs the Pallas chain).
+                from .ops.mxu import MxuEngine, MxuGraph
+
+                try:
+                    mg = MxuGraph.from_host(graph)
+                except ValueError as exc:
+                    # Tile cap exceeded: a user-facing engine-choice
+                    # error, like the push width cap.
+                    print(str(exc), file=sys.stderr)
+                    return 1
+                announce_chunk()
+                engine = MxuEngine(
+                    mg, level_chunk=level_chunk, megachunk=megachunk
                 )
             elif backend == "push":
                 # Frontier-compacted queue BFS: work-optimal on

@@ -37,6 +37,7 @@ from ..utils.donation import donating_jit
 from ..utils.timing import record_dispatch
 from .bfs import host_chunked_loop, validate_level_chunk
 from .bell import forest_hits
+from .engine import frontier_activity
 from .objective import select_best
 from .packed import PackedEngineBase
 from .push import compact_indices
@@ -232,9 +233,7 @@ def hybrid_expand(graph: BellGraph, budget: int, slot_budget=None):
     _, count, _ = graph.sparse
 
     def expand(visited, frontier):
-        active = (frontier != jnp.uint32(0)).any(axis=1)
-        cnt = jnp.sum(active, dtype=jnp.int32)
-        edges = jnp.sum(jnp.where(active, count, 0), dtype=jnp.int32)
+        _, cnt, edges = frontier_activity(frontier, count)
         pred = (cnt <= budget) & (edges <= budget)
         new = lax.cond(
             pred,

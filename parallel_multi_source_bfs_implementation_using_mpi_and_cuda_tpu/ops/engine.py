@@ -31,6 +31,35 @@ from .bfs import (
 from .objective import f_of_u, select_best_jit
 
 
+def frontier_activity(frontier: jax.Array, edge_counts: jax.Array):
+    """(active, cnt, edges) frontier-density estimate: the per-level
+    measurement every direction decision in the repo shares (bitbell /
+    lowk hybrid routing, the mxu push/matmul switch).  ``frontier`` is
+    any (n, lanes) plane layout where a nonzero row means "vertex is in
+    the frontier" — uint32 bit planes and uint8 byte flags both qualify;
+    ``edge_counts`` is the per-vertex dedup out-degree.  Returns the
+    (n,) bool active mask, the int32 active-row count, and the int32
+    outgoing-edge total of the active rows."""
+    active = (frontier != 0).any(axis=1)
+    cnt = jnp.sum(active, dtype=jnp.int32)
+    edges = jnp.sum(jnp.where(active, edge_counts, 0), dtype=jnp.int32)
+    return active, cnt, edges
+
+
+def source_band(queries, n: int):
+    """Host-side initial frontier band ``[lo, hi)`` from (K, S) padded
+    queries: the active-row estimate the stencil window sizes its first
+    chunk from (StencilEngine._band_of) — ``[0, 0]`` when no source is
+    in range.  Pure NumPy; callers gate on "queries are host arrays"
+    themselves."""
+    q = np.asarray(queries)
+    valid = (q >= 0) & (q < n)
+    if not valid.any():
+        return [0, 0]
+    vs = q[valid]
+    return [int(vs.min()), int(vs.max()) + 1]
+
+
 @partial(jax.jit, static_argnames=("max_levels", "expand"))
 def _f_values_chunked(graph, queries, max_levels, expand):
     """(C, J, S) int32 padded queries -> (C, J) int64 F values."""

@@ -54,6 +54,7 @@ from .bitbell import (
     fused_select,
     resolve_megachunk,
 )
+from .engine import frontier_activity
 from .push import compact_indices
 
 # Routing cap for the CLI/serve auto-route: below this many queries the
@@ -132,9 +133,7 @@ def lowk_expand(graph: BellGraph, budget: int):
         if not budget:
             hits = bell_hits_packed(frontier, graph)
         else:
-            active = (frontier != jnp.uint8(0)).any(axis=1)
-            cnt = jnp.sum(active, dtype=jnp.int32)
-            edges = jnp.sum(jnp.where(active, count, 0), dtype=jnp.int32)
+            _, cnt, edges = frontier_activity(frontier, count)
             pred = (cnt <= budget) & (edges <= budget)
             hits = lax.cond(
                 pred,

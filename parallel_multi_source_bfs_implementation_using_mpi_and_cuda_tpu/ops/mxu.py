@@ -1,0 +1,660 @@
+"""MXU frontier expansion: tensor-core BFS over blocked adjacency tiles.
+
+Every other engine in the repo drives level expansion through
+gather/scatter VPU work; this one hands the dense levels to the MXU
+(ROADMAP item 1, after BLEST arxiv 2512.21967 and "Graph Traversal on
+Tensor Cores" arxiv 2606.05081).  The reformulation:
+
+* The dedup CSR is densified HOST-SIDE into per-tile (T, T) 0/1 int8
+  blocks — ``tile[b][u % T, v % T] = 1`` for every directed dedup edge
+  u <- is reached from -> v whose (u // T, v // T) tile is nonzero.  The
+  all-zero tiles (the overwhelming majority on banded graphs) are
+  SKIPPED ENTIRELY via the host-built (tile_row, tile_col) index, built
+  once per graph (and cached by content hash in the serve registry).
+* A level is then hits = OR_b tiles[b] @ frontier[tile_col[b]]: the
+  bit-plane frontier unpacks to an (n_pad, K) 0/1 byte operand, each
+  nonzero tile multiplies its source block (``jnp.dot`` with f32
+  accumulation — counts are exact integers far below 2^24, the
+  ops/dense.py argument tile-wise), a sorted segment-sum ORs the
+  per-tile counts into destination blocks, and ``count > 0`` packs back
+  to bit planes.  The matmul runs either as an XLA bf16 einsum or
+  through the gridless Pallas tile chain (ops/pallas_mxu.py,
+  MSBFS_MXU_KERNEL=1, automatic fallback).
+* Per level a ``lax.cond`` measures frontier density
+  (ops.engine.frontier_activity — the same estimate the bitbell/lowk
+  hybrids use) and routes THIN frontiers through the existing
+  gather/scatter push (ops.bitbell.sparse_hits_or): Beamer's direction
+  switch with the dense direction on the tensor core.  ``MSBFS_MXU_SWITCH``
+  sets the active-row threshold (0 = never push); the auto heuristic is
+  n / 64 active rows with the push edge budget from
+  ops.bitbell.default_sparse_budget.
+
+Everything else is shared machinery: the 7-tuple carry, chunk drivers,
+fused-best programs and K padding come from ops.bitbell, so the engine
+slots into the CLI/serve routing, ChunkSupervisor ladder, SubBatchEngine
+and the agreement matrix unchanged.  Telemetry: every chunked dispatch
+feeds utils.timing.record_mxu_tiles with the analytic tile FLOPs and the
+zero-tile skip counts (CI-observable on CPU, make perf-smoke mxu guard);
+``level_direction_trace`` is the diagnostic host-stepped drive that
+reports the exact per-level push/matmul decisions (bench detail.mxu).
+
+Feasibility bound: densification costs nt * T^2 bytes for the nt nonzero
+tiles, so ``from_host`` refuses graphs whose tile count exceeds
+MSBFS_MXU_MAX_TILES (default 2^15 ~= 512 MB at T=128) — the engine
+targets banded/moderate-n graphs where zero-tile skipping bites; huge
+scale-free graphs stay on the gather engines.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.csr import CSRGraph
+from ..utils.donation import donating_jit
+from ..utils.timing import record_dispatch, record_mxu_tiles
+from .bfs import validate_level_chunk
+from .bitbell import (
+    WORD_BITS,
+    FusedBestEngine,
+    _pack_status,
+    bit_level_chunk,
+    bit_level_init,
+    bit_level_loop,
+    default_sparse_budget,
+    fused_select,
+    pack_byte_planes,
+    pack_queries,
+    resolve_megachunk,
+    sparse_hits_or,
+    unpack_byte_planes,
+    unpack_counts,
+)
+from .engine import frontier_activity
+
+try:  # The Pallas chain is optional: the XLA einsum is the fallback
+    from .pallas_mxu import pallas_tile_products as _pallas_tile_products
+except Exception:  # pragma: no cover - import-environment dependent
+    _pallas_tile_products = None
+
+# MXU-native default: the contraction dim of every per-tile product is the
+# tile size, and 128 is the MXU's systolic width (ops/dense.py LANE).
+DEFAULT_TILE = 128
+# Densification ceiling in nonzero tiles (~512 MB of int8 blocks at T=128).
+DEFAULT_MAX_TILES = 1 << 15
+# Auto direction switch: push when active rows <= n / this (and the edge
+# budget holds) — below that the O(active) scatter beats re-running every
+# nonzero tile through the MXU for a near-empty operand.
+AUTO_SWITCH_DIVISOR = 64
+
+
+def resolve_tile(tile: Optional[int] = None) -> int:
+    """Effective tile size: explicit argument wins, else MSBFS_MXU_TILE,
+    else the MXU-native 128.  Shared by :meth:`MxuGraph.from_host` and
+    the serve registry's tile-index cache key, so a cached layout can
+    never be reused under a different effective tile."""
+    if tile is None:
+        tile = int(os.environ.get("MSBFS_MXU_TILE", "0") or 0)
+        tile = tile or DEFAULT_TILE
+    tile = int(tile)
+    if tile < 8 or tile % 8:
+        raise ValueError(
+            f"MSBFS_MXU_TILE={tile}: tile size must be a multiple of "
+            "8 (>= 8); 128 is the MXU-native width"
+        )
+    return tile
+
+
+class _PushView(NamedTuple):
+    """The two attributes :func:`ops.bitbell.sparse_hits_or` reads,
+    presented over the PADDED vertex space (rows [n, n_pad) have zero
+    degree, so they can never push or be pushed into)."""
+
+    n: int
+    sparse: tuple
+
+
+@jax.tree_util.register_pytree_node_class
+class MxuGraph:
+    """Densified per-tile adjacency + the push-fallback dedup CSR.
+
+    ``tiles`` (nt, T, T) int8 0/1 blocks of the dedup adjacency, one per
+    NONZERO (row_tile, col_tile) pair; ``tile_row``/``tile_col`` (nt,)
+    int32 index them, sorted by (row, col) so the destination
+    segment-sum runs with ``indices_are_sorted``.  ``start``/``count``/
+    ``vals`` are the dedup CSR padded to ``n_pad`` rows — the push
+    branch's operand and the direction predicate's degree vector."""
+
+    def __init__(self, tiles, tile_row, tile_col, start, count, vals,
+                 n, tile):
+        self.tiles = tiles
+        self.tile_row = tile_row
+        self.tile_col = tile_col
+        self.start = start
+        self.count = count
+        self.vals = vals
+        self.n = int(n)
+        self.tile = int(tile)
+
+    # -- static geometry (derived from aux fields, so trace-safe) --------
+
+    @property
+    def ntr(self) -> int:
+        """Tiles per side of the (ntr, ntr) tile grid."""
+        return max(1, -(-self.n // self.tile))
+
+    @property
+    def n_pad(self) -> int:
+        """Vertex rows padded to a whole number of tiles."""
+        return self.ntr * self.tile
+
+    @property
+    def nt(self) -> int:
+        """Nonzero tiles actually multiplied per dense level."""
+        return int(self.tiles.shape[0])
+
+    @property
+    def tiles_total(self) -> int:
+        """Tiles a dense formulation WITHOUT the index would multiply."""
+        return self.ntr * self.ntr
+
+    @property
+    def level_flops(self) -> int:
+        """Analytic MXU FLOPs of one dense level per frontier lane
+        (2*T*T multiply-adds per nonzero tile); multiply by K."""
+        return 2 * self.nt * self.tile * self.tile
+
+    def tree_flatten(self):
+        return (
+            (self.tiles, self.tile_row, self.tile_col,
+             self.start, self.count, self.vals),
+            (self.n, self.tile),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, n=aux[0], tile=aux[1])
+
+    @classmethod
+    def from_host(
+        cls,
+        g: CSRGraph,
+        tile: Optional[int] = None,
+        max_tiles: Optional[int] = None,
+        device: bool = True,
+    ) -> "MxuGraph":
+        """Densify ``g``'s dedup adjacency into per-tile blocks.  Raises
+        ValueError when the nonzero tile count exceeds ``max_tiles``
+        (MSBFS_MXU_MAX_TILES) — the forced-backend CLI route surfaces
+        that as the routing error it is."""
+        tile = resolve_tile(tile)
+        if max_tiles is None:
+            max_tiles = int(os.environ.get("MSBFS_MXU_MAX_TILES", "0") or 0)
+            max_tiles = max_tiles or DEFAULT_MAX_TILES
+        n = g.n
+        u, v, count_n = g.deduped_pairs()
+        ntr = max(1, -(-n // tile))
+        n_pad = ntr * tile
+        count = np.zeros(n_pad, dtype=np.int32)
+        count[:n] = count_n
+        start = np.zeros(n_pad, dtype=np.int32)
+        np.cumsum(count[: n_pad - 1], out=start[1:])
+        tid = (u // tile) * ntr + (v // tile)
+        uniq, inv = np.unique(tid, return_inverse=True)
+        nt = int(uniq.size)
+        if nt > max_tiles:
+            raise ValueError(
+                f"mxu densification needs {nt} nonzero {tile}x{tile} "
+                f"tiles (> MSBFS_MXU_MAX_TILES={max_tiles}, "
+                f"~{nt * tile * tile >> 20} MB): graph too "
+                "tile-dense for the MXU route; use the gather engines"
+            )
+        tiles = np.zeros((nt, tile, tile), dtype=np.int8)
+        if nt:
+            tiles[inv, u % tile, v % tile] = 1
+        tile_row = (uniq // ntr).astype(np.int32)
+        tile_col = (uniq % ntr).astype(np.int32)
+        vals = v.astype(np.int32)
+        arrays = (tiles, tile_row, tile_col, start, count, vals)
+        if device:
+            arrays = tuple(jnp.asarray(a) for a in arrays)
+        return cls(*arrays, n=n, tile=tile)
+
+
+# --- level expansion ---------------------------------------------------------
+
+
+def _tile_products_xla(tiles: jax.Array, rhs: jax.Array) -> jax.Array:
+    """(nt, T, T) x (nt, T, K) -> (nt, T, K) f32 per-tile products: bf16
+    0/1 operands (exact), f32 accumulation (exact below 2^24 — per-tile
+    sums are <= T), the ops/dense.py matmul recipe batched."""
+    return jnp.einsum(
+        "bij,bjk->bik",
+        tiles.astype(jnp.bfloat16),
+        rhs.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def mxu_matmul_hits(
+    graph: MxuGraph, frontier: jax.Array, kernel: bool = False
+) -> jax.Array:
+    """(n_pad, W) uint32 frontier planes -> (n_pad, W) hit planes via the
+    blocked tile x frontier matmul.  OR-accumulate semantics: per-tile
+    products are nonneg neighbor counts, the sorted segment-sum over
+    destination tiles adds them exactly, and ``count > 0`` IS the
+    neighbor-OR."""
+    if graph.nt == 0:  # edgeless: nothing can be hit
+        return jnp.zeros_like(frontier)
+    t, ntr = graph.tile, graph.ntr
+    fr = unpack_byte_planes(frontier).astype(jnp.int8)  # (n_pad, K) 0/1
+    k = fr.shape[1]
+    blocks = fr.reshape(ntr, t, k)
+    rhs = jnp.take(blocks, graph.tile_col, axis=0)  # (nt, T, K)
+    products = (
+        _pallas_tile_products if kernel else _tile_products_xla
+    )(graph.tiles, rhs)
+    acc = jax.ops.segment_sum(
+        products,
+        graph.tile_row,
+        num_segments=ntr,
+        indices_are_sorted=True,
+    )  # (ntr, T, K) f32 neighbor counts
+    hits = (acc > 0).astype(jnp.uint8).reshape(graph.n_pad, k)
+    return pack_byte_planes(hits)
+
+
+def mxu_expand(
+    graph: MxuGraph, switch: int, budget: int, kernel: bool = False
+):
+    """Direction-switched expansion hook for :func:`bit_level_loop`: per
+    level, measure frontier density (the shared
+    ops.engine.frontier_activity estimate) and route thin frontiers
+    (<= ``switch`` active rows AND <= ``budget`` outgoing dedup edges)
+    through the gather/scatter push, everything else through the tile
+    matmul.  Exact same hit planes either way."""
+    view = _PushView(
+        n=graph.n_pad, sparse=(graph.start, graph.count, graph.vals)
+    )
+
+    def expand(visited, frontier):
+        _, cnt, edges = frontier_activity(frontier, graph.count)
+        pred = (cnt <= switch) & (edges <= budget)
+        new = lax.cond(
+            pred,
+            lambda fr: sparse_hits_or(fr, view, budget),
+            lambda fr: mxu_matmul_hits(graph, fr, kernel),
+            frontier,
+        )
+        return new & ~visited
+
+    return expand
+
+
+def _mxu_frontier0(graph: MxuGraph, queries: jax.Array) -> jax.Array:
+    """(K, S) queries -> (n_pad, W) uint32 source planes: the bitbell
+    packing over the REAL vertex range (out-of-range sources drop against
+    n, not n_pad), then zero rows up to the tile boundary."""
+    fr = pack_queries(graph.n, queries)
+    pad = graph.n_pad - graph.n
+    if pad:
+        fr = jnp.concatenate(
+            [fr, jnp.zeros((pad, fr.shape[1]), fr.dtype)], axis=0
+        )
+    return fr
+
+
+# --- jitted drive programs (the ops/lowk.py quartet, mxu expansion) ----------
+
+
+@partial(
+    jax.jit, static_argnames=("max_levels", "switch", "budget", "kernel")
+)
+def mxu_run(
+    graph: MxuGraph,
+    queries: jax.Array,
+    max_levels: Optional[int] = None,
+    switch: int = 0,
+    budget: int = 1,
+    kernel: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(K, S) queries -> per-query (f, levels, reached), whole BFS in one
+    dispatch (shared 7-tuple loop over padded bit planes)."""
+    frontier0 = _mxu_frontier0(graph, queries)
+    return bit_level_loop(
+        frontier0,
+        unpack_counts(frontier0),
+        mxu_expand(graph, switch, budget, kernel),
+        max_levels,
+        counts_of=unpack_counts,
+    )
+
+
+@jax.jit
+def _mxu_init_carry(graph: MxuGraph, queries: jax.Array):
+    frontier0 = _mxu_frontier0(graph, queries)
+    return bit_level_init(frontier0, unpack_counts(frontier0))
+
+
+@donating_jit(
+    donate_argnums=(1,),
+    static_argnames=("max_levels", "switch", "budget", "kernel"),
+)
+def _mxu_chunk(graph, carry, chunk, max_levels, switch, budget, kernel):
+    """One bounded dispatch of <= ``chunk`` levels (carry DONATED: the
+    host driver rebinds it every step)."""
+    return bit_level_chunk(
+        carry,
+        mxu_expand(graph, switch, budget, kernel),
+        chunk,
+        max_levels,
+        counts_of=unpack_counts,
+    )
+
+
+@partial(
+    jax.jit, static_argnames=("max_levels", "switch", "budget", "kernel")
+)
+def mxu_best_fused(
+    graph, queries, k, max_levels, switch, budget, kernel
+) -> jax.Array:
+    """Packing + init + level loop + argmin in ONE program -> (2,) int64
+    [minF, minK] (k is traced: one executable serves every K)."""
+    f, _, _ = mxu_run(graph, queries, max_levels, switch, budget, kernel)
+    min_f, min_k = fused_select(f, k)
+    return jnp.stack([min_f, min_k.astype(jnp.int64)])
+
+
+def _mxu_best_tail(graph, carry, k, chunk, max_levels, switch, budget,
+                   kernel):
+    carry = bit_level_chunk(
+        carry,
+        mxu_expand(graph, switch, budget, kernel),
+        chunk,
+        max_levels,
+        counts_of=unpack_counts,
+    )
+    return carry + (_pack_status(carry, k),)
+
+
+@partial(
+    jax.jit, static_argnames=("max_levels", "switch", "budget", "kernel")
+)
+def _mxu_start_chunk_best(
+    graph, queries, k, chunk, max_levels, switch, budget, kernel
+):
+    """Chunked fused-best START: packing + init + one chunk + status in
+    one dispatch.  NOT donated (argnum 1 is the caller's queries)."""
+    frontier0 = _mxu_frontier0(graph, queries)
+    carry = bit_level_init(frontier0, unpack_counts(frontier0))
+    return _mxu_best_tail(
+        graph, carry, k, chunk, max_levels, switch, budget, kernel
+    )
+
+
+@donating_jit(
+    donate_argnums=(1,),
+    static_argnames=("max_levels", "switch", "budget", "kernel"),
+)
+def _mxu_chunk_best(
+    graph, carry, k, chunk, max_levels, switch, budget, kernel
+):
+    """Chunked fused-best CONTINUATION (7-tuple carry DONATED)."""
+    return _mxu_best_tail(
+        graph, carry, k, chunk, max_levels, switch, budget, kernel
+    )
+
+
+@jax.jit
+def _mxu_probe(graph: MxuGraph, frontier: jax.Array) -> jax.Array:
+    """(2,) int32 [active_rows, active_edges] of a frontier — the
+    diagnostic twin of the in-program direction predicate."""
+    _, cnt, edges = frontier_activity(frontier, graph.count)
+    return jnp.stack([cnt, edges])
+
+
+# --- engine ------------------------------------------------------------------
+
+
+class MxuEngine(FusedBestEngine):
+    """Tensor-core direction-switched engine over an MxuGraph.
+
+    The bit-plane loop, counters, K padding (k_align = 32) and
+    fused-best machinery are shared with ops.bitbell; only the per-level
+    expansion differs (tile matmul vs density-routed push,
+    :func:`mxu_expand`).
+
+    ``switch``: active-row threshold of the per-level direction switch
+    (MSBFS_MXU_SWITCH; None = auto n / 64, 0 = never push).
+    ``push_budget``: edge budget of the push branch
+    (ops.bitbell.default_sparse_budget auto).  ``kernel``
+    (MSBFS_MXU_KERNEL=1): route the tile products through the gridless
+    Pallas chain (ops/pallas_mxu.py), XLA einsum fallback automatic.
+
+    Every chunked dispatch feeds utils.timing.record_mxu_tiles with the
+    analytic tile FLOPs issued and the zero tiles skipped (levels
+    advanced x static per-level counts) — exact under switch = 0, an
+    issued-if-matmul model otherwise; ``level_direction_trace`` gives
+    the exact per-level split.  The unchunked fused path records
+    nothing: it fetches no per-chunk level counter (the stencil
+    plane-pass precedent)."""
+
+    k_align = WORD_BITS
+
+    def __init__(
+        self,
+        graph: MxuGraph,
+        max_levels: Optional[int] = None,
+        switch: Optional[int] = None,
+        push_budget: Optional[int] = None,
+        level_chunk: Optional[int] = None,
+        megachunk: Optional[int] = None,
+        kernel: Optional[bool] = None,
+    ):
+        self.graph = graph
+        self.max_levels = max_levels
+        self.level_chunk = validate_level_chunk(level_chunk)
+        self.megachunk = resolve_megachunk(megachunk, self.level_chunk)
+        if switch is None:
+            env = os.environ.get("MSBFS_MXU_SWITCH", "")
+            switch = int(env) if env.strip() else None
+        if switch is None:
+            switch = max(1, graph.n // AUTO_SWITCH_DIVISOR)
+        self.switch = int(switch)
+        e = int(graph.vals.shape[0])
+        if push_budget is None:
+            push_budget = default_sparse_budget(e)
+        # >= 1: the push branch traces at the static budget size even
+        # when the switch never routes there (lax.cond traces both).
+        # Clamped above by "every vertex active, every edge leaving" —
+        # the largest frontier the push can ever face — so a forced
+        # always-push configuration cannot allocate a larger-than-useful
+        # static compact buffer.
+        self.push_budget = max(
+            1, min(int(push_budget), graph.n_pad + e)
+        )
+        if kernel is None:
+            kernel = os.environ.get("MSBFS_MXU_KERNEL", "") == "1"
+        # Fallback is automatic: without an importable Pallas chain the
+        # XLA einsum serves every request.
+        self.kernel = bool(kernel) and _pallas_tile_products is not None
+        # Exact per-level decisions of the last level_direction_trace
+        # run (diagnostic; the perf paths never pay the per-level sync).
+        self.last_direction_trace = []
+
+    def _account(self, advanced: int, k: int) -> None:
+        """Record ``advanced`` levels of analytic MXU work: tile FLOPs at
+        the matmul-equivalent rate plus the zero-tile skip counts.  The
+        matmul operand is the WORD_BITS-padded plane, so FLOPs count the
+        padded lane width even when fewer queries are valid."""
+        if advanced > 0:
+            g = self.graph
+            lanes = -(-max(int(k), 1) // WORD_BITS) * WORD_BITS
+            record_mxu_tiles(
+                advanced * g.level_flops * lanes,
+                advanced * (g.tiles_total - g.nt),
+                advanced * g.tiles_total,
+            )
+
+    # -- result paths ----------------------------------------------------
+
+    def _run(self, queries):
+        if not self.level_chunk:
+            return mxu_run(
+                self.graph,
+                queries,
+                self.max_levels,
+                self.switch,
+                self.push_budget,
+                self.kernel,
+            )
+        # np.int32 traced bound: rides the dispatch (an eager jnp scalar
+        # would be its own device commit).
+        bound = np.int32(self.level_chunk * self.megachunk)
+        k = int(queries.shape[0])
+        carry = _mxu_init_carry(self.graph, queries)
+        prev_level = 0
+        while True:
+            carry = _mxu_chunk(
+                self.graph,
+                carry,
+                bound,
+                self.max_levels,
+                self.switch,
+                self.push_budget,
+                self.kernel,
+            )
+            level = int(np.asarray(carry[5]))
+            updated = bool(np.asarray(carry[6]))
+            record_dispatch()
+            self._account(level - prev_level, k)
+            prev_level = level
+            if not updated:
+                break
+            if self.max_levels is not None and level >= self.max_levels:
+                break
+        return carry[2], carry[3], carry[4]
+
+    def best(self, queries) -> Tuple[int, int]:
+        queries, k = self._pad_queries(queries)
+        kk = np.int32(k)
+        if not self.level_chunk:
+            min_f, min_k = np.asarray(self._fused_full(queries, kk))
+            record_dispatch()
+            return int(min_f), int(min_k)
+        # Custom fused-best drive (same convergence contract as
+        # ops.bitbell.fused_best_drive) so each chunk's status level can
+        # feed the MXU tile telemetry.
+        bound = np.int32(self.level_chunk * self.megachunk)
+        c8 = None
+        prev_level = 0
+        while True:
+            first = c8 is None
+            fn = _mxu_start_chunk_best if first else _mxu_chunk_best
+            c8 = fn(
+                self.graph,
+                queries if first else c8[:7],
+                kk,
+                bound,
+                self.max_levels,
+                self.switch,
+                self.push_budget,
+                self.kernel,
+            )
+            status = np.asarray(c8[7])
+            record_dispatch()
+            level, updated, min_f, min_k = (int(x) for x in status)
+            self._account(level - prev_level, int(k))
+            prev_level = level
+            if not updated:
+                break
+            if self.max_levels is not None and level >= self.max_levels:
+                break
+        return min_f, min_k
+
+    def _fused_full(self, queries, k):
+        return mxu_best_fused(
+            self.graph,
+            queries,
+            k,
+            self.max_levels,
+            self.switch,
+            self.push_budget,
+            self.kernel,
+        )
+
+    def _fused_chunk(self, state, k, first):
+        fn = _mxu_start_chunk_best if first else _mxu_chunk_best
+        return fn(
+            self.graph,
+            state,
+            k,
+            np.int32(self.level_chunk * self.megachunk),
+            self.max_levels,
+            self.switch,
+            self.push_budget,
+            self.kernel,
+        )
+
+    def f_values(self, queries) -> jax.Array:
+        queries, k = self._pad_queries(queries)
+        f, _, _ = self._run(queries)
+        return f[:k]
+
+    def query_stats(self, queries):
+        queries, k = self._pad_queries(queries)
+        f, levels, reached = self._run(queries)
+        return (
+            np.asarray(levels)[:k],
+            np.asarray(reached)[:k],
+            np.asarray(f)[:k],
+        )
+
+    # -- diagnostics -----------------------------------------------------
+
+    def level_direction_trace(self, queries, max_levels=None):
+        """Exact per-level push/matmul decisions: a host-stepped drive
+        (one density probe + one single-level chunk per executed level —
+        a diagnostic, NOT the perf path) evaluating the identical
+        predicate the in-program ``lax.cond`` routes on.  Returns (and
+        stores in ``last_direction_trace``) one dict per executed level:
+        {level, direction, active_rows, active_edges}."""
+        queries, _ = self._pad_queries(queries)
+        cap = max_levels or self.max_levels or self.graph.n + 1
+        carry = _mxu_init_carry(self.graph, queries)
+        trace = []
+        one = np.int32(1)
+        while len(trace) < cap:
+            cnt, edges = (
+                int(x)
+                for x in np.asarray(_mxu_probe(self.graph, carry[1]))
+            )
+            record_dispatch()
+            if cnt == 0:  # empty frontier: the loop would have exited
+                break
+            push = cnt <= self.switch and edges <= self.push_budget
+            trace.append(
+                {
+                    "level": len(trace) + 1,
+                    "direction": "push" if push else "matmul",
+                    "active_rows": cnt,
+                    "active_edges": edges,
+                }
+            )
+            carry = _mxu_chunk(
+                self.graph,
+                carry,
+                one,
+                self.max_levels,
+                self.switch,
+                self.push_budget,
+                self.kernel,
+            )
+        self.last_direction_trace = trace
+        return trace

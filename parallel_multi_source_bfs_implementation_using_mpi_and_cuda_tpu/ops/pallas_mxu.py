@@ -1,0 +1,114 @@
+"""Gridless Pallas tile-matmul chain for the MXU frontier expansion.
+
+The mxu engine's dense level is a batch of per-tile products: for every
+nonzero adjacency tile ``A[b]`` (T, T) the hit counts of its destination
+rows gain ``A[b] @ F[col(b)]`` where ``F[col(b)]`` is the (T, K) byte
+view of the source block's frontier (ops/mxu.py).  That product is the
+canonical MXU shape — contraction 128-wide at the default tile — so this
+module expresses it as ``jnp.dot(..., preferred_element_type=f32)``
+inside a Pallas kernel, the one matmul form the Mosaic path accepts
+(/opt guide; ops/dense.py uses the same via XLA).
+
+Production constraint carried over from the stencil chain
+(docs/PALLAS_LOG.md round 5): ONLY gridless whole-VMEM ``pallas_call``s
+compile on this stack — every gridded variant crashes the remote AOT
+compile helper.  So the batch dimension is chunked MANUALLY in XLA glue:
+tiles are row-stacked into a 2-D (B*T, T) operand (3-D refs are another
+Mosaic gamble this stack doesn't need), cut into batches whose f32
+product chunk fits the ~2 MB single-VMEM-block budget, and each batch
+runs one gridless call.  ``lru_cache`` keeps at most two compiled
+programs per (T, K) shape (body batch + tail batch) — the
+ops/pallas_stencil.py chain discipline.
+
+Exactness: the 0/1 int8 operands cast to bf16 inside the kernel (exact
+for 0/1), and the f32 ``preferred_element_type`` accumulates integer
+counts exactly below 2^24 — per-tile sums are <= T, far inside.  Off-TPU
+the chain runs ``interpret=True`` so CPU CI pins bit-identity against
+the XLA einsum formulation (tests/test_mxu.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# One gridless call's f32 product chunk budget: (B*T, K) * 4 bytes <= 2 MB
+# — the output dominates the int8 inputs 4:1 (T=128, K=256: B <= 16).
+MAX_OUT_BYTES = 2 << 20
+
+
+def tile_batch(t: int, k: int) -> int:
+    """Tiles per gridless call under the VMEM product budget."""
+    return max(1, MAX_OUT_BYTES // (t * k * 4))
+
+
+def make_tile_kernel(batch, t):
+    """Fused one-VMEM-pass tile-product batch: read the row-stacked
+    adjacency tiles and frontier blocks once, emit every per-tile MXU
+    product once.  ``batch`` is a static python int, so the per-tile loop
+    unrolls into static row slices."""
+
+    def kernel(a_ref, b_ref, o_ref):
+        a = a_ref[...]  # (batch*t, t) int8 row-stacked adjacency tiles
+        b = b_ref[...]  # (batch*t, k) int8 row-stacked frontier blocks
+        outs = []
+        for i in range(batch):
+            ab = a[i * t : (i + 1) * t].astype(jnp.bfloat16)
+            fb = b[i * t : (i + 1) * t].astype(jnp.bfloat16)
+            outs.append(
+                jnp.dot(ab, fb, preferred_element_type=jnp.float32)
+            )
+        o_ref[...] = outs[0] if batch == 1 else jnp.concatenate(outs, 0)
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=None)
+def _tile_call(batch, t, k, interpret):
+    """One gridless whole-VMEM pallas_call per (batch, tile, K) — cached
+    so the chain compiles at most two programs per plane shape (body
+    batch + tail batch)."""
+    import jax.experimental.pallas as pl
+
+    kwargs = {}
+    if not interpret:
+        import jax.experimental.pallas.tpu as pltpu
+
+        kwargs = dict(
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )
+    return pl.pallas_call(
+        make_tile_kernel(batch, t),
+        out_shape=jax.ShapeDtypeStruct((batch * t, k), jnp.float32),
+        interpret=interpret,
+        **kwargs,
+    )
+
+
+def pallas_tile_products(tiles: jax.Array, rhs: jax.Array) -> jax.Array:
+    """(nt, T, T) int8 tiles x (nt, T, K) int8 frontier blocks ->
+    (nt, T, K) f32 per-tile products, as a chain of gridless Pallas calls
+    (interpreter mode off-TPU, so CPU CI pins bit-identity)."""
+    from ..utils.platform import is_tpu_backend
+
+    nt, t, _ = tiles.shape
+    k = rhs.shape[2]
+    interpret = not is_tpu_backend()
+    batch = tile_batch(t, k)
+    a2 = tiles.reshape(nt * t, t)
+    b2 = rhs.reshape(nt * t, k)
+    parts = []
+    for cs in range(0, nt, batch):
+        ce = min(cs + batch, nt)
+        a_c = lax.slice_in_dim(a2, cs * t, ce * t, axis=0)
+        b_c = lax.slice_in_dim(b2, cs * t, ce * t, axis=0)
+        parts.append(_tile_call(ce - cs, t, k, interpret)(a_c, b_c))
+    out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+    return out.reshape(nt, t, k)

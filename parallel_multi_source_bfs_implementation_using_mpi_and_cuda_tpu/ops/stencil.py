@@ -56,6 +56,7 @@ from .bitbell import (
     unpack_byte_planes,
     unpack_counts,
 )
+from .engine import source_band
 
 try:  # The Pallas chain is optional: XLA masked shifts are the fallback
     # whenever pallas (or its TPU lowering) is unavailable (MSBFS_STENCIL
@@ -704,12 +705,7 @@ class StencilEngine(FusedBestEngine):
         their own blocking fetch just to size the window)."""
         if not self.window_active or isinstance(queries, jax.Array):
             return None
-        q = np.asarray(queries)
-        valid = (q >= 0) & (q < self.graph.n)
-        if not valid.any():
-            return [0, 0]
-        vs = q[valid]
-        return [int(vs.min()), int(vs.max()) + 1]
+        return source_band(queries, self.graph.n)
 
     def _window_for(self, band, steps):
         """(wlo, rows) window covering ``band`` + max|d| * steps margin;

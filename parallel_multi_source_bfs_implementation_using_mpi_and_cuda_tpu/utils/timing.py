@@ -111,3 +111,48 @@ def reset_plane_pass() -> None:
     global _plane_pass_bytes
     with _plane_pass_lock:
         _plane_pass_bytes = 0
+
+
+# --- MXU tile accounting (round 8) -------------------------------------------
+# The mxu engine's matmul level is FLOP-bound, not stream-bound: per level
+# it issues 2*T*T*K FLOPs for every NONZERO adjacency tile (ops/mxu.py),
+# and the host-built tile index skips the all-zero tiles entirely.  Both
+# quantities are analytic — tiles are static per graph, levels are counted
+# at the same host fetch sites that ride record_dispatch — so MXU
+# utilization and the zero-tile diet are CI-observable on CPU (bench
+# detail.mxu, the make perf-smoke mxu guard) exactly like the dispatch and
+# plane-byte diets.  The FLOP counter is an ISSUED-IF-MATMUL model: chunked
+# dispatches cannot see per-level direction decisions without extra
+# round-trips, so push levels are counted at the matmul-equivalent rate
+# (exact under MSBFS_MXU_SWITCH=0, which is what the smoke guard pins;
+# MxuEngine.level_direction_trace gives the exact per-level split).
+
+_mxu_flops = 0
+_mxu_tiles_skipped = 0
+_mxu_tiles_total = 0
+_mxu_lock = threading.Lock()
+
+
+def record_mxu_tiles(flops: int, skipped: int, total: int) -> None:
+    """Account one (or more) mxu level expansions: ``flops`` analytic tile
+    FLOPs issued, ``skipped`` all-zero tiles elided of ``total`` tiles in
+    the full (n_tiles x n_tiles) grid."""
+    global _mxu_flops, _mxu_tiles_skipped, _mxu_tiles_total
+    with _mxu_lock:
+        _mxu_flops += int(flops)
+        _mxu_tiles_skipped += int(skipped)
+        _mxu_tiles_total += int(total)
+
+
+def mxu_tile_counts():
+    """(flops, tiles_skipped, tiles_total) since the last
+    :func:`reset_mxu_tiles`."""
+    with _mxu_lock:
+        return _mxu_flops, _mxu_tiles_skipped, _mxu_tiles_total
+
+
+def reset_mxu_tiles() -> None:
+    """Zero the mxu accumulators (callers bracket a measured span)."""
+    global _mxu_flops, _mxu_tiles_skipped, _mxu_tiles_total
+    with _mxu_lock:
+        _mxu_flops = _mxu_tiles_skipped = _mxu_tiles_total = 0

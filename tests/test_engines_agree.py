@@ -248,6 +248,45 @@ def _lowk(g):
     return LowKEngine(BellGraph.from_host(g))
 
 
+def _mxu(g):
+    """Round-8 tensor-core engine: blocked adjacency-tile matmul
+    expansion with the density direction switch on auto (small tile so
+    the RMAT-8 fixture spans many tiles)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.mxu import (
+        MxuEngine,
+        MxuGraph,
+    )
+
+    return MxuEngine(MxuGraph.from_host(g, tile=16))
+
+
+def _mxu_chunked(g):
+    """Chunked + megachunked drive loop over the matmul expansion."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.mxu import (
+        MxuEngine,
+        MxuGraph,
+    )
+
+    return MxuEngine(
+        MxuGraph.from_host(g, tile=16), level_chunk=2, megachunk=3
+    )
+
+
+def _mxu_switch(g):
+    """Forced direction-flip arm: switch=40 makes the dense middle
+    levels matmul and the thin first/last levels push, so the lax.cond
+    takes BOTH branches within one BFS (bit-identity under the flip is
+    the point)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.mxu import (
+        MxuEngine,
+        MxuGraph,
+    )
+
+    return MxuEngine(
+        MxuGraph.from_host(g, tile=16), switch=40, level_chunk=3
+    )
+
+
 # The lowk drive-loop variants (chunked/megachunk) and the sub-batch
 # splitter are pinned against the oracle and the bit-plane reference in
 # tests/test_lowk.py; only the base byte-flag arm needs the full
@@ -263,6 +302,9 @@ ENGINES = {
     "bitbell_chunked": _bitbell_chunked,
     "bitbell_megachunk": _bitbell_megachunk,
     "streamed": _streamed,
+    "mxu": _mxu,
+    "mxu_chunked": _mxu_chunked,
+    "mxu_switch": _mxu_switch,
     "push": _push,
     "packed_push": _packed_push,
     "distributed": _distributed,
@@ -292,7 +334,19 @@ def workload():
     return g, padded, reference
 
 
-@pytest.mark.parametrize("name", sorted(ENGINES))
+# Tier-1 runs -m "not slow" against a tight wall-clock budget, so only
+# the shared-workload mxu + mxu_switch arms — the cross-engine
+# bit-identity contract for the round-8 route, including the direction
+# flip — stay tier-1; the drive-mode and banded (road-regime) arms ride
+# `make mxu` instead.
+def _arms(engines, slow):
+    return [
+        pytest.param(n, marks=pytest.mark.slow) if n in slow else n
+        for n in sorted(engines)
+    ]
+
+
+@pytest.mark.parametrize("name", _arms(ENGINES, slow={"mxu_chunked"}))
 def test_engine_agrees(workload, name):
     g, padded, reference = workload
     if name.startswith(("distributed", "sharded")) and len(jax.devices()) < 8:
@@ -375,6 +429,11 @@ BANDED_ENGINES = {
     "stencil_megachunk": _stencil_megachunk,
     "stencil_window": _stencil_window,
     "stencil_blocked": _stencil_blocked,
+    # The mxu arms on the road lattice exercise the zero-tile-skipping
+    # regime (most of the tile grid empty) and the push-heavy side of
+    # the direction switch (thin deep-BFS wavefronts).
+    "mxu": _mxu,
+    "mxu_switch": _mxu_switch,
     "bitbell": _bitbell,
     "bitbell_chunked": _bitbell_chunked,
     "streamed": _streamed,
@@ -400,7 +459,9 @@ def banded_workload():
     return g, padded, reference
 
 
-@pytest.mark.parametrize("name", sorted(BANDED_ENGINES))
+@pytest.mark.parametrize(
+    "name", _arms(BANDED_ENGINES, slow={"mxu", "mxu_switch"})
+)
 def test_engine_agrees_banded(banded_workload, name):
     g, padded, reference = banded_workload
     if name.startswith(("distributed", "sharded")) and len(jax.devices()) < 8:

@@ -226,8 +226,15 @@ def test_graceful_drain_completes_inflight_and_refuses_new(
     while srv.batcher.depth() < 1 and time.time() < deadline:
         time.sleep(0.01)
     assert srv.batcher.depth() == 1
-    late = MsbfsClient(addr)  # connected before the listener closes
+    late = MsbfsClient(addr)
     try:
+        # Round-trip BEFORE draining: connect() alone only queues the
+        # socket in the listen backlog, and request_drain closes the
+        # listener, which resets un-accepted queued connections — the
+        # acceptor thread must actually win the race and attach a
+        # handler for "liveness stays up while draining" to be about
+        # draining rather than about accept-loop scheduling.
+        assert late.ping()
         srv.request_drain()
         assert srv.draining
         assert late.ping()  # liveness stays up while draining

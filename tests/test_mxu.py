@@ -1,0 +1,351 @@
+"""MXU engine suite (round 8, ops.mxu): oracle parity for the blocked
+adjacency-tile matmul expansion, bit-identity under the density-based
+direction switch (both lax.cond branches within one BFS), the Pallas
+tile-chain interpret-mode parity, K sweep through the sub-batch
+splitter, the analytic tile-FLOP counters, the shared density helpers
+(ops.engine.frontier_activity / source_band) and the serve registry's
+content-hash tile-index cache.
+
+Fixtures are deliberately tiny (n <= 384): tile geometry, not scale, is
+what the matmul formulation can get wrong, and a 16-wide tile on a
+~300-vertex graph already spans hundreds of tiles.
+"""
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.mxu import (
+    MxuEngine,
+    MxuGraph,
+    mxu_matmul_hits,
+    resolve_tile,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (
+    mxu_tile_counts,
+    reset_mxu_tiles,
+)
+
+from oracle import oracle_best, oracle_bfs, oracle_f
+
+
+def _reference(n, edges, queries):
+    f = np.asarray(
+        [oracle_f(oracle_bfs(n, edges, q)) for q in queries], dtype=np.int64
+    )
+    return f, oracle_best(f.tolist())
+
+
+@pytest.fixture(scope="module")
+def rmat():
+    n, edges = generators.rmat_edges(8, edge_factor=8, seed=801)
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 10, max_group=6, seed=802)
+    queries[3] = np.zeros(0, dtype=np.int32)
+    queries[7] = np.array([-1, n + 9], dtype=np.int32)
+    f, best = _reference(n, edges, queries)
+    return n, edges, g, pad_queries(queries), f, best
+
+
+@pytest.fixture(scope="module")
+def road():
+    n, edges = generators.road_edges(18, 21, seed=803)
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 9, max_group=5, seed=804)
+    queries[2] = np.zeros(0, dtype=np.int32)
+    f, best = _reference(n, edges, queries)
+    return n, edges, g, pad_queries(queries), f, best
+
+
+def _assert_agrees(eng, padded, f, best):
+    np.testing.assert_array_equal(np.asarray(eng.f_values(padded)), f)
+    assert eng.best(padded) == best
+
+
+# --- tile packing geometry ---------------------------------------------------
+
+
+def test_tile_index_is_sorted_and_exact(rmat):
+    n, edges, g, _, _, _ = rmat
+    mg = MxuGraph.from_host(g, tile=16, device=False)
+    row = np.asarray(mg.tile_row)
+    col = np.asarray(mg.tile_col)
+    # Sorted by (row, col): the segment-sum's indices_are_sorted contract.
+    order = row.astype(np.int64) * mg.ntr + col
+    assert (np.diff(order) > 0).all()
+    # Every dedup edge lands in exactly one tile cell, and the tile set
+    # holds nothing else.
+    u, v, _ = g.deduped_pairs()
+    assert int(np.asarray(mg.tiles).sum()) == u.size
+    for b in np.random.default_rng(0).integers(0, mg.nt, size=4):
+        tile = np.asarray(mg.tiles[b])
+        uu, vv = np.nonzero(tile)
+        base_u = row[b] * mg.tile
+        base_v = col[b] * mg.tile
+        got = set(zip((base_u + uu).tolist(), (base_v + vv).tolist()))
+        want = {
+            (a, b2)
+            for a, b2 in zip(u.tolist(), v.tolist())
+            if a // mg.tile == row[b] and b2 // mg.tile == col[b]
+        }
+        assert got == want
+
+
+def test_tile_cap_and_size_validation(rmat):
+    _, _, g, _, _, _ = rmat
+    with pytest.raises(ValueError, match="too tile-dense"):
+        MxuGraph.from_host(g, tile=8, max_tiles=4)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        MxuGraph.from_host(g, tile=12)
+    assert resolve_tile(64) == 64
+
+
+def test_matmul_hits_equal_push_expansion(rmat):
+    """One level of the matmul expansion == the brute-force neighbor OR."""
+    import jax.numpy as jnp
+
+    n, edges, g, _, _, _ = rmat
+    mg = MxuGraph.from_host(g, tile=16)
+    u, v, _ = g.deduped_pairs()
+    rng = np.random.default_rng(5)
+    fr_bytes = (rng.random((mg.n_pad, 32)) < 0.1).astype(np.uint8)
+    fr_bytes[n:] = 0
+    want = np.zeros_like(fr_bytes)
+    for a, b in zip(u, v):
+        want[a] |= fr_bytes[b]
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+        pack_byte_planes,
+        unpack_byte_planes,
+    )
+
+    frontier = pack_byte_planes(jnp.asarray(fr_bytes))
+    got = unpack_byte_planes(mxu_matmul_hits(mg, frontier))
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+# --- oracle parity across drive modes ---------------------------------------
+
+
+@pytest.mark.slow  # ~10 s; tier-1 keeps the test_engines_agree mxu arms
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {},  # unchunked fused best
+        {"level_chunk": 2},  # chunked drive loop
+        {"level_chunk": 2, "megachunk": 3},  # megachunk fusion
+        {"switch": 0},  # never push: pure matmul
+        {"switch": 10**9, "push_budget": 10**9},  # always push (clamped)
+        {"switch": 40, "level_chunk": 3},  # both directions in one BFS
+    ],
+)
+def test_rmat_parity(rmat, kwargs):
+    n, edges, g, padded, f, best = rmat
+    _assert_agrees(MxuEngine(MxuGraph.from_host(g, tile=16), **kwargs),
+                   padded, f, best)
+
+
+@pytest.mark.slow  # tier-1 covers the road regime via the banded mxu arm
+def test_road_parity_high_skip(road):
+    """Banded lattice: most of the tile grid is all-zero, the skip index
+    carries the level."""
+    n, edges, g, padded, f, best = road
+    mg = MxuGraph.from_host(g, tile=32)
+    assert mg.nt < mg.tiles_total // 2
+    _assert_agrees(MxuEngine(mg, level_chunk=4), padded, f, best)
+
+
+@pytest.mark.slow  # ~7 s: three compiles over the stranded fixture
+def test_stranded_component_parity():
+    """A path graph plus a disconnected clique: unreached vertices stay
+    -1 through the matmul route, and sources in the stranded component
+    never leak distances across."""
+    path = np.array([[i, i + 1] for i in range(40)], dtype=np.int32)
+    clique = np.array(
+        [[u, v] for u in range(60, 66) for v in range(u + 1, 66)],
+        dtype=np.int32,
+    )
+    edges = np.concatenate([path, clique])
+    n = 96
+    g = CSRGraph.from_edges(n, edges)
+    queries = [
+        np.array([0], dtype=np.int32),
+        np.array([62], dtype=np.int32),
+        np.array([5, 63], dtype=np.int32),
+        np.array([90], dtype=np.int32),  # isolated vertex
+    ]
+    f, best = _reference(n, edges, queries)
+    padded = pad_queries(queries)
+    for kwargs in ({}, {"level_chunk": 2, "switch": 0}, {"switch": 10**6}):
+        _assert_agrees(MxuEngine(MxuGraph.from_host(g, tile=16), **kwargs),
+                       padded, f, best)
+
+
+@pytest.mark.slow  # ~12 s: three K-shapes, each its own compile
+def test_k_sweep_subbatch(rmat):
+    """K=1 (single word), K=64 (two words) and K=320 through the
+    SubBatchEngine splitter (strict-< winner merge) all agree."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.packed import (
+        SubBatchEngine,
+    )
+
+    n, edges, g, _, _, _ = rmat
+    mg = MxuGraph.from_host(g, tile=16)
+    for k, wrap in ((1, False), (64, False), (320, True)):
+        queries = generators.random_queries(n, k, max_group=4, seed=900 + k)
+        f, best = _reference(n, edges, queries)
+        padded = pad_queries(queries)
+        eng = MxuEngine(mg, level_chunk=4)
+        if wrap:
+            eng = SubBatchEngine(eng, batch_k=128)
+        _assert_agrees(eng, padded, f, best)
+
+
+# --- Pallas tile chain -------------------------------------------------------
+
+
+@pytest.mark.slow  # ~10 s: interpret-mode chain is slow off-TPU
+def test_pallas_kernel_parity(rmat):
+    """kernel=True runs the gridless Pallas tile-product chain (interpret
+    mode on CPU) and must be bit-identical to the XLA einsum route."""
+    n, edges, g, padded, f, best = rmat
+    mg = MxuGraph.from_host(g, tile=16)
+    eng = MxuEngine(mg, kernel=True, level_chunk=4)
+    assert eng.kernel  # the chain imported and was selected
+    _assert_agrees(eng, padded, f, best)
+
+
+def test_pallas_chain_chunks_batches():
+    """The tile chain cuts the batch under the VMEM product budget."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.pallas_mxu import (
+        MAX_OUT_BYTES,
+        tile_batch,
+    )
+
+    assert tile_batch(128, 256) == MAX_OUT_BYTES // (128 * 256 * 4)
+    assert tile_batch(128, 1 << 20) == 1  # never zero
+
+
+# --- direction switch --------------------------------------------------------
+
+
+def test_direction_trace_flips_and_is_consistent(rmat):
+    n, edges, g, padded, _, _ = rmat
+    eng = MxuEngine(MxuGraph.from_host(g, tile=16), switch=40)
+    trace = eng.level_direction_trace(padded)
+    assert trace and trace is eng.last_direction_trace
+    dirs = {s["direction"] for s in trace}
+    assert dirs == {"push", "matmul"}  # the fixture exercises BOTH
+    for s in trace:
+        want = (
+            "push"
+            if s["active_rows"] <= eng.switch
+            and s["active_edges"] <= eng.push_budget
+            else "matmul"
+        )
+        assert s["direction"] == want
+
+
+def test_push_budget_is_clamped(rmat):
+    """A huge budget must clamp to n_pad + e: sparse_hits_or allocates
+    budget-sized static intermediates."""
+    _, _, g, _, _, _ = rmat
+    mg = MxuGraph.from_host(g, tile=16)
+    eng = MxuEngine(mg, push_budget=10**9)
+    assert eng.push_budget <= mg.n_pad + int(np.asarray(mg.vals).size)
+
+
+# --- telemetry ---------------------------------------------------------------
+
+
+def test_tile_flop_accounting(rmat):
+    """Chunked best() under switch=0 records exactly levels * analytic
+    per-level FLOPs/skips (the regime where the issued-if-matmul model
+    is exact)."""
+    n, edges, g, padded, _, best = rmat
+    mg = MxuGraph.from_host(g, tile=16)
+    eng = MxuEngine(mg, switch=0, level_chunk=1, megachunk=1)
+    eng.compile(padded.shape)
+    reset_mxu_tiles()
+    assert eng.best(padded) == best
+    flops, skipped, total = mxu_tile_counts()
+    assert total and total % mg.tiles_total == 0
+    levels = total // mg.tiles_total
+    k = -(-padded.shape[0] // 32) * 32
+    assert flops == levels * mg.level_flops * k
+    assert skipped == levels * (mg.tiles_total - mg.nt)
+    reset_mxu_tiles()
+    assert mxu_tile_counts() == (0, 0, 0)
+
+
+# --- shared density helpers (satellite: ops.engine) --------------------------
+
+
+def test_frontier_activity():
+    import jax.numpy as jnp
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+        frontier_activity,
+    )
+
+    frontier = jnp.asarray(
+        [[0, 0], [1, 0], [0, 2], [0, 0]], dtype=jnp.uint32
+    )
+    edge_counts = jnp.asarray([10, 20, 30, 40], dtype=jnp.int32)
+    active, cnt, edges = frontier_activity(frontier, edge_counts)
+    np.testing.assert_array_equal(
+        np.asarray(active), [False, True, True, False]
+    )
+    assert int(cnt) == 2 and int(edges) == 50
+
+
+def test_source_band():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+        source_band,
+    )
+
+    assert source_band(np.array([[5, 2], [9, -1]]), 20) == [2, 10]
+    assert source_band(np.array([[-1, 25]]), 20) == [0, 0]  # none valid
+
+
+# --- serve registry tile cache (satellite: warm reload) ----------------------
+
+
+def test_serve_registry_reuses_tile_index(tmp_path, monkeypatch):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.registry import (
+        GraphRegistry,
+        mxu_tile_cache_stats,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_graph_bin,
+    )
+
+    monkeypatch.setenv("MSBFS_BACKEND", "mxu")
+    monkeypatch.setenv("MSBFS_MXU_TILE", "16")
+    n, edges = generators.gnm_edges(90, 300, seed=51)
+    gpath = str(tmp_path / "g.bin")
+    save_graph_bin(gpath, n, edges)
+    reg = GraphRegistry()
+    before = mxu_tile_cache_stats()
+    e1 = reg.load("g", gpath)
+    mid = mxu_tile_cache_stats()
+    assert mid["entries"] == before["entries"] + 1
+    e2 = reg.reload("g")
+    after = mxu_tile_cache_stats()
+    # The reload re-read identical bytes: same digest, same tile size,
+    # so the packed tile index is REUSED (one hit, no new entry) and the
+    # two engines share the same device-resident MxuGraph.
+    assert after["entries"] == mid["entries"]
+    assert after["hits"] == mid["hits"] + 1
+    assert e2.supervisor.engine.graph is e1.supervisor.engine.graph
+    assert e2.version == e1.version + 1
+    # And the cached layout still answers correctly.
+    queries = generators.random_queries(n, 6, max_group=4, seed=52)
+    f, best = _reference(n, edges, queries)
+    got = e2.supervisor.best(pad_queries(queries))
+    assert tuple(int(x) for x in np.asarray(got)) == best

@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve lifecycle perf-smoke mxu
+.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -45,5 +45,12 @@ mxu:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_mxu.py -x -q
 	JAX_PLATFORMS=cpu python -m pytest tests/test_engines_agree.py -x -q -k "mxu"
 
-test: native resilience serve lifecycle perf-smoke mxu
+# Fleet-scale serving suite (docs/SERVING.md "Fleet"): placement ring,
+# failover router, front end, journal satellites, AND the slow-marked
+# multi-process chaos chain (replica_kill -> failover -> backoff
+# restart -> journal replay, zero acked queries lost).
+fleet: native
+	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_fleet.py -x -q
+
+test: native resilience serve lifecycle perf-smoke mxu fleet
 	python -m pytest tests/ -x -q

@@ -1,0 +1,361 @@
+"""Fleet load harness: heavy-tail arrivals, failover SLOs (round 9).
+
+Boots a 3-replica fleet IN-PROCESS (three stock ``MsbfsServer`` daemons
+on unix sockets behind a :class:`FleetRouter` — the perf harness
+measures routing and tail latency, not fork/exec; the real
+multi-process kill→failover→restart chain lives in tests/test_fleet.py)
+plus a single-daemon *oracle* serving the same graph, then drives two
+load shapes:
+
+* **open loop** — arrivals on a schedule the service cannot slow down:
+  Pareto (heavy-tail) inter-arrival gaps, so bursts arrive faster than
+  the batcher drains and the admission queue + typed shed path do real
+  work.  Per-query deadline rides the wire.  This is the SLO shape:
+  p99 latency and shed rate come from here, and every acked answer is
+  checked bit-identical (``f_values``/``min_f``/``min_k``) against the
+  oracle — an ack that differs or vanishes counts as LOST, budget zero.
+* **closed loop** — N clients issuing back-to-back through the router,
+  the throughput shape (coalescing still applies per replica).
+
+Emits one JSON line per metric ({"metric","value","unit","detail"}, the
+BENCH_*.json style); ``smoke()`` returns the `(name, base, opt)` rows
+`make perf-smoke` pins (fleet-p99-ms / fleet-shed-rate-pct /
+fleet-lost-acks) so a routing regression — a failover that stops
+working, a shed path that starts lying, a tail that grows past the
+deadline — fails CI before any fleet deploy re-measures it.
+
+Run::
+
+    JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPLICAS = int(os.environ.get("BENCH_FLEET_REPLICAS", "3"))
+REPLICATION = int(os.environ.get("BENCH_FLEET_REPLICATION", "2"))
+OPEN_ARRIVALS = int(os.environ.get("BENCH_FLEET_ARRIVALS", "120"))
+CLOSED_CLIENTS = int(os.environ.get("BENCH_FLEET_CLIENTS", "4"))
+CLOSED_PER_CLIENT = int(os.environ.get("BENCH_FLEET_PER_CLIENT", "20"))
+N_VERTICES = int(os.environ.get("BENCH_FLEET_N", "4000"))
+N_EDGES = int(os.environ.get("BENCH_FLEET_M", "16000"))
+DEADLINE_S = float(os.environ.get("BENCH_FLEET_DEADLINE_S", "2.0"))
+# Mean arrival gap ~8 ms with Pareto alpha=1.3: bursty enough that the
+# admission queue fills during flurries on the CPU backend.
+ARRIVAL_SCALE_S = float(os.environ.get("BENCH_FLEET_GAP_S", "0.004"))
+PARETO_ALPHA = 1.3
+K, S = 8, 4
+
+
+def _percentile(samples, p):
+    xs = sorted(samples)
+    if not xs:
+        return 0.0
+    return xs[min(len(xs) - 1, int(round(p / 100.0 * len(xs) + 0.5)) - 1)]
+
+
+class FleetUnderTest:
+    """3 in-process replicas + ring + router + oracle, one graph."""
+
+    def __init__(self):
+        import numpy as np
+
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.registry import (  # noqa: E501
+            content_hash,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.ring import (  # noqa: E501
+            PlacementRing,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.router import (  # noqa: E501
+            FleetRouter,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (  # noqa: E501
+            MsbfsServer,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (  # noqa: E501
+            generators,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (  # noqa: E501
+            save_graph_bin,
+        )
+
+        self.rng = np.random.default_rng(23)
+        self.tmp = tempfile.TemporaryDirectory(prefix="msbfs_bench_fleet_")
+        self.gpath = os.path.join(self.tmp.name, "g.bin")
+        self.n, edges = generators.gnm_edges(N_VERTICES, N_EDGES, seed=29)
+        save_graph_bin(self.gpath, self.n, edges)
+        digest = content_hash(self.gpath)
+        names = [f"r{i}" for i in range(REPLICAS)]
+        self.ring = PlacementRing(names, replication=REPLICATION)
+        owners = set(self.ring.owners(digest))
+        self.servers = {}
+        addresses = {}
+        for name in names:
+            addr = f"unix:{os.path.join(self.tmp.name, name + '.sock')}"
+            addresses[name] = addr
+            graphs = {"bench": self.gpath} if name in owners else {}
+            self.servers[name] = MsbfsServer(listen=addr, graphs=graphs)
+            self.servers[name].start()
+        oracle_addr = f"unix:{os.path.join(self.tmp.name, 'oracle.sock')}"
+        self.oracle = MsbfsServer(
+            listen=oracle_addr, graphs={"bench": self.gpath}
+        )
+        self.oracle.start()
+        self.oracle_addr = oracle_addr
+        self.router = FleetRouter(
+            ring=self.ring,
+            addresses=addresses,
+            digests={"bench": digest},
+            timeout=DEADLINE_S * 4,
+        )
+        self.owners = self.ring.owners(digest)
+
+    def fresh_query(self):
+        return [
+            [int(v) for v in self.rng.integers(0, self.n, size=S)]
+            for _ in range(K)
+        ]
+
+    def warm(self):
+        """Compile the K x S bucket on every owner and the oracle, so
+        the measured tail is execution, not first-touch compiles."""
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (  # noqa: E501
+            MsbfsClient,
+        )
+
+        q = self.fresh_query()
+        for name in self.owners:
+            with MsbfsClient(self.router.addresses[name]) as c:
+                c.query(q, graph="bench")
+        with MsbfsClient(self.oracle_addr) as c:
+            c.query(q, graph="bench")
+
+    def oracle_answer(self, queries):
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (  # noqa: E501
+            MsbfsClient,
+        )
+
+        with MsbfsClient(self.oracle_addr) as c:
+            out = c.query(queries, graph="bench")
+        return (out["f_values"], out["min_f"], out["min_k"])
+
+    def close(self):
+        for s in self.servers.values():
+            s.stop()
+        self.oracle.stop()
+        self.tmp.cleanup()
+
+
+def run_open_loop(fut: "FleetUnderTest"):
+    """Heavy-tail open-loop arrivals through the router; returns
+    (latencies_ms, shed, lost, errors, acked)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (  # noqa: E501
+        BackpressureError,
+    )
+
+    gaps = ARRIVAL_SCALE_S * (
+        fut.rng.pareto(PARETO_ALPHA, size=OPEN_ARRIVALS) + 1.0
+    )
+    payloads = [fut.fresh_query() for _ in range(OPEN_ARRIVALS)]
+    latencies_ms = []
+    acked = []  # (payload index, response) pairs to audit after the run
+    shed = []
+    errors = []
+    lock = threading.Lock()
+    threads = []
+
+    def fire(i):
+        t0 = time.perf_counter()
+        try:
+            out = fut.router.query(
+                payloads[i], graph="bench", deadline_s=DEADLINE_S
+            )
+            ms = (time.perf_counter() - t0) * 1e3
+            with lock:
+                latencies_ms.append(ms)
+                acked.append((i, out))
+        except BackpressureError:
+            with lock:
+                shed.append(i)
+        except Exception as exc:  # noqa: BLE001 — audited below
+            with lock:
+                errors.append(repr(exc))
+
+    for i in range(OPEN_ARRIVALS):
+        t = threading.Thread(target=fire, args=(i,), daemon=True)
+        threads.append(t)
+        t.start()
+        time.sleep(float(gaps[i]))
+    for t in threads:
+        t.join(timeout=DEADLINE_S * 8)
+
+    # The lost-ack audit: every acked answer must be bit-identical to
+    # the single-daemon oracle (routing must never change results).
+    lost = 0
+    for i, out in acked:
+        want = fut.oracle_answer(payloads[i])
+        got = (out["f_values"], out["min_f"], out["min_k"])
+        if got != want:
+            lost += 1
+    return latencies_ms, len(shed), lost, errors, len(acked)
+
+
+def run_closed_loop(fut: "FleetUnderTest"):
+    """CLOSED_CLIENTS concurrent routed clients, back-to-back."""
+    payloads = [
+        [fut.fresh_query() for _ in range(CLOSED_PER_CLIENT)]
+        for _ in range(CLOSED_CLIENTS)
+    ]
+    errors = []
+
+    def run_client(idx):
+        try:
+            for q in payloads[idx]:
+                fut.router.query(q, graph="bench", deadline_s=DEADLINE_S * 4)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(repr(exc))
+
+    threads = [
+        threading.Thread(target=run_client, args=(i,), daemon=True)
+        for i in range(CLOSED_CLIENTS)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall_s = time.perf_counter() - t0
+    qps = (CLOSED_CLIENTS * CLOSED_PER_CLIENT) / max(wall_s, 1e-9)
+    return qps, wall_s, errors
+
+
+def measure():
+    """Boot, warm, drive both loops; returns the full result dict."""
+    fut = FleetUnderTest()
+    try:
+        fut.warm()
+        latencies_ms, shed, lost, errors, acked = run_open_loop(fut)
+        qps, wall_s, closed_errors = run_closed_loop(fut)
+        router_stats = fut.router.stats()
+    finally:
+        fut.close()
+    total = OPEN_ARRIVALS
+    return {
+        "p50_ms": round(_percentile(latencies_ms, 50), 3),
+        "p99_ms": round(_percentile(latencies_ms, 99), 3),
+        "shed": shed,
+        "shed_rate_pct": round(100.0 * shed / max(total, 1), 2),
+        "lost_acks": lost,
+        "acked": acked,
+        "open_errors": errors,
+        "arrivals": total,
+        "closed_qps": round(qps, 2),
+        "closed_wall_s": round(wall_s, 3),
+        "closed_errors": closed_errors,
+        "router": router_stats,
+        "deadline_ms": DEADLINE_S * 1e3,
+    }
+
+
+def smoke():
+    """`make perf-smoke` rows (benchmarks/perf_smoke.py guard formula:
+    pass iff opt * 2 <= base and opt <= BUDGET[name]):
+
+    * fleet-p99-ms        base = the wire deadline; p99 must sit at
+                          half of it or better AND under the pinned
+                          absolute budget.
+    * fleet-shed-rate-pct base = 100 (total load); bounded shed is the
+                          contract, a shed storm is a regression.
+    * fleet-lost-acks     exact-match pin — opt counts acked answers
+                          lost or different from the oracle, budget 0.
+                          Unrouted errors count too: an open-loop error
+                          that is neither an answer nor a typed shed is
+                          an ack we promised and never produced.
+    """
+    out = measure()
+    detail = {k: out[k] for k in (
+        "p50_ms", "p99_ms", "shed_rate_pct", "acked", "arrivals",
+        "closed_qps", "deadline_ms",
+    )}
+    detail["router"] = out["router"]
+    print(f"fleet SLO detail: {json.dumps(detail, sort_keys=True)}")
+    lost = out["lost_acks"] + len(out["open_errors"]) + len(
+        out["closed_errors"]
+    )
+    return [
+        ("fleet-p99-ms", out["deadline_ms"], out["p99_ms"]),
+        ("fleet-shed-rate-pct", 100, out["shed_rate_pct"]),
+        ("fleet-lost-acks", 2 * out["arrivals"], lost),
+    ]
+
+
+def main() -> int:
+    out = measure()
+    tag = (
+        f"{REPLICAS} replicas (replication {REPLICATION}), "
+        f"G(n={N_VERTICES}, m={N_EDGES}), K={K}, S={S}"
+    )
+    print(json.dumps({
+        "metric": f"fleet open-loop p99 latency, heavy-tail arrivals, {tag}",
+        "value": out["p99_ms"],
+        "unit": "ms",
+        "detail": {
+            "p50_ms": out["p50_ms"],
+            "arrivals": out["arrivals"],
+            "acked": out["acked"],
+            "deadline_ms": out["deadline_ms"],
+            "pareto_alpha": PARETO_ALPHA,
+            "mean_gap_ms": ARRIVAL_SCALE_S * 1e3 * PARETO_ALPHA
+            / (PARETO_ALPHA - 1.0),
+        },
+    }))
+    print(json.dumps({
+        "metric": f"fleet open-loop shed rate, {tag}",
+        "value": out["shed_rate_pct"],
+        "unit": "%",
+        "detail": {"shed": out["shed"], "arrivals": out["arrivals"]},
+    }))
+    print(json.dumps({
+        "metric": f"fleet acked-answer integrity vs single-daemon oracle, "
+                  f"{tag}",
+        "value": out["lost_acks"],
+        "unit": "lost acks",
+        "detail": {
+            "acked": out["acked"],
+            "open_errors": out["open_errors"][:3],
+            "closed_errors": out["closed_errors"][:3],
+        },
+    }))
+    print(json.dumps({
+        "metric": f"fleet closed-loop routed throughput, "
+                  f"{CLOSED_CLIENTS} clients, {tag}",
+        "value": out["closed_qps"],
+        "unit": "queries/s",
+        "detail": {
+            "wall_s": out["closed_wall_s"],
+            "router": out["router"],
+        },
+    }))
+    bad = out["lost_acks"] or out["open_errors"] or out["closed_errors"]
+    if bad:
+        print(
+            f"bench_fleet: integrity failures: lost={out['lost_acks']} "
+            f"open_errors={out['open_errors'][:3]} "
+            f"closed_errors={out['closed_errors'][:3]}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(main())

@@ -40,6 +40,15 @@ and decisions come from utils.timing.record_mxu_tiles and
 MxuEngine.level_direction_trace — analytic and platform-independent,
 so a CPU run pins the TPU behavior.
 
+Round 9 adds the fleet SLO guards (serve/ring.py + serve/router.py,
+benchmarks/bench_fleet.py): a 3-replica in-process fleet under
+heavy-tail open-loop arrivals must keep p99 routed latency at/below the
+pinned budget (and at half the wire deadline), keep the shed rate
+bounded, and lose ZERO acked answers — every routed result is audited
+bit-identical against a single-daemon oracle, so a failover or
+placement bug that silently changes answers (rather than loudly
+failing) is caught by the exact-match pin.
+
 Exit 0 on pass; exits 1 with a per-workload report on any violation.
 """
 
@@ -109,6 +118,16 @@ BUDGET = {
     # Exact-match pins: opt is a mismatch count, so the budget is zero.
     "mxu-skip-accounting": 0,
     "mxu-direction-pins": 0,
+    # Round 9 fleet SLOs (bench_fleet.smoke): p99 in ms against a 2 s
+    # wire deadline (warm CPU routed queries sit well under 1 s even
+    # through burst queueing; past it the deadline-shed path starts
+    # eating acks), shed rate in percent of offered open-loop load
+    # (bounded shed under Pareto bursts is the admission contract; 25%
+    # leaves room for scheduling jitter without letting a shed storm
+    # pass), and the zero-budget lost-ack exact pin.
+    "fleet-p99-ms": 1000,
+    "fleet-shed-rate-pct": 25,
+    "fleet-lost-acks": 0,
 }
 
 # The pinned direction sequence for run_mxu's dense-frontier fixture
@@ -241,9 +260,19 @@ def run_mxu():
     return results
 
 
+def run_fleet():
+    """Round-9 fleet SLO rows: defer to the load harness's smoke()
+    (bench_fleet boots the in-process 3-replica fleet + oracle and
+    prints the SLO detail block before returning the rows)."""
+    import bench_fleet
+
+    return bench_fleet.smoke()
+
+
 def main() -> int:
     failures = []
-    for run in (run_config1, run_config4, run_stencil_window, run_mxu):
+    for run in (run_config1, run_config4, run_stencil_window, run_mxu,
+                run_fleet):
         rows = run()
         if isinstance(rows, tuple):
             rows = [rows]

@@ -231,6 +231,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .serve.client import query_main
 
         return query_main(argv[2:])
+    if len(argv) > 1 and argv[1] == "fleet":
+        # Replicated serving fleet: N replica daemons behind the
+        # rendezvous-placement failover router (docs/SERVING.md "Fleet").
+        from .serve.router import fleet_main
+
+        return fleet_main(argv[2:])
     if len(argv) > 1 and argv[1] == "health":
         # Probe alias: ``msbfs health --connect ...`` is the external
         # health check's whole command line (docs/SERVING.md).

@@ -19,14 +19,24 @@ supervisor into an always-on daemon that amortizes all three:
   so faults degrade per-request instead of killing the process;
 * :mod:`.client` — the importable Python client and the thin CLI
   (``msbfs-tpu query --connect ...``);
-* :mod:`.smoke` — the ``make serve`` end-to-end smoke.
+* :mod:`.smoke` — the ``make serve`` end-to-end smoke;
+* :mod:`.ring` — rendezvous-hash placement: graph content digest ->
+  replication-factor owner set, minimal movement on replica loss;
+* :mod:`.fleet` — the fleet supervisor (``msbfs-tpu fleet``): N replica
+  daemons, health heartbeats, backoff restarts, ring reconciliation;
+* :mod:`.router` — the front-end failover/hedge/shed router and the
+  fleet's client-facing socket.
 """
 
 from __future__ import annotations
 
 __all__ = [
+    "FleetFrontend",
+    "FleetRouter",
+    "FleetSupervisor",
     "MsbfsClient",
     "MsbfsServer",
+    "PlacementRing",
     "ServerError",
 ]
 
@@ -42,4 +52,16 @@ def __getattr__(name):
         from . import client
 
         return getattr(client, name)
+    if name == "FleetSupervisor":
+        from .fleet import FleetSupervisor
+
+        return FleetSupervisor
+    if name in ("FleetFrontend", "FleetRouter"):
+        from . import router
+
+        return getattr(router, name)
+    if name == "PlacementRing":
+        from .ring import PlacementRing
+
+        return PlacementRing
     raise AttributeError(name)

@@ -27,6 +27,15 @@ taxonomy (``TransientError``, exit 5) rather than leaking raw socket
 errors to scripts; *idempotent* verbs (ping/health/stats/query/load —
 load is load-once on the server, so re-sending it is safe) additionally
 reconnect with the PR-1 bounded backoff schedule before giving up.
+Round 9 decorrelates that schedule: each client instance seeds its own
+backoff jitter (pid + an instance counter), because N clients born
+from one event — a replica restart dropping every connection at once —
+would otherwise all share seed 0 and retry in lockstep, re-forming the
+very thundering herd the backoff exists to spread.  The schedule is
+additionally capped by ``reconnect_max_elapsed_s`` of wall clock:
+whatever the per-delay arithmetic says, a client gives up (typed,
+exit 5) once the cap elapses, so fleet failover happens within the
+caller's deadline instead of after a worst-case backoff sum.
 ``query`` accepts a per-call ``deadline_s`` propagated on the wire (the
 server sheds work whose client has stopped waiting) and an optional
 ``hedge_after_s``: if the primary connection has not answered by then,
@@ -37,6 +46,8 @@ idempotent and results are deterministic.
 
 from __future__ import annotations
 
+import itertools
+import os
 import sys
 import threading
 import time
@@ -44,6 +55,31 @@ from typing import List, Optional, Sequence
 
 from ..runtime.supervisor import RetryPolicy
 from . import protocol
+
+# Per-process client counter: combined with the pid it decorrelates the
+# default backoff jitter across clients AND across client processes.
+_instance_counter = itertools.count(1)
+
+
+def _instance_seed() -> int:
+    return (os.getpid() << 20) ^ (next(_instance_counter) * 0x9E3779B1)
+
+
+def reconnect_schedule(
+    retry: RetryPolicy, max_elapsed_s: float
+) -> List[float]:
+    """The bounded reconnect sleep schedule: the policy's jittered
+    delays, truncated where their running sum would exceed
+    ``max_elapsed_s``.  Pure (one materialized list per call) so the
+    unit tests can pin it without sleeping."""
+    out: List[float] = []
+    elapsed = 0.0
+    for delay in retry.delays():
+        if elapsed + delay > max_elapsed_s:
+            break
+        out.append(delay)
+        elapsed += delay
+    return out
 
 
 class ServerError(Exception):
@@ -81,14 +117,19 @@ class MsbfsClient:
         address: str,
         timeout: Optional[float] = 300.0,
         retry: Optional[RetryPolicy] = None,
+        reconnect_max_elapsed_s: float = 15.0,
     ):
         self.address = address
         self.timeout = timeout
         # Bounded reconnect schedule for idempotent calls; PR-1's policy
-        # so backoff behavior is one story repo-wide.
+        # so backoff behavior is one story repo-wide — but seeded per
+        # client instance, so a replica restart's dropped connections do
+        # not resurrect as a lockstep retry storm.
         self.retry = retry if retry is not None else RetryPolicy(
-            max_retries=2, base_delay=0.05, max_delay=2.0
+            max_retries=2, base_delay=0.05, max_delay=2.0,
+            seed=_instance_seed(),
         )
+        self.reconnect_max_elapsed_s = float(reconnect_max_elapsed_s)
         self._sock = protocol.connect(address, timeout=timeout)
 
     def close(self) -> None:
@@ -134,8 +175,16 @@ class MsbfsClient:
         """Send one request object, return the ``ok: true`` response or
         raise :class:`ServerError`.  Transport failures are wrapped
         typed; when ``idempotent`` they first retry on a fresh
-        connection per the bounded backoff schedule."""
-        delays = list(self.retry.delays()) if idempotent else []
+        connection per the bounded backoff schedule, capped at
+        ``reconnect_max_elapsed_s`` of total wall clock (the connect
+        attempts themselves burn budget too, so the cap is enforced
+        against the clock, not just the planned sleeps)."""
+        delays = (
+            reconnect_schedule(self.retry, self.reconnect_max_elapsed_s)
+            if idempotent
+            else []
+        )
+        start = time.monotonic()
         attempt = 0
         while True:
             try:
@@ -145,7 +194,10 @@ class MsbfsClient:
             except (protocol.ProtocolError, OSError) as exc:
                 # One dead socket must not poison later calls either way.
                 self._drop_sock()
-                if attempt >= len(delays):
+                if attempt >= len(delays) or (
+                    time.monotonic() - start + delays[attempt]
+                    > self.reconnect_max_elapsed_s
+                ):
                     raise _transport_error(self.address, exc) from exc
                 time.sleep(delays[attempt])
                 attempt += 1

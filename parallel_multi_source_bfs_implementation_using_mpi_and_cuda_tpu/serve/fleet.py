@@ -1,0 +1,425 @@
+"""Fleet supervisor: N replica daemons, heartbeats, backoff restarts.
+
+One ``msbfs serve`` process is a single point of failure; ROADMAP item 3
+("serving at fleet scale") needs the loss of a whole replica to be a
+routine, recoverable event.  This module is the process-level analogue
+of PR 1's :class:`~..runtime.supervisor.ChunkSupervisor`: it spawns N
+replica server processes (each a stock ``msbfs serve`` daemon with its
+own unix socket and its own PR-3 state journal), watches them through
+the ``health`` verb with heartbeat timeouts, and restarts the dead ones
+on the same jittered-backoff :class:`RetryPolicy` schedule the engine
+retries ride — one backoff story repo-wide.
+
+Placement rides :class:`~.ring.PlacementRing`: a registered graph is
+loaded on its ``replication`` ring owners only, so each replica journals
+(and journal-replays) just the graphs it owns.  When a replica dies, the
+supervisor *reconciles*: every graph whose live owner set lost a member
+is registered on the next ring member (HRW guarantees that is the only
+movement), and when the replica comes back its own journal replay plus
+an idempotent re-load converge it — registration is load-once, so
+reconciliation is safe to repeat forever.
+
+Chaos seam (docs/RESILIENCE.md): each monitor tick of replica ``i``
+trips fault site ``replica<i>``; an armed ``replica_kill`` spec raises
+:class:`~..utils.faults.SimulatedReplicaKill`, which the supervisor
+converts into a real ``SIGKILL`` of that replica — journal replay, ring
+failover and restart backoff are all exercised against an actual
+process death.  ``MSBFS_FAULTS`` is deliberately STRIPPED from replica
+environments: the fleet plan belongs to the supervisor process, and a
+replica-level plan is injected explicitly via ``replica_faults``.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from ..runtime.supervisor import RetryPolicy, TransientError
+from ..utils import faults
+from .client import MsbfsClient, ServerError
+from .registry import content_hash
+from .ring import PlacementRing
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+@dataclass
+class ReplicaHandle:
+    """One replica slot: a stable name + address whose process comes and
+    goes.  The name (``r<i>``) is the ring member, so placement survives
+    restarts; the journal path is per-slot, so a restarted process
+    replays its own history."""
+
+    index: int
+    name: str
+    address: str
+    journal_path: str
+    log_path: str
+    proc: Optional[subprocess.Popen] = None
+    state: str = "starting"  # starting | ready | down | failed
+    pid: Optional[int] = None
+    restarts: int = 0
+    injected_kills: int = 0
+    last_exit: Optional[int] = None
+    last_ok: float = 0.0  # monotonic time of last successful health probe
+    spawned_at: float = 0.0
+    restart_due: Optional[float] = None
+    backoff: Optional[object] = None  # iterator over restart delays
+    registered: Set[str] = field(default_factory=set)
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "address": self.address,
+            "state": self.state,
+            "pid": self.pid,
+            "restarts": self.restarts,
+            "injected_kills": self.injected_kills,
+            "last_exit": self.last_exit,
+            "graphs": sorted(self.registered),
+        }
+
+
+class FleetSupervisor:
+    """Spawn, watch and heal a fleet of replica serving daemons.
+
+    ``base_dir`` holds each replica's socket, journal and log.  The
+    supervisor is intentionally stateless beyond the member list — kill
+    the supervisor and a new one re-adopts nothing (replicas die with
+    their spawning process group in tests via ``stop()``); durable graph
+    state lives in the per-replica journals, exactly like PR 3.
+    """
+
+    def __init__(
+        self,
+        size: int,
+        base_dir: str,
+        replication: int = 2,
+        heartbeat_s: float = 0.5,
+        heartbeat_timeout_s: Optional[float] = None,
+        boot_timeout_s: float = 240.0,
+        restart_policy: Optional[RetryPolicy] = None,
+        env: Optional[Dict[str, str]] = None,
+        replica_faults: Optional[Dict[int, str]] = None,
+        server_args: Optional[List[str]] = None,
+    ):
+        if size < 1:
+            raise ValueError(f"fleet size must be >= 1, got {size}")
+        self.base_dir = os.path.abspath(base_dir)
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.heartbeat_s = float(heartbeat_s)
+        self.heartbeat_timeout_s = (
+            float(heartbeat_timeout_s)
+            if heartbeat_timeout_s is not None
+            else max(4 * self.heartbeat_s, 5.0)
+        )
+        self.boot_timeout_s = float(boot_timeout_s)
+        # PR-1 backoff semantics for process restarts: bounded, jittered,
+        # seeded — a crash-looping replica backs off to max_delay and a
+        # replica that exhausts the schedule is marked failed (the fleet
+        # degrades to survivors rather than thrashing forever).
+        self.restart_policy = restart_policy or RetryPolicy(
+            max_retries=6,
+            base_delay=_env_float("MSBFS_FLEET_BACKOFF", 0.2),
+            max_delay=5.0,
+            seed=int(_env_float("MSBFS_FAULT_SEED", 0)),
+        )
+        self._env = dict(os.environ if env is None else env)
+        # The fleet fault plan drives the SUPERVISOR's seams; replicas
+        # get a clean slate unless a per-replica plan is injected.
+        self._env.pop("MSBFS_FAULTS", None)
+        self._replica_faults = dict(replica_faults or {})
+        self._server_args = list(server_args or [])
+        self.replicas: List[ReplicaHandle] = [
+            ReplicaHandle(
+                index=i,
+                name=f"r{i}",
+                address=f"unix:{os.path.join(self.base_dir, f'r{i}.sock')}",
+                journal_path=os.path.join(self.base_dir, f"r{i}.journal"),
+                log_path=os.path.join(self.base_dir, f"r{i}.log"),
+            )
+            for i in range(size)
+        ]
+        self.ring = PlacementRing(
+            [r.name for r in self.replicas], replication=replication
+        )
+        self.graphs: Dict[str, str] = {}  # name -> path
+        self.digests: Dict[str, str] = {}  # name -> content digest
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        self._log_files: List[object] = []
+        self.started = False
+
+    # ---- lifecycle --------------------------------------------------------
+    def start(self, wait_ready_s: Optional[float] = None) -> None:
+        with self._lock:
+            if self.started:
+                raise RuntimeError("fleet already started")
+            self.started = True
+            for r in self.replicas:
+                self._spawn(r)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="msbfs-fleet-monitor", daemon=True
+        )
+        self._monitor.start()
+        if wait_ready_s is not None:
+            self.wait_ready(wait_ready_s)
+
+    def stop(self, drain: bool = False) -> None:
+        """Tear the fleet down: stop the monitor, then SIGTERM (drain) or
+        SIGKILL each replica and reap it.  Idempotent."""
+        self._stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=30.0)
+            self._monitor = None
+        with self._lock:
+            procs = [(r, r.proc) for r in self.replicas]
+        for r, proc in procs:
+            if proc is None or proc.poll() is not None:
+                continue
+            try:
+                proc.send_signal(signal.SIGTERM if drain else signal.SIGKILL)
+            except OSError:
+                pass
+        for r, proc in procs:
+            if proc is None:
+                continue
+            try:
+                proc.wait(timeout=60.0 if drain else 30.0)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=30.0)
+            r.last_exit = proc.returncode
+            r.state = "down"
+            r.pid = None
+        for f in self._log_files:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._log_files = []
+
+    def __enter__(self) -> "FleetSupervisor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def wait_ready(self, timeout_s: float, quorum: Optional[int] = None) -> None:
+        """Block until ``quorum`` replicas (default: all) report ready."""
+        want = len(self.replicas) if quorum is None else int(quorum)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if len(self.ready_names()) >= want:
+                return
+            time.sleep(min(0.1, self.heartbeat_s))
+        raise TransientError(
+            f"fleet: {len(self.ready_names())}/{want} replicas ready "
+            f"after {timeout_s:g}s (states: "
+            f"{[r.state for r in self.replicas]})"
+        )
+
+    # ---- spawning ---------------------------------------------------------
+    def _spawn(self, r: ReplicaHandle) -> None:
+        sock_path = r.address[len("unix:"):]
+        if os.path.exists(sock_path):
+            try:
+                os.unlink(sock_path)
+            except OSError:
+                pass
+        env = dict(self._env)
+        plan = self._replica_faults.get(r.index)
+        if plan:
+            env["MSBFS_FAULTS"] = plan
+        cmd = [
+            sys.executable,
+            os.path.join(_REPO_ROOT, "main.py"),
+            "serve",
+            "--listen",
+            r.address,
+            "--journal",
+            r.journal_path,
+        ] + self._server_args
+        log = open(r.log_path, "ab")
+        self._log_files.append(log)
+        r.proc = subprocess.Popen(
+            cmd, cwd=_REPO_ROOT, env=env, stdout=log, stderr=log
+        )
+        r.pid = r.proc.pid
+        r.state = "starting"
+        r.spawned_at = time.monotonic()
+        r.last_ok = 0.0
+        r.restart_due = None
+        r.registered = set()
+
+    def _schedule_restart(self, r: ReplicaHandle) -> None:
+        if r.backoff is None:
+            r.backoff = iter(self.restart_policy.delays())
+        delay = next(r.backoff, None)
+        if delay is None:
+            r.state = "failed"  # budget exhausted: degrade to survivors
+            r.restart_due = None
+            return
+        r.state = "down"
+        r.restart_due = time.monotonic() + delay
+
+    # ---- monitoring -------------------------------------------------------
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                changed = False
+                for r in self.replicas:
+                    changed |= self._tick(r)
+                if changed:
+                    self._reconcile()
+            except Exception:  # noqa: BLE001 — the monitor must survive
+                pass
+
+    def _tick(self, r: ReplicaHandle) -> bool:
+        """One heartbeat of one replica; True when its readiness flipped
+        (the reconcile trigger).  This is the fleet chaos seam."""
+        if r.state == "failed":
+            return False
+        try:
+            faults.trip(f"replica{r.index}")
+        except faults.SimulatedReplicaKill as kill:
+            victim = self.replicas[kill.replica % len(self.replicas)]
+            if victim.proc is not None and victim.proc.poll() is None:
+                victim.injected_kills += 1
+                try:
+                    victim.proc.kill()
+                    victim.proc.wait(timeout=30.0)
+                except OSError:
+                    pass
+        now = time.monotonic()
+        was_ready = r.state == "ready"
+        if r.proc is None or r.proc.poll() is not None:
+            if r.state not in ("down", "failed") or r.restart_due is None:
+                if r.proc is not None:
+                    r.last_exit = r.proc.returncode
+                if r.state != "failed":
+                    self._schedule_restart(r)
+            if (
+                r.state == "down"
+                and r.restart_due is not None
+                and now >= r.restart_due
+            ):
+                r.restarts += 1
+                self._spawn(r)
+            return was_ready
+        # Process is alive: probe readiness.
+        healthy = self._probe(r)
+        if healthy:
+            r.last_ok = now
+            if r.state != "ready":
+                r.state = "ready"
+                r.backoff = None  # a recovered replica regains full budget
+            return not was_ready
+        if was_ready and now - r.last_ok > self.heartbeat_timeout_s:
+            # Alive but unresponsive past the timeout: treat as dead —
+            # kill hard so the journal-replay restart path takes over.
+            try:
+                r.proc.kill()
+                r.proc.wait(timeout=30.0)
+            except OSError:
+                pass
+            r.last_exit = r.proc.returncode
+            self._schedule_restart(r)
+            return True
+        if r.state == "starting" and now - r.spawned_at > self.boot_timeout_s:
+            try:
+                r.proc.kill()
+                r.proc.wait(timeout=30.0)
+            except OSError:
+                pass
+            r.last_exit = r.proc.returncode
+            self._schedule_restart(r)
+        return False
+
+    def _probe(self, r: ReplicaHandle) -> bool:
+        """One health round trip; no retries (the heartbeat IS the retry
+        loop).  Ready means journal replay finished and the daemon is
+        accepting work."""
+        try:
+            with MsbfsClient(
+                r.address,
+                timeout=max(2.0, self.heartbeat_timeout_s),
+                retry=RetryPolicy(max_retries=0),
+            ) as c:
+                h = c.health()
+            return bool(h.get("ready")) and not h.get("draining")
+        except (ServerError, OSError, ValueError):
+            return False
+
+    # ---- placement --------------------------------------------------------
+    def register(self, name: str, path: str) -> List[str]:
+        """Register ``path`` under ``name`` on the graph's ring owners.
+        Returns the owner names.  Safe to call again (load-once)."""
+        digest = content_hash(path)
+        with self._lock:
+            self.graphs[name] = path
+            self.digests[name] = digest
+        self._reconcile()
+        return self.ring.owners(digest)
+
+    def ready_names(self) -> Set[str]:
+        return {r.name for r in self.replicas if r.state == "ready"}
+
+    def _reconcile(self) -> None:
+        """Converge placement: every graph loaded on its live owner set.
+        Load-once makes this idempotent; a dead owner's key lands on the
+        next ring member (stand-in), and a recovered owner picks its
+        graphs back up on the next pass."""
+        with self._lock:
+            todo = list(self.graphs.items())
+            digests = dict(self.digests)
+        ready = {r.name: r for r in self.replicas if r.state == "ready"}
+        for name, path in todo:
+            owners = self.ring.owners(digests[name], alive=ready.keys())
+            for owner in owners:
+                r = ready[owner]
+                if name in r.registered:
+                    continue
+                try:
+                    with MsbfsClient(r.address, timeout=300.0) as c:
+                        c.load(path, graph=name)
+                    r.registered.add(name)
+                except (ServerError, OSError, ValueError):
+                    pass  # next reconcile pass retries
+
+    # ---- observability ----------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            digests = dict(self.digests)
+        return {
+            "size": len(self.replicas),
+            "replication": self.ring.replication,
+            "ready": sorted(self.ready_names()),
+            "replicas": [r.describe() for r in self.replicas],
+            "graphs": {
+                name: {
+                    "digest": digest,
+                    "owners": self.ring.owners(digest),
+                    "live_owners": self.ring.owners(
+                        digest, alive=self.ready_names()
+                    ),
+                }
+                for name, digest in digests.items()
+            },
+        }

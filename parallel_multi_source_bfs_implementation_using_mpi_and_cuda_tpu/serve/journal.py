@@ -21,6 +21,14 @@ while their (name, hash) still matches the live registration — and
 state (temp file + fsync + rename), so the journal stays proportional
 to the live state, not to the daemon's lifetime.
 
+Growth bound (round 9): a fleet replica lives for months, and reload
+churn + bucket warms grow the file without limit, so :meth:`append`
+auto-compacts when the file exceeds ``MSBFS_JOURNAL_MAX_BYTES``
+(default 1 MiB, <= 0 disables).  Auto-compaction replays WITHOUT
+tripping the ``journal_replay`` fault seam — that seam models restart
+recovery, and a mid-serving compaction firing a restart-armed fault
+would make every crash-replay test's trip counts time-dependent.
+
 Fault sites ``journal_append`` / ``journal_replay`` (utils/faults.py)
 let the ``crash`` kind kill the process mid-journal deterministically —
 the recovery tests' stand-in for a real power cut.
@@ -70,15 +78,35 @@ class StateJournal:
     single batcher thread, both already funneled through server locks
     for the state being journaled)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
+        if max_bytes is None:
+            try:
+                max_bytes = int(
+                    os.environ.get("MSBFS_JOURNAL_MAX_BYTES", str(1 << 20))
+                )
+            except ValueError:
+                max_bytes = 1 << 20
+        self.max_bytes = int(max_bytes)
+        self.compactions = 0
+
+    def bytes(self) -> int:
+        """Current journal size on disk (0 when it does not exist yet) —
+        surfaced by the daemon's ``stats`` verb as ``journal_bytes``."""
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
 
     # ---- append side ------------------------------------------------------
     def append(self, record: dict) -> None:
         """Durably append one record: write + flush + fsync, so the
         record survives a process kill the moment append returns.  A
         failed append is reported once to stderr and swallowed — journal
-        loss degrades restart warmth, it must never fail a request."""
+        loss degrades restart warmth, it must never fail a request.
+        Past ``max_bytes`` the file is auto-compacted down to the
+        reconciled state (which keeps THIS record: compaction runs after
+        the durable append, so a crash between the two still replays)."""
         faults.trip("journal_append")
         line = json.dumps(record, separators=(",", ":"), sort_keys=True)
         try:
@@ -86,12 +114,17 @@ class StateJournal:
                 f.write(line + "\n")
                 f.flush()
                 os.fsync(f.fileno())
+                size = f.tell()
         except OSError as exc:
             print(
                 f"msbfs serve: journal append to {self.path} failed: {exc}"
                 " (restart will not restore this state)",
                 file=sys.stderr,
             )
+            return
+        if self.max_bytes > 0 and size > self.max_bytes:
+            self.compact(self._replay(trip=False))
+            self.compactions += 1
 
     # ---- replay side ------------------------------------------------------
     def replay(self) -> JournalState:
@@ -100,7 +133,11 @@ class StateJournal:
         is dropped silently; a malformed line elsewhere is dropped with
         a stderr note (something other than a crash corrupted the file,
         the operator should know)."""
-        faults.trip("journal_replay")
+        return self._replay(trip=True)
+
+    def _replay(self, trip: bool) -> JournalState:
+        if trip:  # restart recovery only; auto-compaction skips the seam
+            faults.trip("journal_replay")
         state = JournalState()
         try:
             with open(self.path, "r", encoding="utf-8") as f:

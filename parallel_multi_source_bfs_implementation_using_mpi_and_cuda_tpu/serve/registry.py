@@ -22,8 +22,9 @@ import hashlib
 import os
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from ..runtime.supervisor import ChunkSupervisor, InputError, RetryPolicy
 from ..utils.io import load_graph_bin
@@ -57,26 +58,48 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
-# --- MXU tile-index cache (round 8) ------------------------------------------
+# --- MXU tile-index cache (round 8, bounded round 9) -------------------------
 # Densifying CSR adjacency into per-tile blocks is the mxu route's only
 # host-side preprocessing cost (O(E) scatter + unique per graph).  The
 # serve daemon keys graphs by content hash already, so the packed
 # MxuGraph is cached under (content digest, tile size): a warm reload of
 # unchanged bytes — and every identical-content register — reuses the
-# device-resident tiles instead of re-packing.  Bounded by eviction of
-# digests no longer registered is unnecessary at serving scale (a handful
-# of named graphs); the cache holds at most one layout per distinct
-# graph content per tile size.
+# device-resident tiles instead of re-packing.  Round 9 bounds it: a
+# long-lived fleet replica sees an unbounded stream of distinct digests
+# over its lifetime (reloads, many named graphs), and each entry pins
+# device-resident tile arrays — so the cache is LRU with a BYTE cap
+# (``MSBFS_MXU_CACHE_BYTES``, default 256 MiB; <= 0 disables caching,
+# the repo-wide capacity convention of serve/caches.py), sized by the
+# packed arrays' nbytes, with an eviction counter in the stats hook.
 
-_mxu_tile_cache: Dict[tuple, object] = {}
+_MXU_CACHE_DEFAULT_BYTES = 256 << 20
+
+_mxu_tile_cache: "OrderedDict[tuple, Tuple[object, int]]" = OrderedDict()
 _mxu_tile_cache_lock = threading.Lock()
 _mxu_tile_cache_hits = 0
+_mxu_tile_cache_evictions = 0
+_mxu_tile_cache_bytes = 0
+
+
+def _mxu_cache_cap_bytes() -> int:
+    return _env_int("MSBFS_MXU_CACHE_BYTES", _MXU_CACHE_DEFAULT_BYTES)
+
+
+def _mxu_graph_nbytes(mg) -> int:
+    """Footprint of one packed tile index: the sum of its array members'
+    nbytes (device arrays report the device allocation)."""
+    total = 0
+    for name in ("tiles", "tile_row", "tile_col", "start", "count", "vals"):
+        nb = getattr(getattr(mg, name, None), "nbytes", 0)
+        total += int(nb or 0)
+    return max(total, 1)  # never let an entry count as free
 
 
 def _cached_mxu_graph(graph, content_digest: Optional[str]):
     """MxuGraph for ``graph``, reusing the packed tile index when the
     serving content digest (and MSBFS_MXU_TILE) match a prior build."""
-    global _mxu_tile_cache_hits
+    global _mxu_tile_cache_hits, _mxu_tile_cache_evictions
+    global _mxu_tile_cache_bytes
     from ..ops.mxu import MxuGraph, resolve_tile
 
     if content_digest is None:
@@ -84,20 +107,44 @@ def _cached_mxu_graph(graph, content_digest: Optional[str]):
     key = (content_digest, resolve_tile())
     with _mxu_tile_cache_lock:
         have = _mxu_tile_cache.get(key)
-    if have is not None:
-        _mxu_tile_cache_hits += 1
-        return have
+        if have is not None:
+            _mxu_tile_cache.move_to_end(key)  # LRU: refresh recency
+            _mxu_tile_cache_hits += 1
+            return have[0]
     mg = MxuGraph.from_host(graph)
+    cap = _mxu_cache_cap_bytes()
+    if cap <= 0:
+        return mg
+    size = _mxu_graph_nbytes(mg)
     with _mxu_tile_cache_lock:
-        return _mxu_tile_cache.setdefault(key, mg)
+        have = _mxu_tile_cache.get(key)
+        if have is not None:  # lost the build race: reuse the winner
+            _mxu_tile_cache.move_to_end(key)
+            _mxu_tile_cache_hits += 1
+            return have[0]
+        _mxu_tile_cache[key] = (mg, size)
+        _mxu_tile_cache_bytes += size
+        # Evict oldest-first down to the cap.  An entry larger than the
+        # whole cap evicts itself immediately: the build still returns
+        # (capacity bounds the CACHE, not the workload), it just never
+        # parks in it — caches.py's capacity-vs-capability rule.
+        while _mxu_tile_cache_bytes > cap and _mxu_tile_cache:
+            _, (_, old_size) = _mxu_tile_cache.popitem(last=False)
+            _mxu_tile_cache_bytes -= old_size
+            _mxu_tile_cache_evictions += 1
+    return mg
 
 
 def mxu_tile_cache_stats() -> dict:
-    """Observability hook for tests and the daemon: entry count + hits."""
+    """Observability hook for tests and the daemon: entry count, hits,
+    evictions, resident bytes and the active byte cap."""
     with _mxu_tile_cache_lock:
         return {
             "entries": len(_mxu_tile_cache),
             "hits": _mxu_tile_cache_hits,
+            "evictions": _mxu_tile_cache_evictions,
+            "bytes": _mxu_tile_cache_bytes,
+            "cap_bytes": _mxu_cache_cap_bytes(),
         }
 
 

@@ -1,0 +1,84 @@
+"""Rendezvous-hash placement ring for the serving fleet (docs/SERVING.md).
+
+A fleet of N replica daemons must agree — with no coordination service —
+on which replicas own each registered graph.  We use rendezvous
+(highest-random-weight) hashing over the graph's *content digest*: every
+(digest, member) pair gets a pseudo-random score from sha256, and the
+digest's preference order is all members sorted by descending score.
+The first ``replication`` members of that order are the owners; the
+router walks the same order for failover, so the "next ring member" is
+always well defined and identical on every node that knows the member
+list.
+
+Why rendezvous rather than a ring of virtual nodes: the member count is
+small (a handful of replicas, not thousands of shards), so the O(N)
+score scan is free, and HRW gives the minimal-movement property exactly
+— when one member dies, the only keys that move are the ones it owned,
+each promoting its next-preference member (the fleet analogue of PR 1's
+degrade-to-survivors resharding; placement spirit of arxiv 2112.01075's
+memory-efficient live redistribution).  No token ranges to rebalance, no
+stored state: membership + digest fully determine placement.
+
+Scores key on the digest, not the graph *name*, so re-registering the
+same bytes under another name lands on the same owners (their MXU tile
+cache and result cache already hold that content), while a ``reload``
+with new bytes may legitimately move.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, List, Optional, Sequence, Set
+
+
+def _score(digest: str, member: str) -> int:
+    """Pseudo-random weight of ``member`` for ``digest``: the leading 16
+    bytes of sha256 over both, as an int.  Stable across processes and
+    Python hash randomization (this is why built-in hash() is unusable
+    here — every fleet node must compute identical placements)."""
+    h = hashlib.sha256(f"{digest}|{member}".encode()).digest()
+    return int.from_bytes(h[:16], "big")
+
+
+class PlacementRing:
+    """Deterministic digest -> owner-set placement over a fixed member
+    list.  Membership is the replica *names* (stable labels like ``r0``,
+    not addresses — a restarted replica keeps its name, so placement
+    survives restarts)."""
+
+    def __init__(self, members: Sequence[str], replication: int = 2):
+        names = list(members)
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate ring members: {names}")
+        if not names:
+            raise ValueError("placement ring needs at least one member")
+        if replication < 1:
+            raise ValueError(f"replication must be >= 1, got {replication}")
+        self.members: List[str] = names
+        # More owners than members would silently under-replicate; clamp
+        # loudly visible in .replication so health can report it.
+        self.replication = min(int(replication), len(names))
+
+    def preference(self, digest: str) -> List[str]:
+        """ALL members, best owner first — the failover walk order."""
+        return sorted(
+            self.members, key=lambda m: _score(digest, m), reverse=True
+        )
+
+    def owners(
+        self, digest: str, alive: Optional[Iterable[str]] = None
+    ) -> List[str]:
+        """The ``replication`` members that own ``digest``, primary
+        first.  With ``alive`` given, dead members are skipped and the
+        next preference member stands in — so a key owned by a dead
+        replica moves to exactly one new member and every other key
+        stays put (the HRW minimal-movement property)."""
+        pref = self.preference(digest)
+        if alive is not None:
+            live: Set[str] = set(alive)
+            pref = [m for m in pref if m in live]
+        return pref[: self.replication]
+
+    def describe(self, digests: Iterable[str]) -> dict:
+        """Placement table for observability (fleet stats verb)."""
+        return {d: self.owners(d) for d in digests}

@@ -1,0 +1,500 @@
+"""Front-end router: ring-placed forwarding, failover, hedging, shed.
+
+The fleet's query path (docs/SERVING.md "Fleet"): a query for graph
+``g`` goes to the first live ring owner of ``g``'s content digest; on a
+connection error, an injected ``net_drop``, or a replica answering with
+the transport-wrapped ``TransientError``, the router *fails over* to
+the next ring member — same preference walk on every node, so there is
+nothing to coordinate.  Stragglers are hedged through the existing
+client hedge path (a second connection races the first; results are
+deterministic, so either answer is THE answer).  Saturation is not
+failure-masked: a replica answering ``BackpressureError`` is counted
+and skipped, and only when EVERY live owner is saturated does the
+router shed the query with the same typed ``BackpressureError`` — the
+fleet-level admission contract (exit 7, docs/RESILIENCE.md).
+
+Deterministic failure taxonomy is preserved through failover: an
+``InputError`` or ``PoisonQueryError`` from a replica is the *query's*
+fault and re-raising it from another replica would give the same
+answer, so those propagate immediately without burning failover
+attempts.
+
+Chaos seam: every forwarding attempt to replica ``i`` trips fault site
+``route<i>`` — ``net_drop`` kills the attempt before any bytes move
+(failover rehearsal), ``replica_slow`` stalls it (hedge rehearsal).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..runtime.supervisor import (
+    BackpressureError,
+    InputError,
+    MsbfsError,
+    RetryPolicy,
+    TransientError,
+)
+from ..utils import faults
+from . import protocol
+from .client import MsbfsClient, ServerError
+from .ring import PlacementRing
+
+
+class FleetRouter:
+    """Stateless-per-query forwarding over a placement ring.
+
+    ``addresses`` maps ring member name -> daemon address; ``digests``
+    maps graph name -> content digest (the ring key); ``alive_fn``
+    returns the currently-ready member set (None routes over full
+    membership — static placement).  Each attempt uses a fresh
+    connection with NO client-side reconnect retries: the ring walk IS
+    the retry loop, and lockstep reconnect storms are the failure mode
+    the fleet exists to avoid.
+    """
+
+    def __init__(
+        self,
+        ring: PlacementRing,
+        addresses: Dict[str, str],
+        digests: Dict[str, str],
+        alive_fn=None,
+        timeout: float = 300.0,
+        hedge_after_s: Optional[float] = None,
+    ):
+        missing = [m for m in ring.members if m not in addresses]
+        if missing:
+            raise ValueError(f"ring members without addresses: {missing}")
+        self.ring = ring
+        self.addresses = dict(addresses)
+        self.digests = dict(digests)
+        self.alive_fn = alive_fn
+        self.timeout = float(timeout)
+        self.hedge_after_s = hedge_after_s
+        self._index = {m: i for i, m in enumerate(ring.members)}
+        self._lock = threading.Lock()
+        self._stats = {
+            "routed": 0,
+            "failovers": 0,
+            "net_drops": 0,
+            "hedged": 0,
+            "shed": 0,
+            "per_replica": {m: 0 for m in ring.members},
+        }
+
+    @classmethod
+    def for_fleet(cls, supervisor, **kw) -> "FleetRouter":
+        """Router over a live :class:`~.fleet.FleetSupervisor`: shares
+        its digest table (registrations made after construction are
+        visible) and routes only to ready replicas."""
+        router = cls(
+            ring=supervisor.ring,
+            addresses={r.name: r.address for r in supervisor.replicas},
+            digests=supervisor.digests,
+            alive_fn=supervisor.ready_names,
+            **kw,
+        )
+        # The constructor snapshots its digests (static placement); a
+        # fleet router must instead share the supervisor's table so
+        # graphs registered after construction route immediately — the
+        # `msbfs fleet` boot order is router first, -g registrations
+        # second.
+        router.digests = supervisor.digests
+        return router
+
+    def _bump(self, key: str, member: Optional[str] = None) -> None:
+        with self._lock:
+            self._stats[key] += 1
+            if member is not None:
+                self._stats["per_replica"][member] += 1
+
+    # ---- query path -------------------------------------------------------
+    def owners_for(self, graph: str) -> List[str]:
+        digest = self.digests.get(graph)
+        if digest is None:
+            raise InputError(
+                f"no graph registered as {graph!r} in the fleet "
+                f"(have: {', '.join(sorted(self.digests)) or 'none'})"
+            )
+        alive = self.alive_fn() if self.alive_fn is not None else None
+        return self.ring.owners(digest, alive=alive)
+
+    def query(
+        self,
+        queries: Sequence[Sequence[int]],
+        graph: str = "default",
+        deadline_s: Optional[float] = None,
+        hedge_after_s: Optional[float] = None,
+    ) -> dict:
+        """Forward one query batch; returns the replica's response dict
+        plus routing metadata (``replica``, ``failovers``)."""
+        owners = self.owners_for(graph)
+        if not owners:
+            raise TransientError(
+                f"no live owner for graph {graph!r} "
+                "(fleet booting or all owners down)"
+            )
+        if hedge_after_s is None:
+            hedge_after_s = self.hedge_after_s
+        start = time.monotonic()
+        saturated = 0
+        last_err: Optional[Exception] = None
+        failovers = 0
+        for member in owners:
+            remaining = None
+            if deadline_s is not None:
+                remaining = deadline_s - (time.monotonic() - start)
+                if remaining <= 0:
+                    break  # out of budget: report shed/transient below
+            try:
+                faults.trip(f"route{self._index[member]}")
+            except faults.SimulatedNetDrop as drop:
+                self._bump("net_drops")
+                failovers += 1
+                last_err = drop
+                continue
+            try:
+                with MsbfsClient(
+                    self.addresses[member],
+                    timeout=(
+                        self.timeout if remaining is None
+                        else min(self.timeout, remaining)
+                    ),
+                    retry=_NO_RETRY,
+                ) as client:
+                    out = client.query(
+                        queries,
+                        graph=graph,
+                        deadline_s=remaining,
+                        hedge_after_s=hedge_after_s,
+                    )
+            except ServerError as err:
+                if err.type_name == "BackpressureError":
+                    saturated += 1
+                    failovers += 1
+                    last_err = err
+                    continue
+                if err.type_name == "TransientError":
+                    # Transport loss, drain refusal, injected transient:
+                    # the next owner holds the same graph — walk on.
+                    failovers += 1
+                    last_err = err
+                    continue
+                raise  # deterministic failures belong to the query
+            except (protocol.ProtocolError, OSError, socket.timeout) as exc:
+                failovers += 1
+                last_err = exc
+                continue
+            self._bump("routed", member)
+            if failovers:
+                with self._lock:
+                    self._stats["failovers"] += failovers
+            if out.get("hedged"):
+                self._bump("hedged")
+            out = dict(out)
+            out["replica"] = member
+            out["failovers"] = failovers
+            return out
+        if saturated and saturated >= failovers:
+            # Every owner we reached said "queue full": the fleet is
+            # saturated, and masking that as a retryable transient would
+            # invite the retry storm backpressure exists to stop.
+            self._bump("shed")
+            raise BackpressureError(
+                f"all {saturated} live owner(s) of graph {graph!r} are "
+                "saturated; retry with backoff or grow the fleet"
+            )
+        raise TransientError(
+            f"no owner of graph {graph!r} answered "
+            f"({failovers} attempt(s); last: {last_err})"
+        )
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["per_replica"] = dict(self._stats["per_replica"])
+        return out
+
+
+# Routed attempts never retry in place — the ring walk is the retry.
+_NO_RETRY = RetryPolicy(max_retries=0)
+
+
+class FleetFrontend:
+    """The fleet's single client-facing socket: speaks the existing
+    frame protocol, so the stock ``msbfs query`` client talks to a
+    fleet exactly as it talks to one daemon.  Verbs: ``ping``,
+    ``health`` (fleet topology + per-replica states), ``load``
+    (ring-placed registration via the supervisor), ``query`` (routed),
+    ``stats`` (router + fleet counters), ``shutdown``.
+
+    Thread names use the ``msbfs-fleet-`` prefix (distinct from the
+    single-daemon ledger in tests/conftest.py, which must keep failing
+    on leaked *replica* threads, not the front end's).
+    """
+
+    def __init__(self, listen: str, router: FleetRouter, supervisor=None):
+        self.listen = listen
+        self.router = router
+        self.supervisor = supervisor
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+
+    def start(self) -> None:
+        family, target = protocol.parse_address(self.listen)
+        self._sock = socket.socket(family, socket.SOCK_STREAM)
+        if family == socket.AF_INET:
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        if family == socket.AF_UNIX and isinstance(target, str):
+            if os.path.exists(target):
+                os.unlink(target)  # front end owns its path (no journal)
+        self._sock.bind(target)
+        self._sock.listen(64)
+        self._sock.settimeout(0.2)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="msbfs-fleet-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=10.0)
+            self._accept_thread = None
+        family, target = protocol.parse_address(self.listen)
+        if family == socket.AF_UNIX and isinstance(target, str):
+            try:
+                os.unlink(target)
+            except OSError:
+                pass
+
+    def __enter__(self) -> "FleetFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(None)
+            threading.Thread(
+                target=self._serve_connection,
+                args=(conn,),
+                name="msbfs-fleet-conn",
+                daemon=True,
+            ).start()
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stopping.is_set():
+                try:
+                    request = protocol.recv_frame(conn)
+                except (protocol.ProtocolError, OSError):
+                    return
+                if request is None:
+                    return
+                response = self.handle(request)
+                try:
+                    protocol.send_frame(conn, response)
+                except OSError:
+                    return
+                if request.get("op") == "shutdown":
+                    self.stop()
+                    return
+
+    def handle(self, request: dict) -> dict:
+        op = request.get("op")
+        try:
+            if op == "ping":
+                return {"ok": True, "op": "ping", "pid": os.getpid()}
+            if op == "health":
+                return self._op_health()
+            if op == "stats":
+                return {"ok": True, "op": "stats", "stats": self._op_stats()}
+            if op == "query":
+                out = self.router.query(
+                    request.get("queries") or [],
+                    graph=request.get("graph", "default"),
+                    deadline_s=request.get("deadline_s"),
+                    hedge_after_s=request.get("hedge_after_s"),
+                )
+                out["ok"] = True
+                return out
+            if op == "load":
+                if self.supervisor is None:
+                    raise InputError(
+                        "this front end has no supervisor; register "
+                        "graphs on the replicas directly"
+                    )
+                name = request.get("graph", "default")
+                owners = self.supervisor.register(
+                    name, request.get("path", "")
+                )
+                return {
+                    "ok": True,
+                    "op": "load",
+                    "graph": {
+                        "name": name,
+                        "owners": owners,
+                        "hash": self.supervisor.digests[name],
+                    },
+                }
+            if op == "shutdown":
+                return {"ok": True, "op": "shutdown"}
+            raise InputError(f"unknown op {op!r}")
+        except ServerError as err:
+            # A replica's typed verdict passes through unchanged.
+            return {
+                "ok": False,
+                "error": {
+                    "type": err.type_name,
+                    "message": str(err),
+                    "exit_code": err.exit_code,
+                },
+            }
+        except MsbfsError as err:
+            return protocol.error_body(err)
+        except Exception as err:  # noqa: BLE001 — front end must answer
+            return protocol.error_body(MsbfsError(str(err)))
+
+    def _op_health(self) -> dict:
+        fleet = (
+            self.supervisor.status() if self.supervisor is not None else {}
+        )
+        ready = bool(fleet.get("ready")) if fleet else True
+        graphs = fleet.get("graphs", {})
+        routable = all(g["live_owners"] for g in graphs.values())
+        return {
+            "ok": True,
+            "op": "health",
+            "pid": os.getpid(),
+            "ready": ready and routable,
+            "fleet": fleet,
+        }
+
+    def _op_stats(self) -> dict:
+        out = {"router": self.router.stats()}
+        if self.supervisor is not None:
+            out["fleet"] = self.supervisor.status()
+        return out
+
+
+def fleet_main(argv: Optional[List[str]] = None) -> int:
+    """``msbfs-tpu fleet`` / ``python main.py fleet`` entry point: boot
+    N replicas + the front-end router on one command."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="msbfs-tpu fleet",
+        description="Replicated msbfs serving fleet: N replica daemons, "
+        "rendezvous placement, failover router (docs/SERVING.md)",
+    )
+    ap.add_argument(
+        "--listen",
+        default=os.environ.get(
+            "MSBFS_FLEET_LISTEN", "unix:/tmp/msbfs-fleet.sock"
+        ),
+        help="front-end address (default unix:/tmp/msbfs-fleet.sock)",
+    )
+    ap.add_argument("--size", type=int, default=3,
+                    help="replica count (default 3)")
+    ap.add_argument("--replication", type=int, default=2,
+                    help="owners per graph (default 2)")
+    ap.add_argument(
+        "--base-dir",
+        default=None,
+        help="directory for replica sockets/journals/logs "
+        "(default MSBFS_FLEET_DIR or /tmp/msbfs-fleet)",
+    )
+    ap.add_argument(
+        "-g", "--graph", action="append", default=[],
+        metavar="[NAME=]PATH",
+        help="register a graph at startup (repeatable)",
+    )
+    ap.add_argument("--heartbeat-ms", type=float, default=500.0,
+                    help="replica heartbeat period (default 500)")
+    ap.add_argument("--wait-ready-s", type=float, default=240.0,
+                    help="block until all replicas are ready (0 skips)")
+    args = ap.parse_args(argv)
+
+    from .fleet import FleetSupervisor
+
+    plan = faults.FaultPlan.from_env()
+    faults.activate(plan)
+    base_dir = args.base_dir or os.environ.get(
+        "MSBFS_FLEET_DIR", "/tmp/msbfs-fleet"
+    )
+    try:
+        supervisor = FleetSupervisor(
+            size=args.size,
+            base_dir=base_dir,
+            replication=args.replication,
+            heartbeat_s=args.heartbeat_ms / 1000.0,
+        )
+        supervisor.start(
+            wait_ready_s=args.wait_ready_s or None
+        )
+    except (MsbfsError, OSError, ValueError) as err:
+        print(f"msbfs fleet: {err}", file=sys.stderr)
+        return getattr(err, "exit_code", 1)
+    router = FleetRouter.for_fleet(supervisor)
+    frontend = FleetFrontend(args.listen, router, supervisor=supervisor)
+    try:
+        for spec in args.graph:
+            name, sep, path = spec.partition("=")
+            if not sep:
+                name, path = "default", spec
+            supervisor.register(name, path)
+        frontend.start()
+    except (MsbfsError, OSError, ValueError) as err:
+        print(f"msbfs fleet: {err}", file=sys.stderr)
+        supervisor.stop()
+        return getattr(err, "exit_code", 1)
+    import signal as _signal
+
+    def _on_signal(signum, frame):  # noqa: ARG001 — signal signature
+        frontend.stop()
+
+    _signal.signal(_signal.SIGTERM, _on_signal)
+    _signal.signal(_signal.SIGINT, _on_signal)
+    names = ", ".join(sorted(supervisor.graphs)) or "none (use load)"
+    print(
+        f"msbfs fleet: {args.size} replicas (replication "
+        f"{supervisor.ring.replication}) under {base_dir}; front end on "
+        f"{args.listen}; graphs: {names}",
+        file=sys.stderr,
+    )
+    try:
+        while not frontend._stopping.is_set():
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        frontend.stop()
+        supervisor.stop(drain=True)
+    print("msbfs fleet: stopped", file=sys.stderr)
+    return 0
+
+
+__all__ = [
+    "FleetFrontend",
+    "FleetRouter",
+    "fleet_main",
+]

@@ -863,6 +863,10 @@ class MsbfsServer:
             "ready": self._ready.is_set(),
             "draining": self._draining,
             "journal": self.journal.path if self.journal else None,
+            "journal_bytes": self.journal.bytes() if self.journal else 0,
+            "journal_compactions": (
+                self.journal.compactions if self.journal else 0
+            ),
             "graphs": self.registry.describe(),
             "queue": {
                 "depth": self.batcher.depth(),

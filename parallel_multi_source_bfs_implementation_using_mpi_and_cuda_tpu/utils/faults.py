@@ -45,6 +45,21 @@ journal, serve/journal.py).  Kinds:
                data-dependent, deterministic failure that follows the
                poisoned row through batch bisection (the serving
                daemon's quarantine rehearsal, serve/server.py).
+``replica_kill``  site must be ``replica<r>``; trips on the fleet
+               supervisor's heartbeat seam for replica ``r`` and raises
+               :class:`SimulatedReplicaKill` — the supervisor converts
+               it into a real SIGKILL of that replica process, the
+               fleet analogue of ``chip`` (serve/fleet.py).
+``replica_slow``  site must be ``route<r>``; trips on the router's
+               forwarding seam for replica ``r`` and stalls the routed
+               call ``MSBFS_FAULT_SLOW`` seconds (default 0.25) — a
+               deterministic straggler for the hedging path
+               (serve/router.py).
+``net_drop``   site must be ``route<r>``; trips on the router's
+               forwarding seam and raises :class:`SimulatedNetDrop` —
+               the connection to that replica "dies" before the request
+               is sent, so the router must fail over to the next ring
+               owner without the replica ever seeing the query.
 
 Example: ``MSBFS_FAULTS="io:load_graph:1,oom:dispatch:2,hang:dispatch:3,
 chip:rank1:1"``.  Trip counters are plain per-site integers, so a given
@@ -63,10 +78,12 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 KINDS = ("io", "corrupt", "oom", "transient", "hang", "chip", "crash",
-         "poison")
+         "poison", "replica_kill", "replica_slow", "net_drop")
 
 _RANK_RE = re.compile(r"rank(\d+)\Z")
 _VERTEX_RE = re.compile(r"vertex(\d+)\Z")
+_REPLICA_RE = re.compile(r"replica(\d+)\Z")
+_ROUTE_RE = re.compile(r"route(\d+)\Z")
 
 
 class SimulatedResourceExhausted(RuntimeError):
@@ -89,6 +106,32 @@ class SimulatedChipLoss(RuntimeError):
         self.failed_ranks = frozenset(int(r) for r in failed_ranks)
 
 
+class SimulatedReplicaKill(RuntimeError):
+    """A whole serving replica dying on cue.  Raised at the fleet
+    supervisor's heartbeat seam (``replica<r>``); the supervisor turns
+    it into a real ``SIGKILL`` of that replica's process, so everything
+    downstream — journal replay, ring failover, restart backoff — is
+    exercised against an actual process death, not a mock.  Carries the
+    replica index."""
+
+    def __init__(self, msg: str, replica: int):
+        super().__init__(msg)
+        self.replica = int(replica)
+
+
+class SimulatedNetDrop(RuntimeError):
+    """The network path to one replica going away mid-request.  Raised
+    at the router's forwarding seam (``route<r>``) BEFORE any bytes hit
+    the wire, so the replica never sees the query — the router must
+    treat it exactly like a refused connection and fail over.  The
+    message carries the UNAVAILABLE mark so stray escapes classify as
+    :class:`~..runtime.supervisor.TransientError`."""
+
+    def __init__(self, msg: str, replica: int):
+        super().__init__(msg)
+        self.replica = int(replica)
+
+
 class SimulatedPoison(RuntimeError):
     """A query whose content deterministically kills its dispatch —
     retrying or resizing the batch never helps, only removing the row
@@ -104,6 +147,7 @@ class FaultSpec:
     at: int  # fires on the at-th trip of trip_site, 1-based
     rank: Optional[int] = None  # chip faults only
     vertex: Optional[int] = None  # poison faults only
+    replica: Optional[int] = None  # fleet faults (replica_kill/slow/net_drop)
     fired: bool = False
     matches: int = 0  # poison: dispatches that contained the vertex
 
@@ -122,15 +166,18 @@ class FaultPlan:
     sleep + raise — happens outside it).
     """
 
-    def __init__(self, specs, hang_seconds: float = 60.0):
+    def __init__(self, specs, hang_seconds: float = 60.0,
+                 slow_seconds: float = 0.25):
         self.specs: List[FaultSpec] = list(specs)
         self.hang_seconds = float(hang_seconds)
+        self.slow_seconds = float(slow_seconds)
         self.counters: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     # ---- construction -----------------------------------------------------
     @classmethod
-    def parse(cls, text: str, hang_seconds: float = 60.0) -> "FaultPlan":
+    def parse(cls, text: str, hang_seconds: float = 60.0,
+              slow_seconds: float = 0.25) -> "FaultPlan":
         """Parse the ``kind:site:n`` grammar; malformed specs fail loud
         (a typo'd fault plan silently arming nothing would make every
         "recovery works" test vacuous)."""
@@ -175,9 +222,27 @@ class FaultPlan:
                         "vertex<v> (e.g. poison:vertex7:1)"
                     )
                 vertex = int(m.group(1))
+            replica = None
+            if kind == "replica_kill":
+                m = _REPLICA_RE.match(site)
+                if not m:
+                    raise ValueError(
+                        f"fault spec {raw!r}: replica_kill faults need "
+                        "site replica<r> (e.g. replica_kill:replica0:3)"
+                    )
+                replica = int(m.group(1))
+            if kind in ("replica_slow", "net_drop"):
+                m = _ROUTE_RE.match(site)
+                if not m:
+                    raise ValueError(
+                        f"fault spec {raw!r}: {kind} faults need site "
+                        f"route<r> (e.g. {kind}:route1:1)"
+                    )
+                replica = int(m.group(1))
             specs.append(FaultSpec(kind=kind, site=site, at=at, rank=rank,
-                                   vertex=vertex))
-        return cls(specs, hang_seconds=hang_seconds)
+                                   vertex=vertex, replica=replica))
+        return cls(specs, hang_seconds=hang_seconds,
+                   slow_seconds=slow_seconds)
 
     @classmethod
     def from_env(cls) -> Optional["FaultPlan"]:
@@ -193,7 +258,14 @@ class FaultPlan:
                 hang = float(env)
             except ValueError:
                 pass  # malformed knob falls back, file-wide convention
-        return cls.parse(raw, hang_seconds=hang)
+        slow = 0.25
+        env = os.environ.get("MSBFS_FAULT_SLOW", "")
+        if env:
+            try:
+                slow = float(env)
+            except ValueError:
+                pass
+        return cls.parse(raw, hang_seconds=hang, slow_seconds=slow)
 
     # ---- execution --------------------------------------------------------
     def reset(self) -> None:
@@ -292,6 +364,22 @@ class FaultPlan:
             raise SimulatedPoison(
                 f"injected poison query: batch contains vertex "
                 f"{s.vertex} {where}"
+            )
+        if s.kind == "replica_kill":
+            raise SimulatedReplicaKill(
+                f"injected replica kill: replica {s.replica} {where}",
+                s.replica,
+            )
+        if s.kind == "replica_slow":
+            # A straggler, not a failure: the routed call proceeds after
+            # the stall, so only hedging (or the deadline) saves the tail.
+            time.sleep(self.slow_seconds)
+            return
+        if s.kind == "net_drop":
+            raise SimulatedNetDrop(
+                f"UNAVAILABLE: injected net drop to replica "
+                f"{s.replica} {where}",
+                s.replica,
             )
         raise AssertionError(f"unreachable kind {s.kind!r}")
 

@@ -164,6 +164,8 @@ def test_configs_sweep_partial_failure_keeps_partial_results(tmp_path):
     assert full_sweep["1"]["detail"]["ref_model"]["teps"] > 0
 
 
+@pytest.mark.slow  # ~31 s: full configs sweep around the outage; the
+# single-config outage contract stays in tier-1 just above
 def test_configs_sweep_outage_is_one_parsable_record(tmp_path):
     proc = run_bench(
         {

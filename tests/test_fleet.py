@@ -1,0 +1,727 @@
+"""Fleet-scale resilient serving (docs/SERVING.md "Fleet"): rendezvous
+placement ring (determinism, replication, minimal movement), the fleet
+fault kinds (``replica_kill``/``replica_slow``/``net_drop``), router
+failover/shed semantics against real in-process replicas with
+bit-identical results, the front-end protocol, the client reconnect
+backoff satellites (decorrelated jitter + elapsed cap), the journal
+growth bound, a byte-level crash-truncation property for journaled
+registrations, and — slow-marked for the tier-1 wall-clock budget —
+the real multi-process chaos chain: ``replica_kill`` fired mid-load
+against a 3-replica fleet, zero acked queries lost, failover within
+the request deadline, restart with journal replay, reconciled
+placement afterwards.
+"""
+
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from virtual_cpu import virtual_cpu_env  # noqa: E402
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (  # noqa: E402
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (  # noqa: E402
+    BackpressureError,
+    InputError,
+    RetryPolicy,
+    TransientError,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (  # noqa: E402
+    MsbfsClient,
+    ServerError,
+    reconnect_schedule,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.fleet import (  # noqa: E402
+    FleetSupervisor,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.journal import (  # noqa: E402
+    StateJournal,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.registry import (  # noqa: E402
+    content_hash,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.ring import (  # noqa: E402
+    PlacementRing,
+    _score,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.router import (  # noqa: E402
+    FleetFrontend,
+    FleetRouter,
+    fleet_main,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (  # noqa: E402
+    MsbfsServer,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils import (  # noqa: E402
+    faults,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (  # noqa: E402
+    save_graph_bin,
+)
+
+# One query set reused everywhere: one bucket, so a replica compiles at
+# most once across the whole in-process half of this module.
+QS = [[1, 2], [3, 4]]
+
+
+def answer(out: dict):
+    """The bit-identity tuple of a query response."""
+    return (out["f_values"], out["min_f"], out["min_k"])
+
+
+# ---------------------------------------------------------------------------
+# Placement ring units (no server, no device)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_determinism_replication_and_validation():
+    members = ["r0", "r1", "r2", "r3"]
+    ring = PlacementRing(members, replication=2)
+    digests = [f"digest{i:02d}" for i in range(50)]
+    for d in digests:
+        pref = ring.preference(d)
+        assert sorted(pref) == sorted(members)  # a permutation, always
+        owners = ring.owners(d)
+        assert owners == pref[:2] and len(set(owners)) == 2
+        # A fresh ring over the same members agrees exactly: placement
+        # is pure function of (membership, digest), nothing stored.
+        assert PlacementRing(members, replication=2).owners(d) == owners
+    # Owner load is spread: no member owns everything.
+    primaries = {ring.owners(d)[0] for d in digests}
+    assert len(primaries) > 1
+    with pytest.raises(ValueError):
+        PlacementRing(["a", "a"])
+    with pytest.raises(ValueError):
+        PlacementRing([])
+    with pytest.raises(ValueError):
+        PlacementRing(["a"], replication=0)
+    # More owners than members clamps (visible, not silent).
+    assert PlacementRing(["a", "b"], replication=5).replication == 2
+
+
+def test_ring_minimal_movement_on_member_loss():
+    members = ["r0", "r1", "r2", "r3", "r4"]
+    ring = PlacementRing(members, replication=2)
+    digests = [f"key{i:03d}" for i in range(200)]
+    dead = "r2"
+    alive = [m for m in members if m != dead]
+    moved = unmoved = 0
+    for d in digests:
+        before = ring.owners(d)
+        after = ring.owners(d, alive=alive)
+        if dead not in before:
+            assert after == before  # HRW: only the dead member's keys move
+            unmoved += 1
+        else:
+            # Exactly one owner changes: the dead slot's next preference
+            # stands in, the surviving owner keeps its place and order.
+            survivors = [m for m in before if m != dead]
+            assert [m for m in after if m in before] == survivors
+            newcomers = [m for m in after if m not in before]
+            assert len(newcomers) == 1
+            pref = ring.preference(d)
+            assert newcomers[0] == [m for m in pref if m in alive][1]
+            moved += 1
+    assert moved > 0 and unmoved > 0  # both branches really exercised
+
+
+# ---------------------------------------------------------------------------
+# Fleet fault kinds (utils/faults.py)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_fault_kinds_parse_and_validate():
+    plan = faults.FaultPlan.parse(
+        "replica_kill:replica2:1,net_drop:route0:2,replica_slow:route1:1"
+    )
+    kinds = {s.kind: s for s in plan.specs}
+    assert kinds["replica_kill"].replica == 2
+    assert kinds["net_drop"].replica == 0 and kinds["net_drop"].at == 2
+    assert kinds["replica_slow"].replica == 1
+    for bad in (
+        "replica_kill:route0:1",  # kill wants replica<r>
+        "net_drop:replica0:1",  # drop wants route<r>
+        "replica_slow:elsewhere:1",
+        "replica_kill:replica:1",  # no index
+    ):
+        with pytest.raises(ValueError):
+            faults.FaultPlan.parse(bad)
+
+
+def test_fleet_faults_fire_at_their_seams():
+    plan = faults.FaultPlan.parse(
+        "net_drop:route1:2,replica_kill:replica0:1,replica_slow:route2:1",
+        slow_seconds=0.05,
+    )
+    faults.activate(plan)
+    try:
+        faults.trip("route1")  # first trip: armed at 2, no fire
+        with pytest.raises(faults.SimulatedNetDrop) as drop:
+            faults.trip("route1")
+        assert drop.value.replica == 1
+        assert "UNAVAILABLE" in str(drop.value)  # classifies transient
+        faults.trip("route1")  # single-shot: third trip is clean
+        with pytest.raises(faults.SimulatedReplicaKill) as kill:
+            faults.trip("replica0")
+        assert kill.value.replica == 0
+        # replica_slow stalls the attempt once, then never again.
+        t0 = time.monotonic()
+        faults.trip("route2")
+        assert time.monotonic() - t0 >= 0.05
+        t0 = time.monotonic()
+        faults.trip("route2")
+        assert time.monotonic() - t0 < 0.05
+    finally:
+        faults.activate(None)
+
+
+# ---------------------------------------------------------------------------
+# Client reconnect backoff (satellite: jitter decorrelation + elapsed cap)
+# ---------------------------------------------------------------------------
+
+
+def test_reconnect_schedule_respects_elapsed_cap():
+    policy = RetryPolicy(max_retries=8, base_delay=0.5, max_delay=4.0,
+                         seed=7)
+    full = list(policy.delays())
+    for cap in (0.0, 0.3, 1.0, 5.0, 1e9):
+        sched = reconnect_schedule(policy, cap)
+        assert sum(sched) <= cap
+        assert sched == full[: len(sched)]  # truncation, never reordering
+    assert reconnect_schedule(policy, 0.0) == []
+    assert reconnect_schedule(policy, 1e9) == full
+
+
+def test_default_client_backoff_is_decorrelated(trio):
+    # Two clients born from the same event (e.g. a replica restart
+    # dropping every connection) must NOT share a sleep schedule —
+    # lockstep reconnects re-form the thundering herd.
+    addr = trio["addresses"]["r0"]
+    with MsbfsClient(addr) as a, MsbfsClient(addr) as b:
+        sa = reconnect_schedule(a.retry, 1e9)
+        sb = reconnect_schedule(b.retry, 1e9)
+    assert sa and sb
+    assert sa != sb
+
+
+def test_client_call_gives_up_within_elapsed_cap(tmp_path):
+    # A listener that accepts one connection then vanishes: the client
+    # constructor connects fine, the call loses the socket, and every
+    # reconnect attempt fails.  The elapsed cap must bound total wall
+    # clock far below the uncapped schedule (~14s of planned sleeps).
+    path = str(tmp_path / "flaky.sock")
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(path)
+    listener.listen(1)
+    policy = RetryPolicy(max_retries=10, base_delay=0.4, max_delay=2.0,
+                         seed=3)
+    c = MsbfsClient(f"unix:{path}", timeout=5.0, retry=policy,
+                    reconnect_max_elapsed_s=0.5)
+    try:
+        conn, _ = listener.accept()
+        conn.close()
+        listener.close()
+        os.unlink(path)
+        t0 = time.monotonic()
+        with pytest.raises(ServerError) as err:
+            c.call({"op": "ping"}, idempotent=True)
+        elapsed = time.monotonic() - t0
+    finally:
+        c.close()
+    assert err.value.type_name == "TransientError"
+    assert elapsed < 5.0  # cap held; the 10-retry schedule never ran
+
+
+# ---------------------------------------------------------------------------
+# Journal satellites: growth bound + crash-truncation property
+# ---------------------------------------------------------------------------
+
+
+def test_journal_auto_compacts_past_byte_cap(tmp_path):
+    j = StateJournal(str(tmp_path / "state.journal"), max_bytes=600)
+    j.append({"op": "load", "name": "g", "path": "/p", "hash": "aaa"})
+    warm = {"op": "warm", "name": "g", "hash": "aaa", "k_exec": 4,
+            "s_pad": 2}
+    for _ in range(50):  # redundant appends: reload/warm churn stand-in
+        j.append(warm)
+    assert j.compactions >= 1
+    assert 0 < j.bytes() <= 600  # bounded however long the daemon lives
+    state = j.replay()
+    assert state.graphs == {"g": ("/p", "aaa")}
+    assert state.warm == {("g", "aaa", 4, 2)}
+    assert state.dropped == 0
+    j.compact(state)  # explicit fold: exactly the live records remain
+    assert j.replay().replayed == 2
+    # <= 0 disables the bound (operator opt-out).
+    j2 = StateJournal(str(tmp_path / "unbounded.journal"), max_bytes=0)
+    for _ in range(50):
+        j2.append(warm)
+    assert j2.compactions == 0 and j2.bytes() > 600
+
+
+def test_server_stats_report_journal_size(tmp_path):
+    n, edges = generators.gnm_edges(60, 150, seed=11)
+    gpath = str(tmp_path / "g.bin")
+    save_graph_bin(gpath, n, edges)
+    srv = MsbfsServer(
+        listen=f"unix:{tmp_path}/s.sock",
+        graphs={"default": gpath},
+        window_s=0.0,
+        request_timeout_s=60.0,
+        journal_path=str(tmp_path / "state.journal"),
+    )
+    srv.start()
+    try:
+        with MsbfsClient(f"unix:{tmp_path}/s.sock") as c:
+            stats = c.stats()
+        assert stats["journal_bytes"] > 0  # the load record is on disk
+        assert stats["journal_compactions"] == 0
+    finally:
+        srv.stop()
+
+
+def test_journal_truncation_property_acked_never_lost(tmp_path):
+    """The kill -9 contract, as a byte-level property: registrations
+    appended concurrently, then the journal truncated at EVERY byte
+    offset (each one a possible power-cut point mid-``journal_append``).
+    At every offset, an acked registration (append returned, so its
+    full line + fsync completed) is never lost, and a torn line never
+    resurrects a registration whose record bytes are incomplete.  A
+    tail that lost only its newline is a complete record and replay
+    keeps it (the torn-tail drop applies to half-written JSON only)."""
+    path = str(tmp_path / "state.journal")
+    j = StateJournal(path, max_bytes=0)  # no compaction mid-property
+    acked: list = []
+    ack_lock = threading.Lock()
+
+    def register(i: int) -> None:
+        j.append({"op": "load", "name": f"g{i}", "path": f"/p{i}",
+                  "hash": f"h{i}"})
+        with ack_lock:
+            acked.append(f"g{i}")
+
+    threads = [threading.Thread(target=register, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with open(path, "rb") as f:
+        raw = f.read()
+    # Every ack is durable in the full file.
+    full = StateJournal(path).replay()
+    assert sorted(full.graphs) == sorted(acked)
+    crash = str(tmp_path / "crash.journal")
+    for cut in range(len(raw) + 1):
+        with open(crash, "wb") as f:
+            f.write(raw[:cut])
+        state = StateJournal(crash).replay()
+        must, may = set(), set()
+        for line in raw[:cut].split(b"\n"):
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn mid-record: must be dropped
+            may.add(rec["name"])
+            if raw[:cut].count(line + b"\n"):
+                must.add(rec["name"])  # newline landed: fully acked
+        got = set(state.graphs)
+        assert must <= got <= may, f"divergence at byte {cut}"
+
+
+# ---------------------------------------------------------------------------
+# Router over real in-process replicas
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def trio(tmp_path_factory):
+    """Three live in-process replica daemons, each holding the graph —
+    plus the digest the ring places on.  Read-only: tests that kill or
+    saturate replicas build their own."""
+    d = tmp_path_factory.mktemp("fleet_trio")
+    n, edges = generators.gnm_edges(120, 360, seed=7)
+    gpath = str(d / "g.bin")
+    save_graph_bin(gpath, n, edges)
+    servers = {}
+    addresses = {}
+    for i in range(3):
+        name = f"r{i}"
+        addr = f"unix:{d}/{name}.sock"
+        srv = MsbfsServer(listen=addr, graphs={"default": gpath},
+                          window_s=0.0, request_timeout_s=60.0)
+        srv.start()
+        servers[name] = srv
+        addresses[name] = addr
+    yield {
+        "servers": servers,
+        "addresses": addresses,
+        "graph_path": gpath,
+        "digest": content_hash(gpath),
+        "dir": d,
+    }
+    for srv in servers.values():
+        srv.stop()
+
+
+def _router(trio, members=None, replication=2, **kw):
+    members = list(members or trio["addresses"])
+    ring = PlacementRing(members, replication=replication)
+    addresses = {m: trio["addresses"].get(m, f"unix:{trio['dir']}/void.sock")
+                 for m in members}
+    return FleetRouter(ring, addresses, {"default": trio["digest"]}, **kw)
+
+
+def test_router_routes_to_primary_and_matches_oracle(trio):
+    router = _router(trio)
+    owners = router.owners_for("default")
+    assert len(owners) == 2
+    out = router.query(QS)
+    assert out["ok"] is True
+    assert out["replica"] == owners[0] and out["failovers"] == 0
+    # Any single daemon is the oracle: results are deterministic, so a
+    # routed answer must be bit-identical to a direct one.
+    with MsbfsClient(trio["addresses"][owners[1]]) as c:
+        oracle = c.query(QS)
+    assert answer(out) == answer(oracle)
+    stats = router.stats()
+    assert stats["routed"] == 1 and stats["shed"] == 0
+    assert stats["per_replica"][owners[0]] == 1
+
+
+def test_for_fleet_router_sees_registrations_after_construction(trio):
+    """`msbfs fleet` builds its router BEFORE registering the -g graphs,
+    so the for_fleet view must share the supervisor's digest table, not
+    snapshot it — a copy answers InputError 'have: none' forever."""
+
+    class _Stub:  # quacks like FleetSupervisor for for_fleet's reads
+        ring = PlacementRing(list(trio["addresses"]), replication=2)
+        replicas = [
+            type("R", (), {"name": m, "address": a})
+            for m, a in trio["addresses"].items()
+        ]
+        digests: dict = {}
+
+        @staticmethod
+        def ready_names():
+            return set(trio["addresses"])
+
+    router = FleetRouter.for_fleet(_Stub, timeout=60.0)
+    with pytest.raises(InputError):
+        router.owners_for("default")
+    _Stub.digests["default"] = trio["digest"]  # the late -g registration
+    out = router.query(QS)
+    assert out["ok"] is True and out["failovers"] == 0
+
+
+def test_router_fails_over_past_dead_primary(trio):
+    # A member whose socket path exists in no filesystem: every attempt
+    # is a refused connection.  Pick a name that out-scores the live
+    # members so the DEAD one is the digest's primary owner.
+    digest = trio["digest"]
+    live = list(trio["addresses"])
+    dead = next(
+        f"void{i}" for i in range(1000)
+        if all(_score(digest, f"void{i}") > _score(digest, m)
+               for m in live)
+    )
+    router = _router(trio, members=[dead] + live)
+    owners = router.owners_for("default")
+    assert owners[0] == dead
+    t0 = time.monotonic()
+    out = router.query(QS, deadline_s=10.0)
+    assert time.monotonic() - t0 < 10.0  # failover within the deadline
+    assert out["replica"] == owners[1] and out["failovers"] == 1
+    with MsbfsClient(trio["addresses"][owners[1]]) as c:
+        assert answer(out) == answer(c.query(QS))
+    assert router.stats()["failovers"] == 1
+
+
+def test_router_net_drop_fails_over(trio):
+    router = _router(trio)
+    owners = router.owners_for("default")
+    primary_idx = router.ring.members.index(owners[0])
+    faults.activate(faults.FaultPlan.parse(
+        f"net_drop:route{primary_idx}:1"
+    ))
+    try:
+        out = router.query(QS)
+    finally:
+        faults.activate(None)
+    assert out["replica"] == owners[1] and out["failovers"] == 1
+    stats = router.stats()
+    assert stats["net_drops"] == 1 and stats["failovers"] == 1
+    # The drop is single-shot: the next query routes to the primary.
+    assert router.query(QS)["replica"] == owners[0]
+
+
+def test_router_replica_slow_stalls_once(trio):
+    router = _router(trio)
+    owners = router.owners_for("default")
+    primary_idx = router.ring.members.index(owners[0])
+    plan = faults.FaultPlan.parse(
+        f"replica_slow:route{primary_idx}:1", slow_seconds=0.2
+    )
+    faults.activate(plan)
+    try:
+        t0 = time.monotonic()
+        out = router.query(QS)
+        stalled = time.monotonic() - t0
+    finally:
+        faults.activate(None)
+    assert out["replica"] == owners[0]  # slow, not dead: same answer
+    assert stalled >= 0.2
+    assert next(s.fired for s in plan.specs)
+
+
+def test_router_unknown_graph_is_input_error(trio):
+    router = _router(trio)
+    with pytest.raises(InputError):
+        router.query(QS, graph="nope")
+
+
+def test_router_deterministic_replica_error_skips_failover(trio):
+    # The replicas do not know graph "ghost": the first owner's
+    # InputError belongs to the QUERY, so the router must re-raise it
+    # immediately instead of burning failover attempts on an answer
+    # every replica would repeat.
+    members = list(trio["addresses"])
+    ring = PlacementRing(members, replication=2)
+    router = FleetRouter(ring, dict(trio["addresses"]),
+                         {"ghost": "0" * 64})
+    with pytest.raises(ServerError) as err:
+        router.query(QS, graph="ghost")
+    assert err.value.type_name == "InputError"
+    assert router.stats()["failovers"] == 0
+
+
+def test_router_no_live_owner_is_transient(trio):
+    router = _router(trio, alive_fn=lambda: set())
+    with pytest.raises(TransientError):
+        router.query(QS)
+
+
+def test_router_sheds_typed_backpressure_when_all_owners_saturated(
+    trio, tmp_path
+):
+    # Two fresh single-slot replicas, batchers held, queues filled: the
+    # fleet is saturated end to end and the router must say so TYPED —
+    # not mask it as a retryable transient.
+    servers = {}
+    addresses = {}
+    for name in ("s0", "s1"):
+        addr = f"unix:{tmp_path}/{name}.sock"
+        srv = MsbfsServer(listen=addr,
+                          graphs={"default": trio["graph_path"]},
+                          window_s=0.0, queue_capacity=1,
+                          request_timeout_s=60.0)
+        srv.start()
+        srv.batcher.hold()
+        servers[name] = srv
+        addresses[name] = addr
+    stuck = []
+    try:
+        def occupy(addr):
+            try:
+                with MsbfsClient(addr) as c:
+                    c.query(QS)
+            except ServerError:
+                pass  # released at teardown; outcome irrelevant here
+
+        for addr in addresses.values():
+            t = threading.Thread(target=occupy, args=(addr,))
+            t.start()
+            stuck.append(t)
+        deadline = time.time() + 10
+        while (any(s.batcher.depth() < 1 for s in servers.values())
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert all(s.batcher.depth() == 1 for s in servers.values())
+        ring = PlacementRing(list(addresses), replication=2)
+        router = FleetRouter(ring, addresses,
+                             {"default": trio["digest"]})
+        with pytest.raises(BackpressureError):
+            router.query(QS)
+        assert router.stats()["shed"] == 1
+    finally:
+        for srv in servers.values():
+            srv.batcher.release()
+        for t in stuck:
+            t.join(timeout=30)
+        for srv in servers.values():
+            srv.stop()
+
+
+def test_frontend_speaks_the_wire_protocol(trio, tmp_path):
+    router = _router(trio)
+    owners = router.owners_for("default")
+    listen = f"unix:{tmp_path}/fleet.sock"
+    frontend = FleetFrontend(listen, router)
+    frontend.start()
+    try:
+        with MsbfsClient(listen) as c:
+            assert c.ping() is True
+            assert c.health()["ready"] is True
+            out = c.query(QS)
+            assert out["replica"] == owners[0]
+            with MsbfsClient(trio["addresses"][owners[0]]) as direct:
+                assert answer(out) == answer(direct.query(QS))
+            assert c.stats()["router"]["routed"] == 1
+            # No supervisor behind this front end: load is refused typed.
+            with pytest.raises(ServerError) as err:
+                c.load(trio["graph_path"])
+            assert err.value.type_name == "InputError"
+    finally:
+        frontend.stop()
+    assert not os.path.exists(listen[len("unix:"):])  # socket reclaimed
+
+
+def test_fleet_cli_verb_parses():
+    with pytest.raises(SystemExit) as exit_:
+        fleet_main(["--help"])
+    assert exit_.value.code == 0
+
+
+# ---------------------------------------------------------------------------
+# The multi-process chaos chain (slow: 3 replica subprocess boots + a
+# kill/restart cycle — the acceptance invariant for ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_chaos_kill_failover_restart(tmp_path):
+    """``replica_kill`` fired mid-load against a real 3-replica fleet:
+    zero acked queries lost (every response bit-identical to a
+    single-daemon oracle), the router fails over within the request
+    deadline while the victim is down, the supervisor restarts it on
+    backoff, journal replay re-registers its graphs, and placement
+    reconciles back to the original owner set."""
+    n, edges = generators.gnm_edges(120, 360, seed=7)
+    gpath = str(tmp_path / "g.bin")
+    save_graph_bin(gpath, n, edges)
+
+    # Single-daemon oracle, in-process.
+    oracle_srv = MsbfsServer(listen=f"unix:{tmp_path}/oracle.sock",
+                             graphs={"default": gpath},
+                             window_s=0.0, request_timeout_s=60.0)
+    oracle_srv.start()
+    qsets = [QS, [[5, 6], [7, 8]], [[9, 10], [11, 12]]]
+    with MsbfsClient(f"unix:{tmp_path}/oracle.sock") as c:
+        oracle = [answer(c.query(q)) for q in qsets]
+
+    supervisor = FleetSupervisor(
+        size=3,
+        base_dir=str(tmp_path / "fleet"),
+        replication=2,
+        heartbeat_s=0.25,
+        env=virtual_cpu_env(1),
+        restart_policy=RetryPolicy(max_retries=6, base_delay=0.2,
+                                   max_delay=1.0, seed=0),
+    )
+    try:
+        supervisor.start(wait_ready_s=240.0)
+        owners = supervisor.register("default", gpath)
+        router = FleetRouter.for_fleet(supervisor, timeout=60.0)
+        # Static-placement router: ignores liveness, so it MUST walk
+        # through the dead primary and fail over mid-deadline.
+        static = FleetRouter(
+            supervisor.ring,
+            {r.name: r.address for r in supervisor.replicas},
+            supervisor.digests,
+        )
+
+        def wait_owner_ready(deadline_s=240.0):
+            end = time.monotonic() + deadline_s
+            while time.monotonic() < end:
+                live = supervisor.status()["graphs"]["default"][
+                    "live_owners"]
+                if set(owners) <= set(live):
+                    return
+                time.sleep(0.1)
+            raise AssertionError(
+                f"owners {owners} never all live; "
+                f"status {supervisor.status()}"
+            )
+
+        wait_owner_ready()
+        # Warm the primary through the router, then the standby owner
+        # directly — the router pins every healthy query to the first
+        # live owner, so without this the failover path would measure
+        # first-compile, not serving.
+        for i, q in enumerate(qsets):
+            out = router.query(q, deadline_s=120.0)
+            assert answer(out) == oracle[i]
+        for member in owners[1:]:
+            addr = supervisor.replicas[int(member[1:])].address
+            with MsbfsClient(addr, timeout=300.0) as c:
+                for i, q in enumerate(qsets):
+                    assert answer(c.query(q)) == oracle[i]
+
+        victim_name = owners[0]  # the digest's primary owner dies
+        victim_idx = int(victim_name[1:])
+        victim = supervisor.replicas[victim_idx]
+        faults.activate(
+            faults.FaultPlan.parse(f"replica_kill:replica{victim_idx}:1")
+        )
+
+        # Continuous load across the kill: every acked answer must match
+        # the oracle, no query may fail (the surviving owner set always
+        # covers the graph).
+        acked = 0
+        end = time.monotonic() + 60.0
+        while victim.injected_kills < 1 and time.monotonic() < end:
+            i = acked % len(qsets)
+            t0 = time.monotonic()
+            out = router.query(qsets[i], deadline_s=10.0)
+            assert time.monotonic() - t0 < 10.0
+            assert answer(out) == oracle[i], "acked query lost/corrupted"
+            acked += 1
+        assert victim.injected_kills == 1, "replica_kill never fired"
+        assert acked > 0
+
+        # While the victim is down, the static router must reach the
+        # answer THROUGH failover, inside the request deadline.
+        t0 = time.monotonic()
+        out = static.query(qsets[0], deadline_s=5.0)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0
+        assert answer(out) == oracle[0]
+        if victim.state != "ready":  # kill window still open: pin it
+            assert out["failovers"] >= 1
+            assert out["replica"] != victim_name
+
+        # Keep serving through the restart window.
+        end = time.monotonic() + 240.0
+        while time.monotonic() < end:
+            i = acked % len(qsets)
+            out = router.query(qsets[i], deadline_s=30.0)
+            assert answer(out) == oracle[i]
+            acked += 1
+            if victim.state == "ready" and victim.restarts >= 1:
+                break
+            time.sleep(0.2)
+        assert victim.restarts >= 1 and victim.state == "ready"
+
+        # The victim's own journal replayed its registration, and the
+        # reconcile pass converged placement back to the original owners.
+        replayed = StateJournal(victim.journal_path).replay()
+        assert "default" in replayed.graphs
+        wait_owner_ready()
+        for i, q in enumerate(qsets):
+            assert answer(router.query(q, deadline_s=30.0)) == oracle[i]
+        assert router.stats()["shed"] == 0  # nothing was ever dropped
+    finally:
+        faults.activate(None)
+        supervisor.stop()
+        oracle_srv.stop()

@@ -278,6 +278,9 @@ def hub_tail_problem(tail=2500, hub_fan=100):
     return CSRGraph.from_edges(n, edges), pad_queries(queries)
 
 
+@pytest.mark.slow  # ~30 s: every engine against the adversary; tier-1
+# keeps the CLI bound-engaged arm (test_cli.py::test_hub_tail_cli_bound
+# _engaged), `make test` runs the full matrix
 def test_hub_tail_adversary_bounded_all_engines(monkeypatch):
     """The adversarial graph gets the bound at any -gn, and the chunked
     engines agree with the unchunked oracle on it (reference: any graph

@@ -349,3 +349,45 @@ def test_serve_registry_reuses_tile_index(tmp_path, monkeypatch):
     f, best = _reference(n, edges, queries)
     got = e2.supervisor.best(pad_queries(queries))
     assert tuple(int(x) for x in np.asarray(got)) == best
+
+
+def test_tile_cache_byte_cap_evicts_lru(tmp_path, monkeypatch):
+    """Satellite (round 9): the tile cache is BOUNDED.  Distinct digests
+    past the byte cap evict oldest-first (counted in the stats hook),
+    resident bytes stay under the cap, and cap <= 0 disables caching
+    entirely — a long-lived fleet replica must not pin device memory
+    proportional to its reload history."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.registry import (
+        GraphRegistry,
+        mxu_tile_cache_stats,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        save_graph_bin,
+    )
+
+    monkeypatch.setenv("MSBFS_BACKEND", "mxu")
+    monkeypatch.setenv("MSBFS_MXU_TILE", "16")
+
+    def register(seed: int) -> None:
+        n, edges = generators.gnm_edges(90, 300, seed=seed)
+        gpath = str(tmp_path / f"g{seed}.bin")
+        save_graph_bin(gpath, n, edges)
+        GraphRegistry().load(f"g{seed}", gpath)
+
+    register(61)
+    first = mxu_tile_cache_stats()
+    assert first["bytes"] > 0
+    # Cap the cache at exactly the current footprint: the next distinct
+    # digest must push the oldest entry out, never the byte total over.
+    monkeypatch.setenv("MSBFS_MXU_CACHE_BYTES", str(first["bytes"]))
+    register(62)
+    bounded = mxu_tile_cache_stats()
+    assert bounded["evictions"] > first["evictions"]
+    assert 0 < bounded["bytes"] <= first["bytes"]
+    assert bounded["cap_bytes"] == first["bytes"]
+    # cap <= 0: builds still succeed, nothing parks in the cache.
+    monkeypatch.setenv("MSBFS_MXU_CACHE_BYTES", "0")
+    register(63)
+    after = mxu_tile_cache_stats()
+    assert after["entries"] == bounded["entries"]
+    assert after["bytes"] == bounded["bytes"]

@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet
+.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -52,5 +52,13 @@ mxu:
 fleet: native
 	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_fleet.py -x -q
 
-test: native resilience serve lifecycle perf-smoke mxu fleet
+# Output-certification suite (docs/RESILIENCE.md "Silent data
+# corruption"): certificate invariants, digest folding, the
+# 100%-detection bitflip property test at every fault seam, and the
+# certify arm of the engines-agreement matrix.
+audit: native
+	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_certify.py -x -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_engines_agree.py -x -q -k "audit"
+
+test: native resilience serve lifecycle perf-smoke mxu fleet audit
 	python -m pytest tests/ -x -q

@@ -215,6 +215,102 @@ def _bitbell_ladder(graph, level_chunk):
     return rungs
 
 
+def verify_main(argv: List[str]) -> int:
+    """``msbfs verify``: offline certification of distance-to-set
+    answers (docs/RESILIENCE.md "Silent data corruption").
+
+    Recomputes the distance fields with the untrusted host sweep,
+    certifies the recompute against the four BFS invariants, and checks
+    a claimed F vector against the certified field.  The claim is either
+    ``--expect-f`` (a stored query response's ``f_values`` — certifying
+    results after the fact) or, by default, a fresh run of the stock
+    serving engine under a full audit — a standalone hardware-distrust
+    pass over this machine.  Exit 0: certified.  Exit 9
+    (:class:`~.runtime.supervisor.CorruptionError`): the failing
+    invariants are named on stderr.
+    """
+    import argparse
+    import json
+
+    import numpy as np
+
+    ap = argparse.ArgumentParser(
+        prog="msbfs-tpu verify",
+        description="Certify distance-to-set answers against the BFS "
+        "invariants (docs/RESILIENCE.md)",
+    )
+    ap.add_argument("-g", "--graph", required=True, metavar="GRAPH.bin",
+                    help="reference-format graph .bin")
+    ap.add_argument("-q", "--query", required=True, metavar="QUERY.bin",
+                    help="reference-format query .bin")
+    ap.add_argument(
+        "--expect-f", default=None, metavar="F",
+        help="claimed F values to certify: a JSON list, or @PATH to a "
+        "JSON file (e.g. a stored response's f_values).  Default: run "
+        "the stock engine under a full audit and certify its output.",
+    )
+    args = ap.parse_args(argv)
+
+    from .ops import certify
+    from .runtime.supervisor import CorruptionError, InputError, MsbfsError
+    from .utils.io import load_graph_bin, load_query_bin, pad_queries
+    from .utils.report import format_failure
+
+    try:
+        try:
+            graph = load_graph_bin(args.graph)
+            queries = pad_queries(load_query_bin(args.query))
+        except (OSError, ValueError) as exc:
+            raise InputError(str(exc)) from exc
+        if args.expect_f is not None:
+            raw = args.expect_f
+            if raw.startswith("@"):
+                try:
+                    with open(raw[1:], "r", encoding="utf-8") as fh:
+                        raw = fh.read()
+                except OSError as exc:
+                    raise InputError(str(exc)) from exc
+            try:
+                f_claimed = np.asarray(json.loads(raw), dtype=np.int64)
+            except (ValueError, TypeError) as exc:
+                raise InputError(
+                    f"--expect-f is not a JSON int list: {exc}"
+                ) from exc
+            source = "stored F values"
+        else:
+            from .serve.registry import build_supervised_engine
+
+            supervisor = build_supervised_engine(graph)
+            # Full audit regardless of MSBFS_AUDIT: verification is the
+            # entire point of this verb, not a sampled overhead trade.
+            if supervisor.auditor is None:
+                supervisor.auditor = certify.make_auditor(graph)
+            supervisor.audit_sample = 1.0
+            f_claimed = np.asarray(
+                supervisor.f_values(queries), dtype=np.int64
+            )
+            source = "engine output"
+        failing = certify.audit_f_values(
+            graph.row_offsets, graph.col_indices, queries, f_claimed
+        )
+        if failing:
+            raise CorruptionError(
+                f"verification of {source} FAILED for {args.graph} / "
+                f"{args.query}: invariants violated: "
+                f"{', '.join(failing)}",
+                invariants=failing,
+            )
+    except MsbfsError as err:
+        print(format_failure(err), file=sys.stderr)
+        return err.exit_code
+    print(
+        f"verify: CERTIFIED {source} — {queries.shape[0]} queries on "
+        f"{graph.n} vertices / {graph.m} edges; "
+        f"F = {[int(x) for x in np.atleast_1d(f_claimed)]}"
+    )
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv if argv is None else argv)
     # Serving-runtime subcommands (docs/SERVING.md) dispatch BEFORE the
@@ -243,6 +339,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .serve.client import query_main
 
         return query_main(argv[2:] + ["--health"])
+    if len(argv) > 1 and argv[1] == "verify":
+        # Offline output certification (docs/RESILIENCE.md "Silent data
+        # corruption"): exit 0 = certified, exit 9 = corrupt.
+        return verify_main(argv[2:])
     if len(argv) < 5:  # argc < 5, reference main.cu:204-212
         print(
             f"Usage: python {argv[0] if argv else 'main.py'} "

@@ -210,12 +210,29 @@ def host_chunked_loop(carry, advance, max_levels, level_ix=1, updated_ix=2):
     ``advance`` may donate the carry it is passed (utils.donation): the
     loop rebinds ``carry`` before touching device state again, so the
     donated buffers are never re-read.  Each iteration's fetch is ONE
-    blocking commit, recorded for the dispatch-count telemetry."""
-    from ..utils.timing import record_dispatch
+    blocking commit, recorded for the dispatch-count telemetry.
 
+    This loop is also the PLANE-COMMIT integrity seam (docs/RESILIENCE.md
+    "Silent data corruption"): after each committed chunk the state
+    buffer (``carry[0]`` — the distance planes) can be bit-flipped by an
+    armed ``bitflip:plane<i>`` fault (``i`` = 0-based chunk index), and
+    its xor-fold digest is journaled while a certify plane trail is
+    armed.  Both gates are one attribute read on the fault-free path."""
+    from ..utils import faults
+    from ..utils.timing import record_dispatch
+    from . import certify
+
+    chunk_ix = 0
     while True:
         carry = advance(carry)
         record_dispatch()
+        if faults.corruption_armed():
+            flipped = faults.corrupt(f"plane{chunk_ix}", carry[0])
+            if flipped is not carry[0]:
+                carry = (flipped,) + tuple(carry[1:])
+        if certify.trail_armed():
+            certify.record_plane_digest(carry[0])
+        chunk_ix += 1
         active = np.asarray(carry[updated_ix])
         if max_levels is not None:
             active = active & (np.asarray(carry[level_ix]) < max_levels)

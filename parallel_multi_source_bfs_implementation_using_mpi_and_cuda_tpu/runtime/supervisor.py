@@ -49,6 +49,7 @@ __all__ = [
     "TransientError",
     "BackpressureError",
     "PoisonQueryError",
+    "CorruptionError",
     "classify",
     "RetryPolicy",
     "call_with_watchdog",
@@ -120,6 +121,26 @@ class PoisonQueryError(MsbfsError):
     shedding (7) and infrastructure faults (3/4/5)."""
 
     exit_code = 8
+
+
+class CorruptionError(MsbfsError):
+    """Silent data corruption that certification could not repair: the
+    distance-certificate audit (ops/certify.py) rejected an output, the
+    supervisor's escalation ladder (retry same engine -> retry alternate
+    engine/chunking) re-produced a rejected output every time, and no
+    trustworthy answer exists to return.  Also raised when a stored
+    artifact fails its integrity check — a journaled graph whose on-disk
+    bytes no longer match the registered content digest.  NOT retryable
+    by the caller with the same replica/artifact: the corruption is in
+    the data path, not the timing.  Exit 9 so scripting can tell "the
+    hardware lied" from every recoverable failure class.  Carries the
+    failing invariant names (``invariants``)."""
+
+    exit_code = 9
+
+    def __init__(self, msg: str, invariants=()):
+        super().__init__(msg)
+        self.invariants = tuple(invariants)
 
 
 _CAPACITY_MARKS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY", "ALLOCATION FAILURE")
@@ -235,6 +256,8 @@ class ChunkSupervisor(QueryEngineBase):
         ladder: Sequence[Tuple[str, Callable[[], object]]] = (),
         plan: Optional[faults.FaultPlan] = None,
         max_rebuilds: Optional[int] = None,
+        auditor: Optional[Callable[[object, object], List[str]]] = None,
+        audit_sample: float = 1.0,
     ):
         self.engine = engine
         self.policy = policy or RetryPolicy()
@@ -244,6 +267,20 @@ class ChunkSupervisor(QueryEngineBase):
         self.max_rebuilds = max_rebuilds
         self.events: List[dict] = []
         self._rebuilds = 0
+        # Output certification (docs/RESILIENCE.md "Silent data
+        # corruption"): ``auditor(queries, f) -> [failing invariants]``
+        # re-derives the claimed F values against the distance
+        # certificate.  ``audit_sample`` in [0, 1] audits that fraction
+        # of f_values calls (1.0 = every call); a call that FAILS its
+        # audit escalates — retry same engine, then the alternate-engine
+        # ladder, then CorruptionError — and every escalation attempt is
+        # audited regardless of sampling.
+        self.auditor = auditor
+        self.audit_sample = float(audit_sample)
+        self.audited_total = 0
+        self.audit_failures_total = 0
+        self.last_audited = False
+        self._audit_acc = 0.0
         # Optional drain signal (serve/lifecycle.py): while set, backoff
         # sleeps are capped so retries cannot out-sleep the daemon's
         # drain deadline, and an unset->set transition wakes a sleeping
@@ -296,7 +333,18 @@ class ChunkSupervisor(QueryEngineBase):
             # query batch for f_values/query_stats/best, the shape tuple
             # for compile) — data-dependent faults (poison) key on it.
             plan.trip("dispatch", args[0] if args else None)
-        return getattr(self.engine, method)(*args, **kwargs)
+        out = getattr(self.engine, method)(*args, **kwargs)
+        if (
+            method == "f_values"
+            and plan is not None
+            and plan.bitflip_armed()
+        ):
+            # Result-materialize seam (``bitflip:dist``): the F buffer
+            # is corrupted AFTER the engine produced it — the shape of a
+            # flipped bit on the device->host copy or in the result
+            # cache line, which only output certification can catch.
+            out = plan.corrupt("dist", out)
+        return out
 
     def _backoff(self, delay: float) -> None:
         """One retry backoff, drain-aware: while the daemon drains, cap
@@ -310,64 +358,144 @@ class ChunkSupervisor(QueryEngineBase):
         else:
             sig.wait(delay)
 
+    def _audit_due(self) -> bool:
+        """Deterministic sampling: an accumulator crosses 1.0 every
+        ``1/audit_sample`` calls, so a 0.25 rate audits exactly every
+        fourth f_values call — replayable, no RNG."""
+        if self.audit_sample >= 1.0:
+            return True
+        if self.audit_sample <= 0.0:
+            return False
+        self._audit_acc += self.audit_sample
+        if self._audit_acc >= 1.0:
+            self._audit_acc -= 1.0
+            return True
+        return False
+
     def _supervised(self, method, *args, **kwargs):
         delays = self.policy.delays()
         attempt = 0
-        while True:
-            try:
-                return call_with_watchdog(
-                    lambda: self._dispatch(method, args, kwargs),
-                    self.watchdog,
-                )
-            except Exception as exc:
-                err = classify(exc)
-                if isinstance(err, TransientError):
-                    delay = next(delays, None)
-                    if delay is not None:
-                        attempt += 1
-                        self.events.append({
-                            "action": "retry",
-                            "method": method,
-                            "attempt": attempt,
-                            "delay": delay,
-                            "error": str(err),
-                        })
-                        self._backoff(delay)
-                        continue
-                elif isinstance(err, CapacityError) and self.ladder:
-                    label, factory = self.ladder.pop(0)
-                    self.engine = factory()
-                    self.events.append({
-                        "action": "degrade",
-                        "method": method,
-                        "to": label,
-                        "error": str(err),
-                    })
-                    continue
-                elif (
-                    isinstance(err, DeviceError)
-                    and err.failed_ranks
-                    and hasattr(self.engine, "without_ranks")
-                ):
-                    cap = (
-                        self.max_rebuilds
-                        if self.max_rebuilds is not None
-                        else int(getattr(self.engine, "w", 1))
+        audit_attempts = 0
+        # Audit stepdowns BORROW ladder rungs by index and restore the
+        # original engine once the call settles (success or terminal
+        # CorruptionError): a transient double-upset must not downgrade
+        # the supervisor permanently, and must not consume rungs the
+        # CapacityError degrade path may later need.  A capacity
+        # degrade or a reshard DURING the call is permanent as ever and
+        # cancels the restore (the original engine's config/mesh is no
+        # longer the one to come back to).
+        audit_rung = 0
+        restore_engine = None
+        must_audit = False
+        self.last_audited = False
+        try:
+            while True:
+                try:
+                    result = call_with_watchdog(
+                        lambda: self._dispatch(method, args, kwargs),
+                        self.watchdog,
                     )
-                    if self._rebuilds < cap:
-                        self._rebuilds += 1
-                        survivors = self.engine.without_ranks(
-                            err.failed_ranks
-                        )
+                    if method != "f_values" or self.auditor is None:
+                        return result
+                    if not must_audit and not self._audit_due():
+                        return result
+                    self.audited_total += 1
+                    self.last_audited = True
+                    failing = self.auditor(args[0], result)
+                    if not failing:
+                        return result
+                    # Audit escalation ladder: the output flunked its
+                    # certificate.  Retry the same engine once (a
+                    # one-shot upset clears), then swap in the alternate
+                    # engine/chunking rungs, then surface the corruption
+                    # typed — never return an uncertified answer once
+                    # one attempt has failed its audit.
+                    must_audit = True
+                    self.audit_failures_total += 1
+                    audit_attempts += 1
+                    self.events.append({
+                        "action": "audit_fail",
+                        "method": method,
+                        "attempt": audit_attempts,
+                        "invariants": list(failing),
+                    })
+                    if audit_attempts <= 1:
+                        continue
+                    if audit_rung < len(self.ladder):
+                        label, factory = self.ladder[audit_rung]
+                        audit_rung += 1
+                        if restore_engine is None:
+                            restore_engine = self.engine
+                        self.engine = factory()
                         self.events.append({
-                            "action": "reshard",
+                            "action": "audit_degrade",
                             "method": method,
-                            "failed_ranks": sorted(err.failed_ranks),
-                            "survivor_shards": int(
-                                getattr(survivors, "w", 0)
-                            ),
+                            "to": label,
+                        })
+                        continue
+                    raise CorruptionError(
+                        "output certification failed after "
+                        f"{audit_attempts} attempt(s); failing "
+                        f"invariants: {', '.join(failing)}",
+                        invariants=failing,
+                    )
+                except CorruptionError:
+                    raise  # terminal verdict from the audit ladder above
+                except Exception as exc:
+                    err = classify(exc)
+                    if isinstance(err, TransientError):
+                        delay = next(delays, None)
+                        if delay is not None:
+                            attempt += 1
+                            self.events.append({
+                                "action": "retry",
+                                "method": method,
+                                "attempt": attempt,
+                                "delay": delay,
+                                "error": str(err),
+                            })
+                            self._backoff(delay)
+                            continue
+                    elif isinstance(err, CapacityError) and self.ladder:
+                        label, factory = self.ladder.pop(0)
+                        self.engine = factory()
+                        restore_engine = None  # permanent degrade
+                        audit_rung = 0  # rung indices shifted with the pop
+                        self.events.append({
+                            "action": "degrade",
+                            "method": method,
+                            "to": label,
                             "error": str(err),
                         })
-                        self.engine = survivors
                         continue
-                raise err from exc
+                    elif (
+                        isinstance(err, DeviceError)
+                        and err.failed_ranks
+                        and hasattr(self.engine, "without_ranks")
+                    ):
+                        cap = (
+                            self.max_rebuilds
+                            if self.max_rebuilds is not None
+                            else int(getattr(self.engine, "w", 1))
+                        )
+                        if self._rebuilds < cap:
+                            self._rebuilds += 1
+                            survivors = self.engine.without_ranks(
+                                err.failed_ranks
+                            )
+                            self.events.append({
+                                "action": "reshard",
+                                "method": method,
+                                "failed_ranks": sorted(err.failed_ranks),
+                                "survivor_shards": int(
+                                    getattr(survivors, "w", 0)
+                                ),
+                                "error": str(err),
+                            })
+                            self.engine = survivors
+                            restore_engine = None  # the old mesh is gone
+                            continue
+                    raise err from exc
+        finally:
+            if restore_engine is not None:
+                self.engine = restore_engine

@@ -40,7 +40,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
-from ..runtime.supervisor import RetryPolicy, TransientError
+from ..runtime.supervisor import CorruptionError, RetryPolicy, TransientError
 from ..utils import faults
 from .client import MsbfsClient, ServerError
 from .registry import content_hash
@@ -81,6 +81,7 @@ class ReplicaHandle:
     restart_due: Optional[float] = None
     backoff: Optional[object] = None  # iterator over restart delays
     registered: Set[str] = field(default_factory=set)
+    quarantines: int = 0
 
     def describe(self) -> dict:
         return {
@@ -90,6 +91,7 @@ class ReplicaHandle:
             "pid": self.pid,
             "restarts": self.restarts,
             "injected_kills": self.injected_kills,
+            "quarantines": self.quarantines,
             "last_exit": self.last_exit,
             "graphs": sorted(self.registered),
         }
@@ -116,6 +118,7 @@ class FleetSupervisor:
         restart_policy: Optional[RetryPolicy] = None,
         env: Optional[Dict[str, str]] = None,
         replica_faults: Optional[Dict[int, str]] = None,
+        replica_env: Optional[Dict[int, Dict[str, str]]] = None,
         server_args: Optional[List[str]] = None,
     ):
         if size < 1:
@@ -144,6 +147,11 @@ class FleetSupervisor:
         # get a clean slate unless a per-replica plan is injected.
         self._env.pop("MSBFS_FAULTS", None)
         self._replica_faults = dict(replica_faults or {})
+        # Per-replica env overrides (e.g. MSBFS_AUDIT on one replica for
+        # the chaos matrix' audit leg); applied on every (re)spawn.
+        self._replica_env = {
+            int(i): dict(v) for i, v in (replica_env or {}).items()
+        }
         self._server_args = list(server_args or [])
         self.replicas: List[ReplicaHandle] = [
             ReplicaHandle(
@@ -160,6 +168,7 @@ class FleetSupervisor:
         )
         self.graphs: Dict[str, str] = {}  # name -> path
         self.digests: Dict[str, str] = {}  # name -> content digest
+        self.refused_graphs: Dict[str, str] = {}  # name -> refusal reason
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
@@ -244,6 +253,7 @@ class FleetSupervisor:
             except OSError:
                 pass
         env = dict(self._env)
+        env.update(self._replica_env.get(r.index, {}))
         plan = self._replica_faults.get(r.index)
         if plan:
             env["MSBFS_FAULTS"] = plan
@@ -389,13 +399,42 @@ class FleetSupervisor:
         with self._lock:
             todo = list(self.graphs.items())
             digests = dict(self.digests)
-        ready = {r.name: r for r in self.replicas if r.state == "ready"}
+            # Readiness snapshot under the same lock as the graph table:
+            # a replica flipping state mid-snapshot must not let one
+            # graph see a ring the next graph doesn't (the two would
+            # converge to different stand-ins for the same outage).
+            ready = {r.name: r for r in self.replicas if r.state == "ready"}
         for name, path in todo:
             owners = self.ring.owners(digests[name], alive=ready.keys())
-            for owner in owners:
-                r = ready[owner]
-                if name in r.registered:
-                    continue
+            pending = [
+                ready[o] for o in owners if name not in ready[o].registered
+            ]
+            if not pending:
+                continue
+            # Re-registration integrity gate: re-hash the on-disk file
+            # against the digest recorded at register() time.  A file
+            # that changed underneath the fleet must not be silently
+            # re-registered under the old name on a stand-in — record a
+            # typed refusal in status() and keep the placement hole (a
+            # background thread cannot usefully raise).
+            try:
+                digest_now = content_hash(path)
+            except OSError as exc:
+                digest_now, reason = None, f"unreadable: {exc}"
+            if digest_now != digests[name]:
+                if digest_now is not None:
+                    reason = (
+                        f"{CorruptionError.__name__}: on-disk content "
+                        f"hash {digest_now} != registered "
+                        f"{digests[name]} — refusing re-registration of "
+                        "silently different content"
+                    )
+                with self._lock:
+                    self.refused_graphs[name] = reason
+                continue
+            with self._lock:
+                self.refused_graphs.pop(name, None)  # file recovered
+            for r in pending:
                 try:
                     with MsbfsClient(r.address, timeout=300.0) as c:
                         c.load(path, graph=name)
@@ -403,13 +442,42 @@ class FleetSupervisor:
                 except (ServerError, OSError, ValueError):
                     pass  # next reconcile pass retries
 
+    # ---- corruption response ----------------------------------------------
+    def quarantine(self, name_or_index) -> bool:
+        """Take a replica that served a corrupt answer out of rotation:
+        SIGKILL its process so the stock heartbeat machinery does the
+        rest — restart on the jittered backoff schedule, journal replay,
+        reconcile moves its keys to a stand-in meanwhile.  Deliberately
+        NOT a new lifecycle state: a quarantined replica is just a dead
+        one, and dead is the one condition the fleet already heals from
+        end to end.  Returns True when a live process was killed."""
+        with self._lock:
+            for r in self.replicas:
+                if r.name == name_or_index or r.index == name_or_index:
+                    victim = r
+                    break
+            else:
+                return False
+            victim.quarantines += 1
+            proc = victim.proc
+        if proc is None or proc.poll() is not None:
+            return False
+        try:
+            proc.kill()
+            proc.wait(timeout=30.0)
+        except OSError:
+            return False
+        return True
+
     # ---- observability ----------------------------------------------------
     def status(self) -> dict:
         with self._lock:
             digests = dict(self.digests)
+            refused = dict(self.refused_graphs)
         return {
             "size": len(self.replicas),
             "replication": self.ring.replication,
+            "refused_graphs": refused,
             "ready": sorted(self.ready_names()),
             "replicas": [r.describe() for r in self.replicas],
             "graphs": {

@@ -23,6 +23,24 @@ The length prefix is bounded (:data:`MAX_FRAME_BYTES`,
 ``MSBFS_SERVE_MAX_FRAME`` overrides): a corrupt or hostile prefix must
 never turn into a multi-GiB allocation — the same fail-before-allocate
 posture as the binary graph loader (utils/io.py header checks).
+
+Frame integrity: the high bit of the length prefix (:data:`_CRC_FLAG`)
+flags that a 4-byte big-endian crc32 of the body follows the prefix.
+Frames WITHOUT the flag are always accepted (tolerated-absent), so the
+compat is one-way: a pre-crc peer can SEND to this version, but it
+cannot parse a flagged frame (its prefix read sees a length >= 2^31
+and errors).  Rolling a mixed-version fleet forward therefore takes
+two phases, the standard recipe: first deploy every node with
+``MSBFS_WIRE_CRC=legacy`` — send unflagged frames, still verify any
+flagged frame received — then, once no pre-crc peer remains, unset the
+knob (default ``on``) to turn checksummed sends on everywhere.  A crc
+mismatch raises :class:`FrameCorruptError`, which both seams convert to
+the TRANSIENT class, not Input: the payload was damaged in flight, a
+resend or a different replica plausibly succeeds, and the fleet
+router's failover path (serve/router.py) handles it like any dropped
+connection.  The checksum lives OUTSIDE the JSON on purpose — a flipped
+bit can destroy the body's parseability, so an in-band checksum field
+could never be read back off a corrupt frame.
 """
 
 from __future__ import annotations
@@ -31,9 +49,17 @@ import json
 import os
 import socket
 import struct
+import zlib
 from typing import Optional
 
+from ..utils import faults
+
 _LEN = struct.Struct("!I")
+_CRC = struct.Struct("!I")
+
+# The flag bit caps checksummed frames at 2 GiB - 1; the 64 MiB frame
+# bound (and any sane override) sits far below it.
+_CRC_FLAG = 0x80000000
 
 # 64 MiB default: a 255-group x 255-source query batch plus its response
 # is < 1 MiB of JSON, so this bounds damage, not capability.
@@ -43,6 +69,13 @@ MAX_FRAME_BYTES = 64 << 20
 class ProtocolError(ValueError):
     """Malformed frame (oversized prefix, truncated body, non-JSON,
     non-object payload).  Classified as InputError at the server seam."""
+
+
+class FrameCorruptError(ProtocolError):
+    """A frame whose body does not match its crc32: damaged in flight,
+    not malformed by the sender.  Classified as TransientError at both
+    seams (resend/failover recovers), unlike its ProtocolError parent.
+    """
 
 
 def max_frame_bytes() -> int:
@@ -59,18 +92,54 @@ def max_frame_bytes() -> int:
     return MAX_FRAME_BYTES
 
 
-def encode_frame(obj: dict) -> bytes:
+def crc_sends_enabled() -> bool:
+    """``MSBFS_WIRE_CRC``: ``on`` (default) sends checksummed flagged
+    frames; ``legacy`` (or ``off``/``0``) sends unflagged pre-crc
+    frames that any older peer can parse — the phase-1 setting of the
+    two-phase rolling upgrade (module docstring).  Receiving is NOT
+    gated: flagged frames are verified, unflagged frames accepted,
+    whatever the knob says."""
+    raw = os.environ.get("MSBFS_WIRE_CRC", "on").strip().lower()
+    return raw not in ("legacy", "off", "0")
+
+
+def encode_frame(obj: dict, crc: Optional[bool] = None) -> bytes:
+    """One object -> one frame.  ``crc`` None defers to the
+    ``MSBFS_WIRE_CRC`` knob; True/False force the framing (tests)."""
     body = json.dumps(obj, separators=(",", ":")).encode("utf-8")
-    if len(body) > max_frame_bytes():
+    if len(body) > max_frame_bytes() or len(body) >= _CRC_FLAG:
         raise ProtocolError(
             f"frame of {len(body)} bytes exceeds the "
-            f"{max_frame_bytes()}-byte bound"
+            f"{min(max_frame_bytes(), _CRC_FLAG - 1)}-byte bound"
         )
-    return _LEN.pack(len(body)) + body
+    if crc is None:
+        crc = crc_sends_enabled()
+    if not crc:
+        return _LEN.pack(len(body)) + body
+    return (
+        _LEN.pack(len(body) | _CRC_FLAG)
+        + _CRC.pack(zlib.crc32(body))
+        + body
+    )
 
 
 def send_frame(sock: socket.socket, obj: dict) -> None:
-    sock.sendall(encode_frame(obj))
+    frame = encode_frame(obj)
+    if faults.consume_wire_taint():
+        # ``wire_corrupt`` chaos seam: flip one body bit AFTER the crc32
+        # was computed — the receiver's checksum check is the recovery
+        # path under test (a taint on an empty body degrades to nothing
+        # to flip, which no real frame has).  Legacy-mode frames have no
+        # crc word, so locate the body off the flag bit, not a fixed 8.
+        (prefix_word,) = _LEN.unpack(frame[: _LEN.size])
+        prefix = _LEN.size + (
+            _CRC.size if prefix_word & _CRC_FLAG else 0
+        )
+        if len(frame) > prefix:
+            buf = bytearray(frame)
+            buf[prefix + (len(buf) - prefix) // 2] ^= 0x10
+            frame = bytes(buf)
+    sock.sendall(frame)
 
 
 def _read_exact(sock: socket.socket, count: int) -> Optional[bytes]:
@@ -96,15 +165,29 @@ def recv_frame(sock: socket.socket) -> Optional[dict]:
     header = _read_exact(sock, _LEN.size)
     if header is None:
         return None
-    (length,) = _LEN.unpack(header)
+    (prefix,) = _LEN.unpack(header)
+    want_crc = bool(prefix & _CRC_FLAG)
+    length = prefix & ~_CRC_FLAG
     if length > max_frame_bytes():
         raise ProtocolError(
             f"frame prefix claims {length} bytes, bound is "
             f"{max_frame_bytes()}"
         )
+    crc_expected = None
+    if want_crc:
+        crc_header = _read_exact(sock, _CRC.size)
+        if crc_header is None:
+            raise ProtocolError("connection closed between prefix and crc")
+        (crc_expected,) = _CRC.unpack(crc_header)
     body = _read_exact(sock, length) if length else b""
     if body is None:
         raise ProtocolError("connection closed between prefix and body")
+    if crc_expected is not None and zlib.crc32(body) != crc_expected:
+        raise FrameCorruptError(
+            f"frame crc32 mismatch: expected {crc_expected:#010x}, body "
+            f"hashes to {zlib.crc32(body):#010x} ({length} bytes) — "
+            "frame damaged in flight"
+        )
     try:
         obj = json.loads(body.decode("utf-8"))
     except (UnicodeDecodeError, json.JSONDecodeError) as exc:

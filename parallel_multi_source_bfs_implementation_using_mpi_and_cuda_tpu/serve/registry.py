@@ -26,7 +26,12 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
 
-from ..runtime.supervisor import ChunkSupervisor, InputError, RetryPolicy
+from ..runtime.supervisor import (
+    ChunkSupervisor,
+    CorruptionError,
+    InputError,
+    RetryPolicy,
+)
 from ..utils.io import load_graph_bin
 
 
@@ -56,6 +61,23 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, str(default)))
     except ValueError:
         return default
+
+
+def audit_sample_rate() -> float:
+    """``MSBFS_AUDIT`` (docs/RESILIENCE.md "Silent data corruption"):
+    ``off``/unset/``0`` disables, ``full``/``1`` audits every served
+    f_values call, a float in (0, 1) audits that sampled fraction.
+    Malformed values fall back to off (the repo-wide knob convention)."""
+    raw = os.environ.get("MSBFS_AUDIT", "").strip().lower()
+    if raw in ("", "off", "0"):
+        return 0.0
+    if raw in ("full", "1"):
+        return 1.0
+    try:
+        rate = float(raw)
+    except ValueError:
+        return 0.0
+    return min(max(rate, 0.0), 1.0)
 
 
 # --- MXU tile-index cache (round 8, bounded round 9) -------------------------
@@ -252,6 +274,16 @@ def build_supervised_engine(graph, content_digest: Optional[str] = None) -> Chun
             megachunk=megachunk,
         )
         ladder = _bitbell_ladder(graph, level_chunk)
+    # Output certification (MSBFS_AUDIT): the supervisor audits served
+    # f_values against the host-CSR distance certificate and escalates —
+    # retry, alternate rung, typed CorruptionError — before any
+    # uncertified answer can reach the wire (ops/certify.py).
+    sample = audit_sample_rate()
+    auditor = None
+    if sample > 0.0:
+        from ..ops.certify import make_auditor
+
+        auditor = make_auditor(graph)
     return ChunkSupervisor(
         engine,
         policy=RetryPolicy(
@@ -261,6 +293,8 @@ def build_supervised_engine(graph, content_digest: Optional[str] = None) -> Chun
         ),
         watchdog=_env_float("MSBFS_WATCHDOG", 0.0) or None,
         ladder=ladder,
+        auditor=auditor,
+        audit_sample=sample,
     )
 
 
@@ -303,12 +337,30 @@ class GraphRegistry:
         self._entries: Dict[str, GraphEntry] = {}
         self._lock = threading.Lock()
 
-    def load(self, name: str, path: str) -> GraphEntry:
+    def load(
+        self, name: str, path: str, expected_hash: Optional[str] = None
+    ) -> GraphEntry:
         """Register ``path`` under ``name`` (load-once).  Same name +
         same bytes: returns the existing device-resident entry without
         touching the device.  Same name + different bytes: InputError
-        (use :meth:`reload`)."""
+        (use :meth:`reload`).
+
+        ``expected_hash`` is the integrity contract for re-registration
+        paths that REMEMBER what the bytes used to be — journal replay
+        and fleet reconcile: when the on-disk file no longer hashes to
+        it, registration is refused with a typed
+        :class:`CorruptionError` (the file changed underneath the
+        journal; serving it would silently answer from different data
+        than the journal promised)."""
         digest = content_hash(path)
+        if expected_hash is not None and digest != expected_hash:
+            raise CorruptionError(
+                f"graph {name!r} at {path} hashes to {digest}, but its "
+                f"registration records {expected_hash}: the file changed "
+                "underneath the journal — refusing to re-register "
+                "silently different content",
+                invariants=("content-digest",),
+            )
         with self._lock:
             have = self._entries.get(name)
             if have is not None:

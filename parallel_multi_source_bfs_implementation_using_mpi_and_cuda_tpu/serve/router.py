@@ -21,7 +21,24 @@ attempts.
 
 Chaos seam: every forwarding attempt to replica ``i`` trips fault site
 ``route<i>`` — ``net_drop`` kills the attempt before any bytes move
-(failover rehearsal), ``replica_slow`` stalls it (hedge rehearsal).
+(failover rehearsal), ``replica_slow`` stalls it (hedge rehearsal),
+``wire_corrupt`` taints the next frame sent on the attempt's thread
+(crc rehearsal: the replica's checksum rejects it, the failover walk
+recovers).
+
+Cross-replica voting (docs/RESILIENCE.md "Silent data corruption"): a
+sampled fraction of answered queries (``MSBFS_VOTE`` / ``vote_rate``)
+is shadow-routed to the NEXT live ring owner and the two answers'
+:func:`~..ops.certify.fold_digest` fingerprints are compared.  The
+graphs and query batches are identical and every engine is
+deterministic, so the digests must agree; a mismatch means one replica
+served a silently corrupt answer.  The router then recomputes on a
+third owner to form a majority, quarantines the outvoted replica via
+``quarantine_fn`` (the fleet supervisor's kill-and-let-heartbeat-heal
+path), and returns the majority answer.  With no third opinion
+available the vote is counted ``vote_unresolved``, the shadow replica
+is quarantined (the ring-preferred primary is the better bet), and the
+primary's answer stands.
 """
 
 from __future__ import annotations
@@ -33,6 +50,9 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Set
 
+import numpy as np
+
+from ..ops.certify import fold_digest
 from ..runtime.supervisor import (
     BackpressureError,
     InputError,
@@ -44,6 +64,37 @@ from ..utils import faults
 from . import protocol
 from .client import MsbfsClient, ServerError
 from .ring import PlacementRing
+
+
+def vote_rate_from_env() -> float:
+    """``MSBFS_VOTE`` -> [0, 1] shadow-vote sampling rate.  Same parse
+    convention as the server's ``MSBFS_AUDIT``: ``off``/``0``/unset
+    disable, ``full``/``on``/``1`` vote every query, a float samples;
+    malformed values fall back to off (the repo-wide knob convention).
+    """
+    raw = os.environ.get("MSBFS_VOTE", "").strip().lower()
+    if raw in ("", "off", "0"):
+        return 0.0
+    if raw in ("full", "on", "1"):
+        return 1.0
+    try:
+        rate = float(raw)
+    except ValueError:
+        return 0.0
+    return min(max(rate, 0.0), 1.0)
+
+
+def _answer_digest(out: dict) -> int:
+    """Fingerprint of the answer-bearing response fields.  Routing
+    metadata (latency, bucket, replica) legitimately differs between
+    replicas and is excluded; F values and the argmin selection must be
+    bit-identical — the engines are deterministic functions of (graph
+    digest, query batch)."""
+    f = np.asarray(out.get("f_values", []), dtype=np.int64)
+    best = np.asarray(
+        [out.get("min_f", -1), out.get("min_k", -1)], dtype=np.int64
+    )
+    return fold_digest(f, best)
 
 
 class FleetRouter:
@@ -66,6 +117,8 @@ class FleetRouter:
         alive_fn=None,
         timeout: float = 300.0,
         hedge_after_s: Optional[float] = None,
+        vote_rate: Optional[float] = None,
+        quarantine_fn=None,
     ):
         missing = [m for m in ring.members if m not in addresses]
         if missing:
@@ -76,6 +129,12 @@ class FleetRouter:
         self.alive_fn = alive_fn
         self.timeout = float(timeout)
         self.hedge_after_s = hedge_after_s
+        self.vote_rate = (
+            vote_rate_from_env() if vote_rate is None
+            else min(max(float(vote_rate), 0.0), 1.0)
+        )
+        self.quarantine_fn = quarantine_fn
+        self._vote_acc = 0.0
         self._index = {m: i for i, m in enumerate(ring.members)}
         self._lock = threading.Lock()
         self._stats = {
@@ -84,6 +143,10 @@ class FleetRouter:
             "net_drops": 0,
             "hedged": 0,
             "shed": 0,
+            "votes": 0,
+            "vote_mismatches": 0,
+            "vote_unresolved": 0,
+            "quarantined": 0,
             "per_replica": {m: 0 for m in ring.members},
         }
 
@@ -91,7 +154,13 @@ class FleetRouter:
     def for_fleet(cls, supervisor, **kw) -> "FleetRouter":
         """Router over a live :class:`~.fleet.FleetSupervisor`: shares
         its digest table (registrations made after construction are
-        visible) and routes only to ready replicas."""
+        visible), routes only to ready replicas, and wires vote
+        quarantine to the supervisor's kill-and-heal path (duck-typed
+        like every other read here — a supervisor without one simply
+        gets voting without quarantine)."""
+        kw.setdefault(
+            "quarantine_fn", getattr(supervisor, "quarantine", None)
+        )
         router = cls(
             ring=supervisor.ring,
             addresses={r.name: r.address for r in supervisor.replicas},
@@ -199,6 +268,12 @@ class FleetRouter:
             out = dict(out)
             out["replica"] = member
             out["failovers"] = failovers
+            if self._vote_due():
+                deadline = (
+                    None if deadline_s is None else start + deadline_s
+                )
+                out = self._vote(member, owners, queries, graph,
+                                 deadline, out)
             return out
         if saturated and saturated >= failovers:
             # Every owner we reached said "queue full": the fleet is
@@ -213,6 +288,140 @@ class FleetRouter:
             f"no owner of graph {graph!r} answered "
             f"({failovers} attempt(s); last: {last_err})"
         )
+
+    # ---- cross-replica voting ---------------------------------------------
+    def _vote_due(self) -> bool:
+        """Deterministic accumulator sampling (no RNG — two runs of the
+        same query stream vote the same queries, which keeps chaos
+        tests replayable), same scheme as the supervisor's audit
+        sampler."""
+        if self.vote_rate <= 0.0:
+            return False
+        with self._lock:
+            self._vote_acc += self.vote_rate
+            if self._vote_acc >= 1.0:
+                self._vote_acc -= 1.0
+                return True
+        return False
+
+    def _shadow_query(
+        self, member: str, queries, graph: str, remaining: Optional[float]
+    ) -> Optional[dict]:
+        """One best-effort vote leg to ``member``; None when the leg is
+        unavailable (down, saturated, dropped, deadline spent).  An
+        unavailable leg is NOT evidence of corruption — the vote simply
+        doesn't happen, exactly like a dead owner in the main walk."""
+        if remaining is not None and remaining <= 0:
+            return None
+        try:
+            faults.trip(f"route{self._index[member]}")
+            with MsbfsClient(
+                self.addresses[member],
+                timeout=(
+                    self.timeout if remaining is None
+                    else min(self.timeout, remaining)
+                ),
+                retry=_NO_RETRY,
+            ) as client:
+                return client.query(queries, graph=graph,
+                                    deadline_s=remaining)
+        except (
+            faults.SimulatedNetDrop,
+            ServerError,
+            protocol.ProtocolError,
+            OSError,
+            socket.timeout,
+            ValueError,
+        ):
+            return None
+
+    def _quarantine(self, member: str) -> None:
+        if self.quarantine_fn is None:
+            return
+        try:
+            self.quarantine_fn(member)
+        except Exception:  # noqa: BLE001 — voting must not kill the query
+            return
+        self._bump("quarantined")
+
+    def _vote(
+        self,
+        primary: str,
+        owners: List[str],
+        queries,
+        graph: str,
+        deadline: Optional[float],
+        out: dict,
+    ) -> dict:
+        """Shadow-route the answered batch to the next live owner and
+        compare answer digests; on disagreement recompute on a third
+        owner, quarantine the outvoted replica, and return the majority
+        answer (docstring at module top).  ``deadline`` is an ABSOLUTE
+        ``time.monotonic()`` instant: each vote leg re-derives its
+        residual budget just before it starts, so a slow shadow leg
+        shrinks (never resets) what the arbiter leg may spend and the
+        whole vote stays inside the caller's deadline."""
+
+        def remaining() -> Optional[float]:
+            return None if deadline is None else deadline - time.monotonic()
+
+        later = owners[owners.index(primary) + 1:]
+        if not later:
+            return out  # nobody to vote with (replication 1 / lone survivor)
+        shadow_member = later[0]
+        shadow = self._shadow_query(
+            shadow_member, queries, graph, remaining()
+        )
+        if shadow is None:
+            return out
+        self._bump("votes")
+        out["voted"] = True
+        d_primary = _answer_digest(out)
+        if _answer_digest(shadow) == d_primary:
+            return out
+        self._bump("vote_mismatches")
+        out["vote_mismatch"] = True
+        arbiter_member, arbiter = None, None
+        for m in later[1:]:
+            arbiter = self._shadow_query(m, queries, graph, remaining())
+            if arbiter is not None:
+                arbiter_member = m
+                break
+        if arbiter is None:
+            # Two opinions, no tiebreak: keep the ring-preferred
+            # primary's answer, but take the disagreeing shadow out of
+            # rotation — one of the two IS corrupt, and a quarantined
+            # healthy replica merely restarts while a corrupt answer
+            # left standing keeps lying.
+            self._bump("vote_unresolved")
+            self._quarantine(shadow_member)
+            return out
+        d_arbiter = _answer_digest(arbiter)
+        if d_arbiter == d_primary:
+            self._quarantine(shadow_member)
+            return out
+        shadow = dict(shadow)
+        shadow["replica"] = shadow_member
+        shadow["failovers"] = out.get("failovers", 0)
+        shadow["voted"] = True
+        shadow["vote_mismatch"] = True
+        if d_arbiter == _answer_digest(shadow):
+            # Majority against the primary: ITS answer was the corrupt
+            # one — quarantine it and serve the agreeing pair's answer.
+            self._quarantine(primary)
+            return shadow
+        # Three-way disagreement: at least two corrupt answers.  Trust
+        # nothing we cannot certify here — quarantine both vote legs and
+        # serve the arbiter's answer (the only one not yet outvoted).
+        self._bump("vote_unresolved")
+        self._quarantine(primary)
+        self._quarantine(shadow_member)
+        arbiter = dict(arbiter)
+        arbiter["replica"] = arbiter_member
+        arbiter["failovers"] = out.get("failovers", 0)
+        arbiter["voted"] = True
+        arbiter["vote_mismatch"] = True
+        return arbiter
 
     def stats(self) -> dict:
         with self._lock:

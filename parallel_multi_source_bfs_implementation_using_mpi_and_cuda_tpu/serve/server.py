@@ -46,6 +46,7 @@ import weakref
 
 from ..runtime.supervisor import (
     BackpressureError,
+    CorruptionError,
     InputError,
     MsbfsError,
     PoisonQueryError,
@@ -197,16 +198,23 @@ class MsbfsServer:
         self._replayed = threading.Event()  # registry restored from journal
         self._ready = threading.Event()  # replay AND re-warm finished
         self._journal_stats = {"replayed": 0, "dropped": 0}
+        # Silent-data-corruption defenses (docs/RESILIENCE.md): graphs
+        # whose on-disk bytes flunked the journaled digest at replay.
+        self._refused_graphs: Dict[str, str] = {}
         for name, path in (graphs or {}).items():
             self._register(name, path)
 
     # ---- registration (journal-aware) -------------------------------------
-    def _register(self, name: str, path: str) -> GraphEntry:
+    def _register(
+        self, name: str, path: str, expected_hash: Optional[str] = None
+    ) -> GraphEntry:
         """registry.load + drain-signal hookup + journal append.  Every
         registration path (CLI -g, the load verb, journal replay) funnels
-        through here so none can silently skip the journal."""
+        through here so none can silently skip the journal.
+        ``expected_hash`` (journal replay) refuses typed when the file
+        no longer matches the journaled content digest."""
         known = self.registry.maybe_get(name)
-        entry = self.registry.load(name, path)
+        entry = self.registry.load(name, path, expected_hash=expected_hash)
         entry.supervisor.drain_signal = self._drain_signal
         if self.journal is not None and (known is None or known is not entry):
             self.journal.append(
@@ -284,7 +292,23 @@ class MsbfsServer:
                 if self._stopping.is_set():
                     return
                 try:
-                    entry = self._register(name, path)
+                    # The journaled digest is an integrity contract, not
+                    # a hint: a file whose bytes changed underneath the
+                    # journal is REFUSED typed (CorruptionError) and
+                    # stays out of the registry — an operator must
+                    # re-load it deliberately.  The record stays in the
+                    # journal so a restored file recovers on the next
+                    # restart.
+                    self._register(name, path, expected_hash=digest)
+                except CorruptionError as exc:
+                    with self._stats_lock:
+                        self._refused_graphs[name] = str(exc)
+                    print(
+                        f"msbfs serve: journal replay refused graph "
+                        f"{name!r}: {exc}",
+                        file=sys.stderr,
+                    )
+                    continue
                 except (MsbfsError, OSError, ValueError) as exc:
                     print(
                         f"msbfs serve: journal replay cannot restore "
@@ -292,13 +316,6 @@ class MsbfsServer:
                         file=sys.stderr,
                     )
                     continue
-                if entry.hash != digest:
-                    print(
-                        f"msbfs serve: graph {name!r} content changed "
-                        f"since the journal ({digest} -> {entry.hash}); "
-                        "serving the current file",
-                        file=sys.stderr,
-                    )
             self._replayed.set()
             for name, digest, k_exec, s_pad in sorted(state.warm):
                 if self._stopping.is_set() or self._draining:
@@ -427,10 +444,17 @@ class MsbfsServer:
                 except protocol.ProtocolError as exc:
                     # Answer if the socket still writes, then drop the
                     # connection: framing is unrecoverable mid-stream.
+                    # A crc32 mismatch is the one TRANSIENT shape — the
+                    # frame was damaged in flight, so the caller (and
+                    # the fleet router's failover walk) should resend,
+                    # not fix their input.
+                    err = (
+                        TransientError(str(exc))
+                        if isinstance(exc, protocol.FrameCorruptError)
+                        else InputError(str(exc))
+                    )
                     try:
-                        protocol.send_frame(
-                            conn, protocol.error_body(InputError(str(exc)))
-                        )
+                        protocol.send_frame(conn, protocol.error_body(err))
                     except OSError:
                         pass
                     return
@@ -698,7 +722,9 @@ class MsbfsServer:
                  "k_exec": k_exec, "s_pad": s_pad}
             )
         f = np.asarray(supervisor.f_values(batch)).astype(np.int64)
-        return f, offsets, compiled
+        # MSBFS_AUDIT: the supervisor just audited (or sampled past)
+        # this dispatch; carry the verdict to the per-request responses.
+        return f, offsets, compiled, bool(supervisor.last_audited)
 
     def _execute_batch(
         self, requests: List[QueryRequest], k_exec: int, s_pad: int
@@ -727,7 +753,7 @@ class MsbfsServer:
             return
         k_exec = pow2_pad(sum(r.k for r in requests))
         try:
-            f, offsets, compiled = self._dispatch_group(
+            f, offsets, compiled, audited = self._dispatch_group(
                 entry, requests, k_exec, s_pad
             )
         except Exception as exc:  # noqa: BLE001 — typed per-request failure
@@ -743,7 +769,9 @@ class MsbfsServer:
                 req.done.set()
             return
         self._note_recovery(entry)
-        self._finish_batch(requests, f, offsets, compiled, k_exec, s_pad)
+        self._finish_batch(
+            requests, f, offsets, compiled, k_exec, s_pad, audited
+        )
 
     def _quarantine(
         self,
@@ -771,7 +799,7 @@ class MsbfsServer:
                 continue
             k_exec = pow2_pad(sum(r.k for r in group))
             try:
-                f, offsets, compiled = self._dispatch_group(
+                f, offsets, compiled, audited = self._dispatch_group(
                     entry, group, k_exec, s_pad
                 )
             except Exception as exc:  # noqa: BLE001 — keep bisecting
@@ -790,7 +818,9 @@ class MsbfsServer:
                     self._quarantine(entry, group, s_pad, err)
                 continue
             self._note_recovery(entry)
-            self._finish_batch(group, f, offsets, compiled, k_exec, s_pad)
+            self._finish_batch(
+                group, f, offsets, compiled, k_exec, s_pad, audited
+            )
 
     def _finish_batch(
         self,
@@ -800,6 +830,7 @@ class MsbfsServer:
         compiled: bool,
         k_exec: int,
         s_pad: int,
+        audited: bool = False,
     ) -> None:
         """Scatter one successful dispatch back to its requests."""
         label = bucket_label(requests[0].graph_key, k_exec, s_pad)
@@ -834,6 +865,7 @@ class MsbfsServer:
                 "bucket": [k_exec, s_pad],
                 "compiled": bool(compiled),
                 "batched_with": len(requests) - 1,
+                "audited": bool(audited),
                 "latency_ms": round(latency_ms, 3),
             }
             req.done.set()
@@ -858,6 +890,14 @@ class MsbfsServer:
             total = self._requests_total
             shed = self._shed_requests
             quarantined = self._quarantined_requests
+            refused = dict(self._refused_graphs)
+        audited = 0
+        audit_failures = 0
+        for entry in self.registry.describe():
+            sup = self.registry.maybe_get(entry)
+            if sup is not None:
+                audited += int(sup.supervisor.audited_total)
+                audit_failures += int(sup.supervisor.audit_failures_total)
         return {
             "uptime_s": round(time.time() - self.started, 3),
             "ready": self._ready.is_set(),
@@ -883,6 +923,9 @@ class MsbfsServer:
             "requests_failed": failed,
             "requests_shed": shed,
             "requests_quarantined": quarantined,
+            "audited": audited,
+            "audit_failures": audit_failures,
+            "refused_graphs": refused,
             "recovery_events": recovery,
         }
 

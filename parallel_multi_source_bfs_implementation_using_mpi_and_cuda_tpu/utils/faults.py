@@ -60,6 +60,24 @@ journal, serve/journal.py).  Kinds:
                the connection to that replica "dies" before the request
                is sent, so the router must fail over to the next ring
                owner without the replica ever seeing the query.
+``bitflip``    site must be ``plane<i>`` or ``dist``; a *mutating* fault:
+               instead of raising, it flips one deterministic bit in a
+               live buffer.  ``plane<i>`` fires at the ``i``-th chunk
+               boundary of the host drive loop (ops/bfs.py) and corrupts
+               the BFS state carry; ``dist`` fires at the supervisor's
+               result-materialize seam and corrupts the F buffer.  The
+               seams call :func:`corrupt` (not :func:`trip`) because the
+               fault's effect is data, not control flow — silent data
+               corruption, byte-for-byte what a flaky HBM cell or a bad
+               DMA looks like (docs/RESILIENCE.md "Silent data
+               corruption").
+``wire_corrupt``  site must be ``route<r>``; trips on the router's
+               forwarding seam like ``net_drop`` but instead of raising
+               it ARMS a thread-local taint that the very next
+               :func:`..serve.protocol.send_frame` on that thread
+               consumes, flipping one bit in the frame body AFTER the
+               crc32 was computed — so the receiver's checksum check is
+               what must catch it.
 
 Example: ``MSBFS_FAULTS="io:load_graph:1,oom:dispatch:2,hang:dispatch:3,
 chip:rank1:1"``.  Trip counters are plain per-site integers, so a given
@@ -78,12 +96,14 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 KINDS = ("io", "corrupt", "oom", "transient", "hang", "chip", "crash",
-         "poison", "replica_kill", "replica_slow", "net_drop")
+         "poison", "replica_kill", "replica_slow", "net_drop", "bitflip",
+         "wire_corrupt")
 
 _RANK_RE = re.compile(r"rank(\d+)\Z")
 _VERTEX_RE = re.compile(r"vertex(\d+)\Z")
 _REPLICA_RE = re.compile(r"replica(\d+)\Z")
 _ROUTE_RE = re.compile(r"route(\d+)\Z")
+_PLANE_RE = re.compile(r"plane(\d+)\Z")
 
 
 class SimulatedResourceExhausted(RuntimeError):
@@ -231,7 +251,7 @@ class FaultPlan:
                         "site replica<r> (e.g. replica_kill:replica0:3)"
                     )
                 replica = int(m.group(1))
-            if kind in ("replica_slow", "net_drop"):
+            if kind in ("replica_slow", "net_drop", "wire_corrupt"):
                 m = _ROUTE_RE.match(site)
                 if not m:
                     raise ValueError(
@@ -239,6 +259,13 @@ class FaultPlan:
                         f"route<r> (e.g. {kind}:route1:1)"
                     )
                 replica = int(m.group(1))
+            if kind == "bitflip" and site != "dist" \
+                    and not _PLANE_RE.match(site):
+                raise ValueError(
+                    f"fault spec {raw!r}: bitflip faults need site "
+                    "plane<i> or dist (e.g. bitflip:plane0:1, "
+                    "bitflip:dist:1)"
+                )
             specs.append(FaultSpec(kind=kind, site=site, at=at, rank=rank,
                                    vertex=vertex, replica=replica))
         return cls(specs, hang_seconds=hang_seconds,
@@ -309,7 +336,10 @@ class FaultPlan:
             due = [
                 s
                 for s in self.specs
-                if s.kind != "poison"
+                # bitflip is a mutating fault: it is delivered by
+                # :meth:`corrupt` (which hands back a modified buffer),
+                # never by a raise-style trip.
+                if s.kind not in ("poison", "bitflip")
                 and s.trip_site == site
                 and s.at == count
                 and not s.fired
@@ -331,6 +361,36 @@ class FaultPlan:
     def pending(self) -> List[FaultSpec]:
         with self._lock:
             return [s for s in self.specs if not s.fired]
+
+    def bitflip_armed(self) -> bool:
+        """True while any bitflip spec is still unfired — the drive
+        loops' cheap gate before paying a host round-trip for a buffer
+        they would otherwise never materialize."""
+        return any(s.kind == "bitflip" and not s.fired for s in self.specs)
+
+    def corrupt(self, site: str, arr):
+        """The mutating seam: one execution of ``site`` against buffer
+        ``arr``.  Counts the trip exactly like :meth:`trip`; when a
+        ``bitflip`` spec is due, returns a COPY of ``arr`` with one
+        deterministic bit flipped (position keyed on the site name, so a
+        given plan corrupts the same bit every replay).  Returns ``arr``
+        unchanged when nothing is due."""
+        with self._lock:
+            count = self.counters.get(site, 0) + 1
+            self.counters[site] = count
+            due = [
+                s
+                for s in self.specs
+                if s.kind == "bitflip"
+                and s.site == site
+                and s.at == count
+                and not s.fired
+            ]
+            for s in due:
+                s.fired = True
+        if not due:
+            return arr
+        return _flip_bit(arr, site)
 
     def _fire(self, s: FaultSpec) -> None:
         where = f"at {s.site} (trip {s.at})"
@@ -381,6 +441,12 @@ class FaultPlan:
                 f"{s.replica} {where}",
                 s.replica,
             )
+        if s.kind == "wire_corrupt":
+            # Not a raise: the routed call must PROCEED so the corrupt
+            # frame actually crosses the wire — the crc32 check on the
+            # receiving side is the recovery path under test.
+            arm_wire_corruption()
+            return
         raise AssertionError(f"unreachable kind {s.kind!r}")
 
 
@@ -407,6 +473,58 @@ def trip(site: str, context=None) -> None:
     carries the site's payload for data-dependent kinds (poison)."""
     if _active is not None:
         _active.trip(site, context)
+
+
+def corruption_armed() -> bool:
+    """Cheap gate for the mutating seams: True only while the active
+    plan still has an unfired ``bitflip`` spec.  The drive loops check
+    this before materializing any device buffer, so the seam costs one
+    attribute read on every fault-free chunk."""
+    return _active is not None and _active.bitflip_armed()
+
+
+def corrupt(site: str, arr):
+    """Mutating seam entry point (``bitflip`` kinds): returns ``arr``,
+    or a copy with one bit flipped when a spec is due at ``site``."""
+    if _active is None:
+        return arr
+    return _active.corrupt(site, arr)
+
+
+def _flip_bit(arr, token: str):
+    """Flip one bit of ``arr`` (any array-like), position keyed on
+    ``token`` — deterministic, so a fault plan replays byte-for-byte.
+    Returns a fresh numpy array; the caller rebinds it in place of the
+    original (a device array round-trips through the host, exactly like
+    a corrupted DMA would look to the next dispatch)."""
+    import zlib
+
+    import numpy as np
+
+    out = np.array(arr, copy=True)
+    flat = out.view(np.uint8).reshape(-1)
+    if flat.size == 0:
+        return out
+    bit = zlib.crc32(token.encode()) % (flat.size * 8)
+    flat[bit // 8] ^= np.uint8(1 << (bit % 8))
+    return out
+
+
+# ---- wire taint (wire_corrupt) --------------------------------------------
+_WIRE_TAINT = threading.local()
+
+
+def arm_wire_corruption() -> None:
+    """Arm the thread-local taint: the next frame this thread sends has
+    one body bit flipped after its crc32 is computed."""
+    _WIRE_TAINT.armed = True
+
+
+def consume_wire_taint() -> bool:
+    """Check-and-clear the taint (called by ``protocol.send_frame``)."""
+    armed = getattr(_WIRE_TAINT, "armed", False)
+    _WIRE_TAINT.armed = False
+    return armed
 
 
 class injected:

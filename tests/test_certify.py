@@ -1,0 +1,652 @@
+"""Output certification (docs/RESILIENCE.md "Silent data corruption").
+
+Four layers of the distrust-the-hardware defense, bottom up:
+
+* the distance certificate itself (ops/certify.py): the four invariants
+  — source-zero, zero-is-source, edge-relaxation, witness — plus the
+  f-mismatch comparison, each unit-tested in isolation, and the
+  100%-detection property: a BFS distance field is UNIQUE, so flipping
+  ANY single bit of a certified field must flunk some invariant;
+* the fault seams (utils/faults.py): ``bitflip:plane<i>`` at the
+  plane-commit boundary of the host chunk loop, ``bitflip:dist`` at
+  result materialize, the thread-local wire taint behind
+  ``wire_corrupt`` — each flips exactly one deterministic bit;
+* the supervisor escalation ladder (runtime/supervisor.py): audit
+  failure -> retry same engine -> alternate engine -> typed
+  CorruptionError (exit code 9), never an uncertified answer once an
+  attempt flunked;
+* the serving daemon: MSBFS_AUDIT wiring into per-request ``audited``
+  and the stats verb, crc32 frame integrity on the wire, and journal
+  replay refusing a graph whose bytes changed under the journal.
+"""
+
+import json
+import socket
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    CSRGraph,
+    pad_queries,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops import (
+    certify,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+    BitBellEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (
+    ChunkSupervisor,
+    CorruptionError,
+    TransientError,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve import (
+    protocol,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils import (
+    faults,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+    save_graph_bin,
+    save_query_bin,
+)
+
+
+# ---------------------------------------------------------------------------
+# fold_digest
+# ---------------------------------------------------------------------------
+
+
+def test_fold_digest_is_deterministic_and_position_sensitive():
+    a = np.arange(64, dtype=np.int64)
+    assert certify.fold_digest(a) == certify.fold_digest(a.copy())
+    # Same multiset of words in a different order must change the
+    # digest: a plain xor-fold would be blind to transpositions, which
+    # is exactly what a swapped DMA looks like.
+    b = a.copy()
+    b[3], b[11] = b[11], b[3]
+    assert certify.fold_digest(a) != certify.fold_digest(b)
+    # Ordinal sensitivity across arrays: (x, y) vs (y, x).
+    x, y = np.arange(8), np.arange(8, 16)
+    assert certify.fold_digest(x, y) != certify.fold_digest(y, x)
+    # Any single-bit flip moves the digest.
+    c = a.copy()
+    c[20] ^= 1 << 17
+    assert certify.fold_digest(a) != certify.fold_digest(c)
+    assert certify.fold_digest(np.zeros(0, dtype=np.int64)) >= 0
+
+
+# ---------------------------------------------------------------------------
+# the certificate: reference sweep + invariants
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cert_workload():
+    from oracle import oracle_bfs
+
+    n, edges = generators.gnm_edges(180, 540, seed=911)
+    g = CSRGraph.from_edges(n, edges)
+    # K=70 crosses the 64-query uint64 word boundary of the audit
+    # sweep's bit-plane packing; arms include an empty group and an
+    # all-out-of-range group (both must certify as all -1).
+    queries = generators.random_queries(n, 70, max_group=4, seed=912)
+    queries[5] = np.zeros(0, dtype=np.int32)
+    queries[9] = np.array([-3, n + 7], dtype=np.int32)
+    padded = pad_queries(queries)
+    dist_ref = np.asarray(
+        [oracle_bfs(n, edges, q) for q in queries], dtype=np.int32
+    )
+    return g, padded, dist_ref
+
+
+def test_reference_distances_match_oracle(cert_workload):
+    g, padded, dist_ref = cert_workload
+    dist = certify.reference_distances(g.row_offsets, g.col_indices, padded)
+    np.testing.assert_array_equal(dist, dist_ref)
+    assert (
+        certify.certify_distances(g.row_offsets, g.col_indices, padded, dist)
+        == []
+    )
+
+
+def test_reference_distances_edgeless_graph():
+    g = CSRGraph.from_edges(5, np.zeros((0, 2), dtype=np.int64))
+    padded = pad_queries([np.array([2], dtype=np.int32)])
+    dist = certify.reference_distances(g.row_offsets, g.col_indices, padded)
+    want = np.full((1, 5), -1, dtype=np.int32)
+    want[0, 2] = 0
+    np.testing.assert_array_equal(dist, want)
+    assert (
+        certify.certify_distances(g.row_offsets, g.col_indices, padded, dist)
+        == []
+    )
+
+
+def test_reference_distances_trailing_isolated_vertex():
+    """Regression: the reduceat segment starts used to be clamped to
+    E - 1, so a trailing isolated vertex (whose CSR row starts at E)
+    stole the final edge slot from the last non-empty row — here vertex
+    3's [1, 2] adjacency lost its slot for 2, the sweep never reached 3
+    from source 2, and the TRUE field flunked its own witness check.
+    The pad-row reduction must keep both of vertex 3's slots."""
+    edges = np.array([[0, 1], [1, 3], [2, 3]], dtype=np.int64)
+    g = CSRGraph.from_edges(5, edges)  # chain 0-1-3-2, vertex 4 isolated
+    padded = pad_queries([np.array([2], dtype=np.int32)])
+    dist = certify.reference_distances(g.row_offsets, g.col_indices, padded)
+    np.testing.assert_array_equal(
+        dist, np.array([[3, 2, 0, 1, -1]], dtype=np.int32)
+    )
+    # Both reduceat sites: the recompute sweep above, the witness check
+    # here — the true field must certify clean end to end.
+    assert (
+        certify.certify_distances(g.row_offsets, g.col_indices, padded, dist)
+        == []
+    )
+    assert (
+        certify.audit_f_values(
+            g.row_offsets, g.col_indices, padded, np.array([6])
+        )
+        == []
+    )
+
+
+def _path4():
+    """0-1-2-3 path; query from vertex 0: dist = [0, 1, 2, 3]."""
+    edges = np.array([[0, 1], [1, 2], [2, 3]], dtype=np.int64)
+    g = CSRGraph.from_edges(4, edges)
+    padded = pad_queries([np.array([0], dtype=np.int32)])
+    dist = np.array([[0, 1, 2, 3]], dtype=np.int32)
+    return g, padded, dist
+
+
+@pytest.mark.parametrize(
+    "mutate,expect",
+    [
+        (lambda d: d.__setitem__((0, 0), 1), "source-zero"),
+        (lambda d: d.__setitem__((0, 2), 0), "zero-is-source"),
+        (lambda d: d.__setitem__((0, 3), 9), "edge-relaxation"),
+        (lambda d: d.__setitem__((0, 3), -1), "edge-relaxation"),
+    ],
+    ids=["source-zero", "zero-is-source", "jump", "unreached-neighbor"],
+)
+def test_certify_distances_flags_each_invariant(mutate, expect):
+    g, padded, dist = _path4()
+    assert (
+        certify.certify_distances(g.row_offsets, g.col_indices, padded, dist)
+        == []
+    )
+    bad = dist.copy()
+    mutate(bad)
+    assert expect in certify.certify_distances(
+        g.row_offsets, g.col_indices, padded, bad
+    )
+
+
+def test_certify_distances_witness_needs_a_parent():
+    # Two components: {0,1} holds the source, {2,3} is unreachable.
+    # Claiming dist 1/2 on the far component is edge-consistent on the
+    # (2,3) edge in BOTH directions — only the witness invariant (every
+    # dist>=1 vertex has a neighbor at dist-1) can reject it.
+    edges = np.array([[0, 1], [2, 3]], dtype=np.int64)
+    g = CSRGraph.from_edges(4, edges)
+    padded = pad_queries([np.array([0], dtype=np.int32)])
+    good = np.array([[0, 1, -1, -1]], dtype=np.int32)
+    assert (
+        certify.certify_distances(g.row_offsets, g.col_indices, padded, good)
+        == []
+    )
+    bad = np.array([[0, 1, 1, 2]], dtype=np.int32)
+    assert "witness" in certify.certify_distances(
+        g.row_offsets, g.col_indices, padded, bad
+    )
+
+
+def test_certificate_detects_every_single_bit_flip(cert_workload):
+    """The 100%-detection property.  The BFS distance field for a given
+    graph + source set is unique, so ANY bit flip that changes the
+    field must flunk some invariant.  Sweep a deterministic sample of
+    bit positions across the whole buffer — every flip detected."""
+    g, padded, dist_ref = cert_workload
+    flat = dist_ref.view(np.uint8).reshape(-1)
+    total_bits = flat.size * 8
+    # ~200 positions, deterministically spread over the buffer.
+    for bit in range(0, total_bits, max(1, total_bits // 200)):
+        bad = dist_ref.copy()
+        bflat = bad.view(np.uint8).reshape(-1)
+        bflat[bit // 8] ^= np.uint8(1 << (bit % 8))
+        failing = certify.certify_distances(
+            g.row_offsets, g.col_indices, padded, bad
+        )
+        assert failing, f"bit {bit}: corrupt field certified clean"
+
+
+def test_audit_f_values_clean_and_tampered(cert_workload):
+    g, padded, dist_ref = cert_workload
+    f = certify.f_from_distances(dist_ref)
+    assert (
+        certify.audit_f_values(g.row_offsets, g.col_indices, padded, f) == []
+    )
+    # Every single-bit flip of the F buffer itself is caught: the audit
+    # recomputes F from scratch, so any altered word mismatches.
+    flat_bits = f.size * 64
+    for bit in range(0, flat_bits, max(1, flat_bits // 64)):
+        bad = f.copy()
+        bflat = bad.view(np.uint8).reshape(-1)
+        bflat[bit // 8] ^= np.uint8(1 << (bit % 8))
+        assert "f-mismatch" in certify.audit_f_values(
+            g.row_offsets, g.col_indices, padded, bad
+        )
+
+
+# ---------------------------------------------------------------------------
+# fault seams through a real engine + supervisor
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def seam_workload():
+    # 16x16 road lattice: n = 256 exactly fills the Bell block (no
+    # padding rows for a flip to land in), K = 32 exactly fills the
+    # uint32 plane word (no padding lanes), and the ~30-level diameter
+    # gives the level_chunk=1 drive loop a long run of plane<i> seams.
+    n, edges = generators.road_edges(16, 16, seed=901)
+    g = CSRGraph.from_edges(n, edges)
+    queries = generators.random_queries(n, 32, max_group=3, seed=902)
+    padded = pad_queries(queries)
+
+    def make():
+        return BitBellEngine(BellGraph.from_host(g), level_chunk=1)
+
+    clean = np.asarray(make().f_values(padded))
+    return g, padded, make, clean
+
+
+# Sites whose deterministic crc32-keyed flip lands on a bit that alters
+# the answer on THIS fixture (pinned seeds, pinned site names — stable
+# forever).  The other plane sites flip a settled visited bit the
+# frontier has already passed: the answer is unchanged, so there is
+# nothing for an end-to-end output audit to detect (a benign upset).
+_ANSWER_CORRUPTING = {"plane1", "dist"}
+
+
+@pytest.mark.parametrize(
+    "site", ["plane0", "plane1", "plane2", "plane3", "dist"]
+)
+def test_single_bitflip_at_each_seam_never_escapes(site, seam_workload):
+    g, padded, make, clean = seam_workload
+    # Arm the same plan WITHOUT an auditor: this is what the flip does
+    # to an unprotected run, and pins which sites corrupt the answer.
+    with faults.injected(faults.FaultPlan.parse(f"bitflip:{site}:1")):
+        unprotected = np.asarray(
+            ChunkSupervisor(make(), auditor=None).f_values(padded)
+        )
+    corrupts = not np.array_equal(unprotected, clean)
+    assert corrupts == (site in _ANSWER_CORRUPTING)
+    # The audited run: the answer served is ALWAYS the clean one — the
+    # flip either never touched the output, or the audit caught it and
+    # the retry (fault fired, second run clean) recovered.
+    with faults.injected(faults.FaultPlan.parse(f"bitflip:{site}:1")):
+        sup = ChunkSupervisor(
+            make(), auditor=certify.make_auditor(g), audit_sample=1.0
+        )
+        audited = np.asarray(sup.f_values(padded))
+    np.testing.assert_array_equal(audited, clean)
+    if corrupts:
+        assert sup.audit_failures_total == 1
+        assert sup.audited_total == 2  # failed attempt + clean retry
+        assert [e["action"] for e in sup.events] == ["audit_fail"]
+    else:
+        assert sup.audit_failures_total == 0
+
+
+def test_plane_trail_digests_are_deterministic_and_flip_sensitive(
+    seam_workload,
+):
+    g, padded, make, clean = seam_workload
+    certify.start_plane_trail()
+    make().f_values(padded)
+    first = certify.stop_plane_trail()
+    assert first, "chunked drive loop journaled no plane digests"
+    certify.start_plane_trail()
+    make().f_values(padded)
+    assert certify.stop_plane_trail() == first
+    certify.start_plane_trail()
+    with faults.injected(faults.FaultPlan.parse("bitflip:plane1:1")):
+        make().f_values(padded)
+    flipped = certify.stop_plane_trail()
+    assert flipped != first  # the corrupted commit shows in the trail
+
+
+class _LyingEngine:
+    """Adds 1 to every F value — a persistent corruption no retry on
+    the same engine can clear."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def f_values(self, queries):
+        return np.asarray(self._inner.f_values(queries)) + 1
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_supervisor_escalates_persistent_corruption(seam_workload):
+    g, padded, make, clean = seam_workload
+    auditor = certify.make_auditor(g)
+    sup = ChunkSupervisor(
+        _LyingEngine(make()), auditor=auditor, audit_sample=1.0
+    )
+    with pytest.raises(CorruptionError) as err:
+        sup.f_values(padded)
+    assert err.value.exit_code == 9
+    assert "f-mismatch" in err.value.invariants
+    assert sup.audit_failures_total >= 2  # first attempt + forced retry
+
+
+def test_supervisor_audit_ladder_swaps_in_a_clean_engine(seam_workload):
+    g, padded, make, clean = seam_workload
+    lying = _LyingEngine(make())
+    sup = ChunkSupervisor(
+        lying,
+        ladder=[("bitbell-clean", make)],
+        auditor=certify.make_auditor(g),
+        audit_sample=1.0,
+    )
+    out = np.asarray(sup.f_values(padded))
+    np.testing.assert_array_equal(out, clean)
+    assert "audit_degrade" in [e["action"] for e in sup.events]
+    # The audit stepdown is per-call, not a permanent downgrade: the
+    # original engine is restored once the clean recompute settles, and
+    # the rung it borrowed is NOT consumed from the capacity-degrade
+    # ladder (a transient double-upset must leave both intact).
+    assert sup.engine is lying
+    assert len(sup.ladder) == 1
+    # ... so a later call escalates (and recovers) all over again.
+    out2 = np.asarray(sup.f_values(padded))
+    np.testing.assert_array_equal(out2, clean)
+    assert len(sup.ladder) == 1
+
+
+def test_audit_sampling_accumulator():
+    sup = ChunkSupervisor(object(), auditor=lambda q, f: [], audit_sample=0.25)
+    due = [sup._audit_due() for _ in range(8)]
+    assert due == [False, False, False, True] * 2
+
+
+# ---------------------------------------------------------------------------
+# the wire seam: crc32 framing
+# ---------------------------------------------------------------------------
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_frames_carry_crc_and_roundtrip():
+    a, b = _pair()
+    try:
+        protocol.send_frame(a, {"op": "ping", "x": [1, 2, 3]})
+        assert protocol.recv_frame(b) == {"op": "ping", "x": [1, 2, 3]}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_legacy_unflagged_frame_still_accepted():
+    a, b = _pair()
+    try:
+        body = json.dumps({"op": "old"}).encode()
+        a.sendall(struct.pack("!I", len(body)) + body)  # no crc flag
+        assert protocol.recv_frame(b) == {"op": "old"}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_legacy_send_mode_emits_parseable_prefix(monkeypatch):
+    """MSBFS_WIRE_CRC=legacy (phase 1 of a rolling upgrade) must emit
+    frames a pre-crc peer can parse: plain length prefix, high bit
+    clear, no crc word — while flagged frames are still verified on
+    receive (the knob gates sends only)."""
+    monkeypatch.setenv("MSBFS_WIRE_CRC", "legacy")
+    frame = protocol.encode_frame({"op": "ping"})
+    (prefix,) = struct.unpack("!I", frame[:4])
+    assert not (prefix & 0x80000000)  # old peers read this as a length
+    assert prefix == len(frame) - 4  # and the body follows directly
+    a, b = _pair()
+    try:
+        protocol.send_frame(a, {"op": "ping"})
+        assert protocol.recv_frame(b) == {"op": "ping"}
+        # Receive-side verification is NOT gated by the knob.
+        flagged = protocol.encode_frame({"op": "ping"}, crc=True)
+        bad = bytearray(flagged)
+        bad[-1] ^= 0x04
+        a.sendall(bytes(bad))
+        with pytest.raises(protocol.FrameCorruptError):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_any_single_bit_flip_on_the_wire_is_detected():
+    payload = {"op": "query", "queries": [[1, 2], [3, 4]], "graph": "g"}
+    frame = protocol.encode_frame(payload)
+    body = frame[8:]  # 4-byte length|flag prefix + 4-byte crc32
+    for bit in range(0, len(body) * 8, max(1, len(body) * 8 // 96)):
+        a, b = _pair()
+        try:
+            bad = bytearray(frame)
+            bad[8 + bit // 8] ^= 1 << (bit % 8)
+            a.sendall(bytes(bad))
+            with pytest.raises(protocol.FrameCorruptError):
+                protocol.recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+
+def test_wire_taint_corrupts_exactly_one_frame():
+    a, b = _pair()
+    try:
+        faults.arm_wire_corruption()
+        protocol.send_frame(a, {"op": "ping"})
+        with pytest.raises(protocol.FrameCorruptError):
+            protocol.recv_frame(b)
+        # Taint consumed: the next frame is clean.
+        protocol.send_frame(a, {"op": "ping"})
+        assert protocol.recv_frame(b) == {"op": "ping"}
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# the serving daemon: MSBFS_AUDIT, stats, journal digest refusal
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served_graph(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certify_graphs")
+    n, edges = generators.gnm_edges(120, 360, seed=921)
+    path = str(d / "g.bin")
+    save_graph_bin(path, n, edges)
+    return n, path
+
+
+def _start_server(tmp_path, graph_path, **kwargs):
+    import os
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (
+        MsbfsServer,
+    )
+
+    sock = str(tmp_path / f"s{len(os.listdir(tmp_path))}.sock")
+    srv = MsbfsServer(
+        listen=f"unix:{sock}",
+        graphs={"default": graph_path} if graph_path else {},
+        window_s=0.0,
+        request_timeout_s=60.0,
+        **kwargs,
+    )
+    srv.start()
+    return srv, f"unix:{sock}"
+
+
+def test_server_full_audit_marks_responses_and_stats(
+    served_graph, tmp_path, monkeypatch
+):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (
+        MsbfsClient,
+    )
+
+    monkeypatch.setenv("MSBFS_AUDIT", "full")
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    _, path = served_graph
+    srv, addr = _start_server(tmp_path, path)
+    try:
+        with MsbfsClient(addr) as c:
+            out = c.query([[1, 2], [3, 4]])
+            assert out["audited"] is True
+            stats = c.stats()
+            assert stats["audited"] >= 1
+            assert stats["audit_failures"] == 0
+            assert stats["refused_graphs"] == {}
+    finally:
+        faults.activate(None)
+        srv.stop()
+
+
+def test_server_audit_off_leaves_requests_unaudited(
+    served_graph, tmp_path, monkeypatch
+):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (
+        MsbfsClient,
+    )
+
+    monkeypatch.setenv("MSBFS_AUDIT", "off")
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    _, path = served_graph
+    srv, addr = _start_server(tmp_path, path)
+    try:
+        with MsbfsClient(addr) as c:
+            out = c.query([[1, 2], [3, 4]])
+            assert out["audited"] is False
+            assert c.stats()["audited"] == 0
+    finally:
+        faults.activate(None)
+        srv.stop()
+
+
+def test_journal_replay_refuses_swapped_graph_bytes(
+    served_graph, tmp_path, monkeypatch
+):
+    """The file changed underneath the journal: replay must refuse the
+    registration typed and report it — never silently serve different
+    content than the journal promised."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (
+        MsbfsClient,
+        ServerError,
+    )
+
+    monkeypatch.delenv("MSBFS_AUDIT", raising=False)
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    n, edges = generators.gnm_edges(80, 200, seed=922)
+    path = str(tmp_path / "swap.bin")
+    save_graph_bin(path, n, edges)
+    journal = str(tmp_path / "state.journal")
+    srv_a, addr_a = _start_server(tmp_path, path, journal_path=journal)
+    try:
+        with MsbfsClient(addr_a) as c:
+            c.query([[1, 2]], graph="default")
+    finally:
+        srv_a.stop()
+    # Same path, silently different bytes — the corruption under test.
+    n2, edges2 = generators.gnm_edges(80, 200, seed=923)
+    save_graph_bin(path, n2, edges2)
+    srv_b, addr_b = _start_server(tmp_path, None, journal_path=journal)
+    try:
+        assert srv_b._ready.wait(120), "journal replay never finished"
+        with MsbfsClient(addr_b) as c:
+            refused = c.stats()["refused_graphs"]
+            assert "default" in refused
+            assert "refusing" in refused["default"]
+            with pytest.raises(ServerError) as err:
+                c.query([[1, 2]], graph="default")
+            assert err.value.exit_code == 1  # unregistered -> InputError
+    finally:
+        srv_b.stop()
+
+
+# ---------------------------------------------------------------------------
+# the verify CLI verb
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def verify_files(tmp_path_factory):
+    from oracle import oracle_bfs, oracle_f
+
+    d = tmp_path_factory.mktemp("certify_verify")
+    n, edges = generators.gnm_edges(90, 260, seed=931)
+    gpath = str(d / "g.bin")
+    save_graph_bin(gpath, n, edges)
+    queries = generators.random_queries(n, 6, max_group=3, seed=932)
+    qpath = str(d / "q.bin")
+    save_query_bin(qpath, [list(map(int, q)) for q in queries])
+    f_true = [int(oracle_f(oracle_bfs(n, edges, q))) for q in queries]
+    return gpath, qpath, f_true
+
+
+def test_verify_certifies_engine_output(verify_files, capsys, monkeypatch):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+        cli,
+    )
+
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    gpath, qpath, _ = verify_files
+    rc = cli.main(["msbfs", "verify", "-g", gpath, "-q", qpath])
+    faults.activate(None)
+    assert rc == 0
+    assert "CERTIFIED" in capsys.readouterr().out
+
+
+def test_verify_certifies_stored_f_and_rejects_corrupt(
+    verify_files, capsys, monkeypatch
+):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+        cli,
+    )
+
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    gpath, qpath, f_true = verify_files
+    rc = cli.main(
+        ["msbfs", "verify", "-g", gpath, "-q", qpath,
+         "--expect-f", json.dumps(f_true)]
+    )
+    assert rc == 0
+    bad = list(f_true)
+    bad[0] ^= 1 << 7  # one flipped bit in the stored answer
+    rc = cli.main(
+        ["msbfs", "verify", "-g", gpath, "-q", qpath,
+         "--expect-f", json.dumps(bad)]
+    )
+    faults.activate(None)
+    assert rc == 9
+    err = capsys.readouterr().err
+    assert "f-mismatch" in err

@@ -476,3 +476,41 @@ def test_engine_agrees_banded(banded_workload, name):
         else (-1, -1)
     )
     assert eng.best(padded) == want
+
+
+# The certification arm of the same matrix (docs/RESILIENCE.md "Silent
+# data corruption"): every engine's output must pass the trustless
+# distance-certificate audit — recompute via the independent host
+# bit-plane sweep, certify the recompute's invariants, compare F.
+# Unlike the oracle check above this is exactly what MSBFS_AUDIT runs
+# in production, so the matrix proves the auditor accepts every
+# engine's real output (no false alarms engine-by-engine).  Tier-1
+# keeps one arm per engine family; drive-loop variants ride
+# `make audit`.
+AUDIT_SLOW = {
+    "bitbell_chunked",
+    "bitbell_megachunk",
+    "mxu_chunked",
+    "mxu_switch",
+    "packed_push",
+    "distributed_chunked",
+    "distributed_push",
+    "sharded_bell_sparse",
+    "sharded_push",
+}
+
+
+@pytest.mark.parametrize("name", _arms(ENGINES, slow=AUDIT_SLOW))
+def test_engine_output_audits(workload, name):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops import (
+        certify,
+    )
+
+    g, padded, reference = workload
+    if name.startswith(("distributed", "sharded")) and len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device test mesh")
+    eng = ENGINES[name](g)
+    f = np.asarray(eng.f_values(padded), dtype=np.int64)
+    assert (
+        certify.audit_f_values(g.row_offsets, g.col_indices, padded, f) == []
+    )

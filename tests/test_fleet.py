@@ -725,3 +725,270 @@ def test_fleet_chaos_kill_failover_restart(tmp_path):
         faults.activate(None)
         supervisor.stop()
         oracle_srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Cross-replica voting (docs/RESILIENCE.md "Silent data corruption")
+# ---------------------------------------------------------------------------
+
+
+def test_vote_rate_from_env(monkeypatch):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.router import (
+        vote_rate_from_env,
+    )
+
+    for raw, want in [
+        ("", 0.0), ("off", 0.0), ("0", 0.0), ("full", 1.0), ("on", 1.0),
+        ("1", 1.0), ("0.25", 0.25), ("7", 1.0), ("-3", 0.0), ("bogus", 0.0),
+    ]:
+        monkeypatch.setenv("MSBFS_VOTE", raw)
+        assert vote_rate_from_env() == want, raw
+    monkeypatch.delenv("MSBFS_VOTE")
+    assert vote_rate_from_env() == 0.0
+
+
+def _arm_dist_flip(trio, member):
+    """Arm a one-shot ``bitflip:dist`` on one trio replica's serving
+    supervisor (the result-materialize seam, in-process reach-in); the
+    spec fires once and the plan is inert afterwards."""
+    sup = trio["servers"][member].registry.get("default").supervisor
+    sup.plan = faults.FaultPlan.parse("bitflip:dist:1")
+    return sup
+
+
+def test_router_vote_agreement_is_silent(trio):
+    calls = []
+    router = _router(trio, replication=3, vote_rate=1.0,
+                     quarantine_fn=lambda m: calls.append(m) or True)
+    out = router.query(QS)
+    assert out["voted"] is True and "vote_mismatch" not in out
+    with MsbfsClient(trio["addresses"][router.owners_for("default")[1]]) as c:
+        assert answer(out) == answer(c.query(QS))
+    stats = router.stats()
+    assert stats["votes"] == 1 and stats["vote_mismatches"] == 0
+    assert stats["quarantined"] == 0 and calls == []
+
+
+def test_router_vote_outvotes_corrupt_primary(trio):
+    """bitflip:dist on the primary: the shadow disagrees, the third
+    owner sides with the shadow, the primary is quarantined, and the
+    caller gets the MAJORITY (clean) answer — the corruption never
+    reaches an ack.  Fresh query set: a result-cache hit on the primary
+    would never reach f_values, so the flip would never fire."""
+    qs = [[11, 12], [13, 14]]
+    calls = []
+    router = _router(trio, replication=3, vote_rate=1.0,
+                     quarantine_fn=lambda m: calls.append(m) or True)
+    owners = router.owners_for("default")
+    sup = _arm_dist_flip(trio, owners[0])
+    try:
+        out = router.query(qs)
+    finally:
+        sup.plan = None
+    with MsbfsClient(trio["addresses"][owners[2]]) as c:
+        oracle = answer(c.query(qs))
+    assert answer(out) == oracle  # served the clean majority answer
+    assert out["voted"] is True and out["vote_mismatch"] is True
+    assert out["replica"] == owners[1]
+    assert calls == [owners[0]]
+    stats = router.stats()
+    assert stats["votes"] == 1 and stats["vote_mismatches"] == 1
+    assert stats["vote_unresolved"] == 0 and stats["quarantined"] == 1
+
+
+def test_router_vote_quarantines_corrupt_shadow(trio):
+    """bitflip:dist on the SHADOW owner: the arbiter sides with the
+    primary, the shadow is quarantined, the primary's answer stands."""
+    calls = []
+    router = _router(trio, replication=3, vote_rate=1.0,
+                     quarantine_fn=lambda m: calls.append(m) or True)
+    qs = [[15, 16], [17, 18]]
+    owners = router.owners_for("default")
+    sup = _arm_dist_flip(trio, owners[1])
+    try:
+        out = router.query(qs)
+    finally:
+        sup.plan = None
+    with MsbfsClient(trio["addresses"][owners[2]]) as c:
+        assert answer(out) == answer(c.query(qs))
+    assert out["replica"] == owners[0] and out["vote_mismatch"] is True
+    assert calls == [owners[1]]
+
+
+def test_router_vote_unresolved_without_arbiter(trio):
+    """replication=2 leaves no third owner: on disagreement the router
+    keeps the ring-preferred primary's answer, counts the vote
+    unresolved, and takes the disagreeing shadow out of rotation."""
+    calls = []
+    router = _router(trio, replication=2, vote_rate=1.0,
+                     quarantine_fn=lambda m: calls.append(m) or True)
+    qs = [[19, 20], [21, 22]]
+    owners = router.owners_for("default")
+    sup = _arm_dist_flip(trio, owners[1])
+    try:
+        out = router.query(qs)
+    finally:
+        sup.plan = None
+    assert out["replica"] == owners[0]
+    assert out["vote_mismatch"] is True
+    assert calls == [owners[1]]
+    assert router.stats()["vote_unresolved"] == 1
+
+
+def test_router_vote_sampling_accumulates(trio):
+    router = _router(trio, replication=3, vote_rate=0.5,
+                     quarantine_fn=lambda m: True)
+    for _ in range(4):
+        router.query(QS)
+    stats = router.stats()
+    assert stats["routed"] == 4 and stats["votes"] == 2
+
+
+# ---------------------------------------------------------------------------
+# The corruption chaos chain (slow: real 3-replica fleet subprocesses)
+# ---------------------------------------------------------------------------
+
+
+def _await(predicate, deadline_s, what):
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        if predicate():
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.slow
+def test_fleet_chaos_bitflip_vote_quarantine_recompute(tmp_path):
+    """The silent-corruption acceptance chain: ``bitflip:dist`` armed
+    inside the digest's primary owner (a real subprocess replica, audit
+    OFF so the corruption escapes to the wire), full-rate voting
+    catches the disagreement, the corrupt replica is quarantined
+    (killed) and heartbeat-restarted with journal replay, the answer is
+    recomputed on a clean owner — every acked answer bit-identical to
+    the single-daemon oracle, zero acked queries lost."""
+    n, edges = generators.gnm_edges(120, 360, seed=7)
+    gpath = str(tmp_path / "g.bin")
+    save_graph_bin(gpath, n, edges)
+    digest = content_hash(gpath)
+    # Placement is deterministic: pick the victim BEFORE boot so the
+    # fault plan lands inside the replica the router will ask first.
+    names = [f"r{i}" for i in range(3)]
+    victim_name = PlacementRing(names, replication=3).owners(digest)[0]
+    victim_idx = int(victim_name[1:])
+
+    oracle_srv = MsbfsServer(listen=f"unix:{tmp_path}/oracle.sock",
+                             graphs={"default": gpath},
+                             window_s=0.0, request_timeout_s=60.0)
+    oracle_srv.start()
+    with MsbfsClient(f"unix:{tmp_path}/oracle.sock") as c:
+        oracle = answer(c.query(QS))
+
+    supervisor = FleetSupervisor(
+        size=3,
+        base_dir=str(tmp_path / "fleet"),
+        replication=3,
+        heartbeat_s=0.25,
+        env=virtual_cpu_env(1),
+        restart_policy=RetryPolicy(max_retries=8, base_delay=0.2,
+                                   max_delay=1.0, seed=0),
+        replica_faults={victim_idx: "bitflip:dist:1"},
+    )
+    try:
+        supervisor.start(wait_ready_s=240.0)
+        owners = supervisor.register("default", gpath)
+        assert owners[0] == victim_name
+        router = FleetRouter.for_fleet(supervisor, timeout=60.0,
+                                       vote_rate=1.0)
+        _await(lambda: set(owners) <= supervisor.ready_names(), 240.0,
+               "all owners ready")
+        victim = supervisor.replicas[victim_idx]
+
+        # The corrupted query: the victim's first f_values flips a bit;
+        # the vote must outvote it and serve the clean majority answer.
+        out = router.query(QS, deadline_s=180.0)
+        assert answer(out) == oracle, "corrupt answer escaped the vote"
+        assert out["vote_mismatch"] is True
+        assert out["replica"] != victim_name
+        stats = router.stats()
+        assert stats["vote_mismatches"] >= 1 and stats["quarantined"] >= 1
+        assert victim.quarantines >= 1
+
+        # The quarantine is a kill: the stock heartbeat/restart ladder
+        # heals it — journal replay re-registers the graph.
+        _await(lambda: victim.restarts >= 1 and victim.state == "ready",
+               240.0, "victim restart after quarantine")
+        replayed = StateJournal(victim.journal_path).replay()
+        assert "default" in replayed.graphs
+
+        # Keep serving: the restarted victim re-arms its one-shot fault
+        # (fresh process, same MSBFS_FAULTS), so the vote may fire once
+        # more — but every acked answer stays bit-identical to the
+        # oracle, and nothing is shed.
+        for _ in range(5):
+            out = router.query(QS, deadline_s=60.0)
+            assert answer(out) == oracle, "acked query lost/corrupted"
+        assert router.stats()["shed"] == 0
+    finally:
+        faults.activate(None)
+        supervisor.stop()
+        oracle_srv.stop()
+
+
+@pytest.mark.slow
+def test_fleet_chaos_audit_catches_before_vote(tmp_path):
+    """Defense in depth, inner ring first: the same ``bitflip:dist``
+    victim runs with MSBFS_AUDIT=full (per-replica env override), so
+    its OWN supervisor certifies the corrupt F, retries clean, and the
+    wire never sees the flip — the vote agrees and nobody is
+    quarantined."""
+    n, edges = generators.gnm_edges(120, 360, seed=7)
+    gpath = str(tmp_path / "g.bin")
+    save_graph_bin(gpath, n, edges)
+    digest = content_hash(gpath)
+    names = [f"r{i}" for i in range(3)]
+    victim_name = PlacementRing(names, replication=3).owners(digest)[0]
+    victim_idx = int(victim_name[1:])
+
+    oracle_srv = MsbfsServer(listen=f"unix:{tmp_path}/oracle.sock",
+                             graphs={"default": gpath},
+                             window_s=0.0, request_timeout_s=60.0)
+    oracle_srv.start()
+    with MsbfsClient(f"unix:{tmp_path}/oracle.sock") as c:
+        oracle = answer(c.query(QS))
+
+    supervisor = FleetSupervisor(
+        size=3,
+        base_dir=str(tmp_path / "fleet"),
+        replication=3,
+        heartbeat_s=0.25,
+        env=virtual_cpu_env(1),
+        restart_policy=RetryPolicy(max_retries=6, base_delay=0.2,
+                                   max_delay=1.0, seed=0),
+        replica_faults={victim_idx: "bitflip:dist:1"},
+        replica_env={victim_idx: {"MSBFS_AUDIT": "full"}},
+    )
+    try:
+        supervisor.start(wait_ready_s=240.0)
+        owners = supervisor.register("default", gpath)
+        router = FleetRouter.for_fleet(supervisor, timeout=60.0,
+                                       vote_rate=1.0)
+        _await(lambda: set(owners) <= supervisor.ready_names(), 240.0,
+               "all owners ready")
+        victim = supervisor.replicas[victim_idx]
+
+        out = router.query(QS, deadline_s=180.0)
+        assert answer(out) == oracle
+        assert out["voted"] is True and "vote_mismatch" not in out
+        stats = router.stats()
+        assert stats["vote_mismatches"] == 0 and stats["quarantined"] == 0
+        assert victim.quarantines == 0
+        # The flip DID fire — the victim's own audit ate it.
+        with MsbfsClient(victim.address, timeout=60.0) as c:
+            vstats = c.stats()
+        assert vstats["audit_failures"] >= 1
+        assert vstats["audited"] >= 2  # the failed attempt + clean retry
+    finally:
+        faults.activate(None)
+        supervisor.stop()
+        oracle_srv.stop()

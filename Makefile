@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit
+.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -60,5 +60,14 @@ audit: native
 	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_certify.py -x -q
 	JAX_PLATFORMS=cpu python -m pytest tests/test_engines_agree.py -x -q -k "audit"
 
-test: native resilience serve lifecycle perf-smoke mxu fleet audit
+# Flash-crowd autoscale suite (docs/SERVING.md "Autoscaling &
+# overload"): the fast controller units (autoscaler hysteresis, token
+# buckets, priority shed order, brownout ladder, weighted ring) plus
+# the elastic-fleet stampede bench — scale-up reaction, interactive
+# p99 under the crowd, zero acked-query loss across scale events.
+stampede: native
+	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_stampede.py -x -q -m "not slow"
+	JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py --stampede
+
+test: native resilience serve lifecycle perf-smoke mxu fleet audit stampede
 	python -m pytest tests/ -x -q

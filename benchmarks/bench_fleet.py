@@ -1,4 +1,5 @@
-"""Fleet load harness: heavy-tail arrivals, failover SLOs (round 9).
+"""Fleet load harness: heavy-tail arrivals, failover SLOs (round 9),
+flash-crowd autoscale SLOs (round 11, ``--stampede``).
 
 Boots a 3-replica fleet IN-PROCESS (three stock ``MsbfsServer`` daemons
 on unix sockets behind a :class:`FleetRouter` — the perf harness
@@ -24,9 +25,28 @@ fleet-lost-acks) so a routing regression — a failover that stops
 working, a shed path that starts lying, a tail that grows past the
 deadline — fails CI before any fleet deploy re-measures it.
 
+Round 11 adds the **stampede** harness (``--stampede``): an elastic
+in-process fleet (min 1 replica, autoscaled up to 4 by the SAME
+:class:`AutoscalePolicy` the real fleet supervisor runs) under
+connection-multiplexed open-loop arrivals from a simulated population
+of O(10^5-10^6) distinct users — a small worker pool multiplexes the
+whole population's requests, the way a real front end multiplexes
+clients over a handful of sockets.  The schedule has three phases:
+steady state, a **flash crowd** (arrival gaps collapse ~5x — everyone
+refreshes at once), and recovery.  80% of arrivals are batch-priority
+with per-user client ids, 20% interactive, so the adaptive admission
+ladder (CoDel shed, batch gate) protects interactive latency while the
+autoscaler adds capacity.  ``smoke_stampede()`` returns the rows `make
+perf-smoke` pins: scale-up reaction in heartbeats from crowd onset,
+interactive p99 under the stampede, and the zero-budget lost-ack pin —
+every acked answer audited bit-identical against a single-daemon
+oracle ACROSS scale events (a drain that drops queued work, or a fresh
+replica serving a wrong answer, shows up here).
+
 Run::
 
     JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py
+    JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py --stampede
 """
 
 from __future__ import annotations
@@ -297,7 +317,596 @@ def smoke():
     ]
 
 
+# ---- round 11: the stampede ------------------------------------------------
+
+# Simulated user population (client ids drawn from it) and the actual
+# query arrivals sampled out of that population's behavior.  The
+# population is the multiplexing claim — 2e5 users over ~32 worker
+# "connections" — while ARRIVALS bounds the wall clock.
+STAMPEDE_USERS = int(os.environ.get("BENCH_STAMPEDE_USERS", "200000"))
+STAMPEDE_ARRIVALS = int(os.environ.get("BENCH_STAMPEDE_ARRIVALS", "1000"))
+STAMPEDE_WORKERS = int(os.environ.get("BENCH_STAMPEDE_WORKERS", "32"))
+# Steady phase must sit comfortably under ONE replica's throughput and
+# the flash crowd comfortably over it (else the autoscaler either fires
+# before the crowd or never has a reason to).  The stampede replicas
+# run with the result cache OFF so every query computes BFS — measured
+# ~23 ms/query on the CI CPUs, i.e. a ~43/s single replica — and these
+# gaps encode ~25/s steady vs ~100/s crowd against that.
+STAMPEDE_BASE_GAP_S = float(
+    os.environ.get("BENCH_STAMPEDE_GAP_S", "0.04")
+)
+STAMPEDE_CROWD_GAP_S = float(
+    os.environ.get("BENCH_STAMPEDE_CROWD_GAP_S", "0.01")
+)
+STAMPEDE_DEADLINE_S = float(
+    os.environ.get("BENCH_STAMPEDE_DEADLINE_S", "3.0")
+)
+STAMPEDE_HEARTBEAT_S = float(
+    os.environ.get("BENCH_STAMPEDE_HEARTBEAT_S", "0.08")
+)
+STAMPEDE_MIN_R = 1
+STAMPEDE_MAX_R = int(os.environ.get("BENCH_STAMPEDE_MAX_REPLICAS", "4"))
+STAMPEDE_BATCH_FRAC = 0.8  # batch-priority share of arrivals
+STAMPEDE_PAYLOADS = 48     # distinct query batches (oracle audit pool)
+STAMPEDE_COOLDOWN_S = float(
+    os.environ.get("BENCH_STAMPEDE_COOLDOWN_S", "8.0")
+)
+
+# Admission posture for the stampede's in-process replicas: CoDel head
+# shedding at 250 ms sojourn, batch admission suspended above 60% queue
+# — the levers under test; stock daemons keep them off.  MAX_ROWS is
+# pinned to one request's K so same-bucket coalescing cannot amortize
+# the crowd into ever-larger executions: capacity per replica becomes
+# a hard requests/s number and the queue-depth/age signals the
+# autoscaler watches actually move when the crowd lands.
+_STAMPEDE_ENV = {
+    "MSBFS_SERVE_CODEL_TARGET_MS": "250",
+    "MSBFS_SERVE_BATCH_ADMIT": "0.6",
+    "MSBFS_SERVE_MAX_ROWS": str(K),
+    # Short per-replica queues bound the worst-case sojourn (~24/43 s at
+    # the measured service rate) — a deep queue would hold interactive
+    # p99 hostage to its own length, and a full-queue rejection is
+    # exactly what makes the router's owner walk spread load onto the
+    # replicas the autoscaler just added.
+    "MSBFS_SERVE_QUEUE": "24",
+}
+
+
+class ElasticFleet:
+    """In-process elastic fleet: replicas come and go under the SAME
+    AutoscalePolicy + BrownoutLadder objects the real supervisor runs,
+    against the real FleetRouter — only fork/exec is elided (the
+    process-level add/remove/drain chain lives in tests)."""
+
+    def __init__(self):
+        import numpy as np
+
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (  # noqa: E501
+            generators,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.autoscale import (  # noqa: E501
+            AutoscaleConfig,
+            AutoscalePolicy,
+            ReplicaSignal,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.brownout import (  # noqa: E501
+            BrownoutLadder,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (  # noqa: E501
+            MsbfsClient,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.registry import (  # noqa: E501
+            content_hash,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.ring import (  # noqa: E501
+            PlacementRing,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.router import (  # noqa: E501
+            FleetRouter,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (  # noqa: E501
+            MsbfsServer,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (  # noqa: E501
+            save_graph_bin,
+        )
+
+        self._MsbfsServer = MsbfsServer
+        self._MsbfsClient = MsbfsClient
+        self._ReplicaSignal = ReplicaSignal
+        self._env_saved = {
+            k: os.environ.get(k) for k in _STAMPEDE_ENV
+        }
+        os.environ.update(_STAMPEDE_ENV)
+        self.rng = np.random.default_rng(31)
+        self.tmp = tempfile.TemporaryDirectory(prefix="msbfs_stampede_")
+        self.gpath = os.path.join(self.tmp.name, "g.bin")
+        self.n, edges = generators.gnm_edges(N_VERTICES, N_EDGES, seed=29)
+        save_graph_bin(self.gpath, self.n, edges)
+        self.digest = content_hash(self.gpath)
+        self._lock = threading.Lock()
+        self.servers = {}
+        self.alive = set()
+        self._next = 0
+        # Slot r0 pre-seeds the ring so the router constructor sees a
+        # non-empty membership; _spawn_locked_free() below makes it real.
+        # Replication = max replicas: the stampede is a CAPACITY story
+        # for one hot graph, so every member must own it (owners beyond
+        # the replication factor would be dead weight — the router only
+        # walks owners).  Data-partitioned placement keeps REPLICATION.
+        self.ring = PlacementRing(
+            ["r0"], replication=max(REPLICATION, STAMPEDE_MAX_R)
+        )
+        self.addresses = {}
+        self.router = FleetRouter(
+            ring=self.ring,
+            addresses={"r0": "unix:/dev/null"},  # replaced below
+            digests={"bench": self.digest},
+            alive_fn=lambda: set(self.alive),
+            timeout=STAMPEDE_DEADLINE_S * 2,
+        )
+        self.router.addresses = self.addresses  # live view, like for_fleet
+        self.policy = AutoscalePolicy(
+            AutoscaleConfig(
+                min_replicas=STAMPEDE_MIN_R,
+                max_replicas=STAMPEDE_MAX_R,
+                high_watermark=0.5,
+                low_watermark=0.1,
+                age_high_s=0.25,
+                up_after=2,
+                down_after=12,
+                cooldown_ticks=5,
+                max_step=1,
+                churn_budget=8,
+                churn_window=600,
+            )
+        )
+        self.ladder = BrownoutLadder(down_after=3, up_after=10, min_dwell=2)
+        self.scale_events = []  # (monotonic_time, delta, new_size)
+        self._shed_last = 0
+        self.stampede_t0 = None  # set by the arrival loop at crowd onset
+        self._stop = threading.Event()
+        self._spawn_locked_free()  # boots r0 (the pre-seeded ring slot)
+        oracle_addr = f"unix:{os.path.join(self.tmp.name, 'oracle.sock')}"
+        self.oracle = MsbfsServer(
+            listen=oracle_addr, graphs={"bench": self.gpath}
+        )
+        self.oracle.start()
+        self.oracle_addr = oracle_addr
+        self._controller = threading.Thread(
+            target=self._control_loop, name="stampede-controller", daemon=True
+        )
+        self._controller.start()
+
+    # -- membership ----------------------------------------------------
+    def _spawn_locked_free(self):
+        """Create, start and WARM one replica, then splice it in.  The
+        warm-up (one query per pool bucket shape) happens before the
+        ring sees the member, so a fresh replica never serves a cold
+        compile to a deadline-bearing stampede query."""
+        i = self._next
+        self._next += 1
+        name = f"r{i}"
+        addr = f"unix:{os.path.join(self.tmp.name, name + '.sock')}"
+        # Result cache OFF: the stampede is a CAPACITY story, so every
+        # admitted query must compute (a cache-hit fleet absorbs any
+        # crowd at ~1 ms/query and the autoscaler rightly never fires).
+        # The cache-only brownout rung then sheds batch work typed —
+        # the strongest form of "answered only from cache".
+        server = self._MsbfsServer(
+            listen=addr, graphs={"bench": self.gpath}, result_cache_size=0
+        )
+        server.start()
+        if self.ladder.level > 0:
+            server.handle({
+                "op": "posture",
+                "audit_sample": (
+                    0.0 if self.ladder.audit_suppressed() else "restore"
+                ),
+                "cache_only": self.ladder.cache_only(),
+            })
+        with self._MsbfsClient(addr, timeout=120.0) as c:
+            c.query(self._warm_payload, graph="bench")
+        with self._lock:
+            self.servers[name] = server
+            self.addresses[name] = addr
+            if name not in self.ring.members:
+                self.ring.add_member(name)
+            self.alive.add(name)
+        return name
+
+    def _retire_newest(self):
+        """Scale down one replica with the fleet ordering: out of the
+        ring first, then wait for its queue to empty (drain), then
+        stop.  Queued work admitted before the ring change completes."""
+        with self._lock:
+            candidates = [m for m in sorted(self.alive) if m != "r0"]
+            if not candidates:
+                return None
+            name = candidates[-1]
+            if name in self.ring.members:
+                self.ring.remove_member(name)
+            self.alive.discard(name)
+            server = self.servers[name]
+        deadline = time.monotonic() + STAMPEDE_DEADLINE_S * 2
+        while time.monotonic() < deadline:
+            if server.batcher.depth() == 0:
+                break
+            time.sleep(0.05)
+        time.sleep(0.2)  # let the executing micro-batch complete its acks
+        with self._lock:
+            self.addresses.pop(name, None)
+            self.servers.pop(name, None)
+        server.stop()
+        return name
+
+    # -- the control loop ----------------------------------------------
+    def _control_loop(self):
+        while not self._stop.wait(STAMPEDE_HEARTBEAT_S):
+            try:
+                self._control_tick()
+            except Exception:  # noqa: BLE001 — controller must survive
+                pass
+
+    def _control_tick(self):
+        with self._lock:
+            servers = [
+                self.servers[m] for m in self.alive if m in self.servers
+            ]
+        signals = []
+        shed_server = 0
+        for s in servers:
+            b = s.batcher
+            signals.append(
+                self._ReplicaSignal(
+                    utilization=b.depth() / max(1, b.capacity),
+                    oldest_age_s=b.oldest_age(),
+                )
+            )
+            shed_server += b.rejected + b.rejected_batch + b.shed_overload
+        shed_now = self.router.stats()["shed"] + shed_server
+        shed_delta = max(0, shed_now - self._shed_last)
+        self._shed_last = shed_now
+        util = (
+            sum(s.utilization for s in signals) / len(signals)
+            if signals
+            else 0.0
+        )
+        step = self.ladder.tick(
+            bool(signals) and (util >= 0.5 or shed_delta > 0)
+        )
+        if step is not None:
+            # Apply the rung's effects exactly when a transition is
+            # reported, the same push the fleet supervisor does over
+            # the wire — in-process, the verb handler is called direct.
+            posture = {
+                "op": "posture",
+                "audit_sample": (
+                    0.0 if self.ladder.audit_suppressed() else "restore"
+                ),
+                "cache_only": self.ladder.cache_only(),
+            }
+            for s in servers:
+                s.handle(dict(posture))
+        delta = self.policy.tick(
+            size=len(signals), replicas=signals, shed_since_last=shed_delta
+        )
+        if delta > 0:
+            for _ in range(delta):
+                try:
+                    self._spawn_locked_free()
+                except Exception:  # noqa: BLE001
+                    self.policy.cancel()
+                    break
+            self.scale_events.append(
+                (time.monotonic(), delta, len(self.alive))
+            )
+        elif delta < 0:
+            removed = 0
+            for _ in range(-delta):
+                if self._retire_newest() is not None:
+                    removed += 1
+            if removed:
+                self.scale_events.append(
+                    (time.monotonic(), -removed, len(self.alive))
+                )
+            else:
+                self.policy.cancel()
+
+    # -- measurement helpers -------------------------------------------
+    def reaction_heartbeats(self):
+        """Heartbeats from flash-crowd onset to the first scale-up
+        COMMIT; the SLO the autoscaler's hysteresis budget must clear.
+        999 when the crowd never triggered a scale-up at all."""
+        if self.stampede_t0 is None:
+            return 999
+        for when, delta, _ in self.scale_events:
+            if delta > 0 and when >= self.stampede_t0:
+                return max(
+                    1,
+                    int(
+                        (when - self.stampede_t0) / STAMPEDE_HEARTBEAT_S
+                        + 0.999
+                    ),
+                )
+        return 999
+
+    def close(self):
+        self._stop.set()
+        self._controller.join(timeout=10.0)
+        with self._lock:
+            servers = list(self.servers.values())
+            self.servers.clear()
+            self.alive.clear()
+        for s in servers:
+            s.stop()
+        self.oracle.stop()
+        self.tmp.cleanup()
+        for k, v in self._env_saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # Payload pool: a bounded set of distinct batches so the oracle
+    # audit is O(pool), not O(arrivals) — and repeat queries exercise
+    # the result cache exactly like a real crowd refreshing one page.
+    @property
+    def _warm_payload(self):
+        if not hasattr(self, "_warm_q"):
+            self._warm_q = [
+                [int(v) for v in self.rng.integers(0, self.n, size=S)]
+                for _ in range(K)
+            ]
+        return self._warm_q
+
+    def make_payload_pool(self):
+        return [
+            [
+                [int(v) for v in self.rng.integers(0, self.n, size=S)]
+                for _ in range(K)
+            ]
+            for _ in range(STAMPEDE_PAYLOADS)
+        ]
+
+    def oracle_answers(self, pool):
+        out = []
+        with self._MsbfsClient(self.oracle_addr, timeout=120.0) as c:
+            for q in pool:
+                r = c.query(q, graph="bench")
+                out.append((r["f_values"], r["min_f"], r["min_k"]))
+        return out
+
+
+def run_stampede():
+    """Drive the three-phase arrival schedule through the elastic fleet
+    and return the measurement dict (see smoke_stampede for the SLO
+    reading)."""
+    import queue as queue_mod
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (  # noqa: E501
+        BackpressureError,
+        TransientError,
+    )
+
+    fleet = ElasticFleet()
+    try:
+        pool = fleet.make_payload_pool()
+        want = fleet.oracle_answers(pool)
+        total = STAMPEDE_ARRIVALS
+        crowd_lo, crowd_hi = int(total * 0.4), int(total * 0.7)
+        rng = fleet.rng
+        users = rng.integers(0, STAMPEDE_USERS, size=total)
+        is_batch = rng.random(size=total) < STAMPEDE_BATCH_FRAC
+        payload_i = rng.integers(0, STAMPEDE_PAYLOADS, size=total)
+
+        work = queue_mod.Queue()
+        results_lock = threading.Lock()
+        lat_interactive, lat_batch = [], []
+        shed, transients, errors, lost = [], [], [], []
+        acked = [0]
+
+        def worker():
+            while True:
+                item = work.get()
+                if item is None:
+                    return
+                i, t_arrival = item
+                q = pool[payload_i[i]]
+                pr = "batch" if is_batch[i] else "interactive"
+                try:
+                    out = fleet.router.query(
+                        q,
+                        graph="bench",
+                        deadline_s=STAMPEDE_DEADLINE_S,
+                        priority=pr,
+                        client_id=f"u{users[i]}",
+                    )
+                except BackpressureError:
+                    with results_lock:
+                        shed.append(i)
+                    continue
+                except TransientError as exc:
+                    # A typed transient ("no owner answered in budget",
+                    # drain refusal) is an honest refusal the client
+                    # retries — overload shedding by another name, NOT
+                    # a lost ack (nothing was promised).
+                    with results_lock:
+                        transients.append(repr(exc))
+                    continue
+                except Exception as exc:  # noqa: BLE001 — audited
+                    with results_lock:
+                        errors.append(repr(exc))
+                    continue
+                ms = (time.monotonic() - t_arrival) * 1e3
+                got = (out["f_values"], out["min_f"], out["min_k"])
+                with results_lock:
+                    acked[0] += 1
+                    (lat_batch if is_batch[i] else lat_interactive).append(ms)
+                    if got != want[payload_i[i]]:
+                        lost.append(i)
+
+        workers = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(STAMPEDE_WORKERS)
+        ]
+        for w in workers:
+            w.start()
+
+        # Open-loop injection: the schedule does not slow down for the
+        # service.  Crowd onset stamps the reaction clock.
+        for i in range(total):
+            if i == crowd_lo:
+                fleet.stampede_t0 = time.monotonic()
+            gap = (
+                STAMPEDE_CROWD_GAP_S
+                if crowd_lo <= i < crowd_hi
+                else STAMPEDE_BASE_GAP_S
+            )
+            work.put((i, time.monotonic()))
+            time.sleep(gap)
+        deadline = time.monotonic() + STAMPEDE_DEADLINE_S * 4
+        while not work.empty() and time.monotonic() < deadline:
+            time.sleep(0.1)
+        for _ in workers:
+            work.put(None)
+        for w in workers:
+            w.join(timeout=STAMPEDE_DEADLINE_S * 4)
+        # Recovery phase: let the autoscaler walk back down (the lost-
+        # ack audit spans these scale-down drains too, via `lost`).
+        time.sleep(STAMPEDE_COOLDOWN_S)
+        peak = max((size for _, _, size in fleet.scale_events), default=1)
+        return {
+            "arrivals": total,
+            "users": STAMPEDE_USERS,
+            "workers": STAMPEDE_WORKERS,
+            "acked": acked[0],
+            "shed": len(shed),
+            "shed_rate_pct": round(
+                100.0 * (len(shed) + len(transients)) / total, 2
+            ),
+            "transient_errors": transients,
+            "errors": errors,
+            "lost_acks": len(lost),
+            "interactive_p50_ms": round(_percentile(lat_interactive, 50), 3),
+            "interactive_p99_ms": round(_percentile(lat_interactive, 99), 3),
+            "batch_p99_ms": round(_percentile(lat_batch, 99), 3),
+            "interactive_acked": len(lat_interactive),
+            "batch_acked": len(lat_batch),
+            "reaction_heartbeats": fleet.reaction_heartbeats(),
+            "scale_events": [
+                (round(t, 3), d, s) for t, d, s in fleet.scale_events
+            ],
+            "peak_replicas": peak,
+            "final_replicas": len(fleet.alive),
+            "autoscale": fleet.policy.describe(),
+            "brownout": fleet.ladder.describe(),
+            "router": fleet.router.stats(),
+            "deadline_ms": STAMPEDE_DEADLINE_S * 1e3,
+            "heartbeat_ms": STAMPEDE_HEARTBEAT_S * 1e3,
+        }
+    finally:
+        fleet.close()
+
+
+def smoke_stampede():
+    """`make perf-smoke` rows for the stampede (guard formula: pass iff
+    opt * 2 <= base and opt <= BUDGET[name]):
+
+    * stampede-scaleup-heartbeats  base = 40 (the crowd window in
+      heartbeats); the first scale-up commit must land within the
+      pinned reaction budget of crowd onset.
+    * stampede-interactive-p99-ms  base = the wire deadline; the
+      priority ladder must hold interactive p99 to half of it AND
+      under the absolute budget while batch work is shed/queued.
+    * stampede-lost-acks           exact-match pin, budget zero: acked
+      answers across every scale event bit-identical to the oracle;
+      non-typed errors count (an ack promised and never produced).
+      Typed refusals — BackpressureError and TransientError — are
+      sheds, not losses: the client was told to retry, nothing was
+      promised.
+    """
+    out = run_stampede()
+    detail = {
+        k: out[k]
+        for k in (
+            "arrivals", "users", "workers", "acked", "shed_rate_pct",
+            "interactive_p50_ms", "interactive_p99_ms", "batch_p99_ms",
+            "reaction_heartbeats", "scale_events", "peak_replicas",
+            "final_replicas", "deadline_ms", "heartbeat_ms",
+        )
+    }
+    detail["brownout_rung"] = out["brownout"]["rung"]
+    detail["brownout_transitions"] = out["brownout"]["transitions"]
+    print(f"stampede SLO detail: {json.dumps(detail, sort_keys=True)}")
+    lost = out["lost_acks"] + len(out["errors"])
+    return [
+        ("stampede-scaleup-heartbeats", 40, out["reaction_heartbeats"]),
+        ("stampede-interactive-p99-ms", out["deadline_ms"],
+         out["interactive_p99_ms"]),
+        ("stampede-lost-acks", 2 * out["arrivals"], lost),
+    ]
+
+
+def stampede_main() -> int:
+    out = run_stampede()
+    tag = (
+        f"{STAMPEDE_USERS} simulated users over {STAMPEDE_WORKERS} "
+        f"multiplexed connections, {out['arrivals']} arrivals, "
+        f"autoscale {STAMPEDE_MIN_R}..{STAMPEDE_MAX_R} replicas, "
+        f"G(n={N_VERTICES}, m={N_EDGES}), K={K}, S={S}"
+    )
+    print(json.dumps({
+        "metric": f"stampede scale-up reaction, {tag}",
+        "value": out["reaction_heartbeats"],
+        "unit": "heartbeats",
+        "detail": {
+            "heartbeat_ms": out["heartbeat_ms"],
+            "scale_events": out["scale_events"],
+            "peak_replicas": out["peak_replicas"],
+            "final_replicas": out["final_replicas"],
+            "autoscale": out["autoscale"],
+        },
+    }))
+    print(json.dumps({
+        "metric": f"stampede interactive p99 latency, {tag}",
+        "value": out["interactive_p99_ms"],
+        "unit": "ms",
+        "detail": {
+            "interactive_p50_ms": out["interactive_p50_ms"],
+            "batch_p99_ms": out["batch_p99_ms"],
+            "interactive_acked": out["interactive_acked"],
+            "batch_acked": out["batch_acked"],
+            "shed_rate_pct": out["shed_rate_pct"],
+            "deadline_ms": out["deadline_ms"],
+            "brownout": out["brownout"],
+        },
+    }))
+    print(json.dumps({
+        "metric": f"stampede acked-answer integrity across scale events, "
+                  f"{tag}",
+        "value": out["lost_acks"],
+        "unit": "lost acks",
+        "detail": {
+            "acked": out["acked"],
+            "transient_refusals": len(out["transient_errors"]),
+            "errors": out["errors"][:3],
+            "router": out["router"],
+        },
+    }))
+    if out["lost_acks"] or out["errors"]:
+        print(
+            f"bench_fleet --stampede: integrity failures: "
+            f"lost={out['lost_acks']} errors={out['errors'][:3]}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def main() -> int:
+    if "--stampede" in sys.argv[1:]:
+        return stampede_main()
     out = measure()
     tag = (
         f"{REPLICAS} replicas (replication {REPLICATION}), "

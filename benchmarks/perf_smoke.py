@@ -128,6 +128,20 @@ BUDGET = {
     "fleet-p99-ms": 1000,
     "fleet-shed-rate-pct": 25,
     "fleet-lost-acks": 0,
+    # Round 11 stampede SLOs (bench_fleet.smoke_stampede — elastic
+    # in-process fleet under a flash crowd, docs/SERVING.md "Autoscaling
+    # & overload").  Reaction is heartbeats from crowd onset to the
+    # first scale-up COMMIT: up_after=2 hysteresis + signal latency
+    # lands it in ~3-6 ticks; 12 leaves room for CPU scheduling jitter
+    # without letting a deaf autoscaler pass (base 40 = the crowd
+    # window).  Interactive p99 is pinned against a 3 s wire deadline
+    # while batch traffic is gated/shed around it; and lost-acks is the
+    # zero-budget exact pin ACROSS scale events — a scale-down that
+    # drops queued work or a cold scale-up serving a wrong answer is a
+    # correctness bug, not a perf regression.
+    "stampede-scaleup-heartbeats": 12,
+    "stampede-interactive-p99-ms": 1500,
+    "stampede-lost-acks": 0,
     # Round 10 audit overhead (ops/certify.py): one full certification
     # (host recompute + four invariants + F compare) as a PERCENT of the
     # warm query wall it guards, on the high-diameter chunked workload.
@@ -276,6 +290,16 @@ def run_fleet():
     return bench_fleet.smoke()
 
 
+def run_stampede():
+    """Round-11 stampede SLO rows: defer to the elastic-fleet load
+    harness's smoke_stampede() (bench_fleet boots the autoscaled
+    in-process fleet + oracle, drives the flash-crowd schedule, and
+    prints the SLO detail block before returning the rows)."""
+    import bench_fleet
+
+    return bench_fleet.smoke_stampede()
+
+
 def run_audit():
     """Round-10 audit-overhead row: the full output certification
     (ops/certify.py — untrusted host recompute, four invariants, F
@@ -330,7 +354,7 @@ def run_audit():
 def main() -> int:
     failures = []
     for run in (run_config1, run_config4, run_stencil_window, run_mxu,
-                run_fleet, run_audit):
+                run_fleet, run_stampede, run_audit):
         rows = run()
         if isinstance(rows, tuple):
             rows = [rows]

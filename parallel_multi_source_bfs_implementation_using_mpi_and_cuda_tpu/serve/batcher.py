@@ -19,6 +19,35 @@ queue rejects immediately with the typed
 :class:`~..runtime.supervisor.BackpressureError` rather than queueing
 unboundedly — a loaded daemon degrades by shedding, not by growing
 until the OOM killer picks a victim.
+
+Adaptive overload control (docs/SERVING.md "Autoscaling & overload")
+layers three finer levers on that blunt full-queue gate, so overload
+sheds the *cheapest* work first instead of failing uniformly:
+
+* **priority classes** — every request carries ``interactive`` (the
+  default; a user is waiting) or ``batch`` (a pipeline will retry).
+  Batch work is admitted only while the queue is below
+  ``batch_admit_frac`` of capacity (``MSBFS_SERVE_BATCH_ADMIT``), so
+  the last headroom is reserved for interactive traffic.
+* **per-client token buckets** — with ``MSBFS_SERVE_CLIENT_RATE`` > 0,
+  each distinct ``client_id`` refills at that rate (burst
+  ``MSBFS_SERVE_CLIENT_BURST``); one stampeding client exhausts its own
+  bucket and is rejected typed, instead of starving every other client
+  through the shared queue.  Requests without a client id are exempt
+  (backward compatible; the fleet router always forwards one).
+* **CoDel-style queue shedding** — with ``MSBFS_SERVE_CODEL_TARGET_MS``
+  > 0, the consumer watches the queue head's *sojourn time* (monotonic
+  clock).  Once it has stayed above the target for a full interval
+  (``MSBFS_SERVE_CODEL_INTERVAL_MS``), one victim is shed typed per
+  interval — the oldest ``batch`` request if any, else the head — which
+  keeps the queue short enough that admitted interactive work still
+  meets its deadline, rather than serving everyone equally late
+  (Nichols & Jacobson's CoDel insight, applied to an RPC queue).
+
+All three levers default **off** (no batch traffic, no rate, target 0):
+a stock daemon's admission behavior is bit-identical to PR 3.  Draining
+suspends CoDel shedding — accepted work is finished, per the drain
+contract.
 """
 
 from __future__ import annotations
@@ -40,6 +69,35 @@ DEFAULT_WINDOW_S = 0.002
 # this (the per-level intermediates are O(K * E); a runaway coalesce must
 # not assemble a batch the chip cannot hold).
 DEFAULT_MAX_ROWS = 1024
+# Overload-control defaults: batch traffic keeps the last quarter of the
+# queue free for interactive work; token buckets and CoDel are off until
+# their knobs arm them (rate/target of 0 = disabled).
+DEFAULT_BATCH_ADMIT_FRAC = 0.75
+DEFAULT_CODEL_INTERVAL_S = 0.1
+
+PRIORITIES = ("interactive", "batch")
+
+
+class TokenBucket:
+    """Classic leaky token bucket, monotonic-clock fed.  ``now`` is
+    injectable so admission tests run sleepless."""
+
+    def __init__(self, rate: float, burst: float, now: float):
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self.tokens = self.burst
+        self.stamp = float(now)
+
+    def take(self, now: float) -> bool:
+        """Spend one token if available; refills ``rate`` tokens/second
+        since the last call, capped at ``burst``."""
+        elapsed = max(0.0, float(now) - self.stamp)
+        self.stamp = float(now)
+        self.tokens = min(self.burst, self.tokens + elapsed * self.rate)
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return True
+        return False
 
 
 def pow2_pad(x: int) -> int:
@@ -71,6 +129,15 @@ class QueryRequest:
     # server sheds the request instead of computing an unwanted answer
     # (None = no client deadline on the wire).
     deadline: Optional[float] = None
+    # Overload-control metadata: priority class ("interactive" is the
+    # default — absent on the wire means a user is waiting) and the
+    # caller's self-declared client id for per-client rate limiting.
+    priority: str = "interactive"
+    client_id: Optional[str] = None
+    # Monotonic admission stamp (set by submit()): sojourn time for the
+    # CoDel controller and the health verb's queue-age gauge must not
+    # jump when the wall clock steps.
+    enqueued_mono: float = 0.0
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[dict] = None
     error: Optional[MsbfsError] = None
@@ -96,6 +163,11 @@ class MicroBatcher:
         capacity: Optional[int] = None,
         window_s: Optional[float] = None,
         max_rows: Optional[int] = None,
+        batch_admit_frac: Optional[float] = None,
+        client_rate: Optional[float] = None,
+        client_burst: Optional[float] = None,
+        codel_target_s: Optional[float] = None,
+        codel_interval_s: Optional[float] = None,
     ):
         if capacity is None:
             capacity = _env_int("MSBFS_SERVE_QUEUE", DEFAULT_QUEUE_CAPACITY)
@@ -103,13 +175,42 @@ class MicroBatcher:
             window_s = _env_float("MSBFS_SERVE_WINDOW", DEFAULT_WINDOW_S)
         if max_rows is None:
             max_rows = _env_int("MSBFS_SERVE_MAX_ROWS", DEFAULT_MAX_ROWS)
+        if batch_admit_frac is None:
+            batch_admit_frac = _env_float(
+                "MSBFS_SERVE_BATCH_ADMIT", DEFAULT_BATCH_ADMIT_FRAC
+            )
+        if client_rate is None:
+            client_rate = _env_float("MSBFS_SERVE_CLIENT_RATE", 0.0)
+        if client_burst is None:
+            client_burst = _env_float(
+                "MSBFS_SERVE_CLIENT_BURST", max(8.0, 2.0 * client_rate)
+            )
+        if codel_target_s is None:
+            codel_target_s = (
+                _env_float("MSBFS_SERVE_CODEL_TARGET_MS", 0.0) / 1000.0
+            )
+        if codel_interval_s is None:
+            codel_interval_s = (
+                _env_float("MSBFS_SERVE_CODEL_INTERVAL_MS",
+                           DEFAULT_CODEL_INTERVAL_S * 1000.0) / 1000.0
+            )
         self.execute = execute
         self.capacity = max(1, int(capacity))
         self.window_s = max(0.0, float(window_s))
         self.max_rows = max(1, int(max_rows))
+        self.batch_admit_frac = min(1.0, max(0.0, float(batch_admit_frac)))
+        self.client_rate = max(0.0, float(client_rate))
+        self.client_burst = max(1.0, float(client_burst))
+        self.codel_target_s = max(0.0, float(codel_target_s))
+        self.codel_interval_s = max(0.001, float(codel_interval_s))
         self.rejected = 0
+        self.rejected_batch = 0
+        self.rejected_client = 0
+        self.shed_overload = 0
         self.batches = 0
         self.coalesced = 0
+        self._buckets: dict = {}  # client_id -> TokenBucket
+        self._first_above: Optional[float] = None  # CoDel state
         self._queue: deque = deque()
         self._lock = threading.Lock()
         self._ready = threading.Condition(self._lock)
@@ -181,9 +282,15 @@ class MicroBatcher:
         return len(pending)
 
     # ---- producer side ----------------------------------------------------
-    def submit(self, request: QueryRequest) -> None:
+    def submit(self, request: QueryRequest,
+               now: Optional[float] = None) -> None:
         """Admit or reject-now.  Rejection is the typed BackpressureError
-        (wire exit code 7) and counts in stats."""
+        (wire exit code 7) and counts in stats, split by cause
+        (``rejected`` full queue / ``rejected_batch`` priority gate /
+        ``rejected_client`` token bucket).  ``now`` is an injectable
+        monotonic stamp for sleepless admission tests."""
+        if now is None:
+            now = time.monotonic()
         with self._lock:
             if self._stop:
                 raise MsbfsError("server is shutting down")
@@ -193,12 +300,44 @@ class MicroBatcher:
                 raise TransientError(
                     "server is draining; retry against another instance"
                 )
+            if self.client_rate > 0.0 and request.client_id is not None:
+                bucket = self._buckets.get(request.client_id)
+                if bucket is None:
+                    if len(self._buckets) > 4096:
+                        # Full buckets are indistinguishable from fresh
+                        # ones: drop them so one-shot client ids cannot
+                        # grow the map without bound.
+                        self._buckets = {
+                            cid: b for cid, b in self._buckets.items()
+                            if b.tokens < b.burst
+                        }
+                    bucket = TokenBucket(
+                        self.client_rate, self.client_burst, now
+                    )
+                    self._buckets[request.client_id] = bucket
+                if not bucket.take(now):
+                    self.rejected_client += 1
+                    raise BackpressureError(
+                        f"client {request.client_id!r} over its "
+                        f"{self.client_rate:g}/s admission rate; "
+                        "retry with backoff"
+                    )
+            if (request.priority == "batch"
+                    and len(self._queue)
+                    >= self.batch_admit_frac * self.capacity):
+                self.rejected_batch += 1
+                raise BackpressureError(
+                    "batch admission suspended above "
+                    f"{self.batch_admit_frac:g} queue utilization; "
+                    "retry with backoff"
+                )
             if len(self._queue) >= self.capacity:
                 self.rejected += 1
                 raise BackpressureError(
                     f"admission queue full ({self.capacity} pending); "
                     "retry with backoff"
                 )
+            request.enqueued_mono = now
             self._queue.append(request)
             self._ready.notify()
 
@@ -206,26 +345,85 @@ class MicroBatcher:
         with self._lock:
             return len(self._queue)
 
+    def oldest_age(self, now: Optional[float] = None) -> float:
+        """Monotonic age in seconds of the oldest *queued* request (0.0
+        when the queue is empty).  The autoscaler's stuck-head signal
+        and the health verb's gauge; monotonic-clock based, so a wall
+        clock stepping backward can never read as a drained queue."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            if not self._queue:
+                return 0.0
+            return max(0.0, now - self._queue[0].enqueued_mono)
+
     # ---- consumer side ----------------------------------------------------
+    def _shed_overload_locked(self, now: float) -> List[QueryRequest]:
+        """CoDel-style controller, lock held, run at every dequeue
+        opportunity.  Head sojourn above target continuously for a full
+        interval -> shed ONE victim (the oldest ``batch`` request if
+        any, else the head) and restart the interval.  Disabled while
+        draining: accepted work is finished, per the drain contract.
+        Returns the victims; the caller completes them outside the
+        execute path."""
+        if self.codel_target_s <= 0.0 or self._draining or not self._queue:
+            self._first_above = None
+            return []
+        sojourn = now - self._queue[0].enqueued_mono
+        if sojourn <= self.codel_target_s:
+            self._first_above = None
+            return []
+        if self._first_above is None:
+            self._first_above = now
+            return []
+        if now - self._first_above < self.codel_interval_s:
+            return []
+        victim_i = 0
+        for i, req in enumerate(self._queue):
+            if req.priority == "batch":
+                victim_i = i
+                break
+        victim = self._queue[victim_i]
+        del self._queue[victim_i]
+        self._first_above = now
+        self.shed_overload += 1
+        self._idle.notify_all()
+        return [victim]
+
     def _pop_batch(self) -> Optional[List[QueryRequest]]:
         """Block for a first request, wait out the window, then drain
         every queued request in the same (graph key+version, s_pad)
         bucket up to the row bound.  FIFO across buckets: only requests
         *behind* a different-bucket head wait for its batch."""
+        shed: List[QueryRequest] = []
+        head: Optional[QueryRequest] = None
         with self._lock:
             # The hold() gate is honored HERE, before popping: the worker
             # parks inside this wait loop between batches, so a gate that
             # was only checked in _run would let one held request through
             # (tests fill the queue under hold() to rehearse
             # backpressure; 0.1 s polling bounds the release latency).
-            while (
-                not self._queue or not self._gate.is_set()
-            ) and not self._stop:
-                self._ready.wait(0.1)
-            if self._stop and not self._queue:
-                return None
-            head = self._queue.popleft()
-            self._busy = True  # drain() must wait out this batch
+            while head is None:
+                while (
+                    not self._queue or not self._gate.is_set()
+                ) and not self._stop:
+                    self._ready.wait(0.1)
+                if self._stop and not self._queue:
+                    break
+                shed.extend(self._shed_overload_locked(time.monotonic()))
+                if self._queue:
+                    head = self._queue.popleft()
+                    self._busy = True  # drain() must wait out this batch
+        for req in shed:
+            if not req.done.is_set():
+                req.error = BackpressureError(
+                    "shed by overload control: queue sojourn above "
+                    f"{self.codel_target_s * 1000:g} ms for a full "
+                    "interval; retry with backoff"
+                )
+                req.done.set()
+        if head is None:
+            return None
         if self.window_s:
             time.sleep(self.window_s)
         batch = [head]

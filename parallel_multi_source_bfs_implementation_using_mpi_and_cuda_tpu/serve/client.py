@@ -229,17 +229,38 @@ class MsbfsClient:
         graph: str = "default",
         deadline_s: Optional[float] = None,
         hedge_after_s: Optional[float] = None,
+        priority: Optional[str] = None,
+        client_id: Optional[str] = None,
     ) -> dict:
         qs = [[int(v) for v in group] for group in queries]
         request = {"op": "query", "graph": graph, "queries": qs}
         if deadline_s is not None:
             request["deadline_s"] = float(deadline_s)
+        if priority is not None:
+            # "interactive" (default when absent) or "batch"; the server
+            # validates, so a typo fails typed rather than silently
+            # running at the wrong priority.
+            request["priority"] = str(priority)
+        if client_id is not None:
+            request["client_id"] = str(client_id)
         if hedge_after_s is None:
             return self.call(request, idempotent=True)
         return self._hedged_call(request, float(hedge_after_s))
 
     def stats(self) -> dict:
         return self.call({"op": "stats"}, idempotent=True)["stats"]
+
+    def posture(self, audit_sample=None, cache_only=None) -> dict:
+        """Push a brownout posture (docs/SERVING.md "Autoscaling &
+        overload"): ``audit_sample`` a rate in [0, 1] or ``"restore"``,
+        ``cache_only`` a bool.  Omitted fields are left unchanged.
+        Idempotent: re-pushing the same posture is a no-op."""
+        request: dict = {"op": "posture"}
+        if audit_sample is not None:
+            request["audit_sample"] = audit_sample
+        if cache_only is not None:
+            request["cache_only"] = bool(cache_only)
+        return self.call(request, idempotent=True)
 
     def shutdown(self) -> dict:
         return self.call({"op": "shutdown"})

@@ -19,29 +19,49 @@ movement), and when the replica comes back its own journal replay plus
 an idempotent re-load converge it — registration is load-once, so
 reconciliation is safe to repeat forever.
 
-Chaos seam (docs/RESILIENCE.md): each monitor tick of replica ``i``
-trips fault site ``replica<i>``; an armed ``replica_kill`` spec raises
-:class:`~..utils.faults.SimulatedReplicaKill`, which the supervisor
-converts into a real ``SIGKILL`` of that replica — journal replay, ring
-failover and restart backoff are all exercised against an actual
-process death.  ``MSBFS_FAULTS`` is deliberately STRIPPED from replica
-environments: the fleet plan belongs to the supervisor process, and a
-replica-level plan is injected explicitly via ``replica_faults``.
+The membership is **elastic** (docs/SERVING.md "Autoscaling &
+overload"): :meth:`add_replica` spawns a new slot and splices it into
+the ring (minimal movement — it steals only the keys it now wins), and
+:meth:`remove_replica` retires one *safely*: the victim leaves the ring
+first, reconcile re-registers its graphs on the promoted owners, and
+only then does it get SIGTERM — the PR-3 drain path finishes every
+accepted query before exit, so a scale-down loses zero acked work.
+When an :class:`~.autoscale.AutoscalePolicy` is armed, the monitor loop
+feeds it the queue signals each health probe already returns and
+applies its deltas; a :class:`~.brownout.BrownoutLadder` rides the same
+tick and pushes its rung to every replica via the ``posture`` verb.
+
+Replicas may advertise a ``host`` label and listen on TCP
+(``transport="tcp"``) so a fleet can span machines; the ring then
+spreads each graph's owner set across distinct hosts.
+
+Chaos seams (docs/RESILIENCE.md): each monitor tick of replica ``i``
+trips fault site ``replica<i>`` (``replica_kill`` -> real SIGKILL), and
+each distinct host label trips its own site, where an armed
+``host_down`` spec raises
+:class:`~..utils.faults.SimulatedHostDown` — every replica advertising
+that label is SIGKILLed in one tick, exercising cross-host failover.
+``MSBFS_FAULTS`` is deliberately STRIPPED from replica environments:
+the fleet plan belongs to the supervisor process, and a replica-level
+plan is injected explicitly via ``replica_faults``.
 """
 
 from __future__ import annotations
 
 import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set
 
 from ..runtime.supervisor import CorruptionError, RetryPolicy, TransientError
 from ..utils import faults
+from .autoscale import AutoscalePolicy, ReplicaSignal
+from .brownout import BrownoutLadder
 from .client import MsbfsClient, ServerError
 from .registry import content_hash
 from .ring import PlacementRing
@@ -58,20 +78,38 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def _alloc_port() -> int:
+    """Grab an ephemeral TCP port for a replica slot.  The port is
+    bound, read and released — a (tiny) race with other allocators is
+    acceptable for tests/benches; production fleets pass explicit
+    addresses per host."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
 @dataclass
 class ReplicaHandle:
     """One replica slot: a stable name + address whose process comes and
     goes.  The name (``r<i>``) is the ring member, so placement survives
     restarts; the journal path is per-slot, so a restarted process
-    replays its own history."""
+    replays its own history.  ``host`` is the failure-domain label the
+    ring spreads owners across (None = its own domain)."""
 
     index: int
     name: str
     address: str
     journal_path: str
     log_path: str
+    host: Optional[str] = None
+    weight: float = 1.0
     proc: Optional[subprocess.Popen] = None
-    state: str = "starting"  # starting | ready | down | failed
+    state: str = "starting"  # starting | ready | down | failed | draining | removed
+    draining: bool = False
     pid: Optional[int] = None
     restarts: int = 0
     injected_kills: int = 0
@@ -82,23 +120,32 @@ class ReplicaHandle:
     backoff: Optional[object] = None  # iterator over restart delays
     registered: Set[str] = field(default_factory=set)
     quarantines: int = 0
+    # Last health probe's queue gauge (autoscaler signal).
+    queue_depth: int = 0
+    queue_capacity: int = 1
+    queue_age_s: float = 0.0
 
     def describe(self) -> dict:
         return {
             "name": self.name,
             "address": self.address,
+            "host": self.host,
+            "weight": self.weight,
             "state": self.state,
             "pid": self.pid,
             "restarts": self.restarts,
             "injected_kills": self.injected_kills,
             "quarantines": self.quarantines,
             "last_exit": self.last_exit,
+            "queue_depth": self.queue_depth,
+            "queue_age_s": round(self.queue_age_s, 6),
             "graphs": sorted(self.registered),
         }
 
 
 class FleetSupervisor:
-    """Spawn, watch and heal a fleet of replica serving daemons.
+    """Spawn, watch, heal — and now grow and shrink — a fleet of replica
+    serving daemons.
 
     ``base_dir`` holds each replica's socket, journal and log.  The
     supervisor is intentionally stateless beyond the member list — kill
@@ -120,11 +167,24 @@ class FleetSupervisor:
         replica_faults: Optional[Dict[int, str]] = None,
         replica_env: Optional[Dict[int, Dict[str, str]]] = None,
         server_args: Optional[List[str]] = None,
+        transport: str = "unix",
+        hosts: Optional[Dict[int, str]] = None,
+        host_pool: Optional[List[str]] = None,
+        weights: Optional[Dict[int, float]] = None,
+        autoscale: Optional[AutoscalePolicy] = None,
+        brownout: Optional[BrownoutLadder] = None,
+        shed_fn: Optional[Callable[[], int]] = None,
+        drain_timeout_s: float = 60.0,
     ):
         if size < 1:
             raise ValueError(f"fleet size must be >= 1, got {size}")
+        if transport not in ("unix", "tcp"):
+            raise ValueError(
+                f"transport must be 'unix' or 'tcp', got {transport!r}"
+            )
         self.base_dir = os.path.abspath(base_dir)
         os.makedirs(self.base_dir, exist_ok=True)
+        self.transport = transport
         self.heartbeat_s = float(heartbeat_s)
         self.heartbeat_timeout_s = (
             float(heartbeat_timeout_s)
@@ -132,6 +192,7 @@ class FleetSupervisor:
             else max(4 * self.heartbeat_s, 5.0)
         )
         self.boot_timeout_s = float(boot_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
         # PR-1 backoff semantics for process restarts: bounded, jittered,
         # seeded — a crash-looping replica backs off to max_delay and a
         # replica that exhausts the schedule is marked failed (the fleet
@@ -153,18 +214,29 @@ class FleetSupervisor:
             int(i): dict(v) for i, v in (replica_env or {}).items()
         }
         self._server_args = list(server_args or [])
+        self._hosts_cfg = {int(i): str(h) for i, h in (hosts or {}).items()}
+        self._host_pool = list(host_pool or [])
+        self._weights_cfg = {
+            int(i): float(w) for i, w in (weights or {}).items()
+        }
+        self.autoscale = autoscale
+        self.brownout = brownout
+        self.shed_fn = shed_fn
+        self._shed_last = 0
+        self._controllers_armed = False
+        self._next_index = 0
         self.replicas: List[ReplicaHandle] = [
-            ReplicaHandle(
-                index=i,
-                name=f"r{i}",
-                address=f"unix:{os.path.join(self.base_dir, f'r{i}.sock')}",
-                journal_path=os.path.join(self.base_dir, f"r{i}.journal"),
-                log_path=os.path.join(self.base_dir, f"r{i}.log"),
-            )
-            for i in range(size)
+            self._make_handle(i) for i in range(size)
         ]
+        self._next_index = size
+        self.addresses: Dict[str, str] = {
+            r.name: r.address for r in self.replicas
+        }
         self.ring = PlacementRing(
-            [r.name for r in self.replicas], replication=replication
+            [r.name for r in self.replicas],
+            replication=replication,
+            weights={r.name: r.weight for r in self.replicas},
+            hosts={r.name: r.host for r in self.replicas if r.host},
         )
         self.graphs: Dict[str, str] = {}  # name -> path
         self.digests: Dict[str, str] = {}  # name -> content digest
@@ -174,6 +246,37 @@ class FleetSupervisor:
         self._monitor: Optional[threading.Thread] = None
         self._log_files: List[object] = []
         self.started = False
+
+    def _host_for(self, index: int) -> Optional[str]:
+        if index in self._hosts_cfg:
+            return self._hosts_cfg[index]
+        if self._host_pool:
+            return self._host_pool[index % len(self._host_pool)]
+        return None
+
+    def _make_handle(
+        self,
+        index: int,
+        weight: Optional[float] = None,
+        host: Optional[str] = None,
+    ) -> ReplicaHandle:
+        if self.transport == "tcp":
+            address = f"127.0.0.1:{_alloc_port()}"
+        else:
+            address = f"unix:{os.path.join(self.base_dir, f'r{index}.sock')}"
+        return ReplicaHandle(
+            index=index,
+            name=f"r{index}",
+            address=address,
+            journal_path=os.path.join(self.base_dir, f"r{index}.journal"),
+            log_path=os.path.join(self.base_dir, f"r{index}.log"),
+            host=host if host is not None else self._host_for(index),
+            weight=(
+                weight
+                if weight is not None
+                else self._weights_cfg.get(index, 1.0)
+            ),
+        )
 
     # ---- lifecycle --------------------------------------------------------
     def start(self, wait_ready_s: Optional[float] = None) -> None:
@@ -215,7 +318,8 @@ class FleetSupervisor:
                 proc.kill()
                 proc.wait(timeout=30.0)
             r.last_exit = proc.returncode
-            r.state = "down"
+            if r.state != "removed":
+                r.state = "down"
             r.pid = None
         for f in self._log_files:
             try:
@@ -230,14 +334,28 @@ class FleetSupervisor:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    def active_replicas(self) -> List[ReplicaHandle]:
+        """Slots that count toward fleet size: not removed, not on the
+        way out."""
+        with self._lock:
+            return [
+                r
+                for r in self.replicas
+                if r.state != "removed" and not r.draining
+            ]
+
     def wait_ready(self, timeout_s: float, quorum: Optional[int] = None) -> None:
-        """Block until ``quorum`` replicas (default: all) report ready."""
-        want = len(self.replicas) if quorum is None else int(quorum)
+        """Block until ``quorum`` replicas (default: all active) report
+        ready."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
+            want = (
+                len(self.active_replicas()) if quorum is None else int(quorum)
+            )
             if len(self.ready_names()) >= want:
                 return
             time.sleep(min(0.1, self.heartbeat_s))
+        want = len(self.active_replicas()) if quorum is None else int(quorum)
         raise TransientError(
             f"fleet: {len(self.ready_names())}/{want} replicas ready "
             f"after {timeout_s:g}s (states: "
@@ -246,12 +364,13 @@ class FleetSupervisor:
 
     # ---- spawning ---------------------------------------------------------
     def _spawn(self, r: ReplicaHandle) -> None:
-        sock_path = r.address[len("unix:"):]
-        if os.path.exists(sock_path):
-            try:
-                os.unlink(sock_path)
-            except OSError:
-                pass
+        if r.address.startswith("unix:"):
+            sock_path = r.address[len("unix:"):]
+            if os.path.exists(sock_path):
+                try:
+                    os.unlink(sock_path)
+                except OSError:
+                    pass
         env = dict(self._env)
         env.update(self._replica_env.get(r.index, {}))
         plan = self._replica_faults.get(r.index)
@@ -289,22 +408,154 @@ class FleetSupervisor:
         r.state = "down"
         r.restart_due = time.monotonic() + delay
 
+    # ---- elastic membership -----------------------------------------------
+    def add_replica(
+        self, weight: float = 1.0, host: Optional[str] = None
+    ) -> ReplicaHandle:
+        """Scale up by one slot: fresh index (slot names are never
+        reused, so a removed replica's journal can't be replayed by an
+        unrelated successor), spliced into the ring with minimal
+        movement, spawned immediately when the fleet is running.
+        Reconcile then loads onto it exactly the graphs it now owns."""
+        with self._lock:
+            i = self._next_index
+            self._next_index += 1
+            r = self._make_handle(
+                i,
+                weight=weight,
+                host=host if host is not None else self._host_for(i),
+            )
+            self.replicas.append(r)
+            self.addresses[r.name] = r.address
+            self.ring.add_member(r.name, weight=r.weight, host=r.host)
+            if self.started and not self._stop.is_set():
+                self._spawn(r)
+        return r
+
+    def remove_replica(
+        self,
+        name: str,
+        sync: bool = True,
+        drain_timeout_s: Optional[float] = None,
+    ) -> bool:
+        """Scale down by one slot, safely.  Ordering is the contract:
+
+        1. the victim leaves the ring — new queries route to the
+           promoted owners, nothing new lands on it;
+        2. reconcile re-registers its graphs on those owners NOW, while
+           the victim still serves (no availability dip);
+        3. SIGTERM — the PR-3 drain path finishes every accepted query
+           (in flight AND queued) and exits 0; only a drain-timeout
+           stalls to SIGKILL.
+
+        ``sync=False`` runs step 3 on a background thread (the monitor
+        loop uses this so a scale-down never blocks heartbeats).
+        Returns False when ``name`` is unknown or already leaving."""
+        timeout = (
+            self.drain_timeout_s
+            if drain_timeout_s is None
+            else float(drain_timeout_s)
+        )
+        with self._lock:
+            r = next((x for x in self.replicas if x.name == name), None)
+            if r is None or r.draining or r.state == "removed":
+                return False
+            live = [
+                x
+                for x in self.replicas
+                if x.state != "removed" and not x.draining
+            ]
+            if len(live) <= 1:
+                raise ValueError("cannot remove the last live replica")
+            r.draining = True
+            r.state = "draining"
+            if r.name in self.ring.members:
+                self.ring.remove_member(r.name)
+        # Promoted owners pick the victim's graphs up while it still
+        # answers — the walk order is ring order, so by the time the
+        # victim stops accepting, its keys already have live homes.
+        self._reconcile()
+        if sync:
+            self._drain_victim(r, timeout)
+        else:
+            threading.Thread(
+                target=self._drain_victim,
+                args=(r, timeout),
+                name="msbfs-fleet-drain",
+                daemon=True,
+            ).start()
+        return True
+
+    def _drain_victim(self, r: ReplicaHandle, timeout: float) -> None:
+        proc = r.proc
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    proc.kill()
+                    proc.wait(timeout=30.0)
+                except OSError:
+                    pass
+        if proc is not None:
+            r.last_exit = proc.returncode
+        with self._lock:
+            r.pid = None
+            r.state = "removed"
+            self.addresses.pop(r.name, None)
+
     # ---- monitoring -------------------------------------------------------
     def _monitor_loop(self) -> None:
         while not self._stop.wait(self.heartbeat_s):
             try:
+                self._tick_hosts()
+                with self._lock:
+                    snapshot = list(self.replicas)
                 changed = False
-                for r in self.replicas:
+                for r in snapshot:
                     changed |= self._tick(r)
                 if changed:
                     self._reconcile()
+                self._control_tick()
             except Exception:  # noqa: BLE001 — the monitor must survive
                 pass
+
+    def _tick_hosts(self) -> None:
+        """Trip each distinct host label as a fault site.  An armed
+        ``host_down:<host>`` plan raises SimulatedHostDown here; the
+        supervisor answers with a real SIGKILL of every replica on that
+        host — a whole failure domain gone in one heartbeat."""
+        with self._lock:
+            labels: List[str] = []
+            for r in self.replicas:
+                if r.host and r.state != "removed" and r.host not in labels:
+                    labels.append(r.host)
+        for label in labels:
+            try:
+                faults.trip(label)
+            except faults.SimulatedHostDown as down:
+                self._kill_host(down.host)
+
+    def _kill_host(self, host: str) -> None:
+        with self._lock:
+            victims = [r for r in self.replicas if r.host == host]
+        for v in victims:
+            if v.proc is not None and v.proc.poll() is None:
+                v.injected_kills += 1
+                try:
+                    v.proc.kill()
+                    v.proc.wait(timeout=30.0)
+                except OSError:
+                    pass
 
     def _tick(self, r: ReplicaHandle) -> bool:
         """One heartbeat of one replica; True when its readiness flipped
         (the reconcile trigger).  This is the fleet chaos seam."""
-        if r.state == "failed":
+        if r.state in ("failed", "removed") or r.draining:
             return False
         try:
             faults.trip(f"replica{r.index}")
@@ -334,12 +585,16 @@ class FleetSupervisor:
                 self._spawn(r)
             return was_ready
         # Process is alive: probe readiness.
-        healthy = self._probe(r)
+        healthy = self._probe(r) is not None
         if healthy:
             r.last_ok = now
             if r.state != "ready":
                 r.state = "ready"
                 r.backoff = None  # a recovered replica regains full budget
+                # A replica (re)joining mid-brownout must adopt the
+                # current posture — transitions it missed don't re-fire.
+                if self.brownout is not None and self.brownout.level > 0:
+                    self._push_posture_one(r)
             return not was_ready
         if was_ready and now - r.last_ok > self.heartbeat_timeout_s:
             # Alive but unresponsive past the timeout: treat as dead —
@@ -362,10 +617,11 @@ class FleetSupervisor:
             self._schedule_restart(r)
         return False
 
-    def _probe(self, r: ReplicaHandle) -> bool:
+    def _probe(self, r: ReplicaHandle) -> Optional[dict]:
         """One health round trip; no retries (the heartbeat IS the retry
         loop).  Ready means journal replay finished and the daemon is
-        accepting work."""
+        accepting work.  Returns the health payload (the autoscaler's
+        queue signals ride it) or None when not ready."""
         try:
             with MsbfsClient(
                 r.address,
@@ -373,9 +629,116 @@ class FleetSupervisor:
                 retry=RetryPolicy(max_retries=0),
             ) as c:
                 h = c.health()
-            return bool(h.get("ready")) and not h.get("draining")
         except (ServerError, OSError, ValueError):
-            return False
+            return None
+        if not h.get("ready") or h.get("draining"):
+            return None
+        q = h.get("queue") or {}
+        r.queue_depth = int(q.get("depth", h.get("queue_depth", 0)) or 0)
+        r.queue_capacity = max(1, int(q.get("capacity", 1) or 1))
+        r.queue_age_s = float(q.get("oldest_age_s", 0.0) or 0.0)
+        return h
+
+    # ---- overload control loop --------------------------------------------
+    def _control_tick(self) -> None:
+        """Feed the autoscaler and the brownout ladder one heartbeat of
+        fleet signal and apply what they decide.  Both are optional and
+        both are pure controllers — this is the only place decisions
+        turn into membership changes or posture pushes."""
+        if self.autoscale is None and self.brownout is None:
+            return
+        shed_delta = 0
+        if self.shed_fn is not None:
+            try:
+                shed_now = int(self.shed_fn())
+            except Exception:  # noqa: BLE001 — signal, not control
+                shed_now = self._shed_last
+            shed_delta = max(0, shed_now - self._shed_last)
+            self._shed_last = shed_now
+        with self._lock:
+            active = [
+                r
+                for r in self.replicas
+                if r.state != "removed" and not r.draining
+            ]
+            signals = [
+                ReplicaSignal(
+                    utilization=r.queue_depth / max(1, r.queue_capacity),
+                    oldest_age_s=r.queue_age_s,
+                )
+                for r in active
+                if r.state == "ready"
+            ]
+            size = len(active)
+        # An unready fleet is not a dead fleet: until the first replica
+        # has ever reported ready, an empty signal list means "still
+        # booting", and the policy's empty-is-hot rule (meant for a
+        # fleet that LOST everything) would scale up against thin air.
+        if signals:
+            self._controllers_armed = True
+        elif not self._controllers_armed:
+            return
+        if self.brownout is not None:
+            high = (
+                self.autoscale.config.high_watermark
+                if self.autoscale is not None
+                else 0.75
+            )
+            util = (
+                sum(s.utilization for s in signals) / len(signals)
+                if signals
+                else 0.0
+            )
+            saturated = bool(signals) and (util >= high or shed_delta > 0)
+            if self.brownout.tick(saturated) is not None:
+                self._push_posture()
+        if self.autoscale is None or self._stop.is_set():
+            return
+        delta = self.autoscale.tick(
+            size=size, replicas=signals, shed_since_last=shed_delta
+        )
+        if delta > 0:
+            for _ in range(delta):
+                try:
+                    self.add_replica()
+                except Exception:  # noqa: BLE001
+                    self.autoscale.cancel()
+                    break
+        elif delta < 0:
+            # Retire the newest ready replicas first: they own the
+            # fewest long-lived keys and their journals are smallest.
+            victims = [r for r in reversed(active) if r.state == "ready"]
+            victims = victims[: -delta]
+            if not victims:
+                self.autoscale.cancel()
+            for v in victims:
+                try:
+                    self.remove_replica(v.name, sync=False)
+                except ValueError:
+                    self.autoscale.cancel()
+
+    def _push_posture(self) -> None:
+        with self._lock:
+            targets = [r for r in self.replicas if r.state == "ready"]
+        for r in targets:
+            self._push_posture_one(r)
+
+    def _push_posture_one(self, r: ReplicaHandle) -> None:
+        """Best-effort posture push; a miss is healed on the replica's
+        next ready flip or the ladder's next transition."""
+        if self.brownout is None:
+            return
+        audit = 0.0 if self.brownout.audit_suppressed() else "restore"
+        try:
+            with MsbfsClient(
+                r.address, timeout=10.0, retry=RetryPolicy(max_retries=0)
+            ) as c:
+                c.posture(
+                    audit_sample=audit,
+                    cache_only=self.brownout.cache_only(),
+                )
+        except (ServerError, OSError, ValueError):
+            pass
 
     # ---- placement --------------------------------------------------------
     def register(self, name: str, path: str) -> List[str]:
@@ -474,12 +837,15 @@ class FleetSupervisor:
         with self._lock:
             digests = dict(self.digests)
             refused = dict(self.refused_graphs)
-        return {
-            "size": len(self.replicas),
+            replicas = list(self.replicas)
+        out = {
+            "size": len([r for r in replicas if r.state != "removed"]),
+            "slots": self._next_index,
+            "transport": self.transport,
             "replication": self.ring.replication,
             "refused_graphs": refused,
             "ready": sorted(self.ready_names()),
-            "replicas": [r.describe() for r in self.replicas],
+            "replicas": [r.describe() for r in replicas],
             "graphs": {
                 name: {
                     "digest": digest,
@@ -491,3 +857,8 @@ class FleetSupervisor:
                 for name, digest in digests.items()
             },
         }
+        if self.autoscale is not None:
+            out["autoscale"] = self.autoscale.describe()
+        if self.brownout is not None:
+            out["brownout"] = self.brownout.describe()
+        return out

@@ -44,6 +44,7 @@ primary's answer stands.
 from __future__ import annotations
 
 import os
+import re
 import socket
 import sys
 import threading
@@ -119,6 +120,7 @@ class FleetRouter:
         hedge_after_s: Optional[float] = None,
         vote_rate: Optional[float] = None,
         quarantine_fn=None,
+        brownout_fn=None,
     ):
         missing = [m for m in ring.members if m not in addresses]
         if missing:
@@ -134,6 +136,10 @@ class FleetRouter:
             else min(max(float(vote_rate), 0.0), 1.0)
         )
         self.quarantine_fn = quarantine_fn
+        # Brownout hook (docs/SERVING.md "Autoscaling & overload"): a
+        # callable answering "suppress voting right now?".  Rung 1 of
+        # the ladder turns the vote's shadow traffic off router-side.
+        self.brownout_fn = brownout_fn
         self._vote_acc = 0.0
         self._index = {m: i for i, m in enumerate(ring.members)}
         self._lock = threading.Lock()
@@ -144,6 +150,7 @@ class FleetRouter:
             "hedged": 0,
             "shed": 0,
             "votes": 0,
+            "votes_suppressed": 0,
             "vote_mismatches": 0,
             "vote_unresolved": 0,
             "quarantined": 0,
@@ -161,6 +168,9 @@ class FleetRouter:
         kw.setdefault(
             "quarantine_fn", getattr(supervisor, "quarantine", None)
         )
+        ladder = getattr(supervisor, "brownout", None)
+        if ladder is not None:
+            kw.setdefault("brownout_fn", ladder.vote_suppressed)
         router = cls(
             ring=supervisor.ring,
             addresses={r.name: r.address for r in supervisor.replicas},
@@ -168,19 +178,40 @@ class FleetRouter:
             alive_fn=supervisor.ready_names,
             **kw,
         )
-        # The constructor snapshots its digests (static placement); a
-        # fleet router must instead share the supervisor's table so
-        # graphs registered after construction route immediately — the
-        # `msbfs fleet` boot order is router first, -g registrations
-        # second.
+        # The constructor snapshots its digests and addresses (static
+        # placement); a fleet router must instead share the supervisor's
+        # live tables so graphs registered after construction route
+        # immediately (the `msbfs fleet` boot order is router first,
+        # -g registrations second) and replicas added or removed by the
+        # autoscaler are routable the moment the ring knows them.
         router.digests = supervisor.digests
+        addresses = getattr(supervisor, "addresses", None)
+        if addresses is not None:
+            router.addresses = addresses
         return router
 
     def _bump(self, key: str, member: Optional[str] = None) -> None:
         with self._lock:
             self._stats[key] += 1
             if member is not None:
-                self._stats["per_replica"][member] += 1
+                per = self._stats["per_replica"]
+                per[member] = per.get(member, 0) + 1
+
+    _SLOT_RE = re.compile(r"r(\d+)\Z")
+
+    def _route_index(self, member: str) -> int:
+        """Chaos-site index for a member.  Supervisor slot names encode
+        their index (``r<i>`` -> ``route<i>``), which keeps fault sites
+        stable across elastic membership churn; anything else gets the
+        next free index on first sight."""
+        with self._lock:
+            i = self._index.get(member)
+            if i is None:
+                m = self._SLOT_RE.match(member)
+                i = int(m.group(1)) if m else len(self._index)
+                self._index[member] = i
+                self._stats["per_replica"].setdefault(member, 0)
+            return i
 
     # ---- query path -------------------------------------------------------
     def owners_for(self, graph: str) -> List[str]:
@@ -199,9 +230,14 @@ class FleetRouter:
         graph: str = "default",
         deadline_s: Optional[float] = None,
         hedge_after_s: Optional[float] = None,
+        priority: Optional[str] = None,
+        client_id: Optional[str] = None,
     ) -> dict:
         """Forward one query batch; returns the replica's response dict
-        plus routing metadata (``replica``, ``failovers``)."""
+        plus routing metadata (``replica``, ``failovers``).  The
+        admission-control fields (``priority``, ``client_id``) ride
+        through unchanged — shedding decisions belong to the replica's
+        batcher, not the router."""
         owners = self.owners_for(graph)
         if not owners:
             raise TransientError(
@@ -221,15 +257,22 @@ class FleetRouter:
                 if remaining <= 0:
                     break  # out of budget: report shed/transient below
             try:
-                faults.trip(f"route{self._index[member]}")
+                faults.trip(f"route{self._route_index(member)}")
             except faults.SimulatedNetDrop as drop:
                 self._bump("net_drops")
                 failovers += 1
                 last_err = drop
                 continue
+            address = self.addresses.get(member)
+            if address is None:
+                # Membership race: the member left (scale-down drain)
+                # between the owners snapshot and this attempt.
+                failovers += 1
+                last_err = KeyError(member)
+                continue
             try:
                 with MsbfsClient(
-                    self.addresses[member],
+                    address,
                     timeout=(
                         self.timeout if remaining is None
                         else min(self.timeout, remaining)
@@ -241,6 +284,8 @@ class FleetRouter:
                         graph=graph,
                         deadline_s=remaining,
                         hedge_after_s=hedge_after_s,
+                        priority=priority,
+                        client_id=client_id,
                     )
             except ServerError as err:
                 if err.type_name == "BackpressureError":
@@ -269,11 +314,16 @@ class FleetRouter:
             out["replica"] = member
             out["failovers"] = failovers
             if self._vote_due():
-                deadline = (
-                    None if deadline_s is None else start + deadline_s
-                )
-                out = self._vote(member, owners, queries, graph,
-                                 deadline, out)
+                if self._vote_suppressed():
+                    # Brownout rung >= 1: the sample was due but the
+                    # ladder says capacity beats redundancy right now.
+                    self._bump("votes_suppressed")
+                else:
+                    deadline = (
+                        None if deadline_s is None else start + deadline_s
+                    )
+                    out = self._vote(member, owners, queries, graph,
+                                     deadline, out)
             return out
         if saturated and saturated >= failovers:
             # Every owner we reached said "queue full": the fleet is
@@ -290,6 +340,17 @@ class FleetRouter:
         )
 
     # ---- cross-replica voting ---------------------------------------------
+    def _vote_suppressed(self) -> bool:
+        """True while the brownout ladder (rung >= 1) says to skip the
+        vote's shadow traffic.  A broken hook reads as not-suppressed:
+        integrity redundancy only yields to an affirmative signal."""
+        if self.brownout_fn is None:
+            return False
+        try:
+            return bool(self.brownout_fn())
+        except Exception:  # noqa: BLE001 — a signal, never a failure
+            return False
+
     def _vote_due(self) -> bool:
         """Deterministic accumulator sampling (no RNG — two runs of the
         same query stream vote the same queries, which keeps chaos
@@ -313,10 +374,13 @@ class FleetRouter:
         doesn't happen, exactly like a dead owner in the main walk."""
         if remaining is not None and remaining <= 0:
             return None
+        address = self.addresses.get(member)
+        if address is None:
+            return None
         try:
-            faults.trip(f"route{self._index[member]}")
+            faults.trip(f"route{self._route_index(member)}")
             with MsbfsClient(
-                self.addresses[member],
+                address,
                 timeout=(
                     self.timeout if remaining is None
                     else min(self.timeout, remaining)
@@ -543,6 +607,8 @@ class FleetFrontend:
                     graph=request.get("graph", "default"),
                     deadline_s=request.get("deadline_s"),
                     hedge_after_s=request.get("hedge_after_s"),
+                    priority=request.get("priority"),
+                    client_id=request.get("client_id"),
                 )
                 out["ok"] = True
                 return out
@@ -602,7 +668,78 @@ class FleetFrontend:
         out = {"router": self.router.stats()}
         if self.supervisor is not None:
             out["fleet"] = self.supervisor.status()
+            per, totals = self._rollup()
+            out["replicas"] = per
+            out["totals"] = totals
         return out
+
+    # Per-replica stats fields summed into the fleet-wide roll-up; the
+    # queue gauge keys live under each replica's "queue" section.
+    _ROLLUP_KEYS = (
+        "requests_total",
+        "requests_failed",
+        "requests_shed",
+        "requests_quarantined",
+        "audited",
+        "audit_failures",
+        "journal_bytes",
+    )
+    _ROLLUP_QUEUE_KEYS = (
+        "depth",
+        "rejected",
+        "rejected_batch",
+        "rejected_client",
+        "shed_overload",
+    )
+
+    def _rollup(self):
+        """Fleet-wide observability in one verb: fetch each ready
+        replica's ``stats`` and sum the load/shed/integrity counters.
+        Best-effort per replica — a replica that does not answer is
+        listed with an ``error`` and skipped from the totals (the
+        operator sees the hole, the verb still answers)."""
+        per: Dict[str, dict] = {}
+        totals = {k: 0 for k in self._ROLLUP_KEYS}
+        totals.update({f"queue_{k}": 0 for k in self._ROLLUP_QUEUE_KEYS})
+        totals["shed_brownout"] = 0
+        totals["replicas_reporting"] = 0
+        with getattr(self.supervisor, "_lock", threading.Lock()):
+            targets = [
+                (r.name, r.address)
+                for r in self.supervisor.replicas
+                if r.state == "ready"
+            ]
+        for name, address in targets:
+            try:
+                with MsbfsClient(
+                    address, timeout=10.0, retry=_NO_RETRY
+                ) as c:
+                    s = c.stats()
+            except (ServerError, protocol.ProtocolError, OSError,
+                    socket.timeout, ValueError) as exc:
+                per[name] = {"error": str(exc)}
+                continue
+            queue = s.get("queue") or {}
+            posture = s.get("posture") or {}
+            row = {k: int(s.get(k, 0) or 0) for k in self._ROLLUP_KEYS}
+            row.update(
+                {
+                    f"queue_{k}": int(queue.get(k, 0) or 0)
+                    for k in self._ROLLUP_QUEUE_KEYS
+                }
+            )
+            row["queue_oldest_age_s"] = float(
+                queue.get("oldest_age_s", 0.0) or 0.0
+            )
+            row["shed_brownout"] = int(
+                posture.get("shed_brownout", 0) or 0
+            )
+            per[name] = row
+            totals["replicas_reporting"] += 1
+            for k, v in row.items():
+                if k in totals and k != "replicas_reporting":
+                    totals[k] += v
+        return per, totals
 
 
 def fleet_main(argv: Optional[List[str]] = None) -> int:
@@ -641,8 +778,30 @@ def fleet_main(argv: Optional[List[str]] = None) -> int:
                     help="replica heartbeat period (default 500)")
     ap.add_argument("--wait-ready-s", type=float, default=240.0,
                     help="block until all replicas are ready (0 skips)")
+    ap.add_argument(
+        "--transport", choices=("unix", "tcp"), default="unix",
+        help="replica listener transport; tcp advertises host:port "
+        "addresses for cross-host fleets (default unix)",
+    )
+    ap.add_argument(
+        "--hosts", default="", metavar="LABEL[,LABEL...]",
+        help="comma-separated host labels round-robined over replicas; "
+        "the ring then spreads each graph's owners across labels",
+    )
+    ap.add_argument(
+        "--autoscale-max", type=int, default=0, metavar="N",
+        help="arm the autoscaler: grow from --size up to N replicas "
+        "under load, shrink back when quiet (0 = fixed size)",
+    )
+    ap.add_argument(
+        "--brownout", action="store_true",
+        help="arm the brownout ladder (vote -> audit -> cache-only "
+        "quality step-down under sustained saturation)",
+    )
     args = ap.parse_args(argv)
 
+    from .autoscale import AutoscaleConfig, AutoscalePolicy
+    from .brownout import BrownoutLadder
     from .fleet import FleetSupervisor
 
     plan = faults.FaultPlan.from_env()
@@ -650,12 +809,30 @@ def fleet_main(argv: Optional[List[str]] = None) -> int:
     base_dir = args.base_dir or os.environ.get(
         "MSBFS_FLEET_DIR", "/tmp/msbfs-fleet"
     )
+    autoscale = None
+    if args.autoscale_max:
+        autoscale = AutoscalePolicy(
+            AutoscaleConfig(
+                min_replicas=args.size,
+                max_replicas=max(args.size, args.autoscale_max),
+            )
+        )
+    brownout = None
+    if args.brownout:
+        brownout = BrownoutLadder(
+            journal_path=os.path.join(base_dir, "brownout.jsonl")
+        )
+    host_pool = [h.strip() for h in args.hosts.split(",") if h.strip()]
     try:
         supervisor = FleetSupervisor(
             size=args.size,
             base_dir=base_dir,
             replication=args.replication,
             heartbeat_s=args.heartbeat_ms / 1000.0,
+            transport=args.transport,
+            host_pool=host_pool or None,
+            autoscale=autoscale,
+            brownout=brownout,
         )
         supervisor.start(
             wait_ready_s=args.wait_ready_s or None
@@ -664,6 +841,9 @@ def fleet_main(argv: Optional[List[str]] = None) -> int:
         print(f"msbfs fleet: {err}", file=sys.stderr)
         return getattr(err, "exit_code", 1)
     router = FleetRouter.for_fleet(supervisor)
+    # The autoscaler's "admission collapse" signal is the router's shed
+    # counter: fleet-level backpressure is what capacity must answer.
+    supervisor.shed_fn = lambda: router.stats().get("shed", 0)
     frontend = FleetFrontend(args.listen, router, supervisor=supervisor)
     try:
         for spec in args.graph:

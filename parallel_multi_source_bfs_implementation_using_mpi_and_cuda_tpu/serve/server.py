@@ -55,7 +55,13 @@ from ..runtime.supervisor import (
 )
 from ..utils import faults
 from . import lifecycle, protocol
-from .batcher import MicroBatcher, QueryRequest, bucket_label, pow2_pad
+from .batcher import (
+    PRIORITIES,
+    MicroBatcher,
+    QueryRequest,
+    bucket_label,
+    pow2_pad,
+)
 from .caches import ExecutableCache, LRUCache
 from .journal import StateJournal
 from .registry import GraphEntry, GraphRegistry
@@ -184,7 +190,15 @@ class MsbfsServer:
         self._failed_requests = 0
         self._requests_total = 0
         self._shed_requests = 0
+        self._shed_brownout = 0
         self._quarantined_requests = 0
+        # Brownout posture (serve/brownout.py, pushed by the fleet's
+        # ``posture`` verb): an audit-sample override applied to every
+        # supervisor — including ones registered later — and the
+        # cache-only switch for batch-priority traffic.
+        self._posture_audit: Optional[float] = None
+        self._posture_cache_only = False
+        self._audit_saved: Dict[str, float] = {}  # pre-override samples
         self._last_batch_ts: Optional[float] = None
         self._sock: Optional[socket.socket] = None
         self._accept_thread: Optional[threading.Thread] = None
@@ -216,6 +230,14 @@ class MsbfsServer:
         known = self.registry.maybe_get(name)
         entry = self.registry.load(name, path, expected_hash=expected_hash)
         entry.supervisor.drain_signal = self._drain_signal
+        if self._posture_audit is not None:
+            # A graph registered mid-brownout inherits the pushed
+            # posture; its configured rate is stashed like the rest so
+            # the restore push puts it back.
+            self._audit_saved.setdefault(
+                name, float(entry.supervisor.audit_sample)
+            )
+            entry.supervisor.audit_sample = self._posture_audit
         if self.journal is not None and (known is None or known is not entry):
             self.journal.append(
                 {"op": "load", "name": name, "path": path,
@@ -515,6 +537,8 @@ class MsbfsServer:
                 return self._op_query(request)
             if op == "stats":
                 return {"ok": True, "op": "stats", "stats": self.stats()}
+            if op == "posture":
+                return self._op_posture(request)
             if op == "shutdown":
                 return {"ok": True, "op": "shutdown"}
             raise InputError(f"unknown op {op!r}")
@@ -542,6 +566,15 @@ class MsbfsServer:
             "graphs_warm": len(self.registry.describe()),
             "warm_buckets": warm,
             "queue_depth": self.batcher.depth(),
+            # The autoscaler's input gauge: depth over capacity plus the
+            # monotonic-clock age of the queue head (0.0 when empty; a
+            # wall-clock step can never read as a drained queue).
+            # Semantics pinned by tests/test_stampede.py.
+            "queue": {
+                "depth": self.batcher.depth(),
+                "capacity": self.batcher.capacity,
+                "oldest_age_s": round(self.batcher.oldest_age(), 6),
+            },
             "last_batch_age_s": (
                 None if last_batch is None
                 else round(time.time() - last_batch, 3)
@@ -624,6 +657,14 @@ class MsbfsServer:
         entry = self.registry.get(name)
         rows = self._parse_queries(request)
         s_pad = int(rows.shape[1])
+        priority = request.get("priority", "interactive")
+        if priority not in PRIORITIES:
+            raise InputError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}"
+            )
+        client_id = request.get("client_id")
+        if client_id is not None and not isinstance(client_id, str):
+            raise InputError("client_id must be a string")
         with self._stats_lock:
             self._requests_total += 1
         cache_key = (entry.key, rows.shape, rows.tobytes())
@@ -632,6 +673,17 @@ class MsbfsServer:
             out = dict(cached)
             out["cached"] = True
             return out
+        if self._posture_cache_only and priority == "batch":
+            # Deepest brownout rung: batch traffic is answered only from
+            # the result cache — a fresh batch query is shed typed
+            # BEFORE touching the queue, keeping what headroom remains
+            # for interactive work (docs/SERVING.md).
+            with self._stats_lock:
+                self._shed_brownout += 1
+            raise BackpressureError(
+                "brownout: batch queries are served from the result "
+                "cache only; retry later"
+            )
         deadline = None
         raw_deadline = request.get("deadline_s")
         if raw_deadline is not None:
@@ -652,6 +704,8 @@ class MsbfsServer:
             s_pad=s_pad,
             submitted=time.time(),
             deadline=deadline,
+            priority=priority,
+            client_id=client_id,
         )
         self.batcher.submit(req)  # raises BackpressureError when full
         if not req.done.wait(self.request_timeout_s):
@@ -670,6 +724,52 @@ class MsbfsServer:
         out = dict(response)
         out["cached"] = False
         return out
+
+    def _op_posture(self, request: dict) -> dict:
+        """Brownout posture push (serve/brownout.py, docs/SERVING.md
+        "Autoscaling & overload").  ``audit_sample``: a number in [0, 1]
+        overrides every registered supervisor's output-audit rate
+        (configured rates are stashed), ``"restore"`` puts the stashed
+        rates back; ``cache_only``: bool flips the batch-traffic
+        cache-only switch.  Control-plane: answered even while
+        draining, so a recovering fleet can always step quality back
+        up."""
+        out_fields = {}
+        if "audit_sample" in request:
+            raw = request["audit_sample"]
+            if raw == "restore":
+                for gname, sample in self._audit_saved.items():
+                    entry = self.registry.maybe_get(gname)
+                    if entry is not None:
+                        entry.supervisor.audit_sample = sample
+                self._audit_saved = {}
+                self._posture_audit = None
+            else:
+                try:
+                    sample = float(raw)
+                except (TypeError, ValueError):
+                    raise InputError(
+                        "posture audit_sample must be a number or "
+                        f"'restore', got {raw!r}"
+                    ) from None
+                if not (0.0 <= sample <= 1.0):
+                    raise InputError(
+                        f"posture audit_sample must be in [0, 1], "
+                        f"got {sample:g}"
+                    )
+                self._posture_audit = sample
+                for gname in self.registry.describe():
+                    entry = self.registry.maybe_get(gname)
+                    if entry is not None:
+                        self._audit_saved.setdefault(
+                            gname, float(entry.supervisor.audit_sample)
+                        )
+                        entry.supervisor.audit_sample = sample
+        if "cache_only" in request:
+            self._posture_cache_only = bool(request["cache_only"])
+        out_fields["audit_sample_override"] = self._posture_audit
+        out_fields["cache_only"] = self._posture_cache_only
+        return {"ok": True, "op": "posture", "posture": out_fields}
 
     # ---- execution (batcher consumer thread) ------------------------------
     def _shed_expired(
@@ -889,6 +989,7 @@ class MsbfsServer:
             failed = self._failed_requests
             total = self._requests_total
             shed = self._shed_requests
+            shed_brownout = self._shed_brownout
             quarantined = self._quarantined_requests
             refused = dict(self._refused_graphs)
         audited = 0
@@ -911,9 +1012,18 @@ class MsbfsServer:
             "queue": {
                 "depth": self.batcher.depth(),
                 "capacity": self.batcher.capacity,
+                "oldest_age_s": round(self.batcher.oldest_age(), 6),
                 "rejected": self.batcher.rejected,
+                "rejected_batch": self.batcher.rejected_batch,
+                "rejected_client": self.batcher.rejected_client,
+                "shed_overload": self.batcher.shed_overload,
                 "batches": self.batcher.batches,
                 "coalesced": self.batcher.coalesced,
+            },
+            "posture": {
+                "audit_sample_override": self._posture_audit,
+                "cache_only": self._posture_cache_only,
+                "shed_brownout": shed_brownout,
             },
             "result_cache": self.result_cache.snapshot(),
             "compiles": self.executables.compiles(),

@@ -78,6 +78,14 @@ journal, serve/journal.py).  Kinds:
                consumes, flipping one bit in the frame body AFTER the
                crc32 was computed — so the receiver's checksum check is
                what must catch it.
+``host_down``  site is a bare host label (e.g. ``host_down:hostA:1``);
+               trips on the fleet supervisor's per-host heartbeat seam
+               and raises :class:`SimulatedHostDown` — the supervisor
+               converts it into a real SIGKILL of EVERY replica
+               advertising that host, the rack-level analogue of
+               ``replica_kill`` (a switch dies, a rack loses power: all
+               colocated replicas vanish in the same instant, and only
+               cross-host placement keeps the graph reachable).
 
 Example: ``MSBFS_FAULTS="io:load_graph:1,oom:dispatch:2,hang:dispatch:3,
 chip:rank1:1"``.  Trip counters are plain per-site integers, so a given
@@ -97,13 +105,17 @@ from typing import Dict, List, Optional
 
 KINDS = ("io", "corrupt", "oom", "transient", "hang", "chip", "crash",
          "poison", "replica_kill", "replica_slow", "net_drop", "bitflip",
-         "wire_corrupt")
+         "wire_corrupt", "host_down")
 
 _RANK_RE = re.compile(r"rank(\d+)\Z")
 _VERTEX_RE = re.compile(r"vertex(\d+)\Z")
 _REPLICA_RE = re.compile(r"replica(\d+)\Z")
 _ROUTE_RE = re.compile(r"route(\d+)\Z")
 _PLANE_RE = re.compile(r"plane(\d+)\Z")
+# Host labels are operator-chosen strings; constrain them to the safe
+# identifier alphabet so a label can never collide with the structured
+# site grammars above (rank<r>, route<r>, ...) by accident of spelling.
+_HOST_RE = re.compile(r"[A-Za-z0-9._-]+\Z")
 
 
 class SimulatedResourceExhausted(RuntimeError):
@@ -152,6 +164,18 @@ class SimulatedNetDrop(RuntimeError):
         self.replica = int(replica)
 
 
+class SimulatedHostDown(RuntimeError):
+    """A whole host (rack, switch domain) going dark at once.  Raised at
+    the fleet supervisor's per-host heartbeat seam; the supervisor turns
+    it into real SIGKILLs of every replica advertising that host label,
+    so cross-host failover is rehearsed against simultaneous real
+    process deaths.  Carries the host label."""
+
+    def __init__(self, msg: str, host: str):
+        super().__init__(msg)
+        self.host = str(host)
+
+
 class SimulatedPoison(RuntimeError):
     """A query whose content deterministically kills its dispatch —
     retrying or resizing the batch never helps, only removing the row
@@ -168,6 +192,7 @@ class FaultSpec:
     rank: Optional[int] = None  # chip faults only
     vertex: Optional[int] = None  # poison faults only
     replica: Optional[int] = None  # fleet faults (replica_kill/slow/net_drop)
+    host: Optional[str] = None  # host_down faults only
     fired: bool = False
     matches: int = 0  # poison: dispatches that contained the vertex
 
@@ -266,8 +291,18 @@ class FaultPlan:
                     "plane<i> or dist (e.g. bitflip:plane0:1, "
                     "bitflip:dist:1)"
                 )
+            host = None
+            if kind == "host_down":
+                if not _HOST_RE.match(site):
+                    raise ValueError(
+                        f"fault spec {raw!r}: host_down faults need a "
+                        "host label site of [A-Za-z0-9._-]+ "
+                        "(e.g. host_down:hostA:1)"
+                    )
+                host = site
             specs.append(FaultSpec(kind=kind, site=site, at=at, rank=rank,
-                                   vertex=vertex, replica=replica))
+                                   vertex=vertex, replica=replica,
+                                   host=host))
         return cls(specs, hang_seconds=hang_seconds,
                    slow_seconds=slow_seconds)
 
@@ -440,6 +475,10 @@ class FaultPlan:
                 f"UNAVAILABLE: injected net drop to replica "
                 f"{s.replica} {where}",
                 s.replica,
+            )
+        if s.kind == "host_down":
+            raise SimulatedHostDown(
+                f"injected host down: host {s.host} {where}", s.host
             )
         if s.kind == "wire_corrupt":
             # Not a raise: the routed call must PROCEED so the corrupt

@@ -102,6 +102,9 @@ def test_stencil_config_reports_stream_utilization():
     assert d["levels_max"] > 0 and rec["vs_baseline"] is not None
 
 
+@pytest.mark.slow  # ~30 s (a full bench subprocess boot against a
+# bogus backend); harness behavior, not engine correctness — tier-1
+# keeps the in-process bench rule tests, `make test` runs this arm
 def test_outage_fast_parsable_failure():
     """A dead backend must produce an error JSON line within the
     BENCH_WAIT_S budget — not a hang into the driver's kill timeout."""

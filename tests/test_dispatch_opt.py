@@ -153,6 +153,9 @@ def test_resolve_megachunk_contract(monkeypatch):
         resolve_megachunk(-3, 4)
 
 
+@pytest.mark.slow  # ~16 s fuzz sweep; tier-1 keeps the deterministic
+# megachunk arms (donation bit-identity, resolve rules), full sweep in
+# `make test`
 def test_megachunk_fuzz_matches_unfused(rmat):
     """Random (level_chunk, megachunk) grids on random graphs: the fused
     loop is bit-identical to megachunk=1 — fusion only re-buckets levels

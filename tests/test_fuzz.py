@@ -69,7 +69,15 @@ def random_problem(rng: np.random.Generator):
     return n, edges, queries
 
 
-@pytest.mark.parametrize("seed", range(12))
+@pytest.mark.parametrize(
+    "seed",
+    # ~3 s per seed: half the seed sweep stays in tier-1, the other
+    # half is slow-marked for wall-clock budget (`make test` runs all).
+    [
+        s if s < 6 else pytest.param(s, marks=pytest.mark.slow)
+        for s in range(12)
+    ],
+)
 def test_fuzz_bitbell_matches_oracle(seed):
     rng = np.random.default_rng(1000 + seed)
     n, edges, queries = random_problem(rng)

@@ -19,6 +19,8 @@ def test_dryrun_multichip():
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.slow  # ~11 s second dry-run boot; tier-1 keeps the 8-way
+# test_dryrun_multichip arm, the odd-axes shape rides in `make test`
 @pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 (virtual) devices")
 def test_dryrun_multichip_odd_axes():
     ge.dryrun_multichip(4)

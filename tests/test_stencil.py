@@ -182,6 +182,9 @@ def test_k_above_word_width_and_chunked():
         np.testing.assert_array_equal(x, y)
 
 
+@pytest.mark.slow  # ~15 s (3 K widths x 2 chunk routes on a deep
+# lattice); tier-1 keeps the stencil/bitbell bit-identity pin and the
+# fused-best coverage in test_bitbell.py, `make test` runs this arm
 def test_fused_best_matches_generic():
     """The r5 fused best() (loop + argmin in one program) must agree with
     the generic run-then-select path on chunked and unchunked routes —
@@ -313,7 +316,14 @@ class TestActiveWindow:
 
 
 @pytest.mark.parametrize(
-    "name,block", [("road", 2), ("road_rect", 3), ("grid", 4)]
+    "name,block",
+    [
+        ("road", 2),
+        # One lattice pins the blocked-wavefront parity in tier-1
+        # (~6 s/arm); the other two ride in `make test`.
+        pytest.param("road_rect", 3, marks=pytest.mark.slow),
+        pytest.param("grid", 4, marks=pytest.mark.slow),
+    ],
 )
 def test_wavefront_blocked_fuzz(name, block):
     """Wavefront blocking (2-4 levels per while-iteration) must be

@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede
+.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -69,5 +69,14 @@ stampede: native
 	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_stampede.py -x -q -m "not slow"
 	JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py --stampede
 
-test: native resilience serve lifecycle perf-smoke mxu fleet audit stampede
+# Multi-chip 2D-partition suite (docs/MULTIHOST.md "2D partition"): the
+# FULL mesh-shape x merge-tree parity matrix on the forced 8-device
+# virtual mesh, including the shapes slow-marked out of tier-1 for
+# wall-clock budget, the live-reshard arms (mid-drive chip kill through
+# the supervisor), and the mesh2d arms of the engines-agreement matrix.
+multichip: native
+	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_partition2d.py -x -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_engines_agree.py -x -q -k "mesh2d"
+
+test: native resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip
 	python -m pytest tests/ -x -q

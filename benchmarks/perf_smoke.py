@@ -49,6 +49,17 @@ bit-identical against a single-daemon oracle, so a failover or
 placement bug that silently changes answers (rather than loudly
 failing) is caught by the exact-match pin.
 
+Round 10 adds the multichip frontier-traffic guard (parallel/
+partition2d): on a 16-virtual-device CPU mesh (own subprocess — the
+device count is an interpreter-start flag), the 2D adjacency partition's
+measured per-run collective bytes must be <= 0.5x the 1D vertex-sharded
+engine's dense halo exchange on the same graph/queries — 4x4 vs 1x16
+moves (R-1)+(C-1) = 6 segments per chip per level against 1D's p-1 = 15,
+a deterministic 0.4 ratio.  Bytes come from
+utils.timing.record_collective_bytes — analytic wire payloads at the
+dispatch sites — so, like every counter above, a CPU run pins the TPU
+traffic.
+
 Exit 0 on pass; exits 1 with a per-workload report on any violation.
 """
 
@@ -142,6 +153,16 @@ BUDGET = {
     "stampede-scaleup-heartbeats": 12,
     "stampede-interactive-p99-ms": 1500,
     "stampede-lost-acks": 0,
+    # Round 10 multichip traffic (parallel/partition2d): measured
+    # collective bytes of one 4x4-mesh best() on the RMAT-10/K=16
+    # fixture.  Deterministic: levels x R*C*((R-1)+(C-1)) x lsub*words*4
+    # = 6 levels x 16 chips x 6 segments x 256 B = 147,456 B today vs
+    # the 1D dense halo's 368,640 (p-1 = 15 segments: the exact 0.4
+    # ratio the 2D layout predicts; the generic opt*2<=base gate pins
+    # <= 0.5x).  The budget allows one extra level (7 x 24,576) of
+    # jitter only — a byte-model change that grows wire traffic must
+    # come with a PERF_NOTES entry.
+    "multichip-frontier-bytes-ratio": 172_032,
     # Round 10 audit overhead (ops/certify.py): one full certification
     # (host recompute + four invariants + F compare) as a PERCENT of the
     # warm query wall it guards, on the high-diameter chunked workload.
@@ -351,10 +372,88 @@ def run_audit():
     return "audit-overhead-pct", 100, pct
 
 
+def _multichip_child() -> int:
+    """Subprocess body for run_multichip (needs 16 virtual devices, an
+    interpreter-start flag): measure the analytic collective bytes one
+    best() moves for the 1D vertex-sharded dense-halo engine (1x16) and
+    the 2D adjacency partition (4x4) on the same graph and queries, and
+    print them as one JSON line."""
+    import json
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (  # noqa: E501
+        make_mesh,
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (  # noqa: E501
+        Mesh2DEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (  # noqa: E501
+        ShardedBellEngine,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (  # noqa: E501
+        collective_bytes,
+        reset_collective_bytes,
+    )
+
+    n, edges = generators.rmat_edges(10, edge_factor=8, seed=42)
+    host = CSRGraph.from_edges(n, edges)
+    queries = pad_queries(
+        generators.random_queries(n, K, max_group=4, seed=43), pad_to=4
+    )
+
+    def coll(engine):
+        engine.compile(queries.shape)
+        reset_collective_bytes()
+        got = engine.best(queries)
+        return got, collective_bytes()
+
+    # halo_budget=0: the 1D engine's always-dense full-plane halo
+    # exchange — the traffic the 2D layout exists to beat.  Both engines
+    # run the same chunked driver (level_chunk=8, the 2D default): the
+    # collective counter rides the chunked dispatch sites.
+    want, one_d = coll(
+        ShardedBellEngine(
+            make_mesh(1, 16), host, level_chunk=8, halo_budget=0
+        )
+    )
+    got, two_d = coll(Mesh2DEngine(make_mesh2d(4, 4), host))
+    assert got == want, f"mesh2d {got} != 1D {want}"
+    print(json.dumps({"bytes_1d": one_d, "bytes_2d": two_d}), flush=True)
+    return 0
+
+
+def run_multichip():
+    """Round-10 multichip traffic guard: re-exec this file on a forced
+    16-virtual-device CPU mesh (virtual_cpu.virtual_cpu_env — the count
+    is an interpreter-start XLA flag, so it cannot be set in-process)
+    and compare measured 2D-vs-1D collective bytes."""
+    import json
+    import subprocess
+
+    from virtual_cpu import virtual_cpu_env
+
+    env = virtual_cpu_env(16)
+    env["PERF_SMOKE_MULTICHIP_CHILD"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"multichip child failed (rc={proc.returncode}):\n"
+            + proc.stderr[-2000:]
+        )
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    return "multichip-frontier-bytes-ratio", rec["bytes_1d"], rec["bytes_2d"]
+
+
 def main() -> int:
     failures = []
     for run in (run_config1, run_config4, run_stencil_window, run_mxu,
-                run_fleet, run_stampede, run_audit):
+                run_fleet, run_stampede, run_audit, run_multichip):
         rows = run()
         if isinstance(rows, tuple):
             rows = [rows]
@@ -381,4 +480,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if os.environ.get("PERF_SMOKE_MULTICHIP_CHILD") == "1":
+        sys.exit(_multichip_child())
     sys.exit(main())

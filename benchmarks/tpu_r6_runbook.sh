@@ -1,0 +1,75 @@
+#!/bin/bash
+# Round-10 multi-chip measurement runbook — the commands that turn the
+# simulated-mesh numbers (bench configs 7/7t/7l, the perf-smoke multichip
+# guard) into REAL pod numbers the day multi-chip hardware exists.  Every
+# step is re-runnable; artifacts land under benchmarks/raw_r6/.
+#
+# What is already measured WITHOUT a pod (forced 8/16-virtual-device CPU
+# mesh — collective BYTES are analytic and platform-independent, wall
+# clock is not):
+#   * bench configs 7 (2x4), 7t (4x2), 7l (1x8): mesh2d TEPS +
+#     detail.multichip (collective_bytes, merge_tree, scaling efficiency
+#     vs the same engine on 1x1) — `python bench.py` default sweep.
+#   * perf-smoke multichip-frontier-bytes-ratio: 4x4 2D moves 0.4x the
+#     1x16 1D dense-halo wire bytes (147,456 vs 368,640 on RMAT-10/K=16).
+#   * engines-agree mesh2d arms + tests/test_partition2d.py: bit-identical
+#     results across mesh shapes, merge trees, and mid-drive chip loss.
+#
+# What NEEDS a pod (this file): real ICI wall-clock — whether the 2.5x
+# wire-byte diet turns into wall-clock TEPS at real mesh sizes, which
+# merge tree wins per axis size on real links, and the reshard pause.
+#
+# NOTE (hard-won, r5): never OVERWRITE PYTHONPATH on a TPU run — the axon
+# plugin registers via PYTHONPATH=/root/.axon_site; append instead.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+RAW=benchmarks/raw_r6
+mkdir -p "$RAW"
+
+stamp() { date -u +%Y-%m-%dT%H:%M:%SZ; }
+echo "runbook start $(stamp)" | tee -a "$RAW/runbook_meta.txt"
+python -c "import jax; print('jax', jax.__version__, len(jax.devices()), 'devices')" \
+    2>/dev/null | tee -a "$RAW/runbook_meta.txt"
+
+echo "== 1. mesh-shape sweep on real chips: RMAT-22 x K=64 per shape"
+# Unset BENCH_VIRTUAL_CPU semantics: single-config mode runs on the
+# AMBIENT backend, so on a pod these rows measure real ICI.  Shapes
+# must factor the chip count (4 chips: 2x2/1x4; 8: 2x4/4x2/1x8).
+for MESH in 2x2 1x4 2x4 4x2 1x8; do
+  BENCH_CONFIGS= BENCH_ENGINE=mesh2d BENCH_MESH=$MESH BENCH_SCALE=22 \
+      BENCH_K=64 BENCH_REPEATS=3 BENCH_EXTRA_KS= BENCH_RUN_S=3600 \
+      python bench.py 2> "$RAW/mesh_${MESH}.stderr" \
+      | tee "$RAW/mesh_${MESH}.json" || true
+done
+
+echo "== 2. merge-tree shootout per mesh shape (ring vs halving vs oneshot)"
+# detail.multichip.collective_bytes separates wire bytes from wall clock:
+# oneshot trades (C-1)x more bytes for one fewer hop — only real links
+# can say where the crossover sits (docs/MULTIHOST.md 'Reduction trees').
+for TREE in ring halving oneshot; do
+  BENCH_CONFIGS= BENCH_ENGINE=mesh2d BENCH_MESH=2x4 BENCH_MERGE_TREE=$TREE \
+      BENCH_SCALE=22 BENCH_K=64 BENCH_REPEATS=3 BENCH_EXTRA_KS= \
+      BENCH_RUN_S=3600 python bench.py \
+      2> "$RAW/tree_${TREE}.stderr" | tee "$RAW/tree_${TREE}.json" || true
+done
+
+echo "== 3. 2D-vs-1D wall clock on real ICI (the headline scale-out claim)"
+# The 1D row: the same workload through the vertex-sharded dense-halo
+# engine (MSBFS_VSHARD) via the CLI for an apples-to-apples product path.
+BENCH_CONFIGS= BENCH_ENGINE=mesh2d BENCH_MESH=1x8 BENCH_SCALE=22 BENCH_K=64 \
+    BENCH_REPEATS=3 BENCH_EXTRA_KS= BENCH_RUN_S=3600 python bench.py \
+    2> "$RAW/oned_1x8.stderr" | tee "$RAW/oned_1x8.json" || true
+
+echo "== 4. live-reshard pause on real chips (chip-kill chaos via fault plan)"
+# MSBFS_FAULT=chip:rank0:2 + the supervisor: time-to-first-result after a
+# mid-drive device loss = reshard (retile on survivors) + recompile.
+MSBFS_MESH=2x4 MSBFS_FAULT=chip:rank0:2 MSBFS_FAULT_SEED=0 MSBFS_STATS=1 \
+    timeout 1800 python main.py -g data/rmat20.bin -q data/q64.bin -gn 8 \
+    2>&1 | tee "$RAW/reshard_pause.txt" || true
+
+echo "== 5. simulated-mesh twin for the archive (byte-exact, any host)"
+BENCH_CONFIGS=7,7t,7l BENCH_RUN_S=3600 \
+    BENCH_DETAIL_PATH="$RAW/multichip_sim_detail.json" python bench.py \
+    2> "$RAW/multichip_sim.stderr" | tee "$RAW/multichip_sim.json" || true
+
+echo "runbook end $(stamp)" | tee -a "$RAW/runbook_meta.txt"

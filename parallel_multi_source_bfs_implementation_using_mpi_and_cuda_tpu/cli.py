@@ -498,7 +498,58 @@ def main(argv: Optional[List[str]] = None) -> int:
         # Capacity-degradation rungs for the supervisor; populated by the
         # routes that have a documented smaller-footprint fallback.
         ladder_rungs = []
-        if n_chips > 1:
+        mesh_spec = os.environ.get("MSBFS_MESH", "").strip()
+        if n_chips > 1 and mesh_spec:
+            # MSBFS_MESH=RxC selects the 2D adjacency partition
+            # (parallel/partition2d.py): the CSR is tiled over an (R, C)
+            # device mesh so each chip holds an n/R x n/C adjacency tile,
+            # and per-level traffic is a row-axis segment gather plus a
+            # col-axis OR-reduce-scatter — payload scales with n/(R*C)
+            # instead of the 1D row shard's full-frontier allgather.
+            # MSBFS_MERGE_TREE picks the col-axis reduction tree
+            # (auto/oneshot/ring/halving).  Engine selection goes through
+            # capability negotiation (ops.engine.negotiate_engine) so the
+            # route fails loud if no registered engine can serve a 2D
+            # mesh with live reshard.
+            from .ops.engine import negotiate_engine
+            from .parallel.mesh import make_mesh2d, parse_mesh_spec
+            from .parallel.partition2d import Mesh2DEngine
+
+            try:
+                rows, cols = parse_mesh_spec(mesh_spec)
+                if rows * cols != n_chips:
+                    raise ValueError(
+                        f"MSBFS_MESH={mesh_spec} wants {rows * cols} chips "
+                        f"but -gn selected {n_chips}"
+                    )
+                _, engine = negotiate_engine(
+                    {"mesh2d", "reshard"},
+                    [
+                        (
+                            "mesh2d",
+                            Mesh2DEngine,
+                            lambda: Mesh2DEngine(
+                                make_mesh2d(
+                                    rows, cols, devices=mesh_devices
+                                ),
+                                graph,
+                                level_chunk=level_chunk,
+                                merge_tree=(
+                                    os.environ.get("MSBFS_MERGE_TREE")
+                                    or None
+                                ),
+                            ),
+                        ),
+                    ],
+                )
+            except (TypeError, ValueError) as exc:
+                # Malformed spec, mesh/chip mismatch, bad merge tree, or
+                # no capable engine: same user-facing engine-choice error
+                # style as the push route.
+                print(str(exc), file=sys.stderr)
+                return 1
+            announce_chunk()
+        elif n_chips > 1:
             # MSBFS_VSHARD=v splits the CSR over a 'v' mesh axis of that
             # size (vertex sharding for graphs beyond one chip's HBM —
             # beyond-reference capability, parallel/sharded_bell.py);

@@ -121,7 +121,29 @@ def _stats_from_dist_batch(dist):
 
 class QueryEngineBase:
     """Shared selection/compile surface over any ``f_values`` implementation
-    (single-device, replicated-distributed, vertex-sharded)."""
+    (single-device, replicated-distributed, vertex-sharded).
+
+    ``CAPABILITIES`` declares what an engine class can structurally do
+    beyond the base contract — the tokens routing decisions key on
+    (:func:`negotiate_engine`) instead of isinstance chains:
+
+      * ``query_sharded`` — queries split over a mesh axis;
+      * ``vertex_sharded`` — the graph itself split over a mesh axis
+        (serves graphs beyond one chip's HBM);
+      * ``mesh2d`` — 2D (row-block, col-block) adjacency tiling over an
+        ('r', 'c') mesh (parallel.partition2d);
+      * ``reshard`` — ``without_ranks`` rebuilds onto survivors after a
+        chip loss (the supervisor's degrade-to-survivors path);
+      * ``collective_bytes`` — per-level ICI payload is recorded through
+        utils.timing.record_collective_bytes (the wire-roofline model).
+    """
+
+    CAPABILITIES: frozenset = frozenset()
+
+    def capabilities(self) -> frozenset:
+        """This engine's capability tokens (class-declared; instances of
+        one class all negotiate identically)."""
+        return self.CAPABILITIES
 
     def f_values(self, queries) -> jax.Array:  # pragma: no cover - interface
         raise NotImplementedError
@@ -180,6 +202,31 @@ class QueryEngineBase:
         """Optional diagnostic: per-query (levels, reached, F) arrays.
         Engines that don't expose distances return None."""
         return None
+
+
+def negotiate_engine(required, candidates):
+    """Pick the first candidate whose declared capabilities cover
+    ``required``.
+
+    ``candidates`` is a sequence of ``(label, engine_cls, factory)``
+    triples in preference order; the winner's ``factory()`` is invoked
+    (construction is the expensive part — losers never build) and
+    ``(label, engine)`` returned.  No winner raises ValueError naming
+    every candidate's missing tokens, so a route asked for an impossible
+    combination (e.g. ``MSBFS_MESH`` with an engine family that cannot
+    tile) fails loud instead of silently running a lesser engine."""
+    required = frozenset(required)
+    misses = []
+    for label, engine_cls, factory in candidates:
+        have = frozenset(getattr(engine_cls, "CAPABILITIES", ()))
+        missing = required - have
+        if not missing:
+            return label, factory()
+        misses.append(f"{label} lacks {{{', '.join(sorted(missing))}}}")
+    raise ValueError(
+        f"no engine provides {{{', '.join(sorted(required))}}}: "
+        + "; ".join(misses)
+    )
 
 
 class Engine(QueryEngineBase):

@@ -297,6 +297,8 @@ class DistributedEngine(QueryEngineBase):
     graph at any -gn (per-rank serial BFS, main.cu:303-322), and this is
     what keeps that promise on TPU (see ops.bitbell.bitbell_run_chunked)."""
 
+    CAPABILITIES = frozenset({"query_sharded", "reshard"})
+
     def __init__(
         self,
         mesh: Mesh,

@@ -17,6 +17,13 @@ from jax.sharding import Mesh
 QUERY_AXIS = "q"
 VERTEX_AXIS = "v"
 
+# 2D adjacency-partition axes (parallel.partition2d): 'r' indexes the
+# row-block a device's tile serves (destination vertices), 'c' the
+# col-block (source vertices).  Distinct names from ('q', 'v') so a 2D
+# mesh can never be passed where a query mesh is expected.
+ROW_AXIS = "r"
+COL_AXIS = "c"
+
 
 def initialize_distributed(**kwargs) -> None:
     """Multi-host bring-up (the analog of MPI_Init, main.cu:197-201).
@@ -63,6 +70,50 @@ def make_mesh(
         raise ValueError(f"mesh wants {total} devices, only {len(devs)} available")
     grid = np.array(devs[:total]).reshape(num_query_shards, num_vertex_shards)
     return Mesh(grid, (QUERY_AXIS, VERTEX_AXIS))
+
+
+def make_mesh2d(
+    rows: int,
+    cols: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build an ('r', 'c') mesh for the 2D adjacency partition
+    (parallel.partition2d): device (i, j) holds the (row-block i,
+    col-block j) adjacency tile.  Row-major device placement, so on a
+    physical 2D ICI torus a mesh row maps to a ring of neighbors — the
+    col-axis reduce-scatter's ppermute hops stay single-hop."""
+    if rows < 1 or cols < 1:
+        raise ValueError(f"mesh shape {rows}x{cols} must be positive")
+    devs = list(devices if devices is not None else jax.devices())
+    total = rows * cols
+    if total > len(devs):
+        raise ValueError(
+            f"mesh {rows}x{cols} wants {total} devices, only "
+            f"{len(devs)} available"
+        )
+    grid = np.array(devs[:total]).reshape(rows, cols)
+    return Mesh(grid, (ROW_AXIS, COL_AXIS))
+
+
+def parse_mesh_spec(spec: str) -> tuple:
+    """Parse an ``MSBFS_MESH=RxC`` mesh-shape spec into (rows, cols).
+
+    Accepts ``4x2`` / ``4X2`` with positive integer factors; anything
+    else fails loud — a silently ignored mesh knob would run single-chip
+    while the operator believes the graph is sharded."""
+    s = str(spec).strip().lower()
+    parts = s.split("x")
+    if len(parts) != 2:
+        raise ValueError(f"MSBFS_MESH={spec!r}: expected RxC (e.g. 4x2)")
+    try:
+        rows, cols = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"MSBFS_MESH={spec!r}: factors must be integers"
+        ) from None
+    if rows < 1 or cols < 1:
+        raise ValueError(f"MSBFS_MESH={spec!r}: factors must be >= 1")
+    return rows, cols
 
 
 def default_mesh(max_devices: Optional[int] = None) -> Mesh:

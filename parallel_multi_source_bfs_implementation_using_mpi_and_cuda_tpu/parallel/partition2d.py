@@ -1,0 +1,582 @@
+"""2D adjacency partitioning: the bitbell engine over an (R, C) tile mesh.
+
+parallel.sharded_bell scales one graph over p chips with a 1D row
+partition whose per-level halo all_gather moves the FULL (n_pad, W)
+frontier planes to every shard — wire traffic per level scales with n no
+matter how many chips join.  This module is the 2D answer (the classic
+distributed-BFS decomposition of "Parallel Distributed BFS on the Kepler
+Architecture", arxiv 1408.1605, recast for bit-plane multi-query TPU
+execution): shard the adjacency by (row-block, col-block) over an
+('r', 'c') mesh so device (i, j) holds an n/R x n/C tile, and a level
+costs
+
+  * a row-axis all_gather assembling col-block j's frontier from the R
+    devices of mesh column j — (R-1) * Lsub words received per device,
+  * one scatter-free forest pass over the device's tile (ops.bitbell),
+  * a col-axis OR-reduce-scatter of the row-block partial hits — a
+    topology-aware reduction tree (ring / recursive-halving / one-shot,
+    Tascade-style per-axis selection, arxiv 2311.15810) delivering each
+    device exactly its own segment, (C-1) * Lsub words received per
+    device on the ring/halving trees.
+
+Per-level traffic is (R + C - 2)/(R * C) of the 1D path's (p - 1)/p —
+the wire diet the make perf-smoke multichip guard pins.
+
+Layout.  Lsub = ceil(n / (R*C)); device (i, j) OWNS the global vertex
+segment s = j*R + i, rows [s*Lsub, (s+1)*Lsub).  That cyclic segment
+numbering makes the level loop transpose-free:
+
+  * col-block j = segments (0..R-1, j) = CONTIGUOUS global rows
+    [j*R*Lsub, (j+1)*R*Lsub) — assembled by the 'r'-axis all_gather in
+    axis order, no shuffle;
+  * row-block i = segments (i, 0..C-1), local row of global v =
+    (v div (R*Lsub))*Lsub + v mod Lsub — ordered by col-block then
+    offset, so chunk j of the 'c'-axis reduce-scatter IS segment (i, j):
+    each device's reduction output lands exactly on the segment it owns.
+
+Tiles are rectangular (Lr = C*Lsub output rows, Lc = R*Lsub input cols);
+the forest runs over the square padded space Lt = max(Lr, Lc) so
+``bell_hits_or`` (a same-space reduction forest) applies unchanged, and
+all R*C tile forests are harmonized (parallel.sharded_bell.
+harmonize_forests) into one SPMD program.
+
+Live resharding (arxiv 2112.01075's portable redistribution): on chip
+loss, :meth:`Mesh2DEngine.without_ranks` drops every mesh ROW containing
+a failed device and rebuilds the graph tiles from the retained host CSR
+onto the surviving (R', C) submesh — graph tiles move, not just queries
+(PR 1 moved only queries).  Results are bit-identical to a from-scratch
+shard by construction (the rebuild IS a from-scratch shard) and to the
+full-mesh run (BFS level counts are exact integers under any partition).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.bell import DEFAULT_WIDTHS, BellGraph
+from ..models.csr import CSRGraph
+from ..ops.bitbell import (
+    _or_fold,
+    bell_hits_or,
+    bit_level_chunk,
+    bit_level_init,
+    pack_queries,
+    unpack_counts,
+)
+from ..ops.engine import QueryEngineBase
+from ..utils.faults import trip
+from ..utils.timing import record_collective_bytes, record_dispatch
+from .mesh import COL_AXIS, ROW_AXIS, make_mesh2d
+from .sharded_bell import harmonize_forests
+
+# Plane arrays (visited/frontier) live as (n_pad, W) globals with dim 0
+# split across BOTH mesh axes, 'c' major — global position (j*R + i)*Lsub
+# is exactly segment s = j*R + i, so device (i, j) holds its own segment.
+_PLANE_SPEC = P((COL_AXIS, ROW_AXIS))
+
+MERGE_TREES = ("auto", "oneshot", "ring", "halving", "none")
+
+
+def select_merge_tree(c_size: int, override: Optional[str] = None) -> str:
+    """Per-axis reduction-tree policy for the col-axis OR-reduce-scatter.
+
+    ``auto``: recursive halving when C is a power of two (log2 C steps,
+    (C-1)*Lsub words received — the byte-optimal tree), ring otherwise
+    (C-1 single-hop steps, same bytes, no power-of-two requirement);
+    ``oneshot`` (one all_gather + fold, 1 step but (C-1)*Lr words) is
+    explicit-only — it wins only when latency dominates tiny payloads.
+    A degenerate axis (C == 1) needs no reduction at all."""
+    t = (override or "auto").strip().lower()
+    if t not in MERGE_TREES:
+        raise ValueError(
+            f"merge tree {override!r} not in {MERGE_TREES}"
+        )
+    if c_size <= 1:
+        return "none"
+    if t == "none":
+        raise ValueError(f"merge tree 'none' invalid for C={c_size} > 1")
+    if t == "halving" and c_size & (c_size - 1):
+        raise ValueError(
+            f"recursive halving needs a power-of-two col axis, got C={c_size}"
+        )
+    if t != "auto":
+        return t
+    return "halving" if c_size & (c_size - 1) == 0 else "ring"
+
+
+def level_collective_bytes(
+    rows: int, cols: int, lsub: int, words: int, tree: str
+) -> int:
+    """Whole-mesh wire payload ONE 2D level moves (the analytic quantity
+    utils.timing.record_collective_bytes accounts): every device receives
+    (R-1) segments in the row-axis frontier gather plus the tree's
+    col-axis reduce-scatter traffic — (C-1)*Lsub words on ring/halving,
+    (C-1)*Lr on the one-shot gather-and-fold."""
+    seg = lsub * words * 4
+    r_recv = (rows - 1) * seg
+    if tree in ("ring", "halving"):
+        c_recv = (cols - 1) * seg
+    elif tree == "oneshot":
+        c_recv = (cols - 1) * cols * seg  # Lr = C * Lsub rows gathered
+    else:  # "none": degenerate C == 1 axis
+        c_recv = 0
+    return rows * cols * (r_recv + c_recv)
+
+
+class Partition2D:
+    """Host-side 2D tiler: the (row-block, col-block) decomposition of a
+    CSR over an R x C grid, plus the harmonized stacked tile forest.
+
+    ``lsub``: rows per owned segment; ``n_pad = R*C*lsub``; ``lr``/``lc``:
+    tile output-row / input-col extents; ``lt``: the square padded tile
+    space the forests run over.  ``stacked`` leaves carry leading (R, C)
+    axes ready for P('r', 'c') placement."""
+
+    def __init__(
+        self,
+        g: CSRGraph,
+        rows: int,
+        cols: int,
+        widths: Sequence[int] = DEFAULT_WIDTHS,
+        min_bucket_rows: Optional[int] = None,
+    ):
+        self.rows, self.cols = rows, cols
+        p = rows * cols
+        self.lsub = -(-max(g.n, 1) // p)
+        self.n_pad = p * self.lsub
+        self.lr = cols * self.lsub
+        self.lc = rows * self.lsub
+        self.lt = max(self.lr, self.lc)
+        # One width ladder for ALL tiles, resolved from the global degree
+        # histogram — per-tile resolution would break harmonization
+        # (same policy as the 1D build_sharded_forest).
+        widths = BellGraph.resolve_widths(
+            widths, np.asarray(g.degrees), g.n, g.num_directed_edges,
+            min_bucket_rows,
+        )
+        # dedup=False: the tile CSR's rows and cols live in DIFFERENT
+        # coordinate spaces (row-block-local vs col-block-local), so
+        # from_host's self-loop test "col == row" would eat real edges
+        # whose endpoints happen to collide in tile coordinates.
+        # _tile_csr already dedups and drops true self-loops in GLOBAL
+        # coordinates, where the test is meaningful.
+        tiles: List[BellGraph] = [
+            BellGraph.from_host(
+                self._tile_csr(g, i, j),
+                widths=widths,
+                dedup=False,
+                min_bucket_rows=0,
+                keep_sparse=False,  # the 2D loop is pull-only
+            )
+            for i in range(rows)
+            for j in range(cols)
+        ]
+        flat = harmonize_forests(tiles, self.lt, widths)
+        # (R*C, ...) leading shard axis -> (R, C, ...) for the 2D mesh.
+        self.stacked = jax.tree.map(
+            lambda x: x.reshape(rows, cols, *x.shape[1:]), flat
+        )
+
+    def _tile_csr(self, g: CSRGraph, i: int, j: int) -> CSRGraph:
+        """Tile (i, j): adjacency rows of row-block i (pull destinations,
+        tile-local row = jj*lsub + offset for source col-block jj) with
+        neighbor columns restricted to col-block j and rebased to
+        [0, lc) — a CSR over the square space [0, lt).
+
+        Dedup and self-loop removal happen HERE, in global coordinates
+        (same justification as BellGraph.from_host: the per-level hit is
+        a set predicate, and a frontier vertex is already visited) —
+        from_host's own pass would compare row-local against col-local
+        indices, which name different vertices in a rectangular tile."""
+        lsub, rows = self.lsub, self.rows
+        lo_c, hi_c = j * self.lc, (j + 1) * self.lc
+        degrees = np.zeros(self.lt, dtype=np.int64)
+        col_parts: List[np.ndarray] = []
+        for jj in range(self.cols):
+            seg = jj * rows + i
+            lo, hi = seg * lsub, min((seg + 1) * lsub, g.n)
+            if lo >= g.n:
+                continue
+            ro = np.asarray(g.row_offsets[lo : hi + 1], dtype=np.int64)
+            ci = np.asarray(g.col_indices[ro[0] : ro[-1]], dtype=np.int64)
+            row_of_edge = np.repeat(
+                np.arange(hi - lo, dtype=np.int64), np.diff(ro)
+            )
+            keep = (
+                (ci >= lo_c) & (ci < hi_c) & (ci != lo + row_of_edge)
+            )
+            # Unique (row, col) pairs via one flat sorted key; np.unique
+            # keeps row-major CSR order (cols within a row become sorted,
+            # irrelevant to an OR reduction).
+            key = np.unique(
+                row_of_edge[keep] * self.lc + (ci[keep] - lo_c)
+            )
+            cnt = np.bincount(key // self.lc, minlength=hi - lo)
+            base = jj * lsub
+            degrees[base : base + (hi - lo)] = cnt
+            col_parts.append((key % self.lc).astype(np.int32))
+        row_offsets = np.zeros(self.lt + 1, dtype=np.int64)
+        np.cumsum(degrees, out=row_offsets[1:])
+        return CSRGraph(
+            n=self.lt,
+            m=0,  # undirected record count is meaningless for a tile
+            row_offsets=row_offsets,
+            col_indices=(
+                np.concatenate(col_parts)
+                if col_parts
+                else np.zeros(0, dtype=np.int32)
+            ),
+        )
+
+
+def _or_reduce_scatter(x, c_size: int, lsub: int, tree: str):
+    """Col-axis OR-reduce-scatter of the (Lr, W) row-block partial hits:
+    device at col j receives chunk j — its own segment — fully OR-reduced
+    over all C col-blocks.  All three trees compute the identical result
+    (OR is associative, commutative and bit-exact), so tree choice is
+    pure topology tuning and the engines-agree matrix pins equality."""
+    if c_size == 1:
+        return x
+    me = lax.axis_index(COL_AXIS)
+
+    def chunk_at(idx):
+        return lax.dynamic_slice_in_dim(x, idx * lsub, lsub, axis=0)
+
+    if tree == "oneshot":
+        full = lax.all_gather(x, COL_AXIS)  # (C, Lr, W)
+        return lax.dynamic_slice_in_dim(
+            _or_fold(full, 0), me * lsub, lsub, axis=0
+        )
+    if tree == "ring":
+        # Chunk c starts at device c+1 and travels C-1 single hops
+        # d -> d+1, OR-ing each visited device's local chunk c; after
+        # step s device d holds chunk (d - 2 - s) mod C, ending with its
+        # own chunk d fully reduced.
+        perm = [(t, (t + 1) % c_size) for t in range(c_size)]
+        acc = chunk_at((me + c_size - 1) % c_size)
+        for s in range(c_size - 1):
+            acc = lax.ppermute(acc, COL_AXIS, perm)
+            acc = acc | chunk_at((me + 2 * c_size - 2 - s) % c_size)
+        return acc
+    if tree == "halving":
+        # Recursive halving (C a power of two): log2 C pairwise
+        # exchanges, each sending the half the PARTNER keeps; the kept
+        # base offset accumulates (me & h) per round, so the final
+        # single chunk is exactly chunk ``me``.
+        buf = x
+        span, h = c_size, c_size // 2
+        while h >= 1:
+            half_rows = (span // 2) * lsub
+            keep_lo = (me & h) == 0
+            lo, hi = buf[:half_rows], buf[half_rows:]
+            send = jnp.where(keep_lo, hi, lo)
+            recv = lax.ppermute(
+                send, COL_AXIS, [(t, t ^ h) for t in range(c_size)]
+            )
+            buf = jnp.where(keep_lo, lo, hi) | recv
+            span //= 2
+            h //= 2
+        return buf
+    raise ValueError(f"unknown reduction tree {tree!r}")
+
+
+def _mesh2d_expand_own(
+    local: BellGraph, rows: int, cols: int, lsub: int, tree: str
+):
+    """Own-segment 2D expansion: assemble col-block j's frontier with the
+    row-axis gather, run the tile forest over the padded square space,
+    and reduce-scatter the row-block partial hits back to own segments.
+    The own-segment formulation carries (Lsub, W) planes per device
+    between dispatches — never a full (n_pad, W) replica."""
+    lc = rows * lsub
+    lr = cols * lsub
+    lt = local.n
+
+    def expand(visited_own, frontier_own):
+        colblock = lax.all_gather(frontier_own, ROW_AXIS, tiled=True)
+        if lt > lc:
+            colblock = jnp.pad(colblock, ((0, lt - lc), (0, 0)))
+        hits = bell_hits_or(colblock, local)[:lr]
+        own = _or_reduce_scatter(hits, cols, lsub, tree)
+        return own & ~visited_own
+
+    return expand
+
+
+@partial(jax.jit, static_argnames=("mesh", "lsub"))
+def _mesh2d_init(mesh: Mesh, forest, queries: jax.Array, lsub: int):
+    """Per-device own-segment loop carry: planes (Lsub, W) split over
+    ('c','r')-major segments; counters replicated on the whole mesh (the
+    per-level psum spans both axes, so no finish-time merge exists)."""
+    rows = mesh.shape[ROW_AXIS]
+    n_pad = rows * mesh.shape[COL_AXIS] * lsub
+
+    def shard_body(forest, queries):
+        frontier0 = pack_queries(n_pad, queries)
+        counts0 = unpack_counts(frontier0)
+        i = lax.axis_index(ROW_AXIS)
+        j = lax.axis_index(COL_AXIS)
+        seg = j * rows + i
+        own0 = lax.dynamic_slice_in_dim(frontier0, seg * lsub, lsub, axis=0)
+        return bit_level_init(own0, counts0)
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS), P()),
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 5,
+    )(forest, queries)
+
+
+@partial(jax.jit, static_argnames=("mesh", "lsub", "max_levels", "tree"))
+def _mesh2d_chunk(mesh: Mesh, forest, carry, chunk, lsub: int, max_levels, tree: str):
+    """Advance every device's own-segment carry by <= ``chunk`` levels in
+    one dispatch.  Per-level discovery counts psum over BOTH mesh axes
+    (each segment counted exactly once), so the loop counters — and the
+    convergence flag the host loop syncs — are replicated mesh-wide."""
+    rows = mesh.shape[ROW_AXIS]
+    cols = mesh.shape[COL_AXIS]
+
+    def shard_body(forest, *carry):
+        local = jax.tree.map(lambda x: x[0, 0], forest)
+        out = bit_level_chunk(
+            carry,
+            _mesh2d_expand_own(local, rows, cols, lsub, tree),
+            chunk,
+            max_levels,
+            counts_of=lambda new: lax.psum(
+                unpack_counts(new), (ROW_AXIS, COL_AXIS)
+            ),
+        )
+        return out + (out[6].astype(jnp.int32), out[5])
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS),)
+        + (_PLANE_SPEC,) * 2
+        + (P(),) * 5,
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 7,
+    )(forest, *carry)
+
+
+def _mesh2d_run_chunked(
+    mesh: Mesh,
+    forest,
+    queries: jax.Array,
+    lsub: int,
+    max_levels,
+    level_chunk: int,
+    tree: str,
+    level_bytes: int,
+):
+    """Host-chunked 2D drive loop: bounded per-dispatch work (the same
+    high-diameter safety contract as every chunked engine) AND the
+    collective-bytes ledger — the fetched ``max_level`` delta times the
+    analytic per-level wire bytes is exact, not estimated, because the 2D
+    path has a single (gather + reduce-scatter) route per level.  The
+    per-iteration ``trip("dispatch")`` is the chip-loss fault seam: an
+    injected mid-drive device loss surfaces here, between level chunks,
+    exactly where a real ICI failure would."""
+    carry = _mesh2d_init(mesh, forest, queries, lsub)
+    bound = np.int32(level_chunk)
+    prev = 0
+    while True:
+        *carry, any_up, max_level = _mesh2d_chunk(
+            mesh, forest, tuple(carry), bound, lsub, max_levels, tree
+        )
+        record_dispatch()
+        trip("dispatch")
+        now = int(np.asarray(max_level))
+        record_collective_bytes(max(0, now - prev) * level_bytes)
+        prev = now
+        if not int(np.asarray(any_up)):
+            break
+        if max_levels is not None and now >= max_levels:
+            break
+    return tuple(carry)
+
+
+class Mesh2DEngine(QueryEngineBase):
+    """The 2D-partitioned bitbell engine: adjacency tiled over an
+    ('r', 'c') mesh, queries replicated (all K advance together as bit
+    planes on every device), per-level traffic = row-axis segment gather
+    + col-axis reduction tree.
+
+    ``merge_tree``: ``auto`` (default policy, :func:`select_merge_tree`)
+    / ``oneshot`` / ``ring`` / ``halving`` — all bit-identical, only the
+    wire schedule differs.  ``level_chunk``: levels per XLA dispatch
+    (always chunked: the host loop is also the byte ledger and the
+    chip-loss seam).  ``w`` is the device count — the supervisor's
+    rebuild cap and survivor accounting read it like every engine."""
+
+    CAPABILITIES = frozenset(
+        {"mesh2d", "vertex_sharded", "reshard", "collective_bytes"}
+    )
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        graph: CSRGraph,
+        max_levels: Optional[int] = None,
+        widths: Sequence[int] = DEFAULT_WIDTHS,
+        min_bucket_rows: Optional[int] = None,
+        level_chunk: Optional[int] = None,
+        merge_tree: Optional[str] = None,
+    ):
+        if ROW_AXIS not in mesh.shape or COL_AXIS not in mesh.shape:
+            raise ValueError(
+                f"Mesh2DEngine needs an ('{ROW_AXIS}', '{COL_AXIS}') mesh "
+                f"(make_mesh2d), got axes {tuple(mesh.shape)}"
+            )
+        if not isinstance(graph, CSRGraph):
+            raise ValueError(
+                "Mesh2DEngine builds its own tile layout; pass the host "
+                "CSRGraph"
+            )
+        self.mesh = mesh
+        self.rows = mesh.shape[ROW_AXIS]
+        self.cols = mesh.shape[COL_AXIS]
+        self.w = self.rows * self.cols
+        self.n = graph.n
+        self._host_graph = graph
+        self._widths = widths
+        self._min_bucket_rows = min_bucket_rows
+        self._merge_tree = merge_tree
+        self.part = Partition2D(
+            graph, self.rows, self.cols, widths, min_bucket_rows
+        )
+        self.forest = jax.device_put(
+            self.part.stacked, NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+        )
+        self.tree = select_merge_tree(self.cols, merge_tree)
+        self.max_levels = max_levels
+        from ..ops.bfs import validate_level_chunk
+
+        self.level_chunk = validate_level_chunk(level_chunk) or 8
+        self._level_warm_shapes = set()
+
+    # ---- query prep -------------------------------------------------------
+    def _prep(self, queries: np.ndarray):
+        """Bounds-remap vs the TRUE vertex count (ids in [n, n_pad) would
+        hit phantom padding vertices — same rationale as the 1D engine)
+        and right-pad K to a multiple of 32 with inert -1 rows."""
+        queries = np.asarray(queries)
+        queries = np.where(
+            (queries >= 0) & (queries < self.n), queries, -1
+        ).astype(np.int32)
+        k = queries.shape[0]
+        pad = (-k) % 32 if k else 32  # K = 0 still needs one plane word
+        if pad:
+            queries = np.vstack(
+                [queries, np.full((pad, queries.shape[1]), -1, np.int32)]
+            )
+        trip("device_put")  # upload fault seam (parity with shard_queries)
+        placed = jax.device_put(queries, NamedSharding(self.mesh, P()))
+        return placed, k
+
+    def level_bytes(self, k: int) -> int:
+        """Analytic whole-mesh wire bytes per level for a K-query batch."""
+        words = -(-k // 32)
+        return level_collective_bytes(
+            self.rows, self.cols, self.part.lsub, words, self.tree
+        )
+
+    def _run(self, queries: np.ndarray):
+        placed, k = self._prep(queries)
+        carry = _mesh2d_run_chunked(
+            self.mesh,
+            self.forest,
+            placed,
+            self.part.lsub,
+            self.max_levels,
+            self.level_chunk,
+            self.tree,
+            self.level_bytes(k),
+        )
+        return carry, k
+
+    def f_values(self, queries: np.ndarray) -> jax.Array:
+        carry, k = self._run(queries)
+        return carry[2][:k]
+
+    def query_stats(self, queries):
+        """Per-query (levels, reached, F): the loop counters are computed
+        from both-axis psums, hence replicated — read them directly."""
+        carry, k = self._run(queries)
+        return (
+            np.asarray(carry[3][:k]).astype(np.int32),
+            np.asarray(carry[4][:k]).astype(np.int32),
+            np.asarray(carry[2][:k]),
+        )
+
+    def level_stats(self, queries):
+        """Per-level trace (MSBFS_STATS=2): the shared stepped driver over
+        this engine's init/chunk programs; counters are replicated, so
+        ``finish`` is a read, not a merge."""
+        from .distributed import stepped_level_stats
+
+        placed, k = self._prep(queries)
+
+        def init():
+            return _mesh2d_init(self.mesh, self.forest, placed, self.part.lsub)
+
+        def step(carry):
+            *out, _, _ = _mesh2d_chunk(
+                self.mesh,
+                self.forest,
+                tuple(carry),
+                np.int32(1),
+                self.part.lsub,
+                self.max_levels,
+                self.tree,
+            )
+            return tuple(out)
+
+        def finish(carry):
+            return carry[2][:k], carry[3][:k], carry[4][:k]
+
+        shape = np.asarray(queries).shape
+        warmed = shape in self._level_warm_shapes
+        out = stepped_level_stats(init, step, finish, k, self.max_levels, warmed)
+        self._level_warm_shapes.add(shape)
+        return out
+
+    # ---- live resharding --------------------------------------------------
+    def without_ranks(self, failed_ranks) -> "Mesh2DEngine":
+        """Rebuild the TILED graph on the surviving (R', C) submesh: every
+        mesh row containing a failed device is dropped (flat rank r sits
+        at row r // C of the row-major device grid), and the tiles are
+        re-cut from the retained host CSR — portable redistribution
+        (arxiv 2112.01075): nothing references the lost devices' buffers.
+        Raises DeviceError when no full row survives; bit-identity to a
+        from-scratch shard holds by construction (this IS one)."""
+        from ..runtime.supervisor import DeviceError
+
+        failed = {int(r) for r in failed_ranks}
+        grid = np.asarray(self.mesh.devices).reshape(self.rows, self.cols)
+        bad_rows = {r // self.cols for r in failed if 0 <= r < self.w}
+        keep = [i for i in range(self.rows) if i not in bad_rows]
+        if not keep:
+            raise DeviceError(
+                f"no surviving mesh rows (failed ranks {sorted(failed)})",
+                failed_ranks=failed,
+            )
+        survivors = [d for i in keep for d in grid[i]]
+        mesh = make_mesh2d(len(keep), self.cols, devices=survivors)
+        return Mesh2DEngine(
+            mesh,
+            self._host_graph,
+            max_levels=self.max_levels,
+            widths=self._widths,
+            min_bucket_rows=self._min_bucket_rows,
+            level_chunk=self.level_chunk,
+            merge_tree=self._merge_tree,
+        )

@@ -380,6 +380,8 @@ class ShardedPushEngine(QueryEngineBase):
     graphs, so the bound is always on.
     """
 
+    CAPABILITIES = frozenset({"query_sharded", "vertex_sharded"})
+
     def __init__(
         self,
         mesh: Mesh,

@@ -54,7 +54,7 @@ from ..ops.engine import QueryEngineBase
 from ..ops.push import compact_frontier_planes
 from .distributed import _distributed_bitbell_finish, _pad_qblock
 from .mesh import QUERY_AXIS, VERTEX_AXIS
-from ..utils.timing import record_dispatch
+from ..utils.timing import record_collective_bytes, record_dispatch
 from .scheduler import merge_local_f, shard_queries
 
 
@@ -107,7 +107,28 @@ def build_sharded_forest(
         )
         for b in range(p)
     ]
+    return harmonize_forests(shards, n_pad, widths), L, n_pad
 
+
+def harmonize_forests(
+    shards: Sequence[BellGraph], n_space: int, widths: Sequence[int]
+) -> BellGraph:
+    """Pad ``shards`` — per-partition BELL forests over one shared
+    ``n_space``-row frontier space, all built with the same resolved
+    ``widths`` ladder — into a single stacked BellGraph whose every leaf
+    has a leading shard axis and identical shapes across shards, so
+    shard_map can execute one SPMD program (the shard_map shape
+    requirement stated in the module docstring).
+
+    Every (level, bucket) is padded to the cross-shard maximum row count
+    with sentinel rows gathering only the always-zero row ``n_space`` of
+    the frontier (level 0) or the previous level's padded zero slot, and
+    each shard's row references are remapped through the resulting padded
+    positions.  Shared by the 1D vertex sharding (p row blocks over the
+    global space, :func:`build_sharded_forest`) and the 2D adjacency
+    partition (R*C rectangular tiles over a square padded tile space,
+    parallel.partition2d)."""
+    p = len(shards)
     num_levels = max(len(s.level_shapes) for s in shards)
     n_buckets = len(widths)
     sorted_w = sorted(widths)
@@ -154,7 +175,7 @@ def build_sharded_forest(
         # Index of the always-zero row in the previous value array (the
         # frontier for level 0): sentinel target for padding rows and for
         # each shard's own local sentinel.
-        prev_zero = n_pad if li == 0 else pad_level_sizes[li - 1]
+        prev_zero = n_space if li == 0 else pad_level_sizes[li - 1]
         per_bucket = []
         shard_levels = [
             v[li] if li < len(v) else None for v in shard_views
@@ -205,16 +226,15 @@ def build_sharded_forest(
         slots.append(g_map[fs].astype(np.int32))
     final_slot = jnp.asarray(np.stack(slots))
 
-    stacked = BellGraph(
+    return BellGraph(
         level_cols=stacked_cols,
         level_shapes=stacked_shapes,
         final_slot=final_slot,
-        n=n_pad,
-        n_pad=n_pad,
+        n=n_space,
+        n_pad=n_space,
         level_sizes=pad_level_sizes,
         fill=float(np.mean([s.fill for s in shards])),
     )
-    return stacked, L, n_pad
 
 
 def build_push_halo(g: CSRGraph, p: int, L: int, n_pad: int):
@@ -488,6 +508,18 @@ def halo_level_bytes(
     return "dense", n_pad * w_words * 4
 
 
+def dense_halo_level_bytes(mesh: Mesh, j: int, block: int) -> int:
+    """Whole-mesh wire bytes ONE dense-halo level moves: every 'v' shard
+    of every q-shard receives the other p-1 shards' (L, W) word blocks in
+    the frontier all_gather — w_q * p * (p-1) * L * W * 4 payload bytes.
+    ``j`` is the per-q-shard query rows before the multiple-of-32 pad
+    (_pad_qblock), from which the plane word count W follows."""
+    p = mesh.shape[VERTEX_AXIS]
+    w_q = mesh.shape[QUERY_AXIS]
+    words = -(-j // 32)
+    return w_q * p * (p - 1) * block * words * 4
+
+
 @partial(jax.jit, static_argnames=("mesh",))
 def _sharded_halo_rows(mesh: Mesh, frontier_own):
     """Per-q-shard max-over-'v' own-frontier row count for the frontier a
@@ -627,11 +659,25 @@ def _sharded_bitbell_run_chunked(
     """Host-chunked vertex-sharded bitbell: same results as
     :func:`_sharded_bitbell_run`, with per-dispatch work bounded to
     ``level_chunk`` levels so high-diameter (road-class) graphs never run
-    thousands of halo-exchange levels inside one XLA dispatch."""
+    thousands of halo-exchange levels inside one XLA dispatch.
+
+    Collective-bytes accounting (utils.timing.record_collective_bytes):
+    with the DENSE halo only (halo_budget == 0) each executed level moves
+    one full-plane all_gather per q-shard — the per-dispatch executed
+    level count is the fetched ``max_level`` delta, so the recorded bytes
+    are exact, not estimated.  With sparse budgets enabled the per-level
+    route varies on device and ``last_halo_trace`` is the byte model; the
+    counter stays silent rather than record a wrong dense figure."""
     carry = _sharded_bitbell_init(mesh, forest, query_grid, block)
     # np.int32, hoisted: an eager jnp scalar would be its own blocking
     # device commit EVERY iteration (utils.timing documents the floor).
     bound = np.int32(level_chunk)
+    level_bytes = (
+        dense_halo_level_bytes(mesh, query_grid.shape[1], block)
+        if not halo_budget
+        else 0
+    )
+    prev_level = 0
     while True:
         *carry, any_up, max_level = _sharded_bitbell_chunk(
             mesh,
@@ -645,6 +691,10 @@ def _sharded_bitbell_run_chunked(
             push_budget,
         )
         record_dispatch()
+        if level_bytes:
+            now = int(np.asarray(max_level))
+            record_collective_bytes(max(0, now - prev_level) * level_bytes)
+            prev_level = now
         if not int(np.asarray(any_up)):
             break
         if max_levels is not None and int(np.asarray(max_level)) >= max_levels:
@@ -670,6 +720,10 @@ class ShardedBellEngine(QueryEngineBase):
     byte-lane work, a trade only real interconnects win (see __init__);
     0 always exchanges full planes (the round-2 behavior).  Analogous for
     ``push_budget`` (the in-block push edge budget)."""
+
+    CAPABILITIES = frozenset(
+        {"query_sharded", "vertex_sharded", "collective_bytes"}
+    )
 
     def __init__(
         self,

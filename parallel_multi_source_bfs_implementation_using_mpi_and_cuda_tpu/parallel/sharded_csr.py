@@ -196,6 +196,8 @@ class ShardedEngine(QueryEngineBase):
     """Query execution with the CSR sharded over the 'v' mesh axis and
     queries round-robin over 'q' — the full ('q','v') mesh."""
 
+    CAPABILITIES = frozenset({"query_sharded", "vertex_sharded"})
+
     def __init__(
         self,
         mesh: Mesh,

@@ -113,6 +113,46 @@ def reset_plane_pass() -> None:
         _plane_pass_bytes = 0
 
 
+# --- Collective-bytes accounting (round 10) ----------------------------------
+# The multi-chip engines' per-level cost is an ICI wire stream: the 1D
+# vertex-sharded path all_gathers full frontier planes every level, the 2D
+# partition replaces that with a row-axis segment gather plus a col-axis
+# OR-reduce-scatter whose payload scales with n/(R*C), not n (docs/
+# MULTIHOST.md "2D partition").  The engines record the ANALYTIC payload
+# bytes each dispatched level chunk moves over the mesh (executed levels x
+# per-level wire bytes, parallel.partition2d.level_collective_bytes /
+# parallel.sharded_bell dense halo bytes) at the same host fetch sites that
+# ride record_dispatch, so the 2D-vs-1D traffic diet is CI-observable on
+# the virtual CPU mesh (bench detail.collective, the make perf-smoke
+# multichip guard) exactly like the dispatch/plane/MXU diets: wall clock on
+# a simulated mesh measures nothing, counters measure everything.
+# Thread-safe for the same reason as the other counters.
+
+_collective_bytes = 0
+_collective_lock = threading.Lock()
+
+
+def record_collective_bytes(nbytes: int) -> None:
+    """Account ``nbytes`` of analytic inter-chip collective payload (one
+    call per dispatched level chunk, whole-mesh totals)."""
+    global _collective_bytes
+    with _collective_lock:
+        _collective_bytes += int(nbytes)
+
+
+def collective_bytes() -> int:
+    """Bytes recorded since the last :func:`reset_collective_bytes`."""
+    with _collective_lock:
+        return _collective_bytes
+
+
+def reset_collective_bytes() -> None:
+    """Zero the collective-bytes accumulator (callers bracket a span)."""
+    global _collective_bytes
+    with _collective_lock:
+        _collective_bytes = 0
+
+
 # --- MXU tile accounting (round 8) -------------------------------------------
 # The mxu engine's matmul level is FLOP-bound, not stream-bound: per level
 # it issues 2*T*T*K FLOPs for every NONZERO adjacency tile (ops/mxu.py),

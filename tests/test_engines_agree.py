@@ -287,6 +287,55 @@ def _mxu_switch(g):
     )
 
 
+def _mesh2d(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+        Mesh2DEngine,
+    )
+
+    # 2x4: both mesh axes active (row-axis gather + col-axis OR-reduce),
+    # auto merge tree (halving at C=4).
+    return Mesh2DEngine(make_mesh2d(2, 4), g)
+
+
+def _mesh2d_ring(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+        Mesh2DEngine,
+    )
+
+    # Transposed shape + explicit ring reduce + a tight dispatch bound.
+    return Mesh2DEngine(make_mesh2d(4, 2), g, merge_tree="ring", level_chunk=2)
+
+
+def _mesh2d_oneshot(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+        Mesh2DEngine,
+    )
+
+    return Mesh2DEngine(make_mesh2d(2, 4), g, merge_tree="oneshot")
+
+
+def _mesh2d_1x8(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+        Mesh2DEngine,
+    )
+
+    # The degenerate 1D layout expressed in the same engine: no row
+    # axis, the col-axis OR-reduce carries the whole exchange.
+    return Mesh2DEngine(make_mesh2d(1, 8), g)
+
+
 # The lowk drive-loop variants (chunked/megachunk) and the sub-batch
 # splitter are pinned against the oracle and the bit-plane reference in
 # tests/test_lowk.py; only the base byte-flag arm needs the full
@@ -314,6 +363,10 @@ ENGINES = {
     "sharded_bell": _sharded_bell,
     "sharded_bell_sparse": _sharded_bell_sparse,
     "sharded_push": _sharded_push,
+    "mesh2d": _mesh2d,
+    "mesh2d_ring": _mesh2d_ring,
+    "mesh2d_oneshot": _mesh2d_oneshot,
+    "mesh2d_1x8": _mesh2d_1x8,
 }
 
 
@@ -346,10 +399,16 @@ def _arms(engines, slow):
     ]
 
 
-@pytest.mark.parametrize("name", _arms(ENGINES, slow={"mxu_chunked"}))
+@pytest.mark.parametrize(
+    "name",
+    _arms(ENGINES, slow={"mxu_chunked", "mesh2d_oneshot", "mesh2d_1x8"}),
+)
 def test_engine_agrees(workload, name):
     g, padded, reference = workload
-    if name.startswith(("distributed", "sharded")) and len(jax.devices()) < 8:
+    if (
+        name.startswith(("distributed", "sharded", "mesh2d"))
+        and len(jax.devices()) < 8
+    ):
         pytest.skip("needs the 8-device test mesh")
     eng = ENGINES[name](g)
     np.testing.assert_array_equal(np.asarray(eng.f_values(padded)), reference)
@@ -497,6 +556,9 @@ AUDIT_SLOW = {
     "distributed_push",
     "sharded_bell_sparse",
     "sharded_push",
+    "mesh2d_ring",
+    "mesh2d_oneshot",
+    "mesh2d_1x8",
 }
 
 
@@ -507,7 +569,10 @@ def test_engine_output_audits(workload, name):
     )
 
     g, padded, reference = workload
-    if name.startswith(("distributed", "sharded")) and len(jax.devices()) < 8:
+    if (
+        name.startswith(("distributed", "sharded", "mesh2d"))
+        and len(jax.devices()) < 8
+    ):
         pytest.skip("needs the 8-device test mesh")
     eng = ENGINES[name](g)
     f = np.asarray(eng.f_values(padded), dtype=np.int64)

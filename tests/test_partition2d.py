@@ -1,0 +1,213 @@
+"""2D adjacency partition suite (parallel/partition2d; docs/MULTIHOST.md
+"2D partition").
+
+The contract under test, on the forced 8-virtual-device CPU mesh:
+
+* every (R, C) mesh shape and every col-axis merge tree produces
+  BIT-IDENTICAL F values and per-query level stats to the single-chip
+  oracle — tiling, the row-axis segment gather, and the OR-reduce-scatter
+  are layout, not semantics;
+* the per-level wire-byte model (level_collective_bytes) matches the
+  hand-computed figures, and the chunked drive's measured counter
+  (utils.timing.record_collective_bytes) matches levels x model;
+* live resharding: dropping failed mesh rows (without_ranks) is
+  bit-identical to sharding from scratch on the survivor submesh, and a
+  chip lost MID-DRIVE — the fault seam inside the chunked level loop —
+  recovers through the supervisor's reshard rung with the same bits.
+
+Tier-1 keeps the fast arms (2x4 + the mid-drive kill); the full
+shape x tree matrix rides `make multichip` (slow-marked here).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+    CSRGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+    BitBellEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.bell import (
+    BellGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+    make_mesh2d,
+    parse_mesh_spec,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+    Mesh2DEngine,
+    level_collective_bytes,
+    select_merge_tree,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (
+    ChunkSupervisor,
+    DeviceError,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.faults import (
+    FaultPlan,
+    injected,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (
+    collective_bytes,
+    reset_collective_bytes,
+)
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device test mesh"
+)
+
+
+@pytest.fixture(scope="module")
+def workload():
+    """A gnm graph whose n (73) is DELIBERATELY indivisible by every mesh
+    extent under test, so padding, partial last segments, and the
+    row/col-space coordinate split are all exercised; queries include an
+    out-of-range source and an all-invalid row (the CLI's remap cases)."""
+    n, edges = generators.gnm_edges(73, 210, seed=3)
+    g = CSRGraph.from_edges(n, edges)
+    rng = np.random.default_rng(7)
+    queries = rng.integers(0, n, size=(10, 3)).astype(np.int32)
+    queries[3, 1] = -1
+    queries[7] = -1
+    oracle = BitBellEngine(BellGraph.from_host(g))
+    levels, reached, f = (np.asarray(x) for x in oracle.query_stats(queries))
+    return g, queries, f, levels, reached
+
+
+# (R, C, tree) arms: tier-1 runs the balanced 2x4 through the auto
+# (halving) tree; the transposes, rings, oneshot, degenerate 1D layouts
+# and the non-power-of-two col axis ride `make multichip`.
+SHAPES = [
+    (2, 4, "auto"),
+    pytest.param(2, 4, "ring", marks=pytest.mark.slow),
+    pytest.param(2, 4, "oneshot", marks=pytest.mark.slow),
+    pytest.param(4, 2, "auto", marks=pytest.mark.slow),
+    pytest.param(2, 2, "halving", marks=pytest.mark.slow),
+    pytest.param(8, 1, "auto", marks=pytest.mark.slow),
+    pytest.param(1, 8, "auto", marks=pytest.mark.slow),
+    pytest.param(1, 8, "ring", marks=pytest.mark.slow),
+    pytest.param(2, 3, "ring", marks=pytest.mark.slow),
+    pytest.param(1, 1, "auto", marks=pytest.mark.slow),
+]
+
+
+@needs_mesh
+@pytest.mark.parametrize("rows,cols,tree", SHAPES)
+def test_mesh_shape_matches_oracle(workload, rows, cols, tree):
+    g, queries, f, levels, reached = workload
+    eng = Mesh2DEngine(make_mesh2d(rows, cols), g, merge_tree=tree)
+    np.testing.assert_array_equal(np.asarray(eng.f_values(queries)), f)
+    ls, rs, fs = (np.asarray(x) for x in eng.query_stats(queries))
+    np.testing.assert_array_equal(ls, levels)
+    np.testing.assert_array_equal(rs, reached)
+    np.testing.assert_array_equal(fs, f)
+
+
+def test_select_merge_tree_policy():
+    # C == 1: no col axis, nothing to reduce.
+    assert select_merge_tree(1) == "none"
+    # auto: halving needs a power-of-two axis; ring otherwise.
+    assert select_merge_tree(4) == "halving"
+    assert select_merge_tree(3) == "ring"
+    assert select_merge_tree(2, "oneshot") == "oneshot"
+    with pytest.raises(ValueError):
+        select_merge_tree(3, "halving")  # not a power of two
+    with pytest.raises(ValueError):
+        select_merge_tree(4, "none")  # a real axis cannot skip the merge
+    with pytest.raises(ValueError):
+        select_merge_tree(4, "bogus")
+
+
+def test_parse_mesh_spec():
+    assert parse_mesh_spec("4x2") == (4, 2)
+    assert parse_mesh_spec(" 2X4 ") == (2, 4)
+    for bad in ("", "8", "2x", "x4", "0x8", "-1x8", "2x2x2"):
+        with pytest.raises(ValueError):
+            parse_mesh_spec(bad)
+
+
+def test_level_collective_bytes_pins():
+    """Hand-computed wire figures for the n=73, K=10 (1 plane word)
+    workload: seg = lsub*words*4; per level each of the R*C chips
+    receives (R-1) segs on the row axis and (C-1) segs from a ring/
+    halving col reduce — oneshot's all_gather pays (C-1)*C segs."""
+    # 2x4: lsub = ceil(73/8) = 10, seg = 40 B.
+    assert level_collective_bytes(2, 4, 10, 1, "halving") == 1280
+    assert level_collective_bytes(2, 4, 10, 1, "ring") == 1280
+    assert level_collective_bytes(2, 4, 10, 1, "oneshot") == 4160
+    # 2x2: lsub = 19, seg = 76 B.
+    assert level_collective_bytes(2, 2, 19, 1, "ring") == 608
+    assert level_collective_bytes(2, 2, 19, 1, "oneshot") == 912
+    # 1x8 (the 1D layout): lsub = 10 — the col reduce carries it all.
+    assert level_collective_bytes(1, 8, 10, 1, "ring") == 2240
+    assert level_collective_bytes(1, 8, 10, 1, "oneshot") == 17920
+    # 1x1: no mesh, no wire.
+    assert level_collective_bytes(1, 1, 73, 1, "none") == 0
+
+
+@needs_mesh
+def test_measured_collective_bytes_match_model(workload):
+    """The chunked drive's counter is levels x the per-level model —
+    the same analytic bytes bench detail.multichip and the perf-smoke
+    2D-vs-1D guard consume."""
+    g, queries, f, levels, reached = workload
+    eng = Mesh2DEngine(make_mesh2d(2, 4), g, level_chunk=1)
+    eng.compile(queries.shape)
+    reset_collective_bytes()
+    eng.best(queries)
+    got = collective_bytes()
+    want = int(levels.max()) * eng.level_bytes(queries.shape[0])
+    assert got == want, (got, want)
+
+
+@needs_mesh
+def test_without_ranks_matches_fresh_shard(workload):
+    """Row-granular reshard: dropping the failed flat rank's mesh row
+    must be bit-identical to a from-scratch shard on the survivor
+    submesh — the invariant that makes mid-drive recovery silent."""
+    g, queries, f, levels, reached = workload
+    eng = Mesh2DEngine(make_mesh2d(2, 2), g)
+    survivor = eng.without_ranks({1})  # rank 1 sits in mesh row 0
+    assert (survivor.rows, survivor.cols) == (1, 2)
+    np.testing.assert_array_equal(np.asarray(survivor.f_values(queries)), f)
+    fresh = Mesh2DEngine(
+        make_mesh2d(
+            1, 2, devices=list(np.asarray(survivor.mesh.devices).ravel())
+        ),
+        g,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fresh.f_values(queries)),
+        np.asarray(survivor.f_values(queries)),
+    )
+
+
+@needs_mesh
+def test_without_ranks_no_survivors_raises(workload):
+    g, queries, f, levels, reached = workload
+    eng = Mesh2DEngine(make_mesh2d(2, 2), g)
+    with pytest.raises(DeviceError):
+        eng.without_ranks({0, 2})  # one rank in each mesh row
+
+
+@needs_mesh
+def test_mid_drive_chip_loss_reshards_bit_identical(workload):
+    """Kill a simulated chip MID-DRIVE (the dispatch fault seam inside
+    the chunked level loop, count 2: the supervisor's own dispatch trip
+    consumes count 1) and assert the supervisor's reshard rung lands on
+    the survivor mesh with bit-identical results to the clean run."""
+    g, queries, f, levels, reached = workload
+    plan = FaultPlan.parse("chip:rank0:2")
+    sup = ChunkSupervisor(Mesh2DEngine(make_mesh2d(2, 2), g), plan=plan)
+    with injected(plan):
+        got = np.asarray(sup.f_values(queries))
+    np.testing.assert_array_equal(got, f)
+    reshards = [ev for ev in sup.events if ev["action"] == "reshard"]
+    assert len(reshards) == 1
+    assert reshards[0]["failed_ranks"] == [0]
+    assert reshards[0]["survivor_shards"] == 2

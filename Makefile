@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip
+.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -78,5 +78,14 @@ multichip: native
 	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_partition2d.py -x -q
 	JAX_PLATFORMS=cpu python -m pytest tests/test_engines_agree.py -x -q -k "mesh2d"
 
-test: native resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip
+# Dynamic-graph suite (docs/SERVING.md "Mutations & versions"): the
+# versioned delta log (fuzz parity against from-scratch rebuilds),
+# incremental BFS repair (bit-identical to full recompute + certified),
+# the serve mutate/versions verbs with journaled replay, AND the repair
+# arm of the engines-agreement matrix.
+dynamic: native
+	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_dynamic.py -x -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_engines_agree.py -x -q -k "repair"
+
+test: native resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic
 	python -m pytest tests/ -x -q

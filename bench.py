@@ -50,7 +50,7 @@ BASELINE.md; empty disables), BENCH_WAIT_S (device-probe budget, default
 420), BENCH_RUN_S (workload hard deadline, default 1500),
 BENCH_GRAPH (rmat|road — road builds the config-4 grid at side 2^(scale/2)),
 BENCH_CONFIGS (comma list of BASELINE config ids, DEFAULT
-"2,2c,4,1,5,6,6r,7,7t,7l": sweep
+"2,2c,4,1,5,6,6r,7,7t,7l,8,8m": sweep
 mode — each config runs in its own deadline-bounded child and gets its own
 value/error in detail.sweep; the cumulative record re-emits after every
 config so a partial outage cannot zero what was already measured; the
@@ -60,7 +60,12 @@ top-level vs_baseline is null with a baseline_graph_mismatch note, since
 that ratio was measured against a different workload's reference model.
 The "7" family is the round-10 multi-chip scale-out: BENCH_ENGINE=mesh2d
 (the 2D adjacency partition, parallel/partition2d) with BENCH_MESH=RxC on
-a forced 8-virtual-device CPU mesh; rows carry detail.multichip.  Empty =
+a forced 8-virtual-device CPU mesh; rows carry detail.multichip.  The "8"
+family is the round-11 dynamic-graph workload (BENCH_DYNAMIC=1):
+localized-delta incremental BFS repair vs full recompute, host-side, with
+BENCH_DELTA_SIZE/BENCH_DELTA_LOCALITY shaping the seeded delta (gen_cli
+--deltas semantics); rows carry detail.dynamic with the plane-byte
+counters the perf-smoke repair budget pins.  Empty =
 single-config mode, where the BENCH_SCALE/K/... knobs
 apply directly; BENCH_SCALE_CAP caps the preset scales),
 BENCH_DETAIL_PATH (sweep mode: sidecar file for the FULL cumulative
@@ -244,8 +249,167 @@ def _bench_megachunk():
         return None
 
 
+def run_dynamic_workload() -> None:
+    """BENCH_DYNAMIC=1 (config 8 family): localized-delta incremental
+    BFS repair (dynamic/repair.py) vs full recompute, both host-side.
+    One seeded delta batch (BENCH_DELTA_SIZE mutations at
+    BENCH_DELTA_LOCALITY — the gen_cli --deltas knobs) is applied to the
+    base graph; the timed comparison is repair-from-cached-planes
+    against a from-scratch ``reference_distances`` sweep on the patched
+    graph.  The row's value is the measured speedup; detail.dynamic
+    carries the plane-byte accounting the perf-smoke repair budget pins
+    (cone_size, repaired_plane_bytes, full_plane_bytes) plus the
+    bit-identity and certificate verdicts — a row that is fast but wrong
+    reports an error, not a value."""
+    scale = _env_int("BENCH_SCALE", 18)
+    k = _env_int("BENCH_K", 8)
+    max_s = _env_int("BENCH_MAX_S", 8)
+    repeats = _env_int("BENCH_REPEATS", 3)
+    batch_size = _env_int("BENCH_DELTA_SIZE", 24)
+    try:
+        locality = float(os.environ.get("BENCH_DELTA_LOCALITY", "0.98"))
+    except ValueError:
+        locality = 0.98
+    graph_kind = os.environ.get("BENCH_GRAPH", "road")
+
+    import numpy as np
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.dynamic.delta import (
+        DeltaLog,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.dynamic.repair import (
+        repair_distances,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.certify import (
+        certify_distances,
+        reference_distances,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        pad_queries,
+    )
+
+    t0 = time.perf_counter()
+    if graph_kind == "road":
+        side = 1 << (scale // 2)
+        n, edges = generators.road_edges(side, side, seed=46)
+        shape = f"road-{side}x{side} (n={side * side})"
+    else:
+        n, edges = generators.rmat_edges(
+            scale, edge_factor=_env_int("BENCH_EDGE_FACTOR", 16), seed=42
+        )
+        shape = f"RMAT-{scale} (n=2^{scale})"
+    g0 = CSRGraph.from_edges(n, edges)
+    gen_s = time.perf_counter() - t0
+
+    groups = generators.ensure_giant_sources(
+        generators.random_queries(n, k, max_group=max_s, seed=43),
+        n,
+        edges,
+        seed=43,
+    )
+    rows = pad_queries(groups, pad_to=max_s)
+
+    log = DeltaLog.from_graph(g0, "bench")
+    ((ins, dels),) = generators.delta_batches(
+        n,
+        edges,
+        batches=1,
+        batch_size=batch_size,
+        locality=locality,
+        seed=44,
+    )
+    batch = log.append(ins, dels)
+    g1, _ = log.apply()
+    net_ins, net_dels = log.net_delta(0)
+
+    t0 = time.perf_counter()
+    base_planes = reference_distances(g0.row_offsets, g0.col_indices, rows)
+    seed_plane_s = time.perf_counter() - t0
+
+    rep_times = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        dist_rep, rstats = repair_distances(
+            g1, rows, base_planes, net_ins, net_dels
+        )
+        rep_times.append(time.perf_counter() - t0)
+    repair_s = min(rep_times)
+
+    full_times = []
+    for _ in range(max(1, min(repeats, 2))):
+        t0 = time.perf_counter()
+        dist_full = reference_distances(
+            g1.row_offsets, g1.col_indices, rows
+        )
+        full_times.append(time.perf_counter() - t0)
+    full_s = min(full_times)
+
+    identical = bool(np.array_equal(dist_rep, dist_full))
+    failing = certify_distances(
+        g1.row_offsets, g1.col_indices, rows, dist_rep
+    )
+    speedup = round(full_s / repair_s, 3) if repair_s > 0 else None
+    byte_ratio = (
+        round(
+            rstats.repaired_plane_bytes / rstats.full_plane_bytes, 5
+        )
+        if rstats.full_plane_bytes
+        else None
+    )
+    record = {
+        "metric": (
+            f"incremental-repair speedup vs full recompute, "
+            f"{k}-query distance planes, {shape}, "
+            f"{batch.inserts.shape[0]}+/{batch.deletes.shape[0]}- edge "
+            f"delta at locality {locality:g}"
+        ),
+        "value": speedup if identical and not failing else None,
+        "unit": "x",
+        "vs_baseline": None,
+        "detail": {
+            "gen_s": round(gen_s, 3),
+            "seed_plane_s": round(seed_plane_s, 6),
+            "repair_s": round(repair_s, 6),
+            "full_recompute_s": round(full_s, 6),
+            "all_repair_runs_s": [round(t, 6) for t in rep_times],
+            "delta": {
+                "inserts": int(batch.inserts.shape[0]),
+                "deletes": int(batch.deletes.shape[0]),
+                "locality": locality,
+            },
+            "dynamic": {
+                "cone_size": rstats.cone_size,
+                "repaired_plane_bytes": rstats.repaired_plane_bytes,
+                "full_plane_bytes": rstats.full_plane_bytes,
+                "speedup": speedup,
+                "plane_byte_ratio": byte_ratio,
+                "invalidated": rstats.invalidated,
+                "seeds": rstats.seeds,
+                "levels": rstats.levels,
+                "fallback": rstats.fallback,
+                "bit_identical": identical,
+                "certificate_failing": failing,
+            },
+        },
+    }
+    if not identical or failing:
+        record["error"] = (
+            "repaired planes diverge from full recompute "
+            f"(bit_identical={identical}, failing={failing})"
+        )
+    print(json.dumps(record), flush=True)
+
+
 def run_workload() -> None:
     """The actual benchmark (child process; assumes a live backend)."""
+    if os.environ.get("BENCH_DYNAMIC") == "1":
+        return run_dynamic_workload()
     scale = _env_int("BENCH_SCALE", 20)
     edge_factor = _env_int("BENCH_EDGE_FACTOR", 16)
     k = _env_int("BENCH_K", 64)
@@ -988,6 +1152,24 @@ CONFIG_PRESETS = {
            "BENCH_SCALE": "16", "BENCH_K": "64", "BENCH_MESH": "1x8",
            "BENCH_REPEATS": "2", "BENCH_EXTRA_KS": "",
            "BENCH_VIRTUAL_CPU": "8"},
+    # Config 8 family (round 11): dynamic graphs — localized-delta
+    # incremental BFS repair (dynamic/repair.py) vs full recompute,
+    # host-side.  "8" is the street-closure scenario on the road grid
+    # (repair's home turf: a high-diameter graph where a small patch
+    # invalidates a tiny cone); "8m" runs the same delta shape on
+    # RMAT-20, where the small-world cone spreads and the cost model's
+    # fallback earns its keep (the row reports which path ran).  Rows
+    # carry detail.dynamic: cone_size, repaired_plane_bytes,
+    # full_plane_bytes, speedup — the same counters the perf-smoke
+    # repair budget pins — plus bit-identity/certificate verdicts.
+    "8": {"BENCH_GRAPH": "road", "BENCH_DYNAMIC": "1",
+          "BENCH_SCALE": "18", "BENCH_K": "8", "BENCH_MAX_S": "8",
+          "BENCH_DELTA_SIZE": "24", "BENCH_DELTA_LOCALITY": "0.98",
+          "BENCH_EXTRA_KS": ""},
+    "8m": {"BENCH_GRAPH": "rmat", "BENCH_DYNAMIC": "1",
+           "BENCH_SCALE": "20", "BENCH_K": "8", "BENCH_MAX_S": "8",
+           "BENCH_DELTA_SIZE": "24", "BENCH_DELTA_LOCALITY": "0.98",
+           "BENCH_REPEATS": "1", "BENCH_EXTRA_KS": ""},
 }
 
 
@@ -1145,7 +1327,12 @@ def run_sweep(configs) -> int:
         # the one shared helper (virtual_cpu.virtual_cpu_env scrubs the
         # TPU plugin var and pins the device-count flag unambiguously).
         virt = int(preset.pop("BENCH_VIRTUAL_CPU", 0) or 0)
-        env = dict(os.environ, BENCH_CHILD="1", **preset)
+        env = dict(os.environ, BENCH_CHILD="1")
+        # Workload-identity scrub: a stray exported BENCH_DYNAMIC must
+        # not flip a labeled TEPS config into the repair workload — only
+        # the config-8 presets set it.
+        env.pop("BENCH_DYNAMIC", None)
+        env.update(preset)
         if virt:
             from virtual_cpu import virtual_cpu_env
 
@@ -1191,7 +1378,7 @@ def main() -> int:
     configs = [
         c.strip()
         for c in os.environ.get(
-            "BENCH_CONFIGS", "2,2c,4,1,5,6,6r,7,7t,7l"
+            "BENCH_CONFIGS", "2,2c,4,1,5,6,6r,7,7t,7l,8,8m"
         ).split(",")
         if c.strip()
     ]

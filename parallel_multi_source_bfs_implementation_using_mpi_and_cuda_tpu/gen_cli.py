@@ -13,6 +13,12 @@ Kinds: ``rmat`` (power-law, Graph500-style), ``grid`` (side x side
 irregular grid + diagonals + regional shortcuts, see
 models.generators.road_edges), ``gnm`` (uniform random).
 
+Dynamic fixtures: ``--deltas <file>`` additionally emits a binary
+edge-delta file against the generated graph (insert/delete batches with
+a seeded ``--delta-locality`` knob, ``dynamic.delta`` format) — the one
+fixture format the dynamic tests, bench config 8 and ``make perf-smoke``
+all share.
+
 Real datasets: ``--convert <file>`` ingests a public graph instead of
 generating one — DIMACS ``.gr`` (USA-road-d family, ``--informat dimacs``)
 or SNAP whitespace edge lists (``--informat snap``), .gz transparently —
@@ -46,6 +52,29 @@ def main(argv=None) -> int:
     ap.add_argument("--queries", type=int, default=0, help="number of query groups (0: no query file)")
     ap.add_argument("--max-group", type=int, default=64, help="max sources per group (<= 128)")
     ap.add_argument("--query-file", default=None)
+    ap.add_argument(
+        "--deltas",
+        default=None,
+        metavar="FILE",
+        help="also emit a binary edge-delta file against the generated "
+        "graph (dynamic.delta format; docs/SERVING.md 'Mutations & "
+        "versions')",
+    )
+    ap.add_argument(
+        "--delta-batches", type=int, default=1, help="batches in --deltas"
+    )
+    ap.add_argument(
+        "--delta-size",
+        type=int,
+        default=16,
+        help="mutations per batch (half inserts, half deletes)",
+    )
+    ap.add_argument(
+        "--delta-locality",
+        type=float,
+        default=0.9,
+        help="0..1: 1 = street-closure-sized patch, 0 = whole-graph churn",
+    )
     ap.add_argument("--seed", type=int, default=42)
     args = ap.parse_args(argv)
 
@@ -62,6 +91,17 @@ def main(argv=None) -> int:
     ):
         # uint8 K / uint8 set_size wire format (main.cu:143-152)
         print("--queries must be 1..255, --max-group 1..128", file=sys.stderr)
+        return 2
+    if args.deltas and (
+        args.delta_batches < 1
+        or args.delta_size < 1
+        or not 0.0 <= args.delta_locality <= 1.0
+    ):
+        print(
+            "--delta-batches/--delta-size must be >= 1, "
+            "--delta-locality in [0, 1]",
+            file=sys.stderr,
+        )
         return 2
 
     from .models import generators
@@ -120,6 +160,25 @@ def main(argv=None) -> int:
         print(
             f"wrote {args.query_file}: K={len(qs)} sizes="
             f"{[len(q) for q in qs[:8]]}{'...' if len(qs) > 8 else ''}",
+            file=sys.stderr,
+        )
+
+    if args.deltas:
+        from .dynamic.delta import save_delta_bin
+
+        batches = generators.delta_batches(
+            n,
+            edges,
+            batches=args.delta_batches,
+            batch_size=args.delta_size,
+            locality=args.delta_locality,
+            seed=args.seed + 2,
+        )
+        save_delta_bin(args.deltas, n, batches)
+        sizes = [(len(i), len(d)) for i, d in batches[:8]]
+        print(
+            f"wrote {args.deltas}: batches={len(batches)} "
+            f"(ins, del)={sizes}{'...' if len(batches) > 8 else ''}",
             file=sys.stderr,
         )
     return 0

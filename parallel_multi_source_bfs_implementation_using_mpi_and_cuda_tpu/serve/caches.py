@@ -15,15 +15,27 @@ Two caches front the engines (docs/SERVING.md):
   ``stats`` verb reports and the serve tests assert (compile count flat
   across same-bucket requests, +1 for a cold bucket).
 
-Both are thread-safe: connection handler threads probe the result cache
-while the batcher thread fills it.
+A third cache serves the dynamic-graph subsystem (docs/SERVING.md
+"Mutations & versions"):
+
+* :class:`PlaneCache` — byte-capped LRU of certified per-query distance
+  planes, keyed by (graph name, canonical query bytes) WITHOUT the
+  version.  That omission is the point: unlike result-cache entries,
+  which a ``mutate`` must make unreachable (stale answers are not
+  answers), a stale plane is still a valid repair SEED — the entry
+  records which ``(digest, version)`` it was certified against, and the
+  repair path composes the delta span from there to the live version.
+  Planes survive mutations by design; they age out by bytes.
+
+All are thread-safe: connection handler threads probe the caches while
+the batcher thread fills them.
 """
 
 from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 
 class LRUCache:
@@ -84,6 +96,79 @@ class LRUCache:
                 "evictions": self.evictions,
                 "size": len(self._data),
                 "capacity": self.capacity,
+            }
+
+
+class PlaneCache:
+    """Byte-capped LRU of repair-seed distance planes.
+
+    Entries are ``(version, digest, dist)`` with ``dist`` a host (K, n)
+    int32 plane certified at that version.  ``max_bytes <= 0`` disables
+    (the ``MSBFS_SERVE_PLANE_CACHE_BYTES=0`` opt-out — the repair path
+    then always falls back to full recompute).  Keys deliberately
+    exclude the version: a mutate must NOT drop these (see module
+    docstring); ``put`` overwrites the entry for a key with the newest
+    plane, so each query's seed converges back toward version-fresh.
+    """
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self._data: OrderedDict = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key) -> Optional[Tuple[int, str, object]]:
+        """(version, digest, dist) or None."""
+        with self._lock:
+            if self.max_bytes <= 0 or key not in self._data:
+                self.misses += 1
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+            return self._data[key]
+
+    def put(self, key, version: int, digest: str, dist) -> None:
+        nbytes = int(dist.nbytes)
+        with self._lock:
+            if self.max_bytes <= 0 or nbytes > self.max_bytes:
+                return  # a plane bigger than the cap would evict everything
+            if key in self._data:
+                self._bytes -= int(self._data[key][2].nbytes)
+                self._data.move_to_end(key)
+            self._data[key] = (int(version), str(digest), dist)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and self._data:
+                _, (_, _, old) = self._data.popitem(last=False)
+                self._bytes -= int(old.nbytes)
+                self.evictions += 1
+
+    def drop_where(self, predicate: Callable[[object], bool]) -> int:
+        """Eager invalidation for the cases where a seed really IS dead:
+        a reload (new file content, no delta chain to compose) or a
+        graph eviction."""
+        with self._lock:
+            stale = [k for k in self._data if predicate(k)]
+            for k in stale:
+                self._bytes -= int(self._data[k][2].nbytes)
+                del self._data[k]
+            return len(stale)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "size": len(self._data),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
             }
 
 

@@ -223,6 +223,31 @@ class MsbfsClient:
         # after an ambiguous failure could bump twice.
         return self.call({"op": "reload", "graph": graph})
 
+    def mutate(
+        self,
+        inserts: Sequence[Sequence[int]] = (),
+        deletes: Sequence[Sequence[int]] = (),
+        graph: str = "default",
+    ) -> dict:
+        """Append one edge-delta batch to ``graph``'s version chain
+        (docs/SERVING.md "Mutations & versions").  NOT idempotent, same
+        contract as :meth:`reload`: each call appends a chain version,
+        so a blind re-send after an ambiguous failure could apply the
+        delta twice."""
+        return self.call(
+            {
+                "op": "mutate",
+                "graph": graph,
+                "inserts": [[int(u), int(v)] for u, v in inserts],
+                "deletes": [[int(u), int(v)] for u, v in deletes],
+            }
+        )
+
+    def versions(self, graph: str = "default") -> dict:
+        """The graph's version chain (read-only, idempotent)."""
+        return self.call({"op": "versions", "graph": graph},
+                         idempotent=True)
+
     def query(
         self,
         queries: Sequence[Sequence[int]],
@@ -350,6 +375,11 @@ def query_main(argv: Optional[List[str]] = None) -> int:
                     help="registered graph name (default 'default')")
     ap.add_argument("--load", default=None, metavar="PATH",
                     help="register PATH under --graph before querying")
+    ap.add_argument("--mutate", default=None, metavar="FILE",
+                    help="apply an edge-delta file (gen_cli --deltas "
+                    "format) to --graph, one mutate per batch")
+    ap.add_argument("--versions", action="store_true",
+                    help="print --graph's version chain")
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help="per-query deadline; the server sheds the "
                     "request once it expires")
@@ -366,9 +396,10 @@ def query_main(argv: Optional[List[str]] = None) -> int:
                     help="ask the daemon to exit")
     args = ap.parse_args(argv)
     if not (args.query_file or args.stats or args.ping or args.health
-            or args.shutdown or args.load):
-        ap.error("nothing to do: give -q, --load, --stats, --ping, "
-                 "--health or --shutdown")
+            or args.shutdown or args.load or args.mutate
+            or args.versions):
+        ap.error("nothing to do: give -q, --load, --mutate, --versions, "
+                 "--stats, --ping, --health or --shutdown")
     try:
         client = MsbfsClient(args.connect)
     except (OSError, ValueError) as exc:
@@ -402,6 +433,31 @@ def query_main(argv: Optional[List[str]] = None) -> int:
                     f"directed edges, hash {info['hash']})",
                     file=sys.stderr,
                 )
+            if args.mutate:
+                from ..dynamic.delta import load_delta_bin
+
+                _, batches = load_delta_bin(args.mutate)
+                for ins, dels in batches:
+                    info = client.mutate(
+                        inserts=[[int(u), int(v)] for u, v in ins],
+                        deletes=[[int(u), int(v)] for u, v in dels],
+                        graph=args.graph,
+                    )
+                    g = info["graph"]
+                    print(
+                        f"mutated {g['name']} -> delta version "
+                        f"{g['delta_version']} (digest {g['digest']}; "
+                        f"+{info['applied']['inserts']}/"
+                        f"-{info['applied']['deletes']} edges)",
+                        file=sys.stderr,
+                    )
+            if args.versions:
+                chain = client.versions(graph=args.graph)
+                for row in chain["chain"]:
+                    sys.stdout.write(
+                        f"v{row['version']} {row['digest']} "
+                        f"+{row['inserts']} -{row['deletes']}\n"
+                    )
             if args.query_file:
                 out = client.query(
                     _queries_from_file(args.query_file),

@@ -13,10 +13,17 @@ Record grammar (one JSON object per line)::
     {"op": "load",   "name": ..., "path": ..., "hash": ...}
     {"op": "reload", "name": ..., "path": ..., "hash": ...}
     {"op": "warm",   "name": ..., "hash": ..., "k_exec": ..., "s_pad": ...}
+    {"op": "mutate", "name": ..., "inserts": [[u, v], ...],
+     "deletes": [[u, v], ...], "digest": ...}
 
 :meth:`StateJournal.replay` folds the line stream into the reconciled
 end state — last registration per name wins, warm records survive only
-while their (name, hash) still matches the live registration — and
+while their (name, hash) still matches the live registration, mutate
+records form an ORDERED per-name delta chain that a load/reload resets
+(new file content, fresh version 0) and compaction preserves verbatim
+(each record's chained ``digest`` lets the restart verify the replayed
+chain against what was journaled, the mutation analog of the loader's
+``expected_hash`` contract) — and
 :meth:`StateJournal.compact` atomically rewrites the file down to that
 state (temp file + fsync + rename), so the journal stays proportional
 to the live state, not to the daemon's lifetime.
@@ -45,7 +52,23 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..utils import faults
 
-_OPS = ("load", "reload", "warm")
+_OPS = ("load", "reload", "warm", "mutate")
+
+
+def _valid_pairs(pairs) -> bool:
+    """Mutate payload shape check: a list of [u, v] int pairs (bools are
+    ints to json — exclude them; a corrupt journal line must drop, not
+    crash the replay)."""
+    if not isinstance(pairs, list):
+        return False
+    for p in pairs:
+        if not (isinstance(p, (list, tuple)) and len(p) == 2):
+            return False
+        if not all(
+            isinstance(x, int) and not isinstance(x, bool) for x in p
+        ):
+            return False
+    return True
 
 
 @dataclass
@@ -56,15 +79,28 @@ class JournalState:
     graphs: Dict[str, Tuple[str, str]] = field(default_factory=dict)
     # (name, hash, k_exec, s_pad) warmed buckets for live registrations
     warm: Set[Tuple[str, str, int, int]] = field(default_factory=set)
+    # name -> ordered mutate records ({"inserts", "deletes", "digest"})
+    # for the live registration; order IS the version chain, so these
+    # replay (and compact) strictly after the graph's load record
+    deltas: Dict[str, List[dict]] = field(default_factory=dict)
     replayed: int = 0  # records applied
     dropped: int = 0  # malformed/torn/stale lines skipped
 
     def records(self) -> List[dict]:
         """The state as a minimal record list (compaction's payload)."""
-        out: List[dict] = [
-            {"op": "load", "name": n, "path": p, "hash": h}
-            for n, (p, h) in sorted(self.graphs.items())
-        ]
+        out: List[dict] = []
+        for n, (p, h) in sorted(self.graphs.items()):
+            out.append({"op": "load", "name": n, "path": p, "hash": h})
+            out.extend(
+                {
+                    "op": "mutate",
+                    "name": n,
+                    "inserts": d["inserts"],
+                    "deletes": d["deletes"],
+                    "digest": d["digest"],
+                }
+                for d in self.deltas.get(n, ())
+            )
         out.extend(
             {"op": "warm", "name": n, "hash": h, "k_exec": k, "s_pad": s}
             for n, h, k, s in sorted(self.warm)
@@ -187,10 +223,26 @@ class StateJournal:
                 state.dropped += 1
                 return False
             state.graphs[name] = (path, digest)
-            # A re-registration with new content strands the old warms.
+            # A re-registration with new content strands the old warms
+            # AND resets the delta chain: version 0 is the file content.
             state.warm = {
                 w for w in state.warm if not (w[0] == name and w[1] != digest)
             }
+            state.deltas.pop(name, None)
+            return True
+        if op == "mutate":
+            if name not in state.graphs:
+                state.dropped += 1  # chain with no base graph
+                return False
+            inserts = record.get("inserts")
+            deletes = record.get("deletes")
+            digest = record.get("digest")
+            if not _valid_pairs(inserts) or not _valid_pairs(deletes) or not isinstance(digest, str):
+                state.dropped += 1
+                return False
+            state.deltas.setdefault(name, []).append(
+                {"inserts": inserts, "deletes": deletes, "digest": digest}
+            )
             return True
         # op == "warm"
         digest = record.get("hash")

@@ -6,7 +6,8 @@ same framing; a connection carries any number of request/response pairs
 in order (no pipelining guarantees beyond FIFO per connection).
 
 Requests are objects with an ``op`` field (``ping`` / ``health`` /
-``load`` / ``reload`` / ``query`` / ``stats`` / ``shutdown``);
+``load`` / ``reload`` / ``query`` / ``mutate`` / ``versions`` /
+``stats`` / ``posture`` / ``shutdown``);
 responses carry ``ok: true`` plus op-specific fields, or ``ok: false``
 with a typed ``error`` object mirroring the supervisor taxonomy
 (``{"type", "message", "exit_code"}`` — docs/RESILIENCE.md exit-code

@@ -300,7 +300,15 @@ def build_supervised_engine(graph, content_digest: Optional[str] = None) -> Chun
 
 @dataclass
 class GraphEntry:
-    """One registered graph: host CSR + supervised device engine."""
+    """One registered graph: host CSR + supervised device engine.
+
+    ``deltas``/``delta_version`` carry the dynamic-graph version chain
+    (docs/SERVING.md "Mutations & versions"): a ``mutate`` appends to
+    the :class:`..dynamic.delta.DeltaLog` and swaps in a new entry
+    serving the patched CSR, with the chained content digest riding
+    every cache key — the same stale-answers-are-unreachable mechanism
+    reload's version bump uses, one axis deeper.
+    """
 
     name: str
     path: str
@@ -310,13 +318,51 @@ class GraphEntry:
     supervisor: ChunkSupervisor
     loaded_at: float = field(default_factory=time.time)
     lock: threading.Lock = field(default_factory=threading.Lock)
+    deltas: Optional[object] = None  # dynamic.delta.DeltaLog
+    delta_version: int = 0
+
+    @property
+    def digest(self) -> str:
+        """Content-derived identity of what is actually served: the
+        file hash at delta-version 0, the chained delta digest after a
+        mutate — ``(base_digest, version)`` collapsed to one label."""
+        if self.deltas is None or self.delta_version == 0:
+            return self.hash
+        return self.deltas.digest(self.delta_version)
 
     @property
     def key(self) -> str:
         """Cache-key stem: name, content hash AND version — reload (same
         name, new bytes, bumped version) can never collide with entries
-        cached before it."""
-        return f"{self.name}@{self.hash}/v{self.version}"
+        cached before it; a mutate appends its chained delta digest so
+        pre-mutation results are unreachable the same way."""
+        stem = f"{self.name}@{self.hash}/v{self.version}"
+        if self.delta_version:
+            stem += f"+m{self.delta_version}.{self.digest}"
+        return stem
+
+    def version_chain(self) -> list:
+        """The ``versions`` verb payload: one row per delta version,
+        digests chained from the base content hash."""
+        out = [
+            {
+                "version": 0,
+                "digest": self.hash,
+                "inserts": 0,
+                "deletes": 0,
+            }
+        ]
+        if self.deltas is not None:
+            out.extend(
+                {
+                    "version": int(b.version),
+                    "digest": b.digest,
+                    "inserts": int(b.inserts.shape[0]),
+                    "deletes": int(b.deletes.shape[0]),
+                }
+                for b in self.deltas.batches
+            )
+        return out
 
     def describe(self) -> dict:
         return {
@@ -324,6 +370,8 @@ class GraphEntry:
             "path": self.path,
             "hash": self.hash,
             "version": self.version,
+            "delta_version": self.delta_version,
+            "digest": self.digest,
             "n": int(self.graph.n),
             "directed_edges": int(self.graph.num_directed_edges),
             "loaded_at": round(self.loaded_at, 3),
@@ -417,6 +465,56 @@ class GraphRegistry:
             self._entries[name] = entry
         return entry
 
+    def mutate(self, name: str, inserts, deletes) -> Tuple[GraphEntry, object]:
+        """Append one edge-delta batch to ``name``'s version chain and
+        atomically swap in an entry serving the patched dedup CSR
+        (``dynamic.delta.DeltaLog.apply`` — bit-identical to a from-
+        scratch rebuild on the mutated edge list).  Returns (new entry,
+        appended batch).  In-flight requests against the old entry
+        finish on the old engine, exactly like reload; their results are
+        keyed to the old entry key, so they can never be served against
+        a post-delta question.
+
+        Callers serialize mutations per name (the daemon funnels the
+        ``mutate`` verb through one lock); a concurrent reload loses the
+        swap race loudly rather than silently dropping the chain."""
+        from ..dynamic.delta import DeltaLog  # lazy: registry loads fast
+
+        with self._lock:
+            have = self._entries.get(name)
+        if have is None:
+            raise InputError(f"no graph registered as {name!r}")
+        with have.lock:
+            log = have.deltas
+            if log is None:
+                log = DeltaLog.from_graph(have.graph, have.hash)
+            try:
+                batch = log.append(inserts, deletes)
+            except ValueError as exc:
+                raise InputError(f"mutate {name!r}: {exc}")
+            graph, _ = log.apply()
+            entry = GraphEntry(
+                name=name,
+                path=have.path,
+                hash=have.hash,
+                version=have.version,
+                graph=graph,
+                supervisor=build_supervised_engine(
+                    graph, content_digest=batch.digest
+                ),
+                deltas=log,
+                delta_version=log.version,
+            )
+        with self._lock:
+            cur = self._entries.get(name)
+            if cur is not have:
+                raise InputError(
+                    f"graph {name!r} was replaced while mutating; "
+                    "re-issue the mutation against the new registration"
+                )
+            self._entries[name] = entry
+        return entry, batch
+
     def get(self, name: str) -> GraphEntry:
         with self._lock:
             entry = self._entries.get(name)
@@ -431,6 +529,16 @@ class GraphRegistry:
     def maybe_get(self, name: str) -> Optional[GraphEntry]:
         with self._lock:
             return self._entries.get(name)
+
+    def evict(self, name: str) -> Optional[GraphEntry]:
+        """Drop a registration (journal replay refusing a delta chain
+        that no longer verifies: serving the base content would silently
+        answer from pre-mutation data the journal promised was mutated).
+        Returns the removed entry, or None when nothing was registered;
+        in-flight requests against the removed entry finish on its
+        engine — the arrays live until the last reference drops."""
+        with self._lock:
+            return self._entries.pop(name, None)
 
     def describe(self) -> dict:
         with self._lock:

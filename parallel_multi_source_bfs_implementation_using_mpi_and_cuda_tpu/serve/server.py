@@ -29,11 +29,23 @@ recovery & probes"):
   results to a clean run;
 * clients send optional per-call deadlines; the server sheds work whose
   client has already given up before spending device time on it.
+
+Dynamic graphs (docs/SERVING.md "Mutations & versions"): the ``mutate``
+verb appends an edge-delta batch to a graph's version chain
+(dynamic/delta.py) and swaps in the patched CSR; ``versions`` reports
+the chain.  Mutations journal with their chained content digest and
+replay after kill -9 like everything else — a chain that stops
+reproducing its digests is refused typed.  Queries against a mutated
+graph first try the host-side incremental repair path
+(dynamic/repair.py) off a retained distance plane; the repaired answer
+is bit-identical to a cold recompute and sampled through the same
+output certificate as engine answers.
 """
 
 from __future__ import annotations
 
 import os
+import random
 import socket
 import sys
 import threading
@@ -62,11 +74,15 @@ from .batcher import (
     bucket_label,
     pow2_pad,
 )
-from .caches import ExecutableCache, LRUCache
-from .journal import StateJournal
+from .caches import ExecutableCache, LRUCache, PlaneCache
+from .journal import StateJournal, _valid_pairs as _valid_edge_pairs
 from .registry import GraphEntry, GraphRegistry
 
 DEFAULT_RESULT_CACHE = 1024
+# Repair-seed plane budget (docs/SERVING.md "Mutations & versions"):
+# one (K, n) int32 plane per distinct query shape per graph, so the cap
+# is sized for a handful of hot queries, not the whole result cache.
+DEFAULT_PLANE_CACHE_BYTES = 256 << 20
 # A request parked behind a full pipeline must eventually fail typed
 # rather than hold its connection forever.
 DEFAULT_REQUEST_TIMEOUT_S = 300.0
@@ -100,6 +116,29 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, str(default)))
     except ValueError:
         return default
+
+
+def _plane_policy() -> str:
+    """``MSBFS_SERVE_PLANES``: when does a query retain its distance
+    plane as a repair seed?  ``auto`` (default) retains only for graphs
+    that already carry a delta chain — the one case a seed provably pays
+    off; ``1`` always retains (operator knows mutations are coming);
+    ``0`` never does (repair still runs off planes stored by earlier
+    repairs).  Malformed values fall back to the default with a stderr
+    note, the repo-wide knob convention."""
+    raw = os.environ.get("MSBFS_SERVE_PLANES", "auto").strip().lower()
+    if raw in ("auto", ""):
+        return "auto"
+    if raw in ("1", "on", "always"):
+        return "1"
+    if raw in ("0", "off", "never"):
+        return "0"
+    print(
+        f"msbfs serve: MSBFS_SERVE_PLANES={raw!r} is not auto/1/0; "
+        "using auto",
+        file=sys.stderr,
+    )
+    return "auto"
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -167,6 +206,14 @@ class MsbfsServer:
             else _env_int("MSBFS_SERVE_RESULT_CACHE", DEFAULT_RESULT_CACHE)
         )
         self.executables = ExecutableCache()
+        # Repair seeds for the dynamic-graph path: planes survive
+        # mutations BY DESIGN (serve/caches.py module docstring) —
+        # only reload and eviction drop them.
+        self.planes = PlaneCache(
+            _env_int("MSBFS_SERVE_PLANE_CACHE_BYTES",
+                     DEFAULT_PLANE_CACHE_BYTES)
+        )
+        self.plane_policy = _plane_policy()
         self.batcher = MicroBatcher(
             self._execute_batch, capacity=queue_capacity, window_s=window_s
         )
@@ -192,6 +239,16 @@ class MsbfsServer:
         self._shed_requests = 0
         self._shed_brownout = 0
         self._quarantined_requests = 0
+        # Dynamic-graph ledger: one mutate at a time per daemon (the
+        # registry would survive concurrency, but the journal's chain
+        # order must match the applied order exactly).
+        self._mutate_lock = threading.Lock()
+        self._mutations = 0
+        self._requests_repaired = 0
+        self._repair_fallbacks = 0
+        self._planes_retained = 0
+        self._repair_audited = 0
+        self._repair_audit_failures = 0
         # Brownout posture (serve/brownout.py, pushed by the fleet's
         # ``posture`` verb): an audit-sample override applied to every
         # supervisor — including ones registered later — and the
@@ -338,6 +395,18 @@ class MsbfsServer:
                         file=sys.stderr,
                     )
                     continue
+            for name, chain in sorted(state.deltas.items()):
+                if self._stopping.is_set():
+                    return
+                self._replay_deltas(name, chain)
+            # Replay folded the history; rewrite the journal down to the
+            # reconciled state so it cannot grow without bound.  This
+            # MUST happen before _replayed opens the verb gate: every
+            # journal append after boot comes from a verb handler (or
+            # the batcher serving an admitted query), all of which wait
+            # on _replayed — compacting later would race those appends
+            # and silently erase a freshly journaled mutate/load/warm.
+            self.journal.compact(state)
             self._replayed.set()
             for name, digest, k_exec, s_pad in sorted(state.warm):
                 if self._stopping.is_set() or self._draining:
@@ -346,12 +415,51 @@ class MsbfsServer:
                 if entry is None or entry.hash != digest:
                     continue
                 self._warm_bucket(entry, k_exec, s_pad)
-            # Replay folded the history; rewrite the journal down to the
-            # reconciled state so it cannot grow without bound.
-            self.journal.compact(state)
         finally:
             self._replayed.set()  # never leave verbs gated by a crash here
             self._ready.set()
+
+    def _replay_deltas(self, name: str, chain: List[dict]) -> None:
+        """Re-apply one graph's journaled delta chain in order, holding
+        each re-derived digest against the journaled one — the mutation
+        analog of the loader's ``expected_hash`` contract.  A chain that
+        stops reproducing its digests means the journal (or the base
+        content it chains from) was corrupted: the whole registration is
+        REFUSED typed and evicted, because serving any version of it
+        would silently answer from different data than the journal
+        promised."""
+        for i, rec in enumerate(chain):
+            if self._stopping.is_set():
+                return
+            try:
+                entry, batch = self.registry.mutate(
+                    name, rec["inserts"], rec["deletes"]
+                )
+            except MsbfsError as exc:
+                reason = (
+                    f"delta {i + 1}/{len(chain)} failed to re-apply: {exc}"
+                )
+                self._refuse_replayed_graph(name, reason)
+                return
+            entry.supervisor.drain_signal = self._drain_signal
+            if batch.digest != rec["digest"]:
+                reason = (
+                    f"delta {i + 1}/{len(chain)} re-derives digest "
+                    f"{batch.digest}, journal records {rec['digest']}: "
+                    "the chain no longer verifies"
+                )
+                self._refuse_replayed_graph(name, reason)
+                return
+
+    def _refuse_replayed_graph(self, name: str, reason: str) -> None:
+        self.registry.evict(name)
+        with self._stats_lock:
+            self._refused_graphs[name] = reason
+        print(
+            f"msbfs serve: journal replay refused graph {name!r}: "
+            f"{reason}",
+            file=sys.stderr,
+        )
 
     def _warm_bucket(self, entry: GraphEntry, k_exec: int, s_pad: int) -> None:
         label = bucket_label(entry.key, k_exec, s_pad)
@@ -515,8 +623,10 @@ class MsbfsServer:
                 return {"ok": True, "op": "ping", "pid": os.getpid()}
             if op == "health":
                 return self._op_health()
-            if op in ("load", "reload", "query"):
-                if self._draining:
+            if op in ("load", "reload", "query", "mutate", "versions"):
+                if self._draining and op != "versions":
+                    # versions is read-only (like stats) and stays
+                    # answerable while draining; the rest is refused.
                     raise TransientError(
                         "server is draining; retry against another "
                         "instance"
@@ -535,6 +645,10 @@ class MsbfsServer:
                 return self._op_reload(request)
             if op == "query":
                 return self._op_query(request)
+            if op == "mutate":
+                return self._op_mutate(request)
+            if op == "versions":
+                return self._op_versions(request)
             if op == "stats":
                 return {"ok": True, "op": "stats", "stats": self.stats()}
             if op == "posture":
@@ -612,11 +726,102 @@ class MsbfsServer:
         self.executables.drop_where(
             lambda k: isinstance(k, tuple) and k[0] == old.key
         )
+        # Unlike a mutate, a reload DOES kill repair seeds: the new file
+        # is fresh content with no delta chain connecting the old planes
+        # to it.
+        self.planes.drop_where(
+            lambda k: isinstance(k, tuple) and k[0] == name
+        )
         return {
             "ok": True,
             "op": "reload",
             "graph": entry.describe(),
             "invalidated_results": dropped,
+        }
+
+    def _op_mutate(self, request: dict) -> dict:
+        """Append one edge-delta batch to a graph's version chain
+        (docs/SERVING.md "Mutations & versions").  The registry swaps in
+        an entry serving the patched CSR; the journal records the
+        CANONICALIZED batch plus its chained digest, so a kill -9
+        restart replays the exact chain and can verify it; result/
+        executable caches keyed to the pre-delta entry are dropped
+        (unreachable anyway — the delta digest rides the key — but a
+        mutated daemon's cache should not fill with dead weight).
+        Distance planes are deliberately NOT dropped: a stale plane is
+        the repair path's seed."""
+        name = request.get("graph", "default")
+        inserts = request.get("inserts", [])
+        deletes = request.get("deletes", [])
+        if not _valid_edge_pairs(inserts) or not _valid_edge_pairs(deletes):
+            raise InputError(
+                "mutate needs 'inserts'/'deletes': lists of [u, v] "
+                "integer vertex pairs"
+            )
+        if len(inserts) + len(deletes) > MAX_WIRE_QUERIES * 4:
+            raise InputError(
+                f"{len(inserts) + len(deletes)} mutations exceed the "
+                f"{MAX_WIRE_QUERIES * 4} per-request bound; split the "
+                "batch"
+            )
+        with self._mutate_lock:
+            old = self.registry.get(name)
+            entry, batch = self.registry.mutate(name, inserts, deletes)
+            entry.supervisor.drain_signal = self._drain_signal
+            if self._posture_audit is not None:
+                # A mid-brownout mutate inherits the pushed posture,
+                # same as a mid-brownout load (see _register).
+                self._audit_saved.setdefault(
+                    name, float(old.supervisor.audit_sample)
+                )
+                entry.supervisor.audit_sample = self._posture_audit
+            if self.journal is not None:
+                self.journal.append(
+                    {
+                        "op": "mutate",
+                        "name": name,
+                        "inserts": [
+                            [int(u), int(v)] for u, v in batch.inserts
+                        ],
+                        "deletes": [
+                            [int(u), int(v)] for u, v in batch.deletes
+                        ],
+                        "digest": batch.digest,
+                    }
+                )
+        dropped = self.result_cache.drop_where(
+            lambda k: isinstance(k, tuple) and k[0] == old.key
+        )
+        self.executables.drop_where(
+            lambda k: isinstance(k, tuple) and k[0] == old.key
+        )
+        with self._stats_lock:
+            self._mutations += 1
+        return {
+            "ok": True,
+            "op": "mutate",
+            "graph": entry.describe(),
+            "applied": {
+                "inserts": int(batch.inserts.shape[0]),
+                "deletes": int(batch.deletes.shape[0]),
+            },
+            "invalidated_results": dropped,
+        }
+
+    def _op_versions(self, request: dict) -> dict:
+        """The graph's version chain: one row per delta version, digests
+        chained from the base content hash (read-only; a client can
+        diff its last-seen digest against the chain tail to learn
+        whether anything changed)."""
+        name = request.get("graph", "default")
+        entry = self.registry.get(name)
+        return {
+            "ok": True,
+            "op": "versions",
+            "graph": name,
+            "delta_version": entry.delta_version,
+            "digest": entry.digest,
+            "chain": entry.version_chain(),
         }
 
     def _parse_queries(self, request: dict) -> np.ndarray:
@@ -684,6 +889,14 @@ class MsbfsServer:
                 "brownout: batch queries are served from the result "
                 "cache only; retry later"
             )
+        if entry.deltas is not None:
+            # Dynamic-graph fast path: a retained plane certified at an
+            # earlier delta version is repaired across the net delta on
+            # the host — the affected cone only — instead of paying a
+            # full device sweep.  None = no usable seed; fall through.
+            repaired = self._try_repair(entry, name, rows, s_pad, cache_key)
+            if repaired is not None:
+                return repaired
         deadline = None
         raw_deadline = request.get("deadline_s")
         if raw_deadline is not None:
@@ -721,9 +934,157 @@ class MsbfsServer:
             raise req.error
         response = req.result
         self.result_cache.put(cache_key, response)
+        self._maybe_retain_plane(entry, name, rows)
         out = dict(response)
         out["cached"] = False
         return out
+
+    # ---- dynamic-graph repair path ----------------------------------------
+    def _try_repair(
+        self,
+        entry: GraphEntry,
+        name: str,
+        rows: np.ndarray,
+        s_pad: int,
+        cache_key,
+    ) -> Optional[dict]:
+        """Answer a query by repairing a cached distance plane across
+        the delta span from its certified version to the live one.
+        Returns the response dict, or None when there is no usable seed
+        (plane cache miss, or a seed from a different content chain).
+        The repair is exact — bit-identical to a cold recompute (BFS
+        distance fields are unique) — and the cost model inside
+        ``repair_distances`` already degrades to the full host sweep
+        when the cone is too large, so the answer contract never depends
+        on which path ran."""
+        if self.planes.max_bytes <= 0:
+            return None
+        pkey = (name, rows.shape, rows.tobytes())
+        hit = self.planes.get(pkey)
+        if hit is None:
+            return None
+        plane_version, plane_digest, plane = hit
+        log = entry.deltas
+        if (
+            plane_version > entry.delta_version
+            or log.digest(plane_version) != plane_digest
+        ):
+            # A seed whose chain position does not reproduce its
+            # recorded digest belongs to some other content lineage
+            # (a reload raced the cache): dead, drop it.
+            self.planes.drop_where(lambda k: k == pkey)
+            return None
+        started = time.time()
+        from ..dynamic.repair import repair_distances
+        from ..ops.certify import certify_distances, f_from_distances
+
+        inserts, deletes = log.net_delta(plane_version, entry.delta_version)
+        try:
+            dist, rstats = repair_distances(
+                entry.graph, rows, plane, inserts, deletes
+            )
+        except (MsbfsError, ValueError, MemoryError) as exc:
+            print(
+                f"msbfs serve: plane repair for {name!r} failed "
+                f"({exc}); answering via full dispatch",
+                file=sys.stderr,
+            )
+            return None
+        audited = False
+        if random.random() < float(entry.supervisor.audit_sample):
+            # Same sampled-certification contract as the engine path's
+            # output audit: the repaired plane must pass the full BFS
+            # certificate against the live CSR.
+            audited = True
+            failing = certify_distances(
+                entry.graph.row_offsets,
+                entry.graph.col_indices,
+                rows,
+                dist,
+            )
+            with self._stats_lock:
+                self._repair_audited += 1
+                if failing:
+                    self._repair_audit_failures += 1
+            if failing:
+                self.planes.drop_where(lambda k: k == pkey)
+                raise CorruptionError(
+                    f"repaired plane for {name!r} flunked the output "
+                    f"certificate ({', '.join(failing)}); seed dropped "
+                    "— retry recomputes from scratch",
+                    invariants=tuple(failing),
+                )
+        f_req = f_from_distances(dist)
+        valid = f_req >= 0
+        if valid.any():
+            min_k = int(
+                np.argmin(
+                    np.where(valid, f_req, np.iinfo(np.int64).max)
+                )
+            )
+            min_f = int(f_req[min_k])
+        else:
+            min_f, min_k = -1, -1
+        self.planes.put(pkey, entry.delta_version, entry.digest, dist)
+        latency_ms = (time.time() - started) * 1000.0
+        with self._stats_lock:
+            self._requests_repaired += 1
+            if rstats.fallback:
+                self._repair_fallbacks += 1
+        response = {
+            "ok": True,
+            "op": "query",
+            "graph": name,
+            "version": entry.version,
+            "f_values": [int(x) for x in f_req],
+            "min_f": min_f,
+            "min_k": min_k,
+            "bucket": [int(rows.shape[0]), s_pad],
+            "compiled": False,
+            "batched_with": 0,
+            "audited": audited,
+            "repaired": True,
+            "dynamic": rstats.as_dict(),
+            "latency_ms": round(latency_ms, 3),
+        }
+        self.result_cache.put(cache_key, response)
+        out = dict(response)
+        out["cached"] = False
+        return out
+
+    def _maybe_retain_plane(
+        self, entry: GraphEntry, name: str, rows: np.ndarray
+    ) -> None:
+        """Repair-aware warm plane retention (``MSBFS_SERVE_PLANES``):
+        after an engine answer, keep the query's host distance plane so
+        the NEXT mutate can repair instead of recompute.  ``auto``
+        retains only for graphs already carrying a delta chain; the
+        host-side sweep runs on the connection thread, off the device
+        path."""
+        policy = self.plane_policy
+        if policy == "0" or self.planes.max_bytes <= 0:
+            return
+        if policy == "auto" and entry.deltas is None:
+            return
+        pkey = (name, rows.shape, rows.tobytes())
+        have = self.planes.get(pkey)
+        if (
+            have is not None
+            and have[0] == entry.delta_version
+            and have[1] == entry.digest
+        ):
+            return  # seed already version-fresh
+        from ..ops.certify import reference_distances
+
+        try:
+            dist = reference_distances(
+                entry.graph.row_offsets, entry.graph.col_indices, rows
+            )
+        except MemoryError:
+            return  # retention is an optimization, never a failure
+        self.planes.put(pkey, entry.delta_version, entry.digest, dist)
+        with self._stats_lock:
+            self._planes_retained += 1
 
     def _op_posture(self, request: dict) -> dict:
         """Brownout posture push (serve/brownout.py, docs/SERVING.md
@@ -992,6 +1353,15 @@ class MsbfsServer:
             shed_brownout = self._shed_brownout
             quarantined = self._quarantined_requests
             refused = dict(self._refused_graphs)
+            dynamic = {
+                "mutations": self._mutations,
+                "requests_repaired": self._requests_repaired,
+                "repair_fallbacks": self._repair_fallbacks,
+                "planes_retained": self._planes_retained,
+                "repair_audited": self._repair_audited,
+                "repair_audit_failures": self._repair_audit_failures,
+            }
+        dynamic["planes"] = self.planes.snapshot()
         audited = 0
         audit_failures = 0
         for entry in self.registry.describe():
@@ -1026,6 +1396,7 @@ class MsbfsServer:
                 "shed_brownout": shed_brownout,
             },
             "result_cache": self.result_cache.snapshot(),
+            "dynamic": dynamic,
             "compiles": self.executables.compiles(),
             "compiles_total": self.executables.total_compiles(),
             "buckets": buckets,

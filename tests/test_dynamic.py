@@ -1,0 +1,627 @@
+"""Dynamic-graph subsystem (round 11, docs/SERVING.md "Mutations &
+versions"): the versioned edge-delta log (canonicalization rules, the
+fuzz-parity contract — ``apply()`` bit-identical to a from-scratch CSR
+rebuild at every version boundary, the chained content digest),
+incremental BFS repair (insert / delete / mixed parity against full
+recompute plus the output certificate, disconnect and reconnect cones,
+the host-side cost-model fallback), the delta binary format and its
+fail-before-allocate loader, the ``gen_cli --deltas`` fixture path, and
+the serving integration — ``mutate`` / ``versions`` verbs, result-cache
+invalidation, the warm-plane repair hit, journaled mutation replay
+after a restart, and the digest-mismatch refusal posture.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.dynamic.delta import (  # noqa: E402
+    DeltaLog,
+    canonical_edge_keys,
+    canonicalize_batch,
+    keys_to_pairs,
+    load_delta_bin,
+    save_delta_bin,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.dynamic.repair import (  # noqa: E402
+    repair_distances,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (  # noqa: E402
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (  # noqa: E402
+    CSRGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops import (  # noqa: E402
+    certify,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (  # noqa: E402
+    pad_queries,
+    save_graph_bin,
+)
+
+
+def _assert_csr_identical(a: CSRGraph, b: CSRGraph) -> None:
+    assert a.n == b.n
+    np.testing.assert_array_equal(
+        np.asarray(a.row_offsets), np.asarray(b.row_offsets)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.col_indices), np.asarray(b.col_indices)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Delta-log units
+# ---------------------------------------------------------------------------
+
+
+def test_canonicalization_rules():
+    """The set algebra's ground rules: self-loops dropped, duplicates
+    and reversed pairs collapsed, insert/delete overlap nets to
+    PRESENT (the delete side loses)."""
+    keys = canonical_edge_keys(
+        np.array([[3, 1], [1, 3], [1, 3], [5, 5], [0, 2]])
+    )
+    np.testing.assert_array_equal(
+        keys_to_pairs(keys), np.array([[0, 2], [1, 3]], dtype=np.int32)
+    )
+    ins, dels = canonicalize_batch(
+        inserts=[[2, 1], [4, 4], [1, 2]], deletes=[[1, 2], [0, 3]], n=8
+    )
+    np.testing.assert_array_equal(
+        keys_to_pairs(ins), np.array([[1, 2]], dtype=np.int32)
+    )
+    np.testing.assert_array_equal(
+        keys_to_pairs(dels), np.array([[0, 3]], dtype=np.int32)
+    )
+    with pytest.raises(ValueError, match="out of range"):
+        canonicalize_batch([[0, 9]], [], n=8)
+    with pytest.raises(ValueError, match="out of range"):
+        canonicalize_batch([], [[-1, 2]], n=8)
+
+
+def test_fuzz_apply_matches_scratch_rebuild():
+    """The fuzz-parity contract: drive a log with raw (duplicated,
+    reversed, self-looped, absent-delete, present-insert) batches and
+    check, at EVERY version boundary, that the log's edge set matches
+    an independent Python-set model and that ``apply()`` is
+    bit-identical to ``CSRGraph.from_edges`` on that model's pairs."""
+    rng = np.random.default_rng(7)
+    n = 60
+    n0, edges = generators.gnm_edges(n, 150, seed=11)
+    assert n0 == n
+    g0 = CSRGraph.from_edges(n, edges)
+    log = DeltaLog.from_graph(g0, "fuzzbase")
+
+    model = set(int(k) for k in canonical_edge_keys(edges))
+    for _ in range(6):
+        raw_ins = rng.integers(0, n, size=(rng.integers(0, 12), 2))
+        raw_del = rng.integers(0, n, size=(rng.integers(0, 12), 2))
+        if model and rng.random() < 0.8:
+            # Target some LIVE edges so deletes actually bite.
+            live = np.array(sorted(model), dtype=np.int64)
+            pick = live[rng.integers(0, live.size, size=3)]
+            raw_del = np.concatenate([raw_del, keys_to_pairs(pick)])
+        log.append(raw_ins, raw_del)
+        ins_k, del_k = canonicalize_batch(raw_ins, raw_del, n)
+        model -= set(int(k) for k in del_k)
+        model |= set(int(k) for k in ins_k)
+
+        want_keys = np.array(sorted(model), dtype=np.int64)
+        np.testing.assert_array_equal(log.keys_at(), want_keys)
+        got, (base_digest, v) = log.apply()
+        assert (base_digest, v) == ("fuzzbase", log.version)
+        _assert_csr_identical(
+            got, CSRGraph.from_edges(n, keys_to_pairs(want_keys))
+        )
+    # Historic versions stay addressable after later appends.
+    for v in range(log.version + 1):
+        got, (_, gv) = log.apply(v)
+        assert gv == v
+        _assert_csr_identical(
+            got, CSRGraph.from_edges(n, keys_to_pairs(log.keys_at(v)))
+        )
+
+
+def test_digest_chain_names_content():
+    """Two logs fed the same batches agree on every digest; a diverging
+    batch splits the chain at exactly the first bad version; the raw
+    pair ORDER does not matter (canonicalization runs first)."""
+    n, edges = generators.gnm_edges(40, 80, seed=3)
+    g = CSRGraph.from_edges(n, edges)
+    a = DeltaLog.from_graph(g, "basehash")
+    b = DeltaLog.from_graph(g, "basehash")
+    assert a.digest(0) == "basehash"
+    a.append([[1, 2], [3, 4]], [[5, 6]])
+    b.append([[3, 4], [2, 1]], [[6, 5], [5, 6]])  # same canonical batch
+    assert a.digest(1) == b.digest(1)
+    a.append([[7, 8]], [])
+    b.append([[7, 9]], [])  # diverges HERE
+    assert a.digest(1) == b.digest(1)
+    assert a.digest(2) != b.digest(2)
+    with pytest.raises(ValueError, match="outside"):
+        a.digest(3)
+
+
+def test_net_delta_composes_and_cancels():
+    """Churn that nets out across a version span vanishes from the net
+    delta, and applying the net delta to the older edge set reproduces
+    the newer one exactly."""
+    n, edges = generators.gnm_edges(30, 60, seed=5)
+    g = CSRGraph.from_edges(n, edges)
+    log = DeltaLog.from_graph(g, "nd")
+    live = keys_to_pairs(log.keys_at(0))
+    victim = live[0]
+    log.append([[0, 17]], [victim])  # v1: +A -B
+    log.append([victim], [[0, 17]])  # v2: -A +B  (round trip)
+    ins, dels = log.net_delta(0, 2)
+    assert ins.shape == (0, 2) and dels.shape == (0, 2)
+    log.append([[1, 19], [2, 21]], [])
+    ins, dels = log.net_delta(1)
+    old = log.keys_at(1)
+    rebuilt = np.union1d(
+        np.setdiff1d(old, canonical_edge_keys(dels), assume_unique=True),
+        canonical_edge_keys(ins),
+    )
+    np.testing.assert_array_equal(rebuilt, log.keys_at(3))
+
+
+def test_delta_bin_roundtrip_and_corruption(tmp_path):
+    """The binary delta format round-trips (canonicalized on write) and
+    the loader fails BEFORE allocating on truncation, bad magic, and
+    counts that exceed the bytes actually present."""
+    path = str(tmp_path / "d.bin")
+    batches = [
+        (np.array([[2, 1], [1, 2], [3, 3]]), np.array([[4, 5]])),
+        (np.zeros((0, 2), dtype=np.int32), np.array([[0, 7]])),
+    ]
+    save_delta_bin(path, 10, batches)
+    n, got = load_delta_bin(path)
+    assert n == 10 and len(got) == 2
+    np.testing.assert_array_equal(
+        got[0][0], np.array([[1, 2]], dtype=np.int32)
+    )
+    np.testing.assert_array_equal(
+        got[0][1], np.array([[4, 5]], dtype=np.int32)
+    )
+    assert got[1][0].shape == (0, 2)
+    np.testing.assert_array_equal(
+        got[1][1], np.array([[0, 7]], dtype=np.int32)
+    )
+
+    raw = open(path, "rb").read()
+    trunc = str(tmp_path / "trunc.bin")
+    with open(trunc, "wb") as f:
+        f.write(raw[:7])
+    with pytest.raises(IOError, match="truncated delta header"):
+        load_delta_bin(trunc)
+
+    badmagic = str(tmp_path / "magic.bin")
+    with open(badmagic, "wb") as f:
+        f.write(b"XXXX" + raw[4:])
+    with pytest.raises(IOError, match="bad delta magic"):
+        load_delta_bin(badmagic)
+
+    # Flip the first batch's insert count sky-high: the loader must
+    # refuse from the file size, never attempt the allocation.
+    import struct
+
+    bloat = bytearray(raw)
+    bloat[16:24] = struct.pack("<q", 1 << 40)
+    bloated = str(tmp_path / "bloat.bin")
+    with open(bloated, "wb") as f:
+        f.write(bytes(bloat))
+    with pytest.raises(IOError, match="corrupt delta batch"):
+        load_delta_bin(bloated)
+
+
+# ---------------------------------------------------------------------------
+# Incremental repair
+# ---------------------------------------------------------------------------
+
+
+def _repair_case(g0, rows, ins, dels, **kwargs):
+    """Run one repair against its from-scratch reference and return
+    (dist, stats) after asserting the two ground contracts: bit
+    identity and a clean certificate on the post-delta graph."""
+    log = DeltaLog.from_graph(g0, "rc")
+    log.append(ins, dels)
+    g1, _ = log.apply()
+    net_ins, net_dels = log.net_delta(0)
+    old = certify.reference_distances(
+        g0.row_offsets, g0.col_indices, rows
+    )
+    dist, stats = repair_distances(
+        g1, rows, old, net_ins, net_dels, **kwargs
+    )
+    full = certify.reference_distances(
+        g1.row_offsets, g1.col_indices, rows
+    )
+    np.testing.assert_array_equal(dist, full)
+    assert (
+        certify.certify_distances(
+            g1.row_offsets, g1.col_indices, rows, dist
+        )
+        == []
+    )
+    return dist, stats
+
+
+def test_repair_insert_only_shrinks_distances():
+    """A pure-insert delta can only DECREASE distances; the repaired
+    plane must reflect the shortcut exactly."""
+    n, edges = generators.road_edges(12, 12, seed=21)
+    g0 = CSRGraph.from_edges(n, edges)
+    rows = pad_queries([np.array([0], dtype=np.int32)], pad_to=2)
+    # A shortcut from the source corner to the far corner.
+    dist, stats = _repair_case(g0, rows, ins=[[0, n - 1]], dels=[])
+    assert int(dist[0, n - 1]) == 1
+    assert not stats.fallback
+    assert stats.repaired_plane_bytes < stats.full_plane_bytes
+
+
+def test_repair_delete_disconnects_component():
+    """Deleting a bridge strands the far side: repaired distances must
+    go to the canonical unreached -1, same as a cold recompute."""
+    # Two 4-cliques joined by one bridge edge (3, 4).
+    edges = np.array(
+        [[u, v] for u in range(4) for v in range(u + 1, 4)]
+        + [[u, v] for u in range(4, 8) for v in range(u + 1, 8)]
+        + [[3, 4]]
+    )
+    g0 = CSRGraph.from_edges(8, edges)
+    rows = pad_queries([np.array([0], dtype=np.int32)], pad_to=1)
+    dist, stats = _repair_case(g0, rows, ins=[], dels=[[3, 4]])
+    assert (dist[0, 4:] == -1).all()
+    assert (dist[0, :4] >= 0).all()
+    assert stats.invalidated >= 4
+
+
+def test_repair_mixed_delete_and_reconnect():
+    """A delete that severs the graph plus an insert that reconnects it
+    elsewhere in the SAME batch: the cone covers both the invalidated
+    descendants and the new shortcut."""
+    n, edges = generators.grid_edges(10, 4)
+    g0 = CSRGraph.from_edges(n, edges)
+    rows = pad_queries(
+        [np.array([0, 1], dtype=np.int32), np.array([5], dtype=np.int32)],
+        pad_to=2,
+    )
+    # Cut a middle rung, reconnect through a long chord.
+    dist, stats = _repair_case(
+        g0, rows, ins=[[2, n - 1]], dels=[[20, 24]]
+    )
+    assert stats.cone_size > 0
+    assert (dist >= -1).all()
+
+
+def test_repair_cost_model_falls_back_identically():
+    """With the threshold forced tiny the cost model must refuse the
+    sweep — and the answer contract is identical anyway."""
+    n, edges = generators.road_edges(16, 16, seed=22)
+    g0 = CSRGraph.from_edges(n, edges)
+    rows = pad_queries([np.array([3], dtype=np.int32)], pad_to=1)
+    dist, stats = _repair_case(
+        g0, rows, ins=[[0, n - 1]], dels=[], max_frac=1e-9
+    )
+    assert stats.fallback is True
+    assert stats.repaired_plane_bytes == stats.full_plane_bytes
+
+
+def test_repair_max_frac_env_knob(monkeypatch, capsys):
+    """MSBFS_REPAIR_MAX_FRAC drives the default threshold; malformed
+    values fall back to the built-in default with a stderr note (the
+    repo-wide knob convention)."""
+    n, edges = generators.road_edges(10, 10, seed=23)
+    g0 = CSRGraph.from_edges(n, edges)
+    rows = pad_queries([np.array([0], dtype=np.int32)], pad_to=1)
+    monkeypatch.setenv("MSBFS_REPAIR_MAX_FRAC", "0.000000001")
+    _, stats = _repair_case(g0, rows, ins=[[0, n - 1]], dels=[])
+    assert stats.fallback is True
+    monkeypatch.setenv("MSBFS_REPAIR_MAX_FRAC", "banana")
+    _, stats = _repair_case(g0, rows, ins=[[0, n - 1]], dels=[])
+    assert stats.fallback is False  # default 0.5 admits this tiny cone
+    assert "MSBFS_REPAIR_MAX_FRAC" in capsys.readouterr().err
+
+
+@pytest.mark.slow
+def test_repair_fuzz_parity():
+    """Randomized repair parity: random graphs, random multi-version
+    delta spans (net_delta composition), random query batches — every
+    repaired plane bit-identical to cold recompute and certified."""
+    rng = np.random.default_rng(31)
+    for trial in range(8):
+        n, edges = generators.gnm_edges(
+            96, 220 + 10 * trial, seed=100 + trial
+        )
+        g0 = CSRGraph.from_edges(n, edges)
+        log = DeltaLog.from_graph(g0, f"fz{trial}")
+        for b in generators.delta_batches(
+            n,
+            edges,
+            batches=int(rng.integers(1, 4)),
+            batch_size=int(rng.integers(4, 20)),
+            locality=float(rng.uniform(0.0, 1.0)),
+            seed=200 + trial,
+        ):
+            log.append(*b)
+        g1, _ = log.apply()
+        rows = pad_queries(
+            generators.random_queries(
+                n, int(rng.integers(1, 5)), max_group=4, seed=300 + trial
+            ),
+            pad_to=4,
+        )
+        old = certify.reference_distances(
+            g0.row_offsets, g0.col_indices, rows
+        )
+        net_ins, net_dels = log.net_delta(0)
+        dist, _ = repair_distances(g1, rows, old, net_ins, net_dels)
+        full = certify.reference_distances(
+            g1.row_offsets, g1.col_indices, rows
+        )
+        np.testing.assert_array_equal(dist, full)
+        assert (
+            certify.certify_distances(
+                g1.row_offsets, g1.col_indices, rows, dist
+            )
+            == []
+        )
+
+
+# ---------------------------------------------------------------------------
+# Generator + gen_cli fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_delta_batches_deterministic_and_local():
+    n, edges = generators.road_edges(24, 24, seed=41)
+    kw = dict(batches=3, batch_size=16, locality=0.95, seed=9)
+    a = generators.delta_batches(n, edges, **kw)
+    b = generators.delta_batches(n, edges, **kw)
+    assert len(a) == 3
+    for (ia, da), (ib, db) in zip(a, b):
+        np.testing.assert_array_equal(ia, ib)
+        np.testing.assert_array_equal(da, db)
+    live = canonical_edge_keys(edges)
+    span = max(8, int(round(n * 0.05)))
+    seen_deleted = set()
+    for ins, dels in a:
+        ends = np.concatenate([ins.reshape(-1), dels.reshape(-1)])
+        # Every endpoint inside one contiguous window of the span size.
+        assert int(ends.max()) - int(ends.min()) < span
+        del_keys = canonical_edge_keys(dels)
+        assert np.isin(del_keys, live).all()  # drawn from the live set
+        for k in del_keys:  # batches compose: no re-deletes
+            assert int(k) not in seen_deleted
+            seen_deleted.add(int(k))
+
+
+def test_gen_cli_deltas_roundtrip(tmp_path):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+        gen_cli,
+        load_graph_bin,
+    )
+
+    g_path = str(tmp_path / "g.bin")
+    d_path = str(tmp_path / "g.delta")
+    rc = gen_cli.main(
+        [
+            "--kind", "gnm", "--scale", "6", "--edge-factor", "3",
+            "--graph", g_path, "--deltas", d_path,
+            "--delta-batches", "2", "--delta-size", "8",
+            "--delta-locality", "0.9", "--seed", "13",
+        ]
+    )
+    assert rc == 0
+    g = load_graph_bin(g_path)
+    n, batches = load_delta_bin(d_path)
+    assert n == g.n and len(batches) == 2
+    # The file's batches apply cleanly against the emitted graph.
+    log = DeltaLog.from_graph(g, "cli")
+    for ins, dels in batches:
+        log.append(ins, dels)
+    g1, (_, v) = log.apply()
+    assert v == 2 and g1.n == g.n
+    # Bad delta flags fail fast, before any generation.
+    assert (
+        gen_cli.main(
+            ["--kind", "gnm", "--scale", "6", "--graph", g_path,
+             "--deltas", d_path, "--delta-locality", "2.0"]
+        )
+        == 2
+    )
+
+
+# ---------------------------------------------------------------------------
+# Serving integration (in-process servers on unix sockets)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def graph_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("dynamic_graphs")
+    # High-diameter road grid: single-edge deltas visibly move F, so a
+    # stale cache can't pass by coincidence (a low-diameter gnm graph
+    # absorbs single-edge deltas without changing any distance sum).
+    n, edges = generators.road_edges(12, 12, seed=51)
+    path = str(d / "g.bin")
+    save_graph_bin(path, n, edges)
+    return n, edges, path
+
+
+def _start_server(tmp_path, graph_path, **kwargs):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (
+        MsbfsServer,
+    )
+
+    sock = str(tmp_path / f"s{len(os.listdir(tmp_path))}.sock")
+    srv = MsbfsServer(
+        listen=f"unix:{sock}",
+        graphs={"default": graph_path} if graph_path else {},
+        window_s=0.0,
+        request_timeout_s=60.0,
+        **kwargs,
+    )
+    srv.start()
+    return srv, f"unix:{sock}"
+
+
+def _expected_f(graph_path, applied_batches, queries):
+    """Client-side oracle for the post-delta answer: rebuild the same
+    canonical patched CSR the server holds and fold the host reference
+    planes to F."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        load_graph_bin,
+    )
+
+    g0 = load_graph_bin(graph_path)
+    log = DeltaLog.from_graph(g0, "oracle")
+    for ins, dels in applied_batches:
+        log.append(ins, dels)
+    g1, _ = log.apply()
+    rows = pad_queries(
+        [np.asarray(q, dtype=np.int32) for q in queries], pad_to=2
+    )
+    dist = certify.reference_distances(
+        g1.row_offsets, g1.col_indices, rows
+    )
+    return [int(x) for x in certify.f_from_distances(dist)]
+
+
+def test_serve_mutate_versions_and_repair(graph_file, tmp_path, monkeypatch):
+    """The live-mutation loop: mutate bumps the version chain and
+    invalidates cached results; the next engine query retains a warm
+    plane; a second mutate then lets the SAME bucket answer through the
+    incremental repair path (repaired: true + dynamic accounting), with
+    F matching the client-side post-delta oracle either way."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (
+        MsbfsClient,
+        ServerError,
+    )
+
+    _, _, path = graph_file
+    monkeypatch.setenv("MSBFS_RETRIES", "0")
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    srv, addr = _start_server(tmp_path, path)
+    try:
+        with MsbfsClient(addr) as c:
+            queries = [[1, 2], [3, 4]]
+            r0 = c.query(queries)
+            assert c.query(queries)["cached"] is True
+
+            b1 = ([[40, 143]], [[0, 1]])
+            m1 = c.mutate(inserts=b1[0], deletes=b1[1])
+            assert m1["graph"]["delta_version"] == 1
+            assert m1["invalidated_results"] >= 1
+
+            v = c.versions()
+            assert v["delta_version"] == 1
+            assert len(v["chain"]) == 2
+            assert v["chain"][-1]["digest"] == v["digest"]
+            assert v["chain"][0]["digest"] != v["digest"]
+
+            # Post-mutate answer: NOT the stale cache, matches oracle.
+            r1 = c.query(queries)
+            assert r1["cached"] is False
+            assert r1["f_values"] == _expected_f(path, [b1], queries)
+            assert r1["f_values"] != r0["f_values"]
+
+            b2 = ([[5, 130]], [])
+            c.mutate(inserts=b2[0], deletes=b2[1])
+            r2 = c.query(queries)
+            assert r2["f_values"] == _expected_f(path, [b1, b2], queries)
+            assert r2.get("repaired") is True
+            dyn = r2["dynamic"]
+            assert dyn["fallback"] is False
+            assert 0 < dyn["repaired_plane_bytes"] < dyn["full_plane_bytes"]
+
+            stats = c.stats()["dynamic"]
+            assert stats["mutations"] == 2
+            assert stats["requests_repaired"] == 1
+            assert stats["planes_retained"] >= 1
+            assert stats["repair_audit_failures"] == 0
+
+            # Input validation: ragged pairs and out-of-range endpoints
+            # are typed InputErrors, not daemon damage.
+            with pytest.raises(ServerError, match="InputError"):
+                c.call({"op": "mutate", "graph": "default",
+                        "inserts": [[1]], "deletes": []})
+            with pytest.raises(ServerError, match="out of range"):
+                c.mutate(inserts=[[0, 10 ** 6]])
+            assert c.ping()
+    finally:
+        srv.stop()
+
+
+def test_serve_journal_replays_mutation_chain(
+    graph_file, tmp_path, monkeypatch
+):
+    """Acceptance: mutate, die, restart on the journal alone — the
+    version chain re-derives digest-identical and a re-query returns
+    the correct post-delta answer.  Then tamper with one journaled
+    digest: the restarted server REFUSES the whole registration (the
+    chain no longer names the data it served)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (
+        MsbfsClient,
+        ServerError,
+    )
+
+    _, _, path = graph_file
+    monkeypatch.setenv("MSBFS_RETRIES", "0")
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    journal = str(tmp_path / "state.journal")
+    queries = [[7, 8], [9, 10]]
+    batches = [([[0, 141], [2, 50]], [[7, 8]]), ([[3, 60]], [])]
+
+    srv_a, addr_a = _start_server(tmp_path, path, journal_path=journal)
+    try:
+        with MsbfsClient(addr_a) as c:
+            for ins, dels in batches:
+                c.mutate(inserts=ins, deletes=dels)
+            chain_a = c.versions()["chain"]
+            f_a = c.query(queries)["f_values"]
+            assert f_a == _expected_f(path, batches, queries)
+    finally:
+        srv_a.stop()  # journal-wise, stop IS a crash (never compacts)
+
+    srv_b, addr_b = _start_server(tmp_path, None, journal_path=journal)
+    try:
+        assert srv_b._ready.wait(120), "journal replay never finished"
+        with MsbfsClient(addr_b) as c:
+            v = c.versions()
+            assert v["delta_version"] == 2
+            assert v["chain"] == chain_a  # digest-identical re-derive
+            assert c.query(queries)["f_values"] == f_a
+    finally:
+        srv_b.stop()
+
+    # Tamper: corrupt the journaled digest of the second mutate record.
+    lines = open(journal, encoding="utf-8").read().splitlines()
+    tampered = []
+    for line in lines:
+        rec = json.loads(line)
+        if rec.get("op") == "mutate" and rec["inserts"] == [[3, 60]]:
+            rec["digest"] = "beefbeefbeef"
+        tampered.append(json.dumps(rec))
+    with open(journal, "w", encoding="utf-8") as f:
+        f.write("\n".join(tampered) + "\n")
+
+    srv_c, addr_c = _start_server(tmp_path, None, journal_path=journal)
+    try:
+        assert srv_c._replayed.wait(120)
+        with MsbfsClient(addr_c) as c:
+            with pytest.raises(ServerError):
+                c.versions()
+            with pytest.raises(ServerError):
+                c.query(queries)
+            assert c.health()["graphs"] == []  # registration refused
+    finally:
+        srv_c.stop()

@@ -562,6 +562,91 @@ AUDIT_SLOW = {
 }
 
 
+# The dynamic-repair arm of the same matrix (round 11, docs/SERVING.md
+# "Mutations & versions"): after a localized edge delta, the
+# incrementally REPAIRED distance plane (dynamic/repair.py seeded from
+# the pre-delta plane) must pass the trustless certificate on the
+# post-delta graph, match a from-scratch host recompute bit-for-bit,
+# and fold to the same F every engine computes cold on that graph —
+# the exact contract the serve repair path relies on when it answers a
+# query from a warm plane instead of re-driving the engine.  Tier-1
+# keeps the bitbell / lowk / stencil arms (the ISSUE's minimum set);
+# the rest ride `make dynamic`.
+REPAIR_ENGINES = {
+    "bitbell": _bitbell,
+    "lowk": _lowk,
+    "stencil": _stencil,
+    "vmap": _vmap,
+    "push": _push,
+    "bitbell_chunked": _bitbell_chunked,
+}
+
+REPAIR_SLOW = {"vmap", "push", "bitbell_chunked"}
+
+
+@pytest.fixture(scope="module")
+def repair_workload():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.dynamic.delta import (
+        DeltaLog,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.dynamic.repair import (
+        repair_distances,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops import (
+        certify,
+    )
+
+    n, edges = generators.road_edges(18, 21, seed=803)
+    g0 = CSRGraph.from_edges(n, edges)
+    padded = pad_queries(
+        generators.random_queries(n, 8, max_group=5, seed=804)
+    )
+    log = DeltaLog.from_graph(g0, "agree")
+    ((ins, dels),) = generators.delta_batches(
+        n, edges, batches=1, batch_size=12, locality=0.9, seed=805
+    )
+    log.append(ins, dels)
+    g1, _ = log.apply()
+    net_ins, net_dels = log.net_delta(0)
+    old = certify.reference_distances(
+        g0.row_offsets, g0.col_indices, padded
+    )
+    dist, _stats = repair_distances(g1, padded, old, net_ins, net_dels)
+    full = certify.reference_distances(
+        g1.row_offsets, g1.col_indices, padded
+    )
+    return g1, padded, dist, full
+
+
+def test_repaired_plane_bit_identical_and_certified(repair_workload):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops import (
+        certify,
+    )
+
+    g1, padded, dist, full = repair_workload
+    np.testing.assert_array_equal(dist, full)
+    assert (
+        certify.certify_distances(
+            g1.row_offsets, g1.col_indices, padded, dist
+        )
+        == []
+    )
+
+
+@pytest.mark.parametrize("name", _arms(REPAIR_ENGINES, slow=REPAIR_SLOW))
+def test_engine_agrees_repaired(repair_workload, name):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops import (
+        certify,
+    )
+
+    g1, padded, dist, _full = repair_workload
+    eng = REPAIR_ENGINES[name](g1)
+    np.testing.assert_array_equal(
+        np.asarray(eng.f_values(padded), dtype=np.int64),
+        certify.f_from_distances(dist),
+    )
+
+
 @pytest.mark.parametrize("name", _arms(ENGINES, slow=AUDIT_SLOW))
 def test_engine_output_audits(workload, name):
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops import (

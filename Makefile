@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic
+.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -87,5 +87,12 @@ dynamic: native
 	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_dynamic.py -x -q
 	JAX_PLATFORMS=cpu python -m pytest tests/test_engines_agree.py -x -q -k "repair"
 
-test: native resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic
+# Unified-telemetry suite (docs/OBSERVABILITY.md): per-query distributed
+# traces end to end (client -> router -> batcher -> supervisor -> engine
+# chunk spans), the Prometheus metrics verb, fleet histogram roll-up,
+# structured logging, and the crash flight recorder's exit-dump contract.
+observe: native
+	JAX_PLATFORMS=cpu python -m pytest tests/test_observe.py -x -q
+
+test: native resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe
 	python -m pytest tests/ -x -q

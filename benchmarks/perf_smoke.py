@@ -189,6 +189,21 @@ BUDGET = {
     # means <= 50% and the pin means <= 15% — the MSBFS_AUDIT=full
     # posture stays a rider on the query, never a second query.
     "audit-overhead-pct": 15,
+    # Round 12 telemetry overhead (utils/telemetry.py): a traced warm
+    # query (MSBFS_TRACE posture — per-chunk engine spans + counter
+    # deltas recording into the span store) as a PERCENT increase over
+    # the same query untraced.  The span seam is one thread-local read
+    # when off and a handful of dict appends per level chunk when on,
+    # so the measured rider is ~0-2%; 5 pins "tracing is free enough to
+    # leave on for any query you care about" with room for scheduling
+    # jitter.  base=100, so the generic opt*2<=base gate is slack and
+    # the pin does the work.
+    "telemetry-overhead-pct": 5,
+    # Round 12 exposition lint: the metrics verb's output must parse as
+    # valid Prometheus text exposition (utils/telemetry.parse_prometheus
+    # — strict: undeclared samples, bad labels, unknown types all fail).
+    # opt is the violation count; exact zero-budget pin.
+    "metrics-exposition-lint": 0,
 }
 
 # The pinned direction sequence for run_mxu's dense-frontier fixture
@@ -391,6 +406,104 @@ def run_audit():
     return "audit-overhead-pct", 100, pct
 
 
+def run_telemetry():
+    """Round-12 telemetry rows (docs/OBSERVABILITY.md).
+
+    Overhead: the per-level-chunk engine spans (ops/bfs.py
+    host_chunked_loop — span_begin, three counter snapshots, one event
+    append per chunk) must cost <= 5% of the warm query wall when a
+    trace is installed, on the config-1 chunked workload where every
+    level pays the seam.  Untraced cost is a single thread-local read
+    and is covered by the same measurement (it IS the base).
+
+    Exposition lint: boot the real daemon in-process, serve one query,
+    and strict-parse the metrics verb's Prometheus text — a family
+    rename, a histogram emitted without its TYPE line, or a label
+    escaping bug fails here before any scraper sees it.
+    """
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils import (  # noqa: E501
+        telemetry,
+    )
+
+    n, edges = generators.rmat_edges(10, edge_factor=8, seed=42)
+    g = BellGraph.from_host(CSRGraph.from_edges(n, edges))
+    queries = pad_queries(
+        generators.random_queries(n, 4, max_group=4, seed=43), pad_to=4
+    )
+    # level_chunk=1 commits every level: the worst-case span cadence.
+    eng = BitBellEngine(g, level_chunk=1, megachunk=1)
+    eng.compile(queries.shape)
+
+    def wall(fn):
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    np.asarray(eng.f_values(queries))  # warm
+    plain = wall(lambda: np.asarray(eng.f_values(queries)))
+
+    def traced():
+        with telemetry.use_trace(telemetry.new_trace()):
+            np.asarray(eng.f_values(queries))
+
+    traced_wall = wall(traced)
+    telemetry.clear_traces()
+    pct = max(
+        0, int(round(100.0 * (traced_wall - plain) / max(plain, 1e-9)))
+    )
+    print(
+        f"  telemetry: untraced={plain * 1e3:.1f}ms "
+        f"traced={traced_wall * 1e3:.1f}ms overhead={pct}%"
+    )
+    rows = [("telemetry-overhead-pct", 100, pct)]
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (  # noqa: E501
+        MsbfsClient,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (  # noqa: E501
+        MsbfsServer,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (  # noqa: E501
+        save_graph_bin,
+    )
+
+    with tempfile.TemporaryDirectory() as d:
+        gpath = os.path.join(d, "g.bin")
+        save_graph_bin(gpath, n, edges)
+        addr = f"unix:{os.path.join(d, 'perf.sock')}"
+        srv = MsbfsServer(
+            listen=addr, graphs={"default": gpath}, window_s=0.0
+        )
+        srv.start()
+        try:
+            with MsbfsClient(addr) as c:
+                c.query([[0], [1, 2]])
+                text = c.metrics()
+        finally:
+            srv.stop()
+    violations = 0
+    families = {}
+    try:
+        families = telemetry.parse_prometheus(text)
+    except ValueError as exc:
+        print(f"  metrics lint: INVALID exposition: {exc}")
+        violations = 1
+    print(
+        f"  metrics lint: {len(families)} families, "
+        f"{len(text.splitlines())} lines"
+    )
+    rows.append(("metrics-exposition-lint", len(families), violations))
+    return rows
+
+
 def run_repair():
     """Round-11 incremental-repair row: on the deterministic localized
     road delta (the regime dynamic/repair.py exists for — a few edges,
@@ -534,8 +647,8 @@ def run_multichip():
 def main() -> int:
     failures = []
     for run in (run_config1, run_config4, run_stencil_window, run_mxu,
-                run_fleet, run_stampede, run_audit, run_repair,
-                run_multichip):
+                run_fleet, run_stampede, run_audit, run_telemetry,
+                run_repair, run_multichip):
         rows = run()
         if isinstance(rows, tuple):
             rows = [rows]

@@ -301,6 +301,9 @@ def verify_main(argv: List[str]) -> int:
                 invariants=failing,
             )
     except MsbfsError as err:
+        from .utils.telemetry import dump_flight
+
+        dump_flight(f"exit_{err.exit_code}")
         print(format_failure(err), file=sys.stderr)
         return err.exit_code
     print(
@@ -339,6 +342,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .serve.client import query_main
 
         return query_main(argv[2:] + ["--health"])
+    if len(argv) > 1 and argv[1] == "trace":
+        # Per-query distributed trace export: fetch a trace's span
+        # events from a daemon or fleet front end and print Chrome-trace
+        # JSON for Perfetto (docs/OBSERVABILITY.md).
+        from .serve.client import trace_main
+
+        return trace_main(argv[2:])
     if len(argv) > 1 and argv[1] == "verify":
         # Offline output certification (docs/RESILIENCE.md "Silent data
         # corruption"): exit 0 = certified, exit 9 = corrupt.
@@ -1079,6 +1089,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             # The supervisor exhausted its recovery budget during warm-up:
             # same one-line report + documented exit code as a failure in
             # the computation span.
+            from .utils.telemetry import dump_flight
+
+            dump_flight(f"exit_{err.exit_code}")
             print(format_failure(err, engine.events), file=sys.stderr)
             return err.exit_code
 
@@ -1182,7 +1195,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     except MsbfsError as err:
         # The supervisor's recovery budget (retries, ladder rungs, mesh
         # rebuilds) ran out: one-line report, documented exit code
-        # (docs/RESILIENCE.md), no traceback spray.
+        # (docs/RESILIENCE.md), no traceback spray.  The flight recorder
+        # dumps first — the ring's tail (audit failures, retries) is the
+        # post-mortem context the one-line report cannot carry.
+        from .utils.telemetry import dump_flight
+
+        dump_flight(f"exit_{err.exit_code}")
         print(format_failure(err, engine.events), file=sys.stderr)
         return err.exit_code
 

@@ -218,12 +218,24 @@ def host_chunked_loop(carry, advance, max_levels, level_ix=1, updated_ix=2):
     armed ``bitflip:plane<i>`` fault (``i`` = 0-based chunk index), and
     its xor-fold digest is journaled while a certify plane trail is
     armed.  Both gates are one attribute read on the fault-free path."""
-    from ..utils import faults
+    from ..utils import faults, telemetry, timing
     from ..utils.timing import record_dispatch
     from . import certify
 
+    # Per-level-chunk trace spans (utils/telemetry.py): when the serving
+    # layer installed a trace on this thread, each chunk's span absorbs
+    # the DELTAS of the process-global dispatch/plane/collective
+    # counters as attributes — per-query attribution of quantities that
+    # are otherwise unattributable under concurrent serve workers.  The
+    # fault-free/untraced cost is one thread-local read.
+    ctx = telemetry.current_trace()
     chunk_ix = 0
     while True:
+        if ctx is not None:
+            begin = telemetry.span_begin()
+            d0 = timing.dispatch_count()
+            p0 = timing.plane_pass_bytes()
+            c0 = timing.collective_bytes()
         carry = advance(carry)
         record_dispatch()
         if faults.corruption_armed():
@@ -233,7 +245,17 @@ def host_chunked_loop(carry, advance, max_levels, level_ix=1, updated_ix=2):
         if certify.trail_armed():
             certify.record_plane_digest(carry[0])
         chunk_ix += 1
+        # The fetch below is the chunk's blocking commit; the span must
+        # close after it so the device wait lands inside the span.
         active = np.asarray(carry[updated_ix])
+        if ctx is not None:
+            telemetry.span_end(
+                ctx, "engine.level_chunk", begin,
+                chunk=chunk_ix - 1,
+                dispatches=timing.dispatch_count() - d0,
+                plane_pass_bytes=timing.plane_pass_bytes() - p0,
+                collective_bytes=timing.collective_bytes() - c0,
+            )
         if max_levels is not None:
             active = active & (np.asarray(carry[level_ix]) < max_levels)
         if not active.any():

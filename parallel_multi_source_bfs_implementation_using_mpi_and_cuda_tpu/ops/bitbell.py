@@ -708,15 +708,38 @@ def fused_best_drive(c8, advance, max_levels) -> Tuple[int, int]:
     buffer is fetched BEFORE the next advance, so donation never
     invalidates a pending read.  Each fetch is one blocking commit,
     recorded for the dispatch telemetry."""
+    from ..utils import telemetry, timing
+
+    # Same per-level-chunk span contract as ops.bfs.host_chunked_loop:
+    # with a trace installed, each chunk's span brackets the blocking
+    # status fetch and absorbs the counter deltas as attributes.
+    ctx = telemetry.current_trace()
+    chunk_ix = 0
     while True:
+        if ctx is not None:
+            begin = telemetry.span_begin()
+            d0 = timing.dispatch_count()
+            p0 = timing.plane_pass_bytes()
+            c0 = timing.collective_bytes()
         status = np.asarray(c8[7])
         record_dispatch()
         level, updated, min_f, min_k = (int(x) for x in status)
-        if not updated:
+        done = (not updated) or (
+            max_levels is not None and level >= max_levels
+        )
+        if not done:
+            c8 = advance(c8)
+        if ctx is not None:
+            telemetry.span_end(
+                ctx, "engine.level_chunk", begin,
+                chunk=chunk_ix, level=level,
+                dispatches=timing.dispatch_count() - d0,
+                plane_pass_bytes=timing.plane_pass_bytes() - p0,
+                collective_bytes=timing.collective_bytes() - c0,
+            )
+        chunk_ix += 1
+        if done:
             break
-        if max_levels is not None and level >= max_levels:
-            break
-        c8 = advance(c8)
     return min_f, min_k
 
 

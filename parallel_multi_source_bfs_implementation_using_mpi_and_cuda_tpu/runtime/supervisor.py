@@ -40,6 +40,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..ops.engine import QueryEngineBase
 from ..utils import faults
+from ..utils.telemetry import instant, record_flight, span
 
 __all__ = [
     "MsbfsError",
@@ -373,6 +374,13 @@ class ChunkSupervisor(QueryEngineBase):
         return False
 
     def _supervised(self, method, *args, **kwargs):
+        # One span per supervised call; the retry/audit/degrade/reshard
+        # decisions inside surface as instant markers on the same trace
+        # (utils/telemetry.py — all no-ops without an active trace).
+        with span(f"supervise.{method}"):
+            return self._supervised_run(method, *args, **kwargs)
+
+    def _supervised_run(self, method, *args, **kwargs):
         delays = self.policy.delays()
         attempt = 0
         audit_attempts = 0
@@ -419,6 +427,12 @@ class ChunkSupervisor(QueryEngineBase):
                         "attempt": audit_attempts,
                         "invariants": list(failing),
                     })
+                    instant("supervise.audit_fail", method=method,
+                            attempt=audit_attempts,
+                            invariants=list(failing))
+                    record_flight("audit_fail", method=method,
+                                  attempt=audit_attempts,
+                                  invariants=list(failing))
                     if audit_attempts <= 1:
                         continue
                     if audit_rung < len(self.ladder):
@@ -432,6 +446,8 @@ class ChunkSupervisor(QueryEngineBase):
                             "method": method,
                             "to": label,
                         })
+                        instant("supervise.audit_degrade",
+                                method=method, to=label)
                         continue
                     raise CorruptionError(
                         "output certification failed after "
@@ -454,6 +470,8 @@ class ChunkSupervisor(QueryEngineBase):
                                 "delay": delay,
                                 "error": str(err),
                             })
+                            instant("supervise.retry", method=method,
+                                    attempt=attempt, delay=delay)
                             self._backoff(delay)
                             continue
                     elif isinstance(err, CapacityError) and self.ladder:
@@ -467,6 +485,8 @@ class ChunkSupervisor(QueryEngineBase):
                             "to": label,
                             "error": str(err),
                         })
+                        instant("supervise.degrade", method=method,
+                                to=label)
                         continue
                     elif (
                         isinstance(err, DeviceError)
@@ -492,6 +512,15 @@ class ChunkSupervisor(QueryEngineBase):
                                 ),
                                 "error": str(err),
                             })
+                            instant("supervise.reshard", method=method,
+                                    failed_ranks=sorted(err.failed_ranks))
+                            record_flight(
+                                "reshard", method=method,
+                                failed_ranks=sorted(err.failed_ranks),
+                                survivor_shards=int(
+                                    getattr(survivors, "w", 0)
+                                ),
+                            )
                             self.engine = survivors
                             restore_engine = None  # the old mesh is gone
                             continue

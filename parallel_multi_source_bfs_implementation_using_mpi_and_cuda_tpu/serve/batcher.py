@@ -62,6 +62,8 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from ..runtime.supervisor import BackpressureError, MsbfsError
+from ..utils import telemetry
+from ..utils.telemetry import record_flight, span
 
 DEFAULT_QUEUE_CAPACITY = 64
 DEFAULT_WINDOW_S = 0.002
@@ -138,6 +140,10 @@ class QueryRequest:
     # CoDel controller and the health verb's queue-age gauge must not
     # jump when the wall clock steps.
     enqueued_mono: float = 0.0
+    # The submitting query's TraceContext (utils/telemetry.py), if any:
+    # the consumer thread re-installs it so batch/supervisor/engine
+    # spans land on the originating trace despite the thread hop.
+    trace: Optional[object] = None
     done: threading.Event = field(default_factory=threading.Event)
     result: Optional[dict] = None
     error: Optional[MsbfsError] = None
@@ -289,6 +295,12 @@ class MicroBatcher:
         (``rejected`` full queue / ``rejected_batch`` priority gate /
         ``rejected_client`` token bucket).  ``now`` is an injectable
         monotonic stamp for sleepless admission tests."""
+        with span("batch.admit", priority=request.priority) as sp:
+            self._admit(request, now)
+            sp.set(depth=len(self._queue))
+
+    def _admit(self, request: QueryRequest,
+               now: Optional[float] = None) -> None:
         if now is None:
             now = time.monotonic()
         with self._lock:
@@ -317,6 +329,8 @@ class MicroBatcher:
                     self._buckets[request.client_id] = bucket
                 if not bucket.take(now):
                     self.rejected_client += 1
+                    record_flight("batch_shed", reason="client_rate",
+                                  client_id=request.client_id)
                     raise BackpressureError(
                         f"client {request.client_id!r} over its "
                         f"{self.client_rate:g}/s admission rate; "
@@ -326,6 +340,8 @@ class MicroBatcher:
                     and len(self._queue)
                     >= self.batch_admit_frac * self.capacity):
                 self.rejected_batch += 1
+                record_flight("batch_shed", reason="batch_admit_frac",
+                              depth=len(self._queue))
                 raise BackpressureError(
                     "batch admission suspended above "
                     f"{self.batch_admit_frac:g} queue utilization; "
@@ -333,6 +349,8 @@ class MicroBatcher:
                 )
             if len(self._queue) >= self.capacity:
                 self.rejected += 1
+                record_flight("batch_shed", reason="queue_full",
+                              depth=len(self._queue))
                 raise BackpressureError(
                     f"admission queue full ({self.capacity} pending); "
                     "retry with backoff"
@@ -416,6 +434,8 @@ class MicroBatcher:
                     self._busy = True  # drain() must wait out this batch
         for req in shed:
             if not req.done.is_set():
+                record_flight("batch_shed", reason="codel_overload",
+                              graph=req.graph_name, priority=req.priority)
                 req.error = BackpressureError(
                     "shed by overload control: queue sojourn above "
                     f"{self.codel_target_s * 1000:g} ms for a full "
@@ -452,6 +472,24 @@ class MicroBatcher:
                 return
             k_total = sum(r.k for r in batch)
             k_exec = pow2_pad(k_total)
+            # Synthesize one queue-wait/coalesce span per traced request
+            # from its own admission stamp: the consumer thread learns
+            # which traces rode this batch only now, so the span is
+            # backdated to wall-clock submission (epoch µs, the store's
+            # native clock).
+            now = time.time()
+            for req in batch:
+                if req.trace is not None:
+                    telemetry.record_span_event(req.trace.trace_id, {
+                        "name": "batch.queue_wait",
+                        "ph": "X",
+                        "ts": int(req.submitted * 1e6),
+                        "dur": max(0, int((now - req.submitted) * 1e6)),
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident(),
+                        "args": {"coalesced": len(batch),
+                                 "k_exec": k_exec},
+                    })
             try:
                 self.execute(batch, k_exec, batch[0].s_pad)
             except BaseException as exc:  # noqa: BLE001 — daemon must survive

@@ -54,6 +54,7 @@ import time
 from typing import List, Optional, Sequence
 
 from ..runtime.supervisor import RetryPolicy
+from ..utils import telemetry
 from . import protocol
 
 # Per-process client counter: combined with the pid it decorrelates the
@@ -268,12 +269,48 @@ class MsbfsClient:
             request["priority"] = str(priority)
         if client_id is not None:
             request["client_id"] = str(client_id)
+        # Distributed tracing (docs/OBSERVABILITY.md): forward the
+        # thread's active trace, or mint one at this edge under
+        # MSBFS_TRACE=1.  The ``trace`` field rides the JSON body;
+        # legacy servers ignore unknown fields, same tolerated-absent
+        # posture as the crc rollout.
+        ctx = telemetry.current_trace()
+        if ctx is None and telemetry.trace_enabled():
+            ctx = telemetry.new_trace()
+        if ctx is not None:
+            request["trace"] = ctx.to_wire()
+            with telemetry.use_trace(ctx):
+                with telemetry.span("client.query", graph=graph,
+                                    address=self.address):
+                    if hedge_after_s is None:
+                        out = self.call(request, idempotent=True)
+                    else:
+                        out = self._hedged_call(
+                            request, float(hedge_after_s)
+                        )
+            out = dict(out)
+            out["trace_id"] = ctx.trace_id
+            return out
         if hedge_after_s is None:
             return self.call(request, idempotent=True)
         return self._hedged_call(request, float(hedge_after_s))
 
     def stats(self) -> dict:
         return self.call({"op": "stats"}, idempotent=True)["stats"]
+
+    def trace(self, trace_id: Optional[str] = None) -> dict:
+        """Fetch the span events one daemon (or fleet front end, which
+        merges its replicas') recorded for ``trace_id`` — default: the
+        most recent trace it holds.  Read-only, idempotent."""
+        request: dict = {"op": "trace"}
+        if trace_id is not None:
+            request["trace_id"] = str(trace_id)
+        return self.call(request, idempotent=True)
+
+    def metrics(self) -> str:
+        """The daemon's Prometheus text exposition (the ``metrics``
+        verb; docs/OBSERVABILITY.md lists every family)."""
+        return str(self.call({"op": "metrics"}, idempotent=True)["text"])
 
     def posture(self, audit_sample=None, cache_only=None) -> dict:
         """Push a brownout posture (docs/SERVING.md "Autoscaling &
@@ -505,4 +542,81 @@ def query_main(argv: Optional[List[str]] = None) -> int:
         except (protocol.ProtocolError, ConnectionError, OSError) as exc:
             print(f"msbfs query: {exc}", file=sys.stderr)
             return 5
+    return 0
+
+
+def trace_main(argv: Optional[List[str]] = None) -> int:
+    """``msbfs-tpu trace`` / ``python main.py trace``: export a query's
+    distributed trace as Chrome-trace/Perfetto JSON
+    (docs/OBSERVABILITY.md "Reading a trace").  Against a fleet front
+    end the events already include every replica's spans — the front
+    end's ``trace`` verb fans out and merges."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="msbfs-tpu trace",
+        description="Export a per-query distributed trace as "
+        "Chrome-trace JSON (load in chrome://tracing or "
+        "https://ui.perfetto.dev)",
+    )
+    ap.add_argument(
+        "--connect", required=True, metavar="ADDR",
+        help="daemon or fleet front end: unix:<path> or <host>:<port>",
+    )
+    ap.add_argument(
+        "--trace-id", default=None,
+        help="trace to export (default: the most recent one the server "
+        "holds; run queries with MSBFS_TRACE=1 to create traces)",
+    )
+    ap.add_argument(
+        "--list", action="store_true",
+        help="list the trace ids the server currently holds and exit",
+    )
+    ap.add_argument(
+        "-o", "--out", default=None, metavar="PATH",
+        help="write the Chrome-trace JSON here (default: stdout)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        client = MsbfsClient(args.connect)
+    except (OSError, ValueError) as exc:
+        print(f"msbfs trace: cannot reach {args.connect}: {exc}",
+              file=sys.stderr)
+        return 5
+    with client:
+        try:
+            out = client.trace(trace_id=args.trace_id)
+        except ServerError as err:
+            print(f"msbfs trace: {err}", file=sys.stderr)
+            return err.exit_code
+        except (protocol.ProtocolError, ConnectionError, OSError) as exc:
+            print(f"msbfs trace: {exc}", file=sys.stderr)
+            return 5
+    if args.list:
+        for tid in out.get("traces", []):
+            sys.stdout.write(f"{tid}\n")
+        return 0
+    events = out.get("events", [])
+    trace_id = out.get("trace_id")
+    if not events:
+        print(
+            "msbfs trace: no trace events held"
+            + (f" for {trace_id}" if trace_id else "")
+            + " (run queries with MSBFS_TRACE=1 first)",
+            file=sys.stderr,
+        )
+        return 1
+    doc = json.dumps(telemetry.chrome_trace(events), indent=2)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(doc + "\n")
+    else:
+        sys.stdout.write(doc + "\n")
+    print(
+        f"msbfs trace: {len(events)} span event(s) for trace "
+        f"{trace_id}"
+        + (f" -> {args.out}" if args.out else ""),
+        file=sys.stderr,
+    )
     return 0

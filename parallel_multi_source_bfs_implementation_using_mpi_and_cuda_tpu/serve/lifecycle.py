@@ -21,10 +21,10 @@ from __future__ import annotations
 import os
 import signal
 import socket
-import sys
 from typing import Optional
 
 from ..runtime.supervisor import InputError
+from ..utils.telemetry import dump_flight, log_line
 from . import protocol
 
 
@@ -32,21 +32,24 @@ def install_signal_handlers(server) -> None:
     """SIGTERM/SIGINT -> ``server.request_drain()``; a repeat signal ->
     ``server.stop()`` (immediate).  Main-thread only (CPython signal
     rule); the handlers just flip events, the drain itself runs on the
-    thread parked in ``server.wait()``."""
+    thread parked in ``server.wait()``.  Each signal also dumps the
+    flight recorder (utils/telemetry.py): the ring's last-N events are
+    exactly the post-mortem an operator wants from a killed daemon."""
 
     def _handler(signum, frame):  # noqa: ARG001 — signal handler shape
         name = signal.Signals(signum).name
+        dump_flight(f"sig{name}")
         if server.draining or server.stopping:
-            print(
+            log_line(
                 f"msbfs serve: second {name} — stopping immediately",
-                file=sys.stderr,
+                event="signal_stop", signal=name,
             )
             server.stop()
             return
-        print(
+        log_line(
             f"msbfs serve: {name} received — draining "
             f"(deadline {server.drain_deadline_s:g}s)",
-            file=sys.stderr,
+            event="signal_drain", signal=name,
         )
         server.request_drain()
 
@@ -98,10 +101,10 @@ def reclaim_stale_socket(listen: str) -> None:
             f"a daemon is already running on {listen} ({who}); "
             "stop it first or choose another --listen path"
         )
-    print(
+    log_line(
         f"msbfs serve: removing stale socket {target} "
         "(no daemon answered)",
-        file=sys.stderr,
+        event="stale_socket_reclaim", path=target,
     )
     try:
         os.unlink(target)

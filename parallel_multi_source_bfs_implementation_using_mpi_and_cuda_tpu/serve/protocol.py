@@ -7,7 +7,7 @@ in order (no pipelining guarantees beyond FIFO per connection).
 
 Requests are objects with an ``op`` field (``ping`` / ``health`` /
 ``load`` / ``reload`` / ``query`` / ``mutate`` / ``versions`` /
-``stats`` / ``posture`` / ``shutdown``);
+``stats`` / ``trace`` / ``metrics`` / ``posture`` / ``shutdown``);
 responses carry ``ok: true`` plus op-specific fields, or ``ok: false``
 with a typed ``error`` object mirroring the supervisor taxonomy
 (``{"type", "message", "exit_code"}`` — docs/RESILIENCE.md exit-code
@@ -19,6 +19,14 @@ uses to shed requests whose caller has already given up.  Query ids
 and F values are plain JSON numbers: F fits in int64 and JSON numbers
 are exact through 2^53, far beyond any sum of n hop-distances this
 system can hold in HBM.
+
+Observability fields (docs/OBSERVABILITY.md): any request MAY carry an
+optional ``trace`` object (``{"trace_id": <hex string>}``) naming the
+distributed-trace context the handling should be attributed to; the
+rollout is tolerated-absent exactly like the crc flag — receivers
+ignore unknown fields, so a pre-trace peer interoperates unchanged in
+both directions.  ``trace`` (the op) returns a trace's recorded span
+events; ``metrics`` returns a Prometheus text exposition snapshot.
 
 The length prefix is bounded (:data:`MAX_FRAME_BYTES`,
 ``MSBFS_SERVE_MAX_FRAME`` overrides): a corrupt or hostile prefix must

@@ -62,7 +62,16 @@ from ..runtime.supervisor import (
     TransientError,
 )
 from ..utils import faults
-from . import protocol
+from ..utils.telemetry import (
+    Histogram,
+    TraceContext,
+    instant,
+    log_line,
+    record_flight,
+    span,
+    use_trace,
+)
+from . import observe, protocol
 from .client import MsbfsClient, ServerError
 from .ring import PlacementRing
 
@@ -238,6 +247,31 @@ class FleetRouter:
         admission-control fields (``priority``, ``client_id``) ride
         through unchanged — shedding decisions belong to the replica's
         batcher, not the router."""
+        with span("route.query", graph=graph) as sp:
+            out = self._query_walk(
+                queries,
+                graph=graph,
+                deadline_s=deadline_s,
+                hedge_after_s=hedge_after_s,
+                priority=priority,
+                client_id=client_id,
+            )
+            sp.set(
+                replica=out.get("replica", ""),
+                failovers=int(out.get("failovers", 0)),
+                voted=bool(out.get("voted")),
+            )
+            return out
+
+    def _query_walk(
+        self,
+        queries: Sequence[Sequence[int]],
+        graph: str = "default",
+        deadline_s: Optional[float] = None,
+        hedge_after_s: Optional[float] = None,
+        priority: Optional[str] = None,
+        client_id: Optional[str] = None,
+    ) -> dict:
         owners = self.owners_for(graph)
         if not owners:
             raise TransientError(
@@ -271,7 +305,9 @@ class FleetRouter:
                 last_err = KeyError(member)
                 continue
             try:
-                with MsbfsClient(
+                with span(
+                    "route.attempt", member=member, failover=failovers
+                ), MsbfsClient(
                     address,
                     timeout=(
                         self.timeout if remaining is None
@@ -433,17 +469,30 @@ class FleetRouter:
         if not later:
             return out  # nobody to vote with (replication 1 / lone survivor)
         shadow_member = later[0]
-        shadow = self._shadow_query(
-            shadow_member, queries, graph, remaining()
-        )
-        if shadow is None:
-            return out
-        self._bump("votes")
-        out["voted"] = True
-        d_primary = _answer_digest(out)
-        if _answer_digest(shadow) == d_primary:
-            return out
+        with span(
+            "route.vote", graph=graph, primary=primary, shadow=shadow_member
+        ) as sp:
+            shadow = self._shadow_query(
+                shadow_member, queries, graph, remaining()
+            )
+            if shadow is None:
+                return out
+            self._bump("votes")
+            out["voted"] = True
+            d_primary = _answer_digest(out)
+            if _answer_digest(shadow) == d_primary:
+                sp.set(agreed=True)
+                return out
+            sp.set(agreed=False)
         self._bump("vote_mismatches")
+        record_flight(
+            "vote_mismatch", graph=graph, primary=primary,
+            shadow=shadow_member,
+        )
+        instant(
+            "route.vote_mismatch", graph=graph, primary=primary,
+            shadow=shadow_member,
+        )
         out["vote_mismatch"] = True
         arbiter_member, arbiter = None, None
         for m in later[1:]:
@@ -504,7 +553,9 @@ class FleetFrontend:
     fleet exactly as it talks to one daemon.  Verbs: ``ping``,
     ``health`` (fleet topology + per-replica states), ``load``
     (ring-placed registration via the supervisor), ``query`` (routed),
-    ``stats`` (router + fleet counters), ``shutdown``.
+    ``stats`` (router + fleet counters), ``trace`` (per-query trace
+    events, fanned out to the replicas and merged), ``metrics``
+    (Prometheus text exposition of the fleet roll-up), ``shutdown``.
 
     Thread names use the ``msbfs-fleet-`` prefix (distinct from the
     single-daemon ledger in tests/conftest.py, which must keep failing
@@ -593,6 +644,17 @@ class FleetFrontend:
                     return
 
     def handle(self, request: dict) -> dict:
+        # Adopt the caller's trace context (if any) for the whole verb:
+        # the router's forwarding legs use MsbfsClient.query, which
+        # re-injects the thread's current trace into the replica-bound
+        # frame — that is how one trace_id survives the extra hop.
+        ctx = TraceContext.from_wire(request.get("trace"))
+        if ctx is None:
+            return self._handle(request)
+        with use_trace(ctx):
+            return self._handle(request)
+
+    def _handle(self, request: dict) -> dict:
         op = request.get("op")
         try:
             if op == "ping":
@@ -601,6 +663,14 @@ class FleetFrontend:
                 return self._op_health()
             if op == "stats":
                 return {"ok": True, "op": "stats", "stats": self._op_stats()}
+            if op == "trace":
+                return self._op_trace(request)
+            if op == "metrics":
+                return {
+                    "ok": True,
+                    "op": "metrics",
+                    "text": observe.fleet_metrics_text(self),
+                }
             if op == "query":
                 out = self.router.query(
                     request.get("queries") or [],
@@ -650,6 +720,8 @@ class FleetFrontend:
             return protocol.error_body(MsbfsError(str(err)))
 
     def _op_health(self) -> dict:
+        from .server import _pkg_version  # lazy: avoid module cycle
+
         fleet = (
             self.supervisor.status() if self.supervisor is not None else {}
         )
@@ -660,8 +732,51 @@ class FleetFrontend:
             "ok": True,
             "op": "health",
             "pid": os.getpid(),
+            "version": _pkg_version(),
             "ready": ready and routable,
             "fleet": fleet,
+        }
+
+    def _op_trace(self, request: dict) -> dict:
+        """One trace, fleet-wide: the front end's own span events (route
+        legs, votes) merged with each ready replica's events for the
+        same trace_id — the replica fan-out is best-effort, exactly like
+        the stats roll-up (a silent replica leaves a hole, not an
+        error)."""
+        from ..utils import telemetry
+
+        known = telemetry.known_traces()
+        trace_id = request.get("trace_id")
+        if trace_id is None and known:
+            trace_id = known[-1]
+        if not trace_id:
+            return {
+                "ok": True, "op": "trace", "trace_id": None,
+                "events": [], "traces": known,
+            }
+        local = telemetry.trace_events(trace_id)
+        remote_batches = []
+        if self.supervisor is not None:
+            with getattr(self.supervisor, "_lock", threading.Lock()):
+                targets = [
+                    r.address
+                    for r in self.supervisor.replicas
+                    if r.state == "ready"
+                ]
+            for address in targets:
+                try:
+                    with MsbfsClient(
+                        address, timeout=10.0, retry=_NO_RETRY
+                    ) as c:
+                        resp = c.trace(trace_id)
+                except (ServerError, protocol.ProtocolError, OSError,
+                        socket.timeout, ValueError):
+                    continue
+                remote_batches.append(resp.get("events") or [])
+        events = observe.merge_trace_events(local, remote_batches)
+        return {
+            "ok": True, "op": "trace", "trace_id": trace_id,
+            "events": events, "traces": known,
         }
 
     def _op_stats(self) -> dict:
@@ -703,6 +818,12 @@ class FleetFrontend:
         totals.update({f"queue_{k}": 0 for k in self._ROLLUP_QUEUE_KEYS})
         totals["shed_brownout"] = 0
         totals["replicas_reporting"] = 0
+        # Fleet-wide latency distribution: per-bucket histograms share
+        # fixed log2 bounds exactly so they can be SUMMED across
+        # replicas (utils/telemetry.py) — percentiles of percentiles
+        # would be wrong; merged counts are not.  A replica predating
+        # the hist field contributes nothing (from_snapshot -> None).
+        hist_total = Histogram()
         with getattr(self.supervisor, "_lock", threading.Lock()):
             targets = [
                 (r.name, r.address)
@@ -734,11 +855,20 @@ class FleetFrontend:
             row["shed_brownout"] = int(
                 posture.get("shed_brownout", 0) or 0
             )
+            for b in (s.get("buckets") or {}).values():
+                h = Histogram.from_snapshot((b or {}).get("hist"))
+                if h is not None:
+                    try:
+                        hist_total.merge(h)
+                    except ValueError:
+                        pass  # foreign bounds: skip, never poison totals
             per[name] = row
             totals["replicas_reporting"] += 1
             for k, v in row.items():
                 if k in totals and k != "replicas_reporting":
                     totals[k] += v
+        totals["latency_hist"] = hist_total.snapshot()
+        totals["latency_p99_ms"] = hist_total.percentile(0.99)
         return per, totals
 
 
@@ -864,11 +994,14 @@ def fleet_main(argv: Optional[List[str]] = None) -> int:
     _signal.signal(_signal.SIGTERM, _on_signal)
     _signal.signal(_signal.SIGINT, _on_signal)
     names = ", ".join(sorted(supervisor.graphs)) or "none (use load)"
-    print(
+    log_line(
         f"msbfs fleet: {args.size} replicas (replication "
         f"{supervisor.ring.replication}) under {base_dir}; front end on "
         f"{args.listen}; graphs: {names}",
-        file=sys.stderr,
+        event="fleet_start",
+        size=args.size,
+        listen=args.listen,
+        graphs=sorted(supervisor.graphs),
     )
     try:
         while not frontend._stopping.is_set():
@@ -878,7 +1011,7 @@ def fleet_main(argv: Optional[List[str]] = None) -> int:
     finally:
         frontend.stop()
         supervisor.stop(drain=True)
-    print("msbfs fleet: stopped", file=sys.stderr)
+    log_line("msbfs fleet: stopped", event="fleet_stop")
     return 0
 
 

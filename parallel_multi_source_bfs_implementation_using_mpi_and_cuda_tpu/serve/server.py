@@ -65,8 +65,17 @@ from ..runtime.supervisor import (
     TransientError,
     classify,
 )
-from ..utils import faults
-from . import lifecycle, protocol
+from ..utils import faults, telemetry
+from ..utils.telemetry import (
+    Histogram,
+    TraceContext,
+    dump_flight,
+    log_line,
+    record_flight,
+    span,
+    use_trace,
+)
+from . import lifecycle, observe, protocol
 from .batcher import (
     PRIORITIES,
     MicroBatcher,
@@ -102,6 +111,18 @@ _BOUND_PATHS: set = set()
 # hostile frame cannot demand a terabyte batch.
 MAX_WIRE_QUERIES = 4096
 MAX_WIRE_GROUP = 4096
+
+
+def _pkg_version() -> str:
+    """Package version for stats/health: a restarted replica running a
+    different build must be tellable apart in fleet roll-ups.  Lazy so
+    the parent package's own import of this module cannot cycle."""
+    try:
+        from .. import __version__
+
+        return str(__version__)
+    except Exception:  # noqa: BLE001 — versioning must never fail a verb
+        return "unknown"
 
 
 def _env_int(name: str, default: int) -> int:
@@ -150,9 +171,13 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
 
 
 class _BucketStats:
-    """Per-bucket latency/throughput ledger (bounded reservoir)."""
+    """Per-bucket latency/throughput ledger: a bounded reservoir for the
+    exact recent percentiles plus a fixed-log2-bucket histogram
+    (utils/telemetry.py) that the fleet roll-up can merge across
+    replicas — the reservoir cannot merge, the histogram can."""
 
-    __slots__ = ("requests", "batches", "rows", "cache_hits", "samples_ms")
+    __slots__ = ("requests", "batches", "rows", "cache_hits",
+                 "samples_ms", "hist")
 
     MAX_SAMPLES = 1024
 
@@ -162,9 +187,11 @@ class _BucketStats:
         self.rows = 0
         self.cache_hits = 0
         self.samples_ms: List[float] = []
+        self.hist = Histogram()
 
     def record(self, latency_ms: float) -> None:
         self.requests += 1
+        self.hist.observe(latency_ms)
         if len(self.samples_ms) >= self.MAX_SAMPLES:
             # Keep the freshest window: percentile reports should track
             # current behavior, not the cold-start tail forever.
@@ -177,9 +204,11 @@ class _BucketStats:
             "requests": self.requests,
             "batches": self.batches,
             "rows": self.rows,
+            "cache_hits": self.cache_hits,
             "p50_ms": round(_percentile(s, 0.50), 3),
             "p95_ms": round(_percentile(s, 0.95), 3),
             "p99_ms": round(_percentile(s, 0.99), 3),
+            "hist": self.hist.snapshot(),
         }
 
 
@@ -616,7 +645,17 @@ class MsbfsServer:
     def handle(self, request: dict) -> dict:
         """One request object -> one response object (transport-free:
         the tests may call this directly; the wire path goes through
-        :meth:`_serve_connection`)."""
+        :meth:`_serve_connection`).  A request carrying a ``trace``
+        field gets its context installed for the handler's duration so
+        every span below — admission, batch, supervisor, engine —
+        lands on the caller's trace_id (docs/OBSERVABILITY.md)."""
+        ctx = TraceContext.from_wire(request.get("trace"))
+        if ctx is None:
+            return self._handle(request)
+        with use_trace(ctx):
+            return self._handle(request)
+
+    def _handle(self, request: dict) -> dict:
         op = request.get("op")
         try:
             if op == "ping":
@@ -651,6 +690,15 @@ class MsbfsServer:
                 return self._op_versions(request)
             if op == "stats":
                 return {"ok": True, "op": "stats", "stats": self.stats()}
+            if op == "trace":
+                # Read-only, like stats: answerable while draining.
+                return observe.op_trace(request)
+            if op == "metrics":
+                return {
+                    "ok": True,
+                    "op": "metrics",
+                    "text": observe.server_metrics_text(self),
+                }
             if op == "posture":
                 return self._op_posture(request)
             if op == "shutdown":
@@ -673,6 +721,7 @@ class MsbfsServer:
             "ok": True,
             "op": "health",
             "pid": os.getpid(),
+            "version": _pkg_version(),
             "ready": self._ready.is_set(),
             "draining": self._draining,
             "uptime_s": round(time.time() - self.started, 3),
@@ -797,6 +846,10 @@ class MsbfsServer:
         )
         with self._stats_lock:
             self._mutations += 1
+        record_flight("mutate", graph=name,
+                      inserts=int(batch.inserts.shape[0]),
+                      deletes=int(batch.deletes.shape[0]),
+                      version=entry.delta_version)
         return {
             "ok": True,
             "op": "mutate",
@@ -858,6 +911,14 @@ class MsbfsServer:
         return rows
 
     def _op_query(self, request: dict) -> dict:
+        # One span covers the whole in-daemon serve path — cache lookup,
+        # admission, the queue wait and the scatter — so the trace shows
+        # where a query's latency went before the engine even ran.
+        with span("serve.query", graph=request.get("graph", "default"),
+                  pid=os.getpid()) as sp:
+            return self._op_query_traced(request, sp)
+
+    def _op_query_traced(self, request: dict, sp) -> dict:
         name = request.get("graph", "default")
         entry = self.registry.get(name)
         rows = self._parse_queries(request)
@@ -875,6 +936,7 @@ class MsbfsServer:
         cache_key = (entry.key, rows.shape, rows.tobytes())
         cached = self.result_cache.get(cache_key)
         if cached is not None:
+            sp.set(cached=True)
             out = dict(cached)
             out["cached"] = True
             return out
@@ -885,6 +947,8 @@ class MsbfsServer:
             # for interactive work (docs/SERVING.md).
             with self._stats_lock:
                 self._shed_brownout += 1
+            record_flight("batch_shed", reason="brownout_cache_only",
+                          graph=name, priority=priority)
             raise BackpressureError(
                 "brownout: batch queries are served from the result "
                 "cache only; retry later"
@@ -919,7 +983,11 @@ class MsbfsServer:
             deadline=deadline,
             priority=priority,
             client_id=client_id,
+            # The batcher consumer thread re-installs this context so
+            # batch/supervisor/engine spans land on the query's trace.
+            trace=telemetry.current_trace(),
         )
+        sp.set(k=int(rows.shape[0]), s_pad=s_pad, priority=priority)
         self.batcher.submit(req)  # raises BackpressureError when full
         if not req.done.wait(self.request_timeout_s):
             with self._stats_lock:
@@ -1130,6 +1198,8 @@ class MsbfsServer:
             self._posture_cache_only = bool(request["cache_only"])
         out_fields["audit_sample_override"] = self._posture_audit
         out_fields["cache_only"] = self._posture_cache_only
+        if "audit_sample" in request or "cache_only" in request:
+            record_flight("brownout_transition", **out_fields)
         return {"ok": True, "op": "posture", "posture": out_fields}
 
     # ---- execution (batcher consumer thread) ------------------------------
@@ -1144,6 +1214,8 @@ class MsbfsServer:
             if req.deadline is not None and now > req.deadline:
                 with self._stats_lock:
                     self._shed_requests += 1
+                record_flight("batch_shed", reason="deadline_expired",
+                              graph=req.graph_name, priority=req.priority)
                 req.error = TransientError(
                     "request deadline expired before dispatch "
                     "(client gave up); work shed"
@@ -1212,6 +1284,22 @@ class MsbfsServer:
         requests = self._shed_expired(requests)
         if not requests:
             return
+        # A coalesced batch is one device dispatch serving several
+        # queries: its batch/supervisor/engine spans land on the FIRST
+        # traced request's trace (documented in docs/OBSERVABILITY.md —
+        # batchmates see the work attributed once, not duplicated).
+        ctx = next((r.trace for r in requests if r.trace is not None), None)
+        if ctx is None:
+            self._execute_admitted(entry, requests, s_pad)
+            return
+        with use_trace(ctx):
+            with span("batch.execute", graph=requests[0].graph_name,
+                      coalesced=len(requests)):
+                self._execute_admitted(entry, requests, s_pad)
+
+    def _execute_admitted(
+        self, entry: GraphEntry, requests: List[QueryRequest], s_pad: int
+    ) -> None:
         k_exec = pow2_pad(sum(r.k for r in requests))
         try:
             f, offsets, compiled, audited = self._dispatch_group(
@@ -1270,6 +1358,8 @@ class MsbfsServer:
                     req = group[0]
                     with self._stats_lock:
                         self._quarantined_requests += 1
+                    record_flight("quarantine", graph=req.graph_name,
+                                  error=str(err))
                     req.error = PoisonQueryError(
                         "query quarantined: its batch failed and "
                         f"bisection isolated this request ({err})"
@@ -1371,6 +1461,8 @@ class MsbfsServer:
                 audit_failures += int(sup.supervisor.audit_failures_total)
         return {
             "uptime_s": round(time.time() - self.started, 3),
+            "pid": os.getpid(),
+            "version": _pkg_version(),
             "ready": self._ready.is_set(),
             "draining": self._draining,
             "journal": self.journal.path if self.journal else None,
@@ -1480,6 +1572,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     except MsbfsError as err:
         from ..utils.report import format_failure
 
+        dump_flight(f"exit_{err.exit_code}")
         print(format_failure(err), file=sys.stderr)
         return err.exit_code
     except ValueError as exc:
@@ -1487,10 +1580,11 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         return 1
     lifecycle.install_signal_handlers(server)
     names = ", ".join(sorted(graphs)) or "none (use the load verb)"
-    print(
+    log_line(
         f"msbfs serve: listening on {args.listen}; graphs: {names}; "
         f"journal: {server.journal.path if server.journal else 'off'}",
-        file=sys.stderr,
+        event="serve_start", listen=args.listen,
+        graphs=sorted(graphs), pid=os.getpid(),
     )
     try:
         reason = server.wait()
@@ -1500,5 +1594,5 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         reason = "drain"
     if reason == "drain" and not server.stopping:
         server.drain()
-        print("msbfs serve: drained; exiting", file=sys.stderr)
+        log_line("msbfs serve: drained; exiting", event="serve_drained")
     return 0

@@ -196,3 +196,23 @@ def reset_mxu_tiles() -> None:
     global _mxu_flops, _mxu_tiles_skipped, _mxu_tiles_total
     with _mxu_lock:
         _mxu_flops = _mxu_tiles_skipped = _mxu_tiles_total = 0
+
+
+# --- Unified snapshot (round 12) ----------------------------------------------
+# One read of every process-global engine counter, for the telemetry
+# layer (serve/observe.py metrics verb, engine span attributes).  All
+# reads are the non-destructive peeks above, so snapshotting never
+# perturbs the perf-smoke bracketing resets.
+
+def counter_totals() -> dict:
+    """All engine counters in one dict: dispatches, plane_pass_bytes,
+    collective_bytes, mxu_flops/mxu_tiles_skipped/mxu_tiles_total."""
+    flops, skipped, total = mxu_tile_counts()
+    return {
+        "dispatches": dispatch_count(),
+        "plane_pass_bytes": plane_pass_bytes(),
+        "collective_bytes": collective_bytes(),
+        "mxu_flops": flops,
+        "mxu_tiles_skipped": skipped,
+        "mxu_tiles_total": total,
+    }

@@ -1,0 +1,562 @@
+"""Unified telemetry (docs/OBSERVABILITY.md): per-query distributed
+tracing through client -> router -> server -> batcher -> supervisor ->
+engine drive loop, the Prometheus metrics registry and ``metrics`` verb,
+mergeable latency histograms in the fleet roll-up, structured JSON
+logging, and the crash flight recorder's ring + exit-dump contract.
+"""
+
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+    cli,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve import (
+    observe,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (
+    MsbfsClient,
+    trace_main,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.registry import (
+    content_hash,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.ring import (
+    PlacementRing,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.router import (
+    FleetFrontend,
+    FleetRouter,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (
+    MsbfsServer,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils import (
+    faults,
+    telemetry,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+    save_graph_bin,
+    save_query_bin,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(monkeypatch):
+    """Every test starts with no ambient trace, an empty trace store and
+    an empty flight ring; the trace knob defaults off."""
+    monkeypatch.delenv("MSBFS_TRACE", raising=False)
+    monkeypatch.delenv("MSBFS_LOG_FORMAT", raising=False)
+    monkeypatch.delenv("MSBFS_FLIGHT_RECORDER", raising=False)
+    telemetry.clear_traces()
+    telemetry.flight_recorder().clear()
+    yield
+    telemetry.clear_traces()
+    telemetry.flight_recorder().clear()
+
+
+# ---------------------------------------------------------------------------
+# Trace-context primitives (no server)
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_wire_roundtrip_and_tolerance():
+    ctx = telemetry.new_trace()
+    wire = ctx.to_wire()
+    assert wire == {"trace_id": ctx.trace_id}
+    back = telemetry.TraceContext.from_wire(wire)
+    assert back is not None and back.trace_id == ctx.trace_id
+    # Tolerated-absent rollout (same posture as the wire crc): absent,
+    # junk-typed, or out-of-contract trace fields all read as "no trace".
+    for junk in (None, 7, "x", [], {}, {"trace_id": 9},
+                 {"trace_id": ""}, {"trace_id": "y" * 65}):
+        assert telemetry.TraceContext.from_wire(junk) is None
+
+
+def test_span_is_noop_without_installed_trace():
+    assert telemetry.current_trace() is None
+    with telemetry.span("orphan", a=1) as sp:
+        sp.set(b=2)  # must not raise
+    telemetry.instant("orphan.instant")
+    assert telemetry.known_traces() == []
+
+
+def test_use_trace_installs_nests_and_restores():
+    outer, inner = telemetry.new_trace(), telemetry.new_trace()
+    with telemetry.use_trace(outer):
+        assert telemetry.current_trace().trace_id == outer.trace_id
+        with telemetry.use_trace(inner):
+            assert telemetry.current_trace().trace_id == inner.trace_id
+            with telemetry.span("inner.work"):
+                pass
+        assert telemetry.current_trace().trace_id == outer.trace_id
+    assert telemetry.current_trace() is None
+    names = [e["name"] for e in telemetry.trace_events(inner.trace_id)]
+    assert names == ["inner.work"]
+    assert telemetry.trace_events(outer.trace_id) == []
+
+
+def test_span_records_duration_attrs_and_chrome_shape():
+    ctx = telemetry.new_trace()
+    with telemetry.use_trace(ctx):
+        with telemetry.span("work", phase="test") as sp:
+            time.sleep(0.01)
+            sp.set(rows=4)
+    (ev,) = telemetry.trace_events(ctx.trace_id)
+    assert ev["name"] == "work" and ev["ph"] == "X"
+    assert ev["dur"] >= 5000  # microseconds
+    assert ev["args"]["phase"] == "test" and ev["args"]["rows"] == 4
+    doc = telemetry.chrome_trace(telemetry.trace_events(ctx.trace_id))
+    assert doc["displayTimeUnit"] == "ms"
+    assert {e["name"] for e in doc["traceEvents"]} == {"work"}
+    # Chrome-trace docs must be JSON-serializable as-is.
+    json.dumps(doc)
+
+
+def test_trace_store_bounds():
+    for _ in range(telemetry.MAX_TRACES + 10):
+        ctx = telemetry.new_trace()
+        with telemetry.use_trace(ctx):
+            telemetry.instant("tick")
+    assert len(telemetry.known_traces()) == telemetry.MAX_TRACES
+    # The newest trace survived the LRU; events per trace are capped.
+    assert telemetry.known_traces()[-1] == ctx.trace_id
+    big = telemetry.new_trace()
+    with telemetry.use_trace(big):
+        for _ in range(telemetry.MAX_EVENTS_PER_TRACE + 50):
+            telemetry.instant("spam")
+    assert (
+        len(telemetry.trace_events(big.trace_id))
+        == telemetry.MAX_EVENTS_PER_TRACE
+    )
+
+
+# ---------------------------------------------------------------------------
+# Histogram / metrics registry (the fleet-mergeable latency contract)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_merge_p99_across_replicas():
+    """The roll-up contract: per-replica histograms share fixed log2
+    bounds so fleet p99 comes from SUMMED counts — a slow minority on
+    one replica must surface in the merged tail even though the other
+    replica's local p99 hides it."""
+    fast, slow = telemetry.Histogram(), telemetry.Histogram()
+    for _ in range(90):
+        fast.observe(0.7)
+    for _ in range(10):
+        slow.observe(1500.0)
+    assert fast.percentile(0.99) == 1.0
+    merged = telemetry.Histogram()
+    merged.merge(fast)
+    merged.merge(slow)
+    assert sum(merged.counts) == 100
+    assert merged.percentile(0.99) == 2048.0  # the slow bucket's bound
+    # Snapshot -> wire -> restore -> merge is exactly the fleet path.
+    restored = telemetry.Histogram.from_snapshot(merged.snapshot())
+    assert restored.percentile(0.99) == 2048.0
+    assert restored.snapshot() == merged.snapshot()
+
+
+def test_histogram_merge_rejects_foreign_bounds_and_junk_snapshots():
+    h = telemetry.Histogram()
+    other = telemetry.Histogram(bounds=(1.0, 2.0))
+    with pytest.raises(ValueError):
+        h.merge(other)
+    for junk in (None, 3, [], {"bounds_ms": "x"}, {"counts": [1]}):
+        assert telemetry.Histogram.from_snapshot(junk) is None
+
+
+def test_metrics_registry_renders_valid_exposition():
+    reg = telemetry.MetricsRegistry()
+    reg.counter("t_requests_total", 7, help_text="requests")
+    reg.gauge("t_depth", 3, graph="default", kind="a\"b\\c")
+    h = telemetry.Histogram()
+    h.observe(5.0)
+    reg.histogram("t_latency_ms", h, help_text="latency")
+    text = reg.render()
+    families = telemetry.parse_prometheus(text)
+    assert families == {
+        "t_requests_total": "counter",
+        "t_depth": "gauge",
+        "t_latency_ms": "histogram",
+    }
+    assert 't_latency_ms_bucket{le="+Inf"} 1' in text
+    assert "t_latency_ms_count 1" in text
+
+
+def test_parse_prometheus_rejects_malformed():
+    with pytest.raises(ValueError, match="no # TYPE"):
+        telemetry.parse_prometheus("undeclared_total 3\n")
+    with pytest.raises(ValueError, match="unknown metric type"):
+        telemetry.parse_prometheus("# TYPE x wat\nx 1\n")
+    with pytest.raises(ValueError, match="unparsable sample"):
+        telemetry.parse_prometheus("# TYPE x counter\nx nope\n")
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_is_bounded_and_keeps_newest():
+    ring = telemetry.FlightRecorder(maxlen=8)
+    for i in range(20):
+        ring.record("tick", i=i)
+    events = ring.snapshot()
+    assert len(events) == 8
+    assert [e["i"] for e in events] == list(range(12, 20))
+    assert all(e["kind"] == "tick" and "ts" in e for e in events)
+
+
+def test_flight_dump_writes_jsonl_with_marker(tmp_path):
+    ring = telemetry.FlightRecorder(maxlen=8)
+    ring.record("audit_fail", method="f_values", attempt=1)
+    path = str(tmp_path / "flight.jsonl")
+    out = ring.dump("test_reason", path=path)
+    assert out == path
+    lines = [json.loads(s) for s in open(path, encoding="utf-8")]
+    assert lines[0]["kind"] == "audit_fail"
+    assert lines[-1]["kind"] == "flight_dump"
+    assert lines[-1]["reason"] == "test_reason"
+    # dump() appends: a second incident extends the same black box.
+    ring.dump("again", path=path)
+    lines = [json.loads(s) for s in open(path, encoding="utf-8")]
+    assert [l["reason"] for l in lines if l["kind"] == "flight_dump"] == [
+        "test_reason", "again",
+    ]
+
+
+def test_dump_flight_noop_without_env(monkeypatch):
+    monkeypatch.delenv("MSBFS_FLIGHT_RECORDER", raising=False)
+    telemetry.record_flight("mutate", graph="g")
+    assert telemetry.dump_flight("nowhere") is None
+
+
+def test_exit9_run_leaves_audit_fail_in_flight_jsonl(
+    tmp_path, monkeypatch, capsys
+):
+    """The acceptance pin: a run that dies with the documented exit 9
+    (CorruptionError) leaves a flight-recorder JSONL whose tail holds
+    the audit_fail events leading up to the dump marker."""
+    flight = str(tmp_path / "flight.jsonl")
+    monkeypatch.setenv("MSBFS_FLIGHT_RECORDER", flight)
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    g, q = str(tmp_path / "g.bin"), str(tmp_path / "q.bin")
+    n, edges = generators.gnm_edges(64, 192, seed=11)
+    save_graph_bin(g, n, edges)
+    save_query_bin(q, [[0], [1, 2]])
+    # Corrupt the F buffer on EVERY attempt (retry + each audit-ladder
+    # rung): certification can never pass, so the supervisor's verdict
+    # is the terminal typed CorruptionError.
+    plan = faults.FaultPlan.parse(
+        ",".join(f"bitflip:dist:{i}" for i in range(1, 9))
+    )
+    with faults.injected(plan):
+        rc = cli.main(["msbfs", "verify", "-g", g, "-q", q])
+    assert rc == 9
+    assert capsys.readouterr().err  # the typed failure was reported
+    lines = [json.loads(s) for s in open(flight, encoding="utf-8")]
+    kinds = [l["kind"] for l in lines]
+    assert "audit_fail" in kinds
+    assert lines[-1]["kind"] == "flight_dump"
+    assert lines[-1]["reason"] == "exit_9"
+
+
+# ---------------------------------------------------------------------------
+# Structured logging
+# ---------------------------------------------------------------------------
+
+
+def test_log_line_plain_and_json_modes(monkeypatch, capsys):
+    monkeypatch.delenv("MSBFS_LOG_FORMAT", raising=False)
+    telemetry.log_line("hello world", event="greet")
+    assert capsys.readouterr().err == "hello world\n"
+    monkeypatch.setenv("MSBFS_LOG_FORMAT", "json")
+    ctx = telemetry.new_trace()
+    with telemetry.use_trace(ctx):
+        telemetry.log_line("hello json", event="greet", n=3)
+    rec = json.loads(capsys.readouterr().err)
+    assert rec["msg"] == "hello json"
+    assert rec["level"] == "info" and rec["event"] == "greet"
+    assert rec["n"] == 3 and "ts" in rec
+    assert rec["trace_id"] == ctx.trace_id
+    # Outside any trace: no trace_id key, still valid JSON.
+    telemetry.log_line("no trace", level="warn")
+    rec = json.loads(capsys.readouterr().err)
+    assert rec["level"] == "warn" and "trace_id" not in rec
+
+
+# ---------------------------------------------------------------------------
+# Single daemon: trace adoption, trace/metrics verbs, identity fields
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def graph_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("observe_graphs")
+    n, edges = generators.gnm_edges(120, 360, seed=13)
+    path = str(d / "g.bin")
+    save_graph_bin(path, n, edges)
+    return n, path
+
+
+@pytest.fixture()
+def server(graph_file, tmp_path, monkeypatch):
+    monkeypatch.setenv("MSBFS_RETRIES", "0")
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    _, path = graph_file
+    sock = str(tmp_path / "observe.sock")
+    srv = MsbfsServer(
+        listen=f"unix:{sock}",
+        graphs={"default": path},
+        window_s=0.0,
+        request_timeout_s=30.0,
+    )
+    srv.start()
+    yield srv, f"unix:{sock}"
+    faults.activate(None)
+    srv.stop()
+
+
+def test_traced_query_spans_every_layer(server, monkeypatch):
+    """One MSBFS_TRACE=1 query yields ONE trace_id whose events cover
+    client, server admission, batch execution, the supervised dispatch,
+    and at least one per-level-chunk engine span carrying the dispatch
+    and collective-byte counter deltas."""
+    _, addr = server
+    monkeypatch.setenv("MSBFS_TRACE", "1")
+    with MsbfsClient(addr) as c:
+        out = c.query([[1, 2], [3, 4]])
+        assert out["ok"]
+        tid = out["trace_id"]
+        resp = c.trace(tid)
+    assert resp["trace_id"] == tid
+    events = resp["events"]
+    names = {e["name"] for e in events}
+    assert {"client.query", "serve.query", "batch.admit",
+            "batch.queue_wait", "batch.execute",
+            "supervise.f_values", "engine.level_chunk"} <= names
+    chunk_spans = [e for e in events if e["name"] == "engine.level_chunk"]
+    assert chunk_spans and all(
+        e["args"]["dispatches"] >= 1
+        and "collective_bytes" in e["args"]
+        and "plane_pass_bytes" in e["args"]
+        for e in chunk_spans
+    )
+    doc = observe.chrome_trace_json(events)
+    json.dumps(doc)  # Perfetto-loadable as-is
+    assert len(doc["traceEvents"]) == len(events)
+
+
+def test_untraced_query_records_nothing(server):
+    _, addr = server
+    with MsbfsClient(addr) as c:
+        out = c.query([[5]])
+        assert out["ok"] and "trace_id" not in out
+        resp = c.trace()
+    assert resp["events"] == [] and resp["trace_id"] is None
+
+
+def test_trace_verb_lists_known_traces(server, monkeypatch):
+    _, addr = server
+    monkeypatch.setenv("MSBFS_TRACE", "1")
+    with MsbfsClient(addr) as c:
+        t1 = c.query([[1]])["trace_id"]
+        t2 = c.query([[2]])["trace_id"]
+        resp = c.trace()
+    assert resp["traces"][-2:] == [t1, t2]
+    assert resp["trace_id"] == t2  # default: the most recent trace
+
+
+def test_metrics_verb_is_valid_prometheus_and_covers_counters(server):
+    _, addr = server
+    with MsbfsClient(addr) as c:
+        c.query([[1, 2]])
+        c.query([[1, 2]])  # result-cache hit
+        text = c.metrics()
+    families = telemetry.parse_prometheus(text)
+    # Every pre-existing counter class surfaces as a family.
+    for family, mtype in {
+        "msbfs_requests_total": "counter",
+        "msbfs_requests_failed_total": "counter",
+        "msbfs_requests_shed_total": "counter",
+        "msbfs_requests_quarantined_total": "counter",
+        "msbfs_audited_total": "counter",
+        "msbfs_audit_failures_total": "counter",
+        "msbfs_mutations_total": "counter",
+        "msbfs_queue_depth": "gauge",
+        "msbfs_queue_rejected_total": "counter",
+        "msbfs_batches_coalesced_total": "counter",
+        "msbfs_cache_hits_total": "counter",
+        "msbfs_cache_misses_total": "counter",
+        "msbfs_engine_dispatches": "gauge",
+        "msbfs_engine_collective_bytes": "gauge",
+        "msbfs_engine_plane_pass_bytes": "gauge",
+        "msbfs_uptime_seconds": "gauge",
+        "msbfs_request_latency_ms": "histogram",
+    }.items():
+        assert families.get(family) == mtype, (family, families.get(family))
+    # The result-cache hit is visible in the exposition.
+    assert 'msbfs_cache_hits_total{cache="result"} 1' in text
+
+
+def test_stats_and_health_carry_identity(server):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+        __version__,
+    )
+
+    srv, addr = server
+    with MsbfsClient(addr) as c:
+        stats = c.stats()
+        health = c.call({"op": "health"})
+    assert stats["pid"] == health["pid"]
+    assert stats["version"] == health["version"] == __version__
+    assert stats["uptime_s"] >= 0.0
+    # Per-bucket latency histograms ride the stats verb for the fleet
+    # roll-up to merge.
+    for b in stats["buckets"].values():
+        snap = b["hist"]
+        assert snap["bounds_ms"] == list(telemetry.LATENCY_BUCKETS_MS)
+        assert sum(snap["counts"]) >= 1
+
+
+def test_trace_cli_exports_chrome_json(server, monkeypatch, tmp_path,
+                                       capsys):
+    _, addr = server
+    monkeypatch.setenv("MSBFS_TRACE", "1")
+    with MsbfsClient(addr) as c:
+        tid = c.query([[7, 8]])["trace_id"]
+    out_path = str(tmp_path / "trace.json")
+    rc = trace_main(
+        ["--connect", addr, "--trace-id", tid, "-o", out_path]
+    )
+    assert rc == 0
+    doc = json.load(open(out_path, encoding="utf-8"))
+    assert {e["name"] for e in doc["traceEvents"]} >= {
+        "client.query", "serve.query",
+    }
+    rc = trace_main(["--connect", addr, "--list"])
+    assert rc == 0
+    assert tid in capsys.readouterr().out.splitlines()
+
+
+# ---------------------------------------------------------------------------
+# Fleet: one trace across the extra hop, histogram roll-up, fleet metrics
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def duo(graph_file, tmp_path, monkeypatch):
+    """Two in-process replicas behind a router + frontend (handle()
+    called directly — no frontend socket), with a minimal supervisor
+    stand-in so the roll-up/fan-out paths run."""
+    monkeypatch.setenv("MSBFS_RETRIES", "0")
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    _, path = graph_file
+    servers, addresses = {}, {}
+    for i in range(2):
+        name = f"r{i}"
+        addr = f"unix:{tmp_path}/{name}.sock"
+        srv = MsbfsServer(listen=addr, graphs={"default": path},
+                          window_s=0.0, request_timeout_s=30.0)
+        srv.start()
+        servers[name] = srv
+        addresses[name] = addr
+    replicas = [
+        SimpleNamespace(name=n_, address=a, state="ready")
+        for n_, a in addresses.items()
+    ]
+    supervisor = SimpleNamespace(
+        _lock=threading.Lock(),
+        replicas=replicas,
+        status=lambda: {"ready": True, "graphs": {}},
+    )
+    ring = PlacementRing(list(addresses), replication=2)
+    router = FleetRouter(ring, addresses, {"default": content_hash(path)})
+    frontend = FleetFrontend("unix:unused", router, supervisor=supervisor)
+    yield frontend, router
+    faults.activate(None)
+    for srv in servers.values():
+        srv.stop()
+
+
+def test_fleet_trace_single_id_spans_route_and_replica(duo, monkeypatch):
+    """The fleet acceptance pin: one traced query through the frontend
+    keeps ONE trace_id across the router hop, and the trace verb's
+    merged Chrome JSON shows route, batch, supervisor and engine
+    spans."""
+    frontend, _ = duo
+    monkeypatch.setenv("MSBFS_TRACE", "1")
+    ctx = telemetry.new_trace()
+    out = frontend.handle({
+        "op": "query", "graph": "default",
+        "queries": [[2, 3], [4, 5]],
+        "trace": ctx.to_wire(),
+    })
+    assert out["ok"], out
+    assert out["trace_id"] == ctx.trace_id
+    assert telemetry.known_traces() == [ctx.trace_id]  # no second trace
+    resp = frontend.handle({"op": "trace", "trace_id": ctx.trace_id})
+    assert resp["ok"] and resp["trace_id"] == ctx.trace_id
+    names = {e["name"] for e in resp["events"]}
+    assert {"route.query", "route.attempt", "serve.query",
+            "batch.execute", "supervise.f_values",
+            "engine.level_chunk"} <= names
+    chunk = next(e for e in resp["events"]
+                 if e["name"] == "engine.level_chunk")
+    assert chunk["args"]["dispatches"] >= 1
+    assert "collective_bytes" in chunk["args"]
+    route = next(e for e in resp["events"] if e["name"] == "route.query")
+    assert route["args"]["replica"] in ("r0", "r1")
+
+
+def test_fleet_rollup_merges_latency_histograms(duo):
+    frontend, router = duo
+    # Drive both replicas directly so each holds latency observations.
+    for member, address in router.addresses.items():
+        with MsbfsClient(address) as c:
+            assert c.query([[1, int(member[-1]) + 2]])["ok"]
+    per, totals = frontend._rollup()
+    assert totals["replicas_reporting"] == 2
+    merged = telemetry.Histogram.from_snapshot(totals["latency_hist"])
+    assert merged is not None and sum(merged.counts) >= 2
+    assert totals["latency_p99_ms"] == merged.percentile(0.99) > 0.0
+    assert set(per) == set(router.addresses)
+
+
+def test_fleet_metrics_text_parses_and_counts(duo):
+    frontend, _ = duo
+    assert frontend.handle({
+        "op": "query", "graph": "default", "queries": [[9]],
+    })["ok"]
+    resp = frontend.handle({"op": "metrics"})
+    assert resp["ok"]
+    families = telemetry.parse_prometheus(resp["text"])
+    for family in ("msbfs_fleet_routed_total",
+                   "msbfs_fleet_failovers_total",
+                   "msbfs_fleet_votes_total",
+                   "msbfs_fleet_vote_mismatches_total",
+                   "msbfs_fleet_shed_total",
+                   "msbfs_fleet_totals_replicas_reporting",
+                   "msbfs_fleet_request_latency_ms"):
+        assert family in families, family
+    assert "msbfs_fleet_routed_total 1" in resp["text"]
+
+
+def test_fleet_health_carries_version(duo):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+        __version__,
+    )
+
+    frontend, _ = duo
+    health = frontend.handle({"op": "health"})
+    assert health["ok"] and health["version"] == __version__

@@ -58,6 +58,46 @@ def test_profiler_trace_noop_without_dir(monkeypatch):
         assert active is False
 
 
+def test_profiler_trace_env_dir(tmp_path, monkeypatch):
+    """The env-var path: MSBFS_PROFILE_DIR alone activates the profiler
+    (no explicit log_dir argument)."""
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MSBFS_PROFILE_DIR", str(tmp_path))
+    with profiler_trace() as active:
+        assert active is True
+        jnp.arange(4).sum().block_until_ready()
+    assert any(tmp_path.rglob("*"))
+
+
+def test_format_halo_stats():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.trace import (
+        format_halo_stats,
+    )
+
+    per_level = [
+        {"routes": ["sparse", "sparse"], "own_rows": 4, "bytes": 128},
+        {"routes": ["sparse", "dense"], "own_rows": 2, "bytes": 256},
+    ]
+    out = format_halo_stats(per_level)
+    lines = out.strip().split("\n")
+    assert lines[0].split() == ["level", "own_rows", "route", "halo_bytes"]
+    # Levels are 1-based (the exchange serves the expansion that
+    # discovers that distance); diverged q-shard routes read "mixed".
+    assert lines[1].split() == ["1", "4", "sparse", "128"]
+    assert lines[2].split() == ["2", "2", "mixed", "256"]
+    assert lines[3] == "total halo bytes: 384"
+
+
+def test_format_halo_stats_empty():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.trace import (
+        format_halo_stats,
+    )
+
+    out = format_halo_stats([])
+    assert out.strip().split("\n")[-1] == "total halo bytes: 0"
+
+
 def test_profiler_trace_collects(tmp_path):
     import jax.numpy as jnp
 

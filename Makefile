@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe
+.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe analyze lockwatch
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -94,5 +94,25 @@ dynamic: native
 observe: native
 	JAX_PLATFORMS=cpu python -m pytest tests/test_observe.py -x -q
 
-test: native resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe
+# Repo-native static analysis gate (docs/ANALYSIS.md): trace-safety
+# lint over ops/ and parallel/, lock-discipline race detection over
+# serve/ and runtime/, MSBFS_* knob-contract enforcement against
+# utils/knobs.py + the README table, and raise/exit-code contract
+# enforcement against the typed taxonomy + docs/RESILIENCE.md.  Pure
+# stdlib ast — no jax import, runs in seconds.  Only findings absent
+# from ANALYSIS_BASELINE.json fail the gate.
+analyze:
+	python -m $(PKG).analysis.cli
+
+# Dynamic lock-order watchdog (docs/ANALYSIS.md "Lock watchdog"): the
+# concurrency-heavy suites run with every threading.Lock/RLock
+# instrumented; any pair of locks ever taken in both orders — the
+# deadlock precondition, even if this run didn't deadlock — fails the
+# session with the witness stacks.
+lockwatch: native
+	JAX_PLATFORMS=cpu MSBFS_LOCK_WATCHDOG=1 MSBFS_FAULT_SEED=0 python -m pytest \
+	    tests/test_serve.py tests/test_lifecycle.py tests/test_fleet.py \
+	    tests/test_stampede.py -x -q -m "not slow"
+
+test: native analyze resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe
 	python -m pytest tests/ -x -q

@@ -204,6 +204,16 @@ BUDGET = {
     # — strict: undeclared samples, bad labels, unknown types all fail).
     # opt is the violation count; exact zero-budget pin.
     "metrics-exposition-lint": 0,
+    # Round 13 static-analysis wall-clock (analysis/, docs/ANALYSIS.md):
+    # one full `msbfs analyze` run — four ast passes over the whole
+    # package plus tests and benchmarks — in milliseconds.  The gate
+    # rides `make test` on every change, so it must stay interactive:
+    # measured ~2 s today (pure stdlib ast, no jax import); base 60 s
+    # with the 30 s pin means the analyzer can grow 15x before anyone
+    # notices it in the edit loop.  A blowup here means a pass went
+    # superlinear (fixpoint that stopped converging, per-file work that
+    # became per-file-pair) — fix the pass, don't raise the pin.
+    "analyze-wall-ms": 30_000,
 }
 
 # The pinned direction sequence for run_mxu's dense-frontier fixture
@@ -566,6 +576,37 @@ def run_repair():
     )
 
 
+def run_analyze():
+    """Round-13 analyzer wall-clock row: one full static-analysis run
+    (the `make analyze` gate) in a fresh interpreter — import cost is
+    part of what the edit loop pays, so it counts.  rc 0 is required:
+    a dirty tree is a gate failure, not a perf number."""
+    import subprocess
+    import time
+
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu"
+            ".analysis.cli",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    ms = int(round((time.perf_counter() - t0) * 1e3))
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"msbfs analyze failed (rc={proc.returncode}) — fix or "
+            f"baseline the findings before measuring:\n{proc.stdout[-2000:]}"
+        )
+    print(f"  analyze: {ms}ms  ({proc.stdout.strip().splitlines()[-1]})")
+    return "analyze-wall-ms", 60_000, ms
+
+
 def _multichip_child() -> int:
     """Subprocess body for run_multichip (needs 16 virtual devices, an
     interpreter-start flag): measure the analytic collective bytes one
@@ -648,7 +689,7 @@ def main() -> int:
     failures = []
     for run in (run_config1, run_config4, run_stencil_window, run_mxu,
                 run_fleet, run_stampede, run_audit, run_telemetry,
-                run_repair, run_multichip):
+                run_repair, run_multichip, run_analyze):
         rows = run()
         if isinstance(rows, tuple):
             rows = [rows]

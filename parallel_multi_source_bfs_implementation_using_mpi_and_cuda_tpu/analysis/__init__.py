@@ -1,0 +1,10 @@
+"""Repo-native static analysis (docs/ANALYSIS.md).
+
+Four AST passes over the tree — trace-safety lint, lock discipline,
+knob contract, error contract — plus the dynamic lock-order watchdog.
+Entry point: ``msbfs analyze`` (analysis.cli.analyze_main).  This
+package imports neither jax nor the engine stack: it must stay cheap
+enough to run on every `make test`.
+"""
+
+from .core import Finding  # noqa: F401

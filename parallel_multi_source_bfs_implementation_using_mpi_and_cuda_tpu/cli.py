@@ -27,21 +27,18 @@ from typing import List, Optional
 import numpy as np
 
 
+from .utils import knobs
+
+
 def _env_int(name: str, default: int) -> int:
-    """Integer env knob with the file-wide atoi-ish convention: malformed
-    values fall back to the default rather than crash."""
-    try:
-        return int(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
+    """Integer env knob via the central registry (utils/knobs.py):
+    malformed values fall back to the default rather than crash."""
+    return knobs.get_int(name, default)
 
 
 def _env_float(name: str, default: float) -> float:
     """Float env knob, same malformed-falls-back convention."""
-    try:
-        return float(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
+    return knobs.get_float(name, default)
 
 
 def parse_args(argv: List[str]):
@@ -119,7 +116,7 @@ def _explicit_level_chunk() -> Optional[int]:
     unset, like the file's other optional knobs) or malformed.  A
     MALFORMED value warns and falls back to the auto policy — a typo must
     not switch off a safety mitigation."""
-    raw = os.environ.get("MSBFS_LEVEL_CHUNK")
+    raw = knobs.raw("MSBFS_LEVEL_CHUNK")
     if raw is None or raw == "":
         return None
     try:
@@ -195,7 +192,7 @@ def _bitbell_ladder(graph, level_chunk):
             # Deliberate safety bound — never megachunk-multiplied.
             megachunk=1,
             slot_budget=(
-                1 << 25 if not os.environ.get("MSBFS_SLOT_BUDGET") else None
+                1 << 25 if not knobs.raw("MSBFS_SLOT_BUDGET") else None
             ),
         ),
     ))
@@ -208,7 +205,7 @@ def _bitbell_ladder(graph, level_chunk):
         lambda: StreamedBitBellEngine(
             BellGraph.from_host(graph, keep_sparse=False, device=False),
             slot_budget=(
-                1 << 25 if not os.environ.get("MSBFS_SLOT_BUDGET") else None
+                1 << 25 if not knobs.raw("MSBFS_SLOT_BUDGET") else None
             ),
         ),
     ))
@@ -353,6 +350,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         # Offline output certification (docs/RESILIENCE.md "Silent data
         # corruption"): exit 0 = certified, exit 9 = corrupt.
         return verify_main(argv[2:])
+    if len(argv) > 1 and argv[1] == "analyze":
+        # Repo-native static analysis (docs/ANALYSIS.md): trace-safety
+        # lint, lock discipline, knob + error contracts.  Imports only
+        # the AST passes — no jax — so CI can gate on it cheaply.
+        from .analysis.cli import analyze_main
+
+        return analyze_main(argv[2:])
     if len(argv) < 5:  # argc < 5, reference main.cu:204-212
         print(
             f"Usage: python {argv[0] if argv else 'main.py'} "
@@ -394,7 +398,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     # single-process (the common case).  Genuine bring-up failures
     # propagate, like MPI_Init aborting.  MUST run before anything that
     # initializes the XLA backend (jax.distributed's own contract).
-    coordinator = os.environ.get("MSBFS_COORDINATOR")
+    coordinator = knobs.raw("MSBFS_COORDINATOR")
     if coordinator:
         from .parallel.mesh import initialize_distributed
 
@@ -508,7 +512,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # Capacity-degradation rungs for the supervisor; populated by the
         # routes that have a documented smaller-footprint fallback.
         ladder_rungs = []
-        mesh_spec = os.environ.get("MSBFS_MESH", "").strip()
+        mesh_spec = knobs.raw("MSBFS_MESH", "").strip()
         if n_chips > 1 and mesh_spec:
             # MSBFS_MESH=RxC selects the 2D adjacency partition
             # (parallel/partition2d.py): the CSR is tiled over an (R, C)
@@ -545,7 +549,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 graph,
                                 level_chunk=level_chunk,
                                 merge_tree=(
-                                    os.environ.get("MSBFS_MERGE_TREE")
+                                    knobs.raw("MSBFS_MERGE_TREE")
                                     or None
                                 ),
                             ),
@@ -602,7 +606,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # engine (road-class); everything else runs the bitbell
             # default, with a warning for backends that only exist
             # single-chip.
-            backend = os.environ.get("MSBFS_BACKEND", "auto")
+            backend = knobs.raw("MSBFS_BACKEND", "auto")
             if backend in _SINGLE_CHIP_ONLY_BACKENDS:
                 print(
                     f"MSBFS_BACKEND={backend} is single-chip only; using "
@@ -656,7 +660,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
                     def _opt_env_int(name):
                         # None = unset (engine auto-sizes); 0 disables.
-                        raw = os.environ.get(name)
+                        raw = knobs.raw(name)
                         if raw is None or raw == "":
                             return None
                         try:
@@ -716,7 +720,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # expansion as a bf16 matmul on the MXU, worthwhile when the
             # n^2 adjacency fits HBM; "auto" picks it for small graphs on
             # MXU-bearing devices only.
-            backend = os.environ.get("MSBFS_BACKEND", "auto")
+            backend = knobs.raw("MSBFS_BACKEND", "auto")
             hbm_warn = (
                 hbm_need > hbm_have
                 and backend not in _NON_BITBELL_FOOTPRINT_BACKENDS
@@ -740,7 +744,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             if backend == "stencil" or (
                 backend == "auto"
                 and road_class
-                and os.environ.get("MSBFS_STENCIL", "") != "0"
+                and knobs.raw("MSBFS_STENCIL", "") != "0"
             ):
                 from .ops.stencil import (
                     AUTO_STENCIL_LEVEL_CHUNK,
@@ -795,8 +799,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     backend == "auto"
                     and not hbm_warn
                     and 0 < padded.shape[0] <= _env_int("MSBFS_LOWK_MAX_K", 4)
-                    and os.environ.get("MSBFS_LOWK", "") != "0"
-                    and os.environ.get("MSBFS_STATS", "") != "2"
+                    and knobs.raw("MSBFS_LOWK", "") != "0"
+                    and knobs.raw("MSBFS_STATS", "") != "2"
                 )
             ):
                 from .models.bell import BellGraph
@@ -989,7 +993,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                         megachunk=1,
                         slot_budget=(
                             1 << 25
-                            if not os.environ.get("MSBFS_SLOT_BUDGET")
+                            if not knobs.raw("MSBFS_SLOT_BUDGET")
                             else None
                         ),
                     )
@@ -1052,14 +1056,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             ladder=ladder_rungs,
             plan=fault_plan,
         )
-        stats_env = os.environ.get("MSBFS_STATS", "")
+        stats_env = knobs.raw("MSBFS_STATS", "")
         stats_mode = stats_env in ("1", "2")
         # MSBFS_STATS=2: additionally trace each BFS level (frontier size,
         # wall time) via the engine's stepped loop, when it has one.
         stats_level = stats_env == "2" and callable(
             getattr(engine, "level_stats", None)
         )
-        ckpt_path = os.environ.get("MSBFS_CHECKPOINT")
+        ckpt_path = knobs.raw("MSBFS_CHECKPOINT")
         ckpt_chunk = _env_int("MSBFS_CHECKPOINT_CHUNK", 64)
         try:
             if ckpt_path:

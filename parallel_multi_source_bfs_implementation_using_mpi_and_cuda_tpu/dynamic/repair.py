@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..ops.certify import reference_distances
+from ..utils import knobs
 from ..utils.timing import record_plane_pass
 
 __all__ = ["RepairStats", "repair_cost_estimate", "repair_distances"]
@@ -59,7 +60,7 @@ _DEFAULT_MAX_FRAC = 0.5
 
 
 def _max_frac() -> float:
-    raw = os.environ.get("MSBFS_REPAIR_MAX_FRAC")
+    raw = knobs.raw("MSBFS_REPAIR_MAX_FRAC")
     if raw is None:
         return _DEFAULT_MAX_FRAC
     try:

@@ -38,9 +38,9 @@ def rmat_edges(
     m = edge_factor * n
     d = 1.0 - a - b - c
     if native is None:
-        import os
+        from ..utils import knobs
 
-        native = os.environ.get("MSBFS_NATIVE_RMAT") == "1"
+        native = knobs.raw("MSBFS_NATIVE_RMAT") == "1"
     if native:
         from ..runtime import native_loader
 
@@ -49,7 +49,9 @@ def rmat_edges(
             # Explicitly requested stream must not silently substitute the
             # NumPy one (same seed, DIFFERENT graph -> irreproducible
             # benchmark rows); same contract as utils/io.py's native flag.
-            raise RuntimeError(
+            from ..runtime.supervisor import InputError
+
+            raise InputError(
                 "native R-MAT requested (MSBFS_NATIVE_RMAT/native=True) "
                 "but librt_loader.so is not built (run `make native`)"
             )

@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 
 from ..models.bell import BellGraph
+from ..utils import knobs
 from ..utils.donation import donating_jit
 from ..utils.timing import record_dispatch
 from .bfs import host_chunked_loop, validate_level_chunk
@@ -70,7 +71,7 @@ def resolve_megachunk(megachunk, level_chunk) -> int:
     if not level_chunk:
         return 1
     if megachunk is None:
-        env = os.environ.get("MSBFS_MEGACHUNK", "")
+        env = knobs.raw("MSBFS_MEGACHUNK", "")
         if env:
             try:
                 megachunk = int(env)
@@ -846,7 +847,7 @@ class BitBellEngine(FusedBestEngine):
         # 0 = never segment; an int forces it.  MSBFS_SLOT_BUDGET mirrors
         # the constructor arg for the CLI/bench surface.
         if slot_budget is None:
-            env = os.environ.get("MSBFS_SLOT_BUDGET", "")
+            env = knobs.raw("MSBFS_SLOT_BUDGET", "")
             if env:
                 try:
                     slot_budget = int(env)

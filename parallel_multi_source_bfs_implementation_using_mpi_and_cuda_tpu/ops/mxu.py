@@ -57,6 +57,7 @@ import numpy as np
 from jax import lax
 
 from ..models.csr import CSRGraph
+from ..utils import knobs
 from ..utils.donation import donating_jit
 from ..utils.timing import record_dispatch, record_mxu_tiles
 from .bfs import validate_level_chunk
@@ -100,7 +101,7 @@ def resolve_tile(tile: Optional[int] = None) -> int:
     the serve registry's tile-index cache key, so a cached layout can
     never be reused under a different effective tile."""
     if tile is None:
-        tile = int(os.environ.get("MSBFS_MXU_TILE", "0") or 0)
+        tile = knobs.get_int("MSBFS_MXU_TILE", 0)
         tile = tile or DEFAULT_TILE
     tile = int(tile)
     if tile < 8 or tile % 8:
@@ -195,7 +196,7 @@ class MxuGraph:
         that as the routing error it is."""
         tile = resolve_tile(tile)
         if max_tiles is None:
-            max_tiles = int(os.environ.get("MSBFS_MXU_MAX_TILES", "0") or 0)
+            max_tiles = knobs.get_int("MSBFS_MXU_MAX_TILES", 0)
             max_tiles = max_tiles or DEFAULT_MAX_TILES
         n = g.n
         u, v, count_n = g.deduped_pairs()
@@ -462,7 +463,7 @@ class MxuEngine(FusedBestEngine):
         self.level_chunk = validate_level_chunk(level_chunk)
         self.megachunk = resolve_megachunk(megachunk, self.level_chunk)
         if switch is None:
-            env = os.environ.get("MSBFS_MXU_SWITCH", "")
+            env = knobs.raw("MSBFS_MXU_SWITCH", "")
             switch = int(env) if env.strip() else None
         if switch is None:
             switch = max(1, graph.n // AUTO_SWITCH_DIVISOR)
@@ -480,7 +481,7 @@ class MxuEngine(FusedBestEngine):
             1, min(int(push_budget), graph.n_pad + e)
         )
         if kernel is None:
-            kernel = os.environ.get("MSBFS_MXU_KERNEL", "") == "1"
+            kernel = knobs.raw("MSBFS_MXU_KERNEL", "") == "1"
         # Fallback is automatic: without an importable Pallas chain the
         # XLA einsum serves every request.
         self.kernel = bool(kernel) and _pallas_tile_products is not None

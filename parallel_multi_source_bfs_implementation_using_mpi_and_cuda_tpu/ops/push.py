@@ -45,6 +45,8 @@ import numpy as np
 from jax import lax
 
 from ..models.csr import CSRGraph
+from ..runtime.supervisor import CapacityError
+from ..utils import knobs
 from ..utils.donation import donating_jit
 from ..utils.timing import record_dispatch
 from .engine import QueryEngineBase
@@ -270,7 +272,7 @@ def default_push_chunk() -> int:
     import os
 
     try:
-        return max(1, int(os.environ.get("MSBFS_PUSH_CHUNK", "64")))
+        return max(1, knobs.get_int("MSBFS_PUSH_CHUNK", 64))
     except ValueError:
         return 64
 
@@ -313,9 +315,12 @@ def push_run(
     return f, levels, reached, max_count
 
 
-class FrontierOverflow(RuntimeError):
+class FrontierOverflow(CapacityError):
     """A level's frontier exceeded the engine's capacity; re-run with a
-    larger ``capacity`` (results were NOT truncated — the run is rejected)."""
+    larger ``capacity`` (results were NOT truncated — the run is rejected).
+    A :class:`~..runtime.supervisor.CapacityError` (exit 3): the typed
+    taxonomy's resource-exhaustion class, so the supervisor's capacity
+    ladder can catch and degrade instead of crashing."""
 
 
 class PushEngine(QueryEngineBase):

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..utils import knobs
 from ..utils.donation import donating_jit
 from ..utils.timing import record_dispatch, record_plane_pass
 from .bfs import validate_level_chunk
@@ -675,10 +676,10 @@ class StencilEngine(FusedBestEngine):
         self.megachunk = resolve_megachunk(megachunk, self.level_chunk)
         self._level_warm_shapes = set()
         if wavefront is None:
-            wavefront = int(os.environ.get("MSBFS_WAVEFRONT", "1") or "1")
+            wavefront = knobs.get_int("MSBFS_WAVEFRONT", 1)
         self.wavefront = max(1, int(wavefront))
         if window is None:
-            window = os.environ.get("MSBFS_STENCIL_WINDOW", "") != "0"
+            window = knobs.raw("MSBFS_STENCIL_WINDOW", "") != "0"
         self.window_requested = bool(window)
         # Exactness precondition: windowing needs an empty residual (see
         # _window_advance) and a chunked drive to window per-chunk.
@@ -689,7 +690,7 @@ class StencilEngine(FusedBestEngine):
         )
         self._maxd = max((abs(d) for d in graph.offsets), default=0)
         if kernel is None:
-            kernel = os.environ.get("MSBFS_STENCIL_KERNEL", "") == "1"
+            kernel = knobs.raw("MSBFS_STENCIL_KERNEL", "") == "1"
         # Fallback is automatic: without an importable Pallas chain the
         # XLA masked shifts serve every request (ISSUE r7 routing).
         self.kernel = bool(kernel) and _pallas_hits is not None

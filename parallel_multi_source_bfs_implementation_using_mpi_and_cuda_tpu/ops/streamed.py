@@ -52,6 +52,7 @@ import numpy as np
 from jax import lax
 
 from ..models.bell import BellGraph
+from ..utils import knobs
 from ..utils.donation import donating_jit
 from ..utils.timing import record_dispatch
 from .bell import _slot_segments
@@ -67,13 +68,7 @@ from .packed import PackedEngineBase
 
 
 def _env_int(name: str, default: int) -> int:
-    env = os.environ.get(name, "")
-    if env:
-        try:
-            return int(env)
-        except ValueError:
-            pass
-    return default
+    return knobs.get_int(name, default)
 
 
 @partial(jax.jit, static_argnums=(0,))

@@ -135,7 +135,9 @@ def available() -> bool:
 def load_graph_csr(path: str) -> CSRGraph:
     lib = _get_lib()
     if lib is None:
-        raise RuntimeError(f"{_LIB_NAME} not built (run `make native`)")
+        from .supervisor import InputError
+
+        raise InputError(f"{_LIB_NAME} not built (run `make native`)")
     n = ctypes.c_int64()
     m = ctypes.c_int64()
     rc = lib.msbfs_graph_header(path.encode(), ctypes.byref(n), ctypes.byref(m))

@@ -62,7 +62,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from ..runtime.supervisor import BackpressureError, MsbfsError
-from ..utils import telemetry
+from ..utils import knobs, telemetry
 from ..utils.telemetry import record_flight, span
 
 DEFAULT_QUEUE_CAPACITY = 64
@@ -512,14 +512,8 @@ class MicroBatcher:
 
 
 def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
+    return knobs.get_int(name, default)
 
 
 def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
+    return knobs.get_float(name, default)

@@ -59,7 +59,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set
 
 from ..runtime.supervisor import CorruptionError, RetryPolicy, TransientError
-from ..utils import faults
+from ..utils import faults, knobs
 from .autoscale import AutoscalePolicy, ReplicaSignal
 from .brownout import BrownoutLadder
 from .client import MsbfsClient, ServerError
@@ -72,10 +72,7 @@ _REPO_ROOT = os.path.dirname(
 
 
 def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
+    return knobs.get_float(name, default)
 
 
 def _alloc_port() -> int:
@@ -282,7 +279,9 @@ class FleetSupervisor:
     def start(self, wait_ready_s: Optional[float] = None) -> None:
         with self._lock:
             if self.started:
-                raise RuntimeError("fleet already started")
+                from ..runtime.supervisor import InputError
+
+                raise InputError("fleet already started")
             self.started = True
             for r in self.replicas:
                 self._spawn(r)

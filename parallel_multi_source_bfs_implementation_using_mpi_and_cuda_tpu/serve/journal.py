@@ -50,7 +50,7 @@ import tempfile
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..utils import faults
+from ..utils import faults, knobs
 
 _OPS = ("load", "reload", "warm", "mutate")
 
@@ -117,12 +117,7 @@ class StateJournal:
     def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
         if max_bytes is None:
-            try:
-                max_bytes = int(
-                    os.environ.get("MSBFS_JOURNAL_MAX_BYTES", str(1 << 20))
-                )
-            except ValueError:
-                max_bytes = 1 << 20
+            max_bytes = knobs.get_int("MSBFS_JOURNAL_MAX_BYTES", 1 << 20)
         self.max_bytes = int(max_bytes)
         self.compactions = 0
 

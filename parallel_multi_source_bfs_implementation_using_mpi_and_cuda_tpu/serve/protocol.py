@@ -61,7 +61,7 @@ import struct
 import zlib
 from typing import Optional
 
-from ..utils import faults
+from ..utils import faults, knobs
 
 _LEN = struct.Struct("!I")
 _CRC = struct.Struct("!I")
@@ -90,7 +90,7 @@ class FrameCorruptError(ProtocolError):
 def max_frame_bytes() -> int:
     """The active bound (env-overridable, malformed values fall back —
     the repo-wide knob convention)."""
-    raw = os.environ.get("MSBFS_SERVE_MAX_FRAME", "")
+    raw = knobs.raw("MSBFS_SERVE_MAX_FRAME", "")
     if raw:
         try:
             v = int(raw)
@@ -108,7 +108,7 @@ def crc_sends_enabled() -> bool:
     two-phase rolling upgrade (module docstring).  Receiving is NOT
     gated: flagged frames are verified, unflagged frames accepted,
     whatever the knob says."""
-    raw = os.environ.get("MSBFS_WIRE_CRC", "on").strip().lower()
+    raw = knobs.raw("MSBFS_WIRE_CRC", "on").strip().lower()
     return raw not in ("legacy", "off", "0")
 
 

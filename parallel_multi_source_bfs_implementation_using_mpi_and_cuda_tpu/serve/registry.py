@@ -32,6 +32,7 @@ from ..runtime.supervisor import (
     InputError,
     RetryPolicy,
 )
+from ..utils import knobs
 from ..utils.io import load_graph_bin
 
 
@@ -50,17 +51,11 @@ def content_hash(path: str) -> str:
 
 
 def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
+    return knobs.get_int(name, default)
 
 
 def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
+    return knobs.get_float(name, default)
 
 
 def audit_sample_rate() -> float:
@@ -68,7 +63,7 @@ def audit_sample_rate() -> float:
     ``off``/unset/``0`` disables, ``full``/``1`` audits every served
     f_values call, a float in (0, 1) audits that sampled fraction.
     Malformed values fall back to off (the repo-wide knob convention)."""
-    raw = os.environ.get("MSBFS_AUDIT", "").strip().lower()
+    raw = knobs.raw("MSBFS_AUDIT", "").strip().lower()
     if raw in ("", "off", "0"):
         return 0.0
     if raw in ("full", "1"):
@@ -193,13 +188,13 @@ def build_supervised_engine(graph, content_digest: Optional[str] = None) -> Chun
     megachunk = (
         1 if (explicit_chunk is not None and explicit_chunk > 0) else None
     )
-    backend = os.environ.get("MSBFS_BACKEND", "auto")
+    backend = knobs.raw("MSBFS_BACKEND", "auto")
     ladder = []
     engine = None
     if backend == "stencil" or (
         backend == "auto"
         and _road_class(graph)
-        and os.environ.get("MSBFS_STENCIL", "") != "0"
+        and knobs.raw("MSBFS_STENCIL", "") != "0"
     ):
         # Round 7: the served route mirrors the batch CLI's stencil
         # probe, so a registered road/grid graph serves through the

@@ -61,7 +61,7 @@ from ..runtime.supervisor import (
     RetryPolicy,
     TransientError,
 )
-from ..utils import faults
+from ..utils import faults, knobs
 from ..utils.telemetry import (
     Histogram,
     TraceContext,
@@ -82,7 +82,7 @@ def vote_rate_from_env() -> float:
     disable, ``full``/``on``/``1`` vote every query, a float samples;
     malformed values fall back to off (the repo-wide knob convention).
     """
-    raw = os.environ.get("MSBFS_VOTE", "").strip().lower()
+    raw = knobs.raw("MSBFS_VOTE", "").strip().lower()
     if raw in ("", "off", "0"):
         return 0.0
     if raw in ("full", "on", "1"):
@@ -884,7 +884,7 @@ def fleet_main(argv: Optional[List[str]] = None) -> int:
     )
     ap.add_argument(
         "--listen",
-        default=os.environ.get(
+        default=knobs.raw(
             "MSBFS_FLEET_LISTEN", "unix:/tmp/msbfs-fleet.sock"
         ),
         help="front-end address (default unix:/tmp/msbfs-fleet.sock)",
@@ -936,7 +936,7 @@ def fleet_main(argv: Optional[List[str]] = None) -> int:
 
     plan = faults.FaultPlan.from_env()
     faults.activate(plan)
-    base_dir = args.base_dir or os.environ.get(
+    base_dir = args.base_dir or knobs.raw(
         "MSBFS_FLEET_DIR", "/tmp/msbfs-fleet"
     )
     autoscale = None

@@ -65,7 +65,7 @@ from ..runtime.supervisor import (
     TransientError,
     classify,
 )
-from ..utils import faults, telemetry
+from ..utils import faults, knobs, telemetry
 from ..utils.telemetry import (
     Histogram,
     TraceContext,
@@ -126,17 +126,11 @@ def _pkg_version() -> str:
 
 
 def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
+    return knobs.get_int(name, default)
 
 
 def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, str(default)))
-    except ValueError:
-        return default
+    return knobs.get_float(name, default)
 
 
 def _plane_policy() -> str:
@@ -147,7 +141,7 @@ def _plane_policy() -> str:
     ``0`` never does (repair still runs off planes stored by earlier
     repairs).  Malformed values fall back to the default with a stderr
     note, the repo-wide knob convention."""
-    raw = os.environ.get("MSBFS_SERVE_PLANES", "auto").strip().lower()
+    raw = knobs.raw("MSBFS_SERVE_PLANES", "auto").strip().lower()
     if raw in ("auto", ""):
         return "auto"
     if raw in ("1", "on", "always"):
@@ -252,7 +246,7 @@ class MsbfsServer:
             else _env_float("MSBFS_SERVE_TIMEOUT", DEFAULT_REQUEST_TIMEOUT_S)
         )
         if journal_path is None:
-            journal_path = os.environ.get("MSBFS_SERVE_JOURNAL", "") or None
+            journal_path = knobs.raw("MSBFS_SERVE_JOURNAL", "") or None
         self.journal = StateJournal(journal_path) if journal_path else None
         self.drain_deadline_s = (
             drain_deadline_s
@@ -1514,7 +1508,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     )
     ap.add_argument(
         "--listen",
-        default=os.environ.get("MSBFS_SERVE_LISTEN", "unix:/tmp/msbfs.sock"),
+        default=knobs.raw("MSBFS_SERVE_LISTEN", "unix:/tmp/msbfs.sock"),
         help="unix:<path> or <host>:<port> (default unix:/tmp/msbfs.sock)",
     )
     ap.add_argument(

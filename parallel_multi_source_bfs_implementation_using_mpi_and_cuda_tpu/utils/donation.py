@@ -27,12 +27,13 @@ and tracing behave exactly as before.
 
 from __future__ import annotations
 
-import os
 from typing import Callable
 
 import jax
 
-_enabled = os.environ.get("MSBFS_DONATE", "1").lower() not in (
+from . import knobs
+
+_enabled = knobs.raw("MSBFS_DONATE", "1").lower() not in (
     "0",
     "off",
     "false",

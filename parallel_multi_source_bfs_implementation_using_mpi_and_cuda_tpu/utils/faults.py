@@ -310,23 +310,13 @@ class FaultPlan:
     def from_env(cls) -> Optional["FaultPlan"]:
         """Plan from ``MSBFS_FAULTS`` (+ ``MSBFS_FAULT_HANG``), or None
         when unset/empty (the normal no-faults case)."""
-        raw = os.environ.get("MSBFS_FAULTS", "").strip()
+        from . import knobs
+
+        raw = knobs.raw("MSBFS_FAULTS", "").strip()
         if not raw:
             return None
-        hang = 60.0
-        env = os.environ.get("MSBFS_FAULT_HANG", "")
-        if env:
-            try:
-                hang = float(env)
-            except ValueError:
-                pass  # malformed knob falls back, file-wide convention
-        slow = 0.25
-        env = os.environ.get("MSBFS_FAULT_SLOW", "")
-        if env:
-            try:
-                slow = float(env)
-            except ValueError:
-                pass
+        hang = knobs.get_float("MSBFS_FAULT_HANG", 60.0)
+        slow = knobs.get_float("MSBFS_FAULT_SLOW", 0.25)
         return cls.parse(raw, hang_seconds=hang, slow_seconds=slow)
 
     # ---- execution --------------------------------------------------------

@@ -46,7 +46,9 @@ def load_graph_bin(path: str | os.PathLike, native: Optional[bool] = None) -> CS
         if native_loader.available():
             return native_loader.load_graph_csr(os.fspath(path))
         if native:
-            raise RuntimeError(
+            from ..runtime.supervisor import InputError
+
+            raise InputError(
                 "native loader requested but librt_loader.so is not built "
                 "(run `make -C runtime` / `make native`)"
             )
@@ -171,12 +173,16 @@ def _native_text_parse(path, native, parse, label):
             if out is not None:
                 return out
         if native:
-            raise RuntimeError(
+            from ..runtime.supervisor import InputError
+
+            raise InputError(
                 f"native {label} parser requested but librt_loader.so is "
                 "not built (run `make native`)"
             )
     elif native:
-        raise RuntimeError(f"native {label} parser cannot read .gz files")
+        from ..runtime.supervisor import InputError
+
+        raise InputError(f"native {label} parser cannot read .gz files")
     return None
 
 

@@ -1,0 +1,174 @@
+"""Central ``MSBFS_*`` knob registry — the knob contract's single source
+of truth (docs/ANALYSIS.md "Knob contract").
+
+Every environment knob the repo reads is declared here once: name,
+documented default, parse kind, one doc line.  All package code reads
+knobs through the accessors below (``raw``/``get_int``/``get_float``),
+never through ``os.environ`` directly — the ``msbfs analyze`` knob pass
+enforces both directions statically (an unregistered read and a raw
+``os.environ`` read are both findings), and the accessors enforce it at
+runtime by refusing unregistered names fail-loud.  A registered knob no
+code references is *dead* and also a finding: the registry can never
+drift from reality in either direction.
+
+The accessors keep the repo-wide parse convention exactly: a malformed
+value falls back to the call site's default rather than crashing (a typo
+must never switch off a safety mitigation), and the empty string means
+unset.  Sites with richer grammars (``MSBFS_AUDIT``'s ``off/sample/full``,
+``MSBFS_MESH``'s ``RxC``) read the raw string via :func:`raw` and keep
+their own parsing.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One registered environment knob."""
+
+    name: str
+    default: Optional[str]  # documented default, as the env string; None = unset
+    kind: str  # int / float / flag / str / path / spec
+    doc: str
+
+
+def _k(name: str, default: Optional[str], kind: str, doc: str) -> Knob:
+    return Knob(name, default, kind, doc)
+
+
+# The registry.  Grouped by layer; one line per knob.  README.md's knob
+# table carries the long-form documentation — the analyze knob pass pins
+# that every name here appears there too.
+_ALL = (
+    # --- engine selection & level loop (cli.py, ops/) ---
+    _k("MSBFS_BACKEND", "auto", "str", "engine selection (auto/bitbell/bell/push/ppush/packed/stencil/streamed/lowk/mxu/vmap/dense/pallas/csr)"),
+    _k("MSBFS_LEVEL_CHUNK", None, "int", "BFS levels per device dispatch; 0 disables the bound, unset = auto 128"),
+    _k("MSBFS_MEGACHUNK", None, "int", "level chunks fused into one dispatched program on the chunked drive loops; unset = auto factor 8"),
+    _k("MSBFS_SUBBATCH_K", "256", "int", "K above which a single-chip batch splits into pipelined sub-batches; 0 disables"),
+    _k("MSBFS_DENSE_THRESHOLD", "8192", "int", "max n for the auto dense-MXU path"),
+    _k("MSBFS_EDGE_CHUNKS", "1", "int", "bound the packed engine's per-level (E/chunks, K) intermediate"),
+    _k("MSBFS_SLOT_BUDGET", None, "int", "bitbell: max live gather rows per segmented per-level gather; unset = auto, 0 never segments"),
+    _k("MSBFS_STENCIL", None, "flag", "0 disables the banded-adjacency auto route"),
+    _k("MSBFS_STENCIL_WINDOW", None, "flag", "0 disables the stencil active-row window"),
+    _k("MSBFS_STENCIL_KERNEL", None, "flag", "1 routes the stencil sweep through the chunked Pallas kernel chain"),
+    _k("MSBFS_WAVEFRONT", "1", "int", "stencil wavefront blocking: BFS levels unrolled per dispatch region"),
+    _k("MSBFS_LOWK", None, "flag", "0 disables the low-K byte-flag auto route"),
+    _k("MSBFS_LOWK_MAX_K", "4", "int", "K at or below which single-chip auto picks the byte-flag engine"),
+    _k("MSBFS_MXU_TILE", "128", "int", "mxu adjacency tile side (multiple of 8)"),
+    _k("MSBFS_MXU_MAX_TILES", "32768", "int", "mxu densification ceiling in nonzero tiles"),
+    _k("MSBFS_MXU_SWITCH", None, "int", "mxu per-level direction switch threshold in active rows; 0 never pushes, unset = auto n/64"),
+    _k("MSBFS_MXU_KERNEL", None, "flag", "1 routes mxu tile products through the Pallas tile chain"),
+    _k("MSBFS_PUSH_CHUNK", "64", "int", "push engine: BFS levels per device dispatch"),
+    _k("MSBFS_STREAM_PREFETCH", "2", "int", "host-streamed engine: forest-segment upload lookahead"),
+    _k("MSBFS_DONATE", "1", "flag", "0 disables buffer donation on the chunked drive loops"),
+    # --- multi-chip & multi-host (cli.py, parallel/) ---
+    _k("MSBFS_MESH", None, "spec", "RxC selects the 2D adjacency partition at -gn > 1"),
+    _k("MSBFS_MERGE_TREE", None, "str", "2D engine col-axis reduction tree: auto/ring/halving/oneshot"),
+    _k("MSBFS_VSHARD", "0", "int", "split the CSR over a 'v' mesh axis of this size at -gn > 1"),
+    _k("MSBFS_HALO_BUDGET", None, "int", "vertex-sharded engine: compacted-halo threshold in own-frontier rows; 0 always dense"),
+    _k("MSBFS_PUSH_HALO", None, "int", "vertex-sharded engine: in-block push edge budget inside the sparse-halo branch"),
+    _k("MSBFS_HBM_BYTES", None, "int", "per-chip HBM budget override for the capacity estimate"),
+    _k("MSBFS_COORDINATOR", None, "spec", "multi-host bring-up: coordinator addr:port (the mpirun analog)"),
+    _k("MSBFS_NUM_PROCESSES", "1", "int", "multi-host bring-up: world size"),
+    _k("MSBFS_PROCESS_ID", "0", "int", "multi-host bring-up: this process's rank"),
+    # --- resilience (runtime/, utils/faults.py, utils/checkpoint.py) ---
+    _k("MSBFS_RETRIES", "2", "int", "supervisor transient-retry budget per dispatch"),
+    _k("MSBFS_BACKOFF", "0.1", "float", "supervisor base backoff delay in seconds"),
+    _k("MSBFS_WATCHDOG", "0", "float", "wall-clock dispatch deadline in seconds; 0/unset = off"),
+    _k("MSBFS_FAULTS", None, "spec", "deterministic fault-injection plan: kind:site:n[,...]"),
+    _k("MSBFS_FAULT_SEED", "0", "int", "backoff-jitter RNG stream"),
+    _k("MSBFS_FAULT_HANG", "60", "float", "injected-hang stall seconds"),
+    _k("MSBFS_FAULT_SLOW", "0.25", "float", "replica_slow stall seconds"),
+    _k("MSBFS_CHECKPOINT", None, "path", "resumable journal path for chunk-wise execution"),
+    _k("MSBFS_CHECKPOINT_CHUNK", "64", "int", "queries per checkpointed chunk"),
+    _k("MSBFS_AUDIT", "off", "spec", "output certification: off / sample[:rate] / full"),
+    # --- serving daemon (serve/) ---
+    _k("MSBFS_SERVE_LISTEN", "unix:/tmp/msbfs.sock", "spec", "serving daemon listen address"),
+    _k("MSBFS_SERVE_QUEUE", "64", "int", "admission queue capacity (full -> typed exit-7 rejection)"),
+    _k("MSBFS_SERVE_WINDOW", "0.002", "float", "micro-batching coalescing window in seconds"),
+    _k("MSBFS_SERVE_MAX_ROWS", "1024", "int", "max query rows per dispatched batch"),
+    _k("MSBFS_SERVE_RESULT_CACHE", "1024", "int", "result-cache LRU entries; 0 disables"),
+    _k("MSBFS_SERVE_TIMEOUT", "30", "float", "per-request deadline in seconds"),
+    _k("MSBFS_SERVE_MAX_FRAME", "268435456", "int", "wire-frame byte bound"),
+    _k("MSBFS_SERVE_JOURNAL", None, "path", "crash-recovery state journal path"),
+    _k("MSBFS_SERVE_DRAIN", "10", "float", "SIGTERM graceful-drain deadline in seconds"),
+    _k("MSBFS_SERVE_CLIENT_RATE", "0", "float", "per-client admission tokens per second; 0 disables"),
+    _k("MSBFS_SERVE_CLIENT_BURST", None, "float", "per-client token-bucket burst; unset = max(8, 2*rate)"),
+    _k("MSBFS_SERVE_BATCH_ADMIT", "0.5", "float", "batch-class admission headroom fraction of queue capacity"),
+    _k("MSBFS_SERVE_CODEL_TARGET_MS", "0", "float", "CoDel sojourn target in ms; 0 disables"),
+    _k("MSBFS_SERVE_CODEL_INTERVAL_MS", "100", "float", "CoDel control interval in ms"),
+    _k("MSBFS_SERVE_PLANES", "auto", "str", "retain distance planes as repair seeds: auto/1/0"),
+    _k("MSBFS_SERVE_PLANE_CACHE_BYTES", "268435456", "int", "plane-cache byte cap"),
+    _k("MSBFS_JOURNAL_MAX_BYTES", "1048576", "int", "journal auto-compaction threshold in bytes"),
+    _k("MSBFS_MXU_CACHE_BYTES", "268435456", "int", "registry MXU tile-index cache byte cap (LRU); <= 0 disables"),
+    _k("MSBFS_WIRE_CRC", "on", "str", "protocol frame crc32: on / legacy (send pre-crc frames)"),
+    # --- fleet (serve/fleet.py, serve/router.py) ---
+    _k("MSBFS_FLEET_LISTEN", "unix:/tmp/msbfs-fleet.sock", "spec", "fleet front-end listen address"),
+    _k("MSBFS_FLEET_DIR", None, "path", "fleet replica sockets/journals/logs directory"),
+    _k("MSBFS_FLEET_BACKOFF", "0.2", "float", "replica restart base backoff in seconds"),
+    _k("MSBFS_VOTE", "off", "spec", "cross-replica vote: off / on / sample rate in (0,1)"),
+    # --- dynamic graphs (dynamic/) ---
+    _k("MSBFS_REPAIR_MAX_FRAC", "0.5", "float", "repair-cone fraction above which repair falls back to full recompute"),
+    # --- observability (utils/telemetry.py, utils/trace.py) ---
+    _k("MSBFS_STATS", None, "str", "1 = per-query stats table, 2 = + per-level trace"),
+    _k("MSBFS_TRACE", None, "flag", "1 mints a per-query distributed trace at the client edge"),
+    _k("MSBFS_LOG_FORMAT", None, "str", "json switches daemon stderr to structured logs"),
+    _k("MSBFS_FLIGHT_RECORDER", None, "path", "append the flight ring as JSONL here on typed exits"),
+    _k("MSBFS_PROFILE_DIR", None, "path", "capture a jax.profiler trace of the computation span"),
+    # --- platform & caches (utils/) ---
+    _k("MSBFS_CACHE_DIR", "~/.cache/msbfs_tpu/xla", "path", "persistent XLA compilation cache directory; empty disables"),
+    _k("MSBFS_NATIVE_RMAT", None, "flag", "1 samples R-MAT edges in native C++"),
+    _k("MSBFS_NATIVE_THREADS", None, "int", "native loader thread count override (loader.cpp)"),
+    # --- test & bench harness ---
+    _k("MSBFS_TEST_TPU", None, "flag", "1 runs the test suite on real devices instead of the virtual CPU mesh"),
+    _k("MSBFS_BASELINE_CPU_MESH", None, "flag", "bench: force the virtual CPU mesh baseline comparison"),
+    _k("MSBFS_ICI_CHILD", None, "flag", "benchmarks: ICI-probe subprocess marker"),
+    _k("MSBFS_EXP_CHILD", None, "flag", "benchmarks: experiment subprocess marker"),
+    _k("MSBFS_LOCK_WATCHDOG", None, "flag", "1 installs the instrumented-lock order watchdog in conftest"),
+)
+
+KNOBS: Dict[str, Knob] = {k.name: k for k in _ALL}
+
+
+def _check(name: str) -> None:
+    if name not in KNOBS:
+        raise KeyError(
+            f"unregistered knob {name!r}: declare it in utils/knobs.py "
+            "(the knob contract, docs/ANALYSIS.md)"
+        )
+
+
+def raw(name: str, default: Optional[str] = None) -> Optional[str]:
+    """The knob's raw env string, or ``default`` when unset (exactly
+    ``os.environ.get``) — for sites with their own grammar."""
+    _check(name)
+    return os.environ.get(name, default)
+
+
+def get_int(name: str, default: int) -> int:
+    """Integer knob with the repo-wide convention: unset, empty or
+    malformed values fall back to ``default``."""
+    _check(name)
+    val = os.environ.get(name)
+    if val is None or val == "":
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        return default
+
+
+def get_float(name: str, default: float) -> float:
+    """Float knob, same malformed-falls-back convention."""
+    _check(name)
+    val = os.environ.get(name)
+    if val is None or val == "":
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        return default

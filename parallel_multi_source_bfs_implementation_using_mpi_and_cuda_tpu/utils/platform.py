@@ -22,9 +22,9 @@ def device_hbm_bytes(default: int = 16 * 1024**3) -> int:
     ``MSBFS_HBM_BYTES`` overrides; otherwise the device's reported
     bytes_limit, falling back to ``default`` (v5e's 16 GB) when the
     backend exposes no memory stats (CPU, some plugins)."""
-    import os
+    from . import knobs
 
-    env = os.environ.get("MSBFS_HBM_BYTES")
+    env = knobs.raw("MSBFS_HBM_BYTES")
     if env:
         try:
             return int(env)

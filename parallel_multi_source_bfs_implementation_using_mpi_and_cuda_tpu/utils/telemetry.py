@@ -45,6 +45,8 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Iterable, List, Optional
 
+from . import knobs
+
 # ---------------------------------------------------------------------------
 # Trace context + span store
 # ---------------------------------------------------------------------------
@@ -93,7 +95,7 @@ def trace_enabled() -> bool:
     else enables client-edge trace creation.  Servers do not read this —
     they adopt whatever trace rides the request, so only the edge that
     ORIGINATES queries needs the knob."""
-    raw = os.environ.get("MSBFS_TRACE", "").strip().lower()
+    raw = knobs.raw("MSBFS_TRACE", "").strip().lower()
     return raw not in ("", "0", "off")
 
 
@@ -538,7 +540,7 @@ class FlightRecorder:
 
 
 def flight_path() -> Optional[str]:
-    return os.environ.get("MSBFS_FLIGHT_RECORDER") or None
+    return knobs.raw("MSBFS_FLIGHT_RECORDER") or None
 
 
 _FLIGHT = FlightRecorder()
@@ -566,7 +568,7 @@ def log_json_enabled() -> bool:
     """``MSBFS_LOG_FORMAT=json`` switches server logs to one-JSON-object
     -per-line; anything else (default) keeps the plain human lines
     byte-identical to before."""
-    return os.environ.get("MSBFS_LOG_FORMAT", "").strip().lower() == "json"
+    return knobs.raw("MSBFS_LOG_FORMAT", "").strip().lower() == "json"
 
 
 def log_line(msg: str, level: str = "info", stream=None, **fields) -> None:

@@ -25,7 +25,9 @@ def profiler_trace(log_dir: Optional[str] = None) -> Iterator[bool]:
     Yields True when tracing is active.  No-op (yields False) when no
     directory is configured, so callers can wrap unconditionally.
     """
-    log_dir = log_dir or os.environ.get("MSBFS_PROFILE_DIR")
+    from . import knobs
+
+    log_dir = log_dir or knobs.raw("MSBFS_PROFILE_DIR")
     if not log_dir:
         yield False
         return

@@ -64,7 +64,9 @@ def configure_compilation_cache() -> None:
         )
         return
 
-    cache_dir = os.environ.get(
+    from . import knobs
+
+    cache_dir = knobs.raw(
         "MSBFS_CACHE_DIR",
         os.path.join(
             os.path.expanduser("~"),

@@ -54,6 +54,33 @@ def pytest_configure(config):
 
 import pytest  # noqa: E402
 
+# Dynamic lock-order watchdog (docs/ANALYSIS.md "Lock watchdog"):
+# MSBFS_LOCK_WATCHDOG=1 swaps threading.Lock/RLock for instrumented
+# proxies BEFORE any package module constructs a lock, records the
+# cross-thread acquisition-order graph through the whole run, and the
+# session fixture below fails the run on any order inversion.  Installed
+# here — after the re-exec guard, before test collection imports the
+# serving stack — so every lock the daemons create is watched.
+_LOCKWATCH = None
+if os.environ.get("MSBFS_LOCK_WATCHDOG") == "1" and not _needs_reexec():
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.analysis import (  # noqa: E501
+        lockwatch as _LOCKWATCH,
+    )
+
+    _LOCKWATCH.install()
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _lockwatch_no_inversions():
+    """With the watchdog armed, assert the whole session observed a
+    consistent lock acquisition order (no A->B in one thread and B->A in
+    another — the interleaving that deadlocks under load)."""
+    yield
+    if _LOCKWATCH is None:
+        return
+    inv = _LOCKWATCH.inversions()
+    assert not inv, _LOCKWATCH.report()
+
 
 @pytest.fixture(autouse=True, scope="session")
 def _no_stray_servers():

@@ -36,9 +36,15 @@ tile-matmul engine with density-based direction switching, ops.mxu —
 its rows carry detail.mxu: analytic tile FLOPs, zero-tile skip rate and
 the exact per-level push/matmul decisions; "mesh2d" is the round-10
 multi-chip 2D adjacency partition, parallel/partition2d — BENCH_MESH=RxC
-picks the mesh shape, BENCH_MERGE_TREE the col-axis reduction tree, and
-rows carry detail.multichip: measured collective bytes, ICI roofline,
-scaling efficiency vs the same engine on a 1x1 mesh),
+picks the mesh shape, BENCH_MERGE_TREE the col-axis reduction tree
+(round 15 adds "pipelined": stripe the word plane BENCH_WIRE_CHUNKS ways
+and overlap each stripe's ring exchange with the previous stripe's tile
+pass), BENCH_WIRE_SPARSE the density-adaptive sparse wire budget
+(empty = auto Lsub*W/8 pairs, 0 = always dense), BENCH_RESIDENCY
+hbm|streamed the tile-forest residency (streamed = host RAM with
+double-buffered uploads), and rows carry detail.multichip: measured
+collective bytes, ICI roofline, scaling efficiency vs the same engine on
+a 1x1 mesh, plus the round-15 wire ledger detail.multichip.wire),
 BENCH_EDGE_CHUNKS (packed engine HBM knob, default 1),
 BENCH_SPARSE (bitbell hybrid budget; empty=auto, 0=pure pull, no dedup CSR),
 BENCH_LEVEL_CHUNK (bitbell levels per dispatch; empty=unchunked, "auto"=the
@@ -50,7 +56,7 @@ BASELINE.md; empty disables), BENCH_WAIT_S (device-probe budget, default
 420), BENCH_RUN_S (workload hard deadline, default 1500),
 BENCH_GRAPH (rmat|road — road builds the config-4 grid at side 2^(scale/2)),
 BENCH_CONFIGS (comma list of BASELINE config ids, DEFAULT
-"2,2c,4,1,5,6,6r,7,7t,7l,8,8m": sweep
+"2,2c,4,1,5,6,6r,7,7t,7l,7s,8,8m": sweep
 mode — each config runs in its own deadline-bounded child and gets its own
 value/error in detail.sweep; the cumulative record re-emits after every
 config so a partial outage cannot zero what was already measured; the
@@ -60,7 +66,10 @@ top-level vs_baseline is null with a baseline_graph_mismatch note, since
 that ratio was measured against a different workload's reference model.
 The "7" family is the round-10 multi-chip scale-out: BENCH_ENGINE=mesh2d
 (the 2D adjacency partition, parallel/partition2d) with BENCH_MESH=RxC on
-a forced 8-virtual-device CPU mesh; rows carry detail.multichip.  The "8"
+a forced 8-virtual-device CPU mesh; rows carry detail.multichip.  "7s"
+(round 15) is the sparse-frontier road workload whose
+detail.multichip.wire ledger records the density-adaptive encoding per
+level and measured-vs-dense-model bytes.  The "8"
 family is the round-11 dynamic-graph workload (BENCH_DYNAMIC=1):
 localized-delta incremental BFS repair vs full recompute, host-side, with
 BENCH_DELTA_SIZE/BENCH_DELTA_LOCALITY shaping the seeded delta (gen_cli
@@ -549,7 +558,12 @@ def run_workload() -> None:
             # BENCH_MESH=RxC picks the mesh shape over the visible
             # devices (on CPU the BENCH_VIRTUAL_CPU preset key forces the
             # virtual device count); BENCH_MERGE_TREE pins the col-axis
-            # reduction tree (empty = the engine's auto policy).
+            # reduction tree (empty = the engine's auto policy).  Round
+            # 15 wire knobs ride the same pattern: BENCH_WIRE_SPARSE is
+            # the sparse (index, word) pair budget (empty = the engine's
+            # auto Lsub*W/8, "0" = always dense), BENCH_WIRE_CHUNKS the
+            # pipelined-tree stripe count, BENCH_RESIDENCY hbm|streamed
+            # the tile-forest residency.
             from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
                 make_mesh2d,
                 parse_mesh_spec,
@@ -562,11 +576,17 @@ def run_workload() -> None:
                 rows, cols = parse_mesh_spec(
                     os.environ.get("BENCH_MESH", "2x4")
                 )
+                wire_chunks_env = os.environ.get("BENCH_WIRE_CHUNKS", "")
                 return Mesh2DEngine(
                     make_mesh2d(rows, cols),
                     g,
                     level_chunk=_bench_level_chunk(8),
                     merge_tree=os.environ.get("BENCH_MERGE_TREE") or None,
+                    residency=os.environ.get("BENCH_RESIDENCY") or None,
+                    wire_sparse=os.environ.get("BENCH_WIRE_SPARSE") or None,
+                    wire_chunks=(
+                        int(wire_chunks_env) if wire_chunks_env else None
+                    ),
                 )
             except ValueError as e:
                 sys.exit(f"BENCH_ENGINE=mesh2d: {e}")
@@ -795,6 +815,23 @@ def run_workload() -> None:
                 "statement on the simulated CPU mesh"
             ),
         }
+        # Round 15: the per-level wire ledger (encoding the density cond
+        # took, measured bytes) vs the dense wire model — the ratio the
+        # perf-smoke sparse-wire row pins.  Untimed diagnostic re-drive,
+        # one level per dispatch; hbm residency only (the streamed drive
+        # records dense bytes by construction).
+        if getattr(engine, "residency", "hbm") == "hbm":
+            try:
+                wire = engine.wire_trace(queries)
+                # Ledger capped at 64 levels (road runs reach hundreds);
+                # the sparse_levels / bytes_* totals stay exact.
+                wire["levels_total"] = len(wire["levels"])
+                wire["levels"] = wire["levels"][:64]
+                multichip_detail["wire"] = wire
+            except Exception as exc:  # diagnostic only
+                print(
+                    f"bench: wire trace leg failed: {exc}", file=sys.stderr
+                )
 
     # --- Untimed diagnostics for the model/utilization fields ------------
     # Per-query level counts drive the per-config reference model; one
@@ -1152,6 +1189,17 @@ CONFIG_PRESETS = {
            "BENCH_SCALE": "16", "BENCH_K": "64", "BENCH_MESH": "1x8",
            "BENCH_REPEATS": "2", "BENCH_EXTRA_KS": "",
            "BENCH_VIRTUAL_CPU": "8"},
+    # 7s (round 15): the density-adaptive wire showcase — the road grid's
+    # thin deep-BFS wavefront keeps the frontier under the auto sparse
+    # budget for most levels, so the row-gather/col-reduce legs ride the
+    # (index, word) encoding and detail.multichip.wire records the
+    # per-level encoding ledger plus measured-vs-dense-model bytes (the
+    # <= 0.5x ratio the perf-smoke sparse-wire row pins).  One repeat:
+    # hundreds of levels per run, same as 6r.
+    "7s": {"BENCH_GRAPH": "road", "BENCH_ENGINE": "mesh2d",
+           "BENCH_SCALE": "16", "BENCH_K": "32", "BENCH_MAX_S": "8",
+           "BENCH_MESH": "2x4", "BENCH_REPEATS": "1",
+           "BENCH_EXTRA_KS": "", "BENCH_VIRTUAL_CPU": "8"},
     # Config 8 family (round 11): dynamic graphs — localized-delta
     # incremental BFS repair (dynamic/repair.py) vs full recompute,
     # host-side.  "8" is the street-closure scenario on the road grid
@@ -1378,7 +1426,7 @@ def main() -> int:
     configs = [
         c.strip()
         for c in os.environ.get(
-            "BENCH_CONFIGS", "2,2c,4,1,5,6,6r,7,7t,7l,8,8m"
+            "BENCH_CONFIGS", "2,2c,4,1,5,6,6r,7,7t,7l,7s,8,8m"
         ).split(",")
         if c.strip()
     ]

@@ -53,6 +53,34 @@ for TREE in ring halving oneshot; do
       2> "$RAW/tree_${TREE}.stderr" | tee "$RAW/tree_${TREE}.json" || true
 done
 
+echo "== 2b. sparse-vs-dense wire on real ICI (round 15 density-adaptive wire)"
+# The road workload is the sparse wire's home regime (thin deep-BFS
+# wavefront).  Dense leg pins BENCH_WIRE_SPARSE=0; sparse leg runs the
+# auto budget.  detail.multichip.wire carries the per-level encoding
+# ledger + measured-vs-dense-model bytes, so this pair says whether the
+# <= 0.5x byte diet (pinned on CPU by the perf-smoke sparse-wire-bytes
+# row) turns into wall clock on real links.
+for WIRE in 0 auto; do
+  BENCH_CONFIGS= BENCH_ENGINE=mesh2d BENCH_MESH=2x4 BENCH_GRAPH=road \
+      BENCH_SCALE=20 BENCH_K=32 BENCH_MAX_S=8 BENCH_WIRE_SPARSE=$WIRE \
+      BENCH_REPEATS=2 BENCH_EXTRA_KS= BENCH_RUN_S=3600 python bench.py \
+      2> "$RAW/wire_${WIRE}.stderr" | tee "$RAW/wire_${WIRE}.json" || true
+done
+
+echo "== 2c. pipelined-vs-oneshot exchange overlap (round 15 striped ring)"
+# The pipelined tree moves ring bytes but overlaps each stripe's
+# ppermute with the previous stripe's tile pass — only real links can
+# price the overlap (on the simulated CPU mesh transfer is a memcpy, so
+# the CPU rows say bytes only).  Stripe count sweep: 1 degenerates to
+# plain ring (the control), 8 halves the per-hop payload twice more.
+for CHUNKS in 1 2 4 8; do
+  BENCH_CONFIGS= BENCH_ENGINE=mesh2d BENCH_MESH=2x4 \
+      BENCH_MERGE_TREE=pipelined BENCH_WIRE_CHUNKS=$CHUNKS \
+      BENCH_WIRE_SPARSE=0 BENCH_SCALE=22 BENCH_K=64 BENCH_REPEATS=3 \
+      BENCH_EXTRA_KS= BENCH_RUN_S=3600 python bench.py \
+      2> "$RAW/pipe_${CHUNKS}.stderr" | tee "$RAW/pipe_${CHUNKS}.json" || true
+done
+
 echo "== 3. 2D-vs-1D wall clock on real ICI (the headline scale-out claim)"
 # The 1D row: the same workload through the vertex-sharded dense-halo
 # engine (MSBFS_VSHARD) via the CLI for an apples-to-apples product path.
@@ -68,7 +96,7 @@ MSBFS_MESH=2x4 MSBFS_FAULT=chip:rank0:2 MSBFS_FAULT_SEED=0 MSBFS_STATS=1 \
     2>&1 | tee "$RAW/reshard_pause.txt" || true
 
 echo "== 5. simulated-mesh twin for the archive (byte-exact, any host)"
-BENCH_CONFIGS=7,7t,7l BENCH_RUN_S=3600 \
+BENCH_CONFIGS=7,7t,7l,7s BENCH_RUN_S=3600 \
     BENCH_DETAIL_PATH="$RAW/multichip_sim_detail.json" python bench.py \
     2> "$RAW/multichip_sim.stderr" | tee "$RAW/multichip_sim.json" || true
 

@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""Cross-round benchmark trajectory table + headline regression gate.
+
+Every driver round leaves a ``BENCH_r<NN>.json`` record at the repo root
+(``{n, cmd, rc, tail, parsed}`` — ``parsed`` is bench.py's last JSON
+line: headline ``metric``/``value`` plus ``detail.sweep`` with one
+``{value, error}`` row per BASELINE config).  Nothing reads them ACROSS
+rounds, so a regression that lands between two TPU sessions — a config
+that quietly got slower while the headline config held — only surfaces
+when someone eyeballs two JSON blobs by hand.
+
+This script is that cross-round read:
+
+  1. TABLE — one row per config ever measured (plus the headline),
+     one column per round, GTEPS-formatted, so the trajectory of every
+     config is a single glance (``--table`` alone never gates).
+  2. GATE — the headline config's latest measured value must be within
+     ``--threshold`` (default 10%) of its best PRIOR round.  The
+     comparison is per-CONFIG, not per-record-position: round records
+     whose headline fell back to a different config (r06's sweep ran
+     only the MXU configs, so its top-level value is config 6's) would
+     otherwise "regress" by orders of magnitude against a different
+     workload.  A config absent from the latest round is skipped with a
+     warning — an unmeasured config is a coverage gap, not a measured
+     regression.
+
+Exit 0 when every comparable config holds; exit 1 with a per-config
+report on any >threshold drop.  The final stdout line is one JSON
+record (``{"rounds", "compared", "violations", ...}``) so the
+perf-smoke trend row can consume it without re-parsing the table.
+
+Deliberately jax-free: this runs as a perf-smoke row on every
+``make test``, and parsing a handful of JSON files must never pay an
+accelerator-runtime import.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# Configs whose regressions gate (the headline family): config 2 is the
+# BASELINE headline workload; the others each anchor a subsystem round.
+# Diagnostic variants (2c, 7t, 7l, ...) ride the table but not the gate
+# — they exist to explain the anchors, not to pin them.
+GATED_CONFIGS = ("2", "4", "5", "6", "7", "7s", "8")
+
+
+def load_rounds(root):
+    """[(round_number, parsed-record-or-None)] sorted by round, from the
+    driver's BENCH_r*.json artifacts."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            rounds.append((int(m.group(1)), None))
+            continue
+        rounds.append((int(m.group(1)), rec.get("parsed") or None))
+    return rounds
+
+
+def config_values(parsed):
+    """{config_id: value} for one round's parsed record: the per-config
+    sweep rows, plus "headline" for the top-level value.  Rounds before
+    sweep mode (r01-r04) only carry the headline."""
+    out = {}
+    if not parsed:
+        return out
+    if isinstance(parsed.get("value"), (int, float)):
+        out["headline"] = parsed["value"]
+    sweep = (parsed.get("detail") or {}).get("sweep") or {}
+    for cfg, row in sweep.items():
+        if isinstance(row, dict) and isinstance(
+            row.get("value"), (int, float)
+        ):
+            out[cfg] = row["value"]
+    return out
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if v >= 1e9:
+        return f"{v / 1e9:.2f}G"
+    if v >= 1e6:
+        return f"{v / 1e6:.1f}M"
+    if v >= 1e3:
+        return f"{v / 1e3:.0f}k"
+    return str(int(v))
+
+
+def _config_order(cfg):
+    # "headline" first, then BASELINE id order (numeric, then suffix).
+    if cfg == "headline":
+        return (0, 0, "")
+    m = re.match(r"(\d+)(.*)", cfg)
+    return (1, int(m.group(1)), m.group(2)) if m else (2, 0, cfg)
+
+
+def trajectory(rounds):
+    """(config ids in display order, {cfg: {round: value}})."""
+    table = {}
+    for rnum, parsed in rounds:
+        for cfg, val in config_values(parsed).items():
+            table.setdefault(cfg, {})[rnum] = val
+    return sorted(table, key=_config_order), table
+
+
+def print_table(rounds, configs, table, out=sys.stdout):
+    rnums = [r for r, _ in rounds]
+    head = ["config"] + [f"r{r:02d}" for r in rnums]
+    rows = [
+        [cfg] + [_fmt(table[cfg].get(r)) for r in rnums] for cfg in configs
+    ]
+    widths = [
+        max(len(head[i]), *(len(row[i]) for row in rows)) if rows
+        else len(head[i])
+        for i in range(len(head))
+    ]
+    for line in [head] + rows:
+        print(
+            "  ".join(c.rjust(widths[i]) for i, c in enumerate(line)),
+            file=out,
+        )
+
+
+def gate(rounds, table, threshold):
+    """(compared, violations): per-config latest-vs-best-prior check on
+    the gated anchors.  A config needs >= 2 measured rounds to compare;
+    one measured round is a baseline being established, not a trend."""
+    compared, violations = 0, []
+    for cfg in GATED_CONFIGS:
+        hist = sorted((table.get(cfg) or {}).items())
+        if len(hist) < 2:
+            continue
+        (_, latest), prior = hist[-1], [v for _, v in hist[:-1]]
+        best = max(prior)
+        compared += 1
+        if latest < best * (1.0 - threshold):
+            violations.append(
+                f"config {cfg}: r{hist[-1][0]:02d} {_fmt(latest)} is "
+                f"{100 * (1 - latest / best):.1f}% below best prior "
+                f"{_fmt(best)} (threshold {100 * threshold:.0f}%)"
+            )
+    return compared, violations
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--root",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding the BENCH_r*.json records (repo root)",
+    )
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.10,
+        help="gated fractional drop vs best prior round (default 0.10)",
+    )
+    ap.add_argument(
+        "--table",
+        action="store_true",
+        help="print the trajectory table only; never gate",
+    )
+    args = ap.parse_args(argv)
+
+    rounds = load_rounds(args.root)
+    configs, table = trajectory(rounds)
+    if not rounds:
+        print(f"trend: no BENCH_r*.json under {args.root}", file=sys.stderr)
+        print(json.dumps({"rounds": 0, "compared": 0, "violations": 0}))
+        return 0
+
+    print_table(rounds, configs, table)
+    if args.table:
+        return 0
+
+    compared, violations = gate(rounds, table, args.threshold)
+    for v in violations:
+        print("REGRESSION " + v, file=sys.stderr)
+    missing = [
+        cfg
+        for cfg in GATED_CONFIGS
+        if cfg in table and rounds[-1][0] not in table[cfg]
+    ]
+    if missing:
+        print(
+            "trend: not measured in latest round (coverage gap, not "
+            "gated): " + ", ".join(missing),
+            file=sys.stderr,
+        )
+    print(
+        json.dumps(
+            {
+                "rounds": len(rounds),
+                "compared": compared,
+                "violations": len(violations),
+                "missing_latest": missing,
+            }
+        )
+    )
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

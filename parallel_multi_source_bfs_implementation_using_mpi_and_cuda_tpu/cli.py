@@ -521,10 +521,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             # col-axis OR-reduce-scatter — payload scales with n/(R*C)
             # instead of the 1D row shard's full-frontier allgather.
             # MSBFS_MERGE_TREE picks the col-axis reduction tree
-            # (auto/oneshot/ring/halving).  Engine selection goes through
+            # (auto/oneshot/ring/halving/pipelined); MSBFS_WIRE_SPARSE /
+            # MSBFS_WIRE_CHUNKS shape the density-adaptive wire format,
+            # and MSBFS_MESH_RESIDENCY=streamed keeps the tile forest in
+            # host RAM (over-HBM tile sets), which ADDS the "streamed"
+            # token to the required capability set — the composition is
+            # negotiated, not hand-wired.  Engine selection goes through
             # capability negotiation (ops.engine.negotiate_engine) so the
             # route fails loud if no registered engine can serve a 2D
-            # mesh with live reshard.
+            # mesh with live reshard (and streamed residency when asked).
             from .ops.engine import negotiate_engine
             from .parallel.mesh import make_mesh2d, parse_mesh_spec
             from .parallel.partition2d import Mesh2DEngine
@@ -536,11 +541,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"MSBFS_MESH={mesh_spec} wants {rows * cols} chips "
                         f"but -gn selected {n_chips}"
                     )
+                residency = (
+                    knobs.raw("MSBFS_MESH_RESIDENCY") or "hbm"
+                ).strip().lower()
+                required = {"mesh2d", "reshard"}
+                if residency == "streamed":
+                    required.add("streamed")
                 _, engine = negotiate_engine(
-                    {"mesh2d", "reshard"},
+                    required,
                     [
                         (
-                            "mesh2d",
+                            "mesh2d+streamed"
+                            if residency == "streamed"
+                            else "mesh2d",
                             Mesh2DEngine,
                             lambda: Mesh2DEngine(
                                 make_mesh2d(
@@ -552,6 +565,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                     knobs.raw("MSBFS_MERGE_TREE")
                                     or None
                                 ),
+                                residency=residency,
                             ),
                         ),
                     ],

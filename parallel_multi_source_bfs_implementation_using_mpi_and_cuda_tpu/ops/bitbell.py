@@ -269,6 +269,27 @@ def bit_level_init(
     )
 
 
+def bit_level_apply(carry, new, counts_of=unpack_counts):
+    """Fold one level's newly-reached planes into the 7-tuple carry — the
+    accounting half of :func:`bit_level_body` with the expansion hoisted
+    out, so drive loops that interleave the expansion with side outputs
+    (the streamed per-level apply, the 2D wire-format loop's byte ledger)
+    share the exact counter/F/level arithmetic instead of re-deriving it."""
+    visited, frontier, f, levels, reached, level, _ = carry
+    counts = counts_of(new)
+    found = counts > 0
+    dist = level + 1  # newly discovered vertices are at this distance
+    return (
+        visited | new,
+        new,
+        f + counts.astype(jnp.int64) * dist.astype(jnp.int64),
+        jnp.where(found, dist + 1, levels),
+        reached + counts,
+        level + 1,
+        jnp.any(found),
+    )
+
+
 def bit_level_body(expand, counts_of=unpack_counts):
     """One BFS level over the 7-tuple carry.  ``counts_of`` maps the
     newly-reached planes ``expand`` returns to per-query discovery counts —
@@ -276,20 +297,7 @@ def bit_level_body(expand, counts_of=unpack_counts):
     when each shard returns only its own vertex block."""
 
     def body(carry):
-        visited, frontier, f, levels, reached, level, _ = carry
-        new = expand(visited, frontier)
-        counts = counts_of(new)
-        found = counts > 0
-        dist = level + 1  # newly discovered vertices are at this distance
-        return (
-            visited | new,
-            new,
-            f + counts.astype(jnp.int64) * dist.astype(jnp.int64),
-            jnp.where(found, dist + 1, levels),
-            reached + counts,
-            level + 1,
-            jnp.any(found),
-        )
+        return bit_level_apply(carry, expand(carry[0], carry[1]), counts_of)
 
     return body
 

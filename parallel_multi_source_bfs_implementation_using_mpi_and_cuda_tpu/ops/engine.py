@@ -135,7 +135,12 @@ class QueryEngineBase:
       * ``reshard`` — ``without_ranks`` rebuilds onto survivors after a
         chip loss (the supervisor's degrade-to-survivors path);
       * ``collective_bytes`` — per-level ICI payload is recorded through
-        utils.timing.record_collective_bytes (the wire-roofline model).
+        utils.timing.record_collective_bytes (the wire-roofline model);
+      * ``streamed`` — the graph structure can stay host-resident and
+        stream through the device per level (over-HBM residency: the
+        single-chip ops.streamed engine, and Mesh2DEngine's
+        ``residency="streamed"`` composition — routes ask for
+        ``mesh2d`` + ``streamed`` together rather than a bespoke engine).
     """
 
     CAPABILITIES: frozenset = frozenset()

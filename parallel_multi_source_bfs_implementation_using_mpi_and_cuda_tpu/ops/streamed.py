@@ -59,12 +59,33 @@ from .bell import _slot_segments
 from .bitbell import (
     WORD_BITS,
     _or_fold,
+    bit_level_apply,
     bit_level_init,
     fused_select,
     pack_queries,
     unpack_counts,
 )
 from .packed import PackedEngineBase
+
+
+def prefetched_uploads(items, put, depth: int):
+    """Yield ``put(item)`` results in order with a ``depth``-deep upload
+    window: the upload of item i+depth is ISSUED before item i is yielded
+    for compute, so an async ``put`` (``jax.device_put`` on TPU rides the
+    DMA engines) overlaps the consumer's in-flight programs — the
+    double-buffering core shared by the single-chip streamed forest pass
+    and the mesh2d streamed-residency drive (parallel.partition2d)."""
+    depth = max(1, int(depth))
+    window = deque()
+    n = len(items)
+    for i in range(min(depth, n)):
+        window.append(put(items[i]))
+    for i in range(n):
+        cur = window.popleft()
+        nxt = i + depth
+        if nxt < n:
+            window.append(put(items[nxt]))
+        yield cur
 
 
 def _env_int(name: str, default: int) -> int:
@@ -119,24 +140,11 @@ def _final_hits(final_slot, *outs):
 
 @donating_jit(donate_argnums=(0,))
 def _apply_level(carry, hits):
-    """ops.bitbell.bit_level_body with the forest pass hoisted OUT (it ran
-    as the streamed segment programs); folds the hit planes into the
+    """ops.bitbell.bit_level_apply with the forest pass hoisted OUT (it
+    ran as the streamed segment programs); folds the hit planes into the
     carry.  Carry DONATED: the host loop rebinds it before reading device
     state again (utils.donation)."""
-    visited, frontier, f, levels, reached, level, _ = carry
-    new = hits & ~visited
-    counts = unpack_counts(new)
-    found = counts > 0
-    dist = level + 1
-    return (
-        visited | new,
-        new,
-        f + counts.astype(jnp.int64) * dist.astype(jnp.int64),
-        jnp.where(found, dist + 1, levels),
-        reached + counts,
-        level + 1,
-        jnp.any(found),
-    )
+    return bit_level_apply(carry, hits & ~carry[0])
 
 
 _select_jit = jax.jit(fused_select)
@@ -158,6 +166,8 @@ class StreamedBitBellEngine(PackedEngineBase):
     (tests/test_engines_agree.py) and the streamed arm of
     tests/test_dispatch_opt.py.
     """
+
+    CAPABILITIES = frozenset({"streamed"})
 
     k_align = WORD_BITS
 
@@ -228,24 +238,16 @@ class StreamedBitBellEngine(PackedEngineBase):
         if not self._plan:  # n == 0: nothing to hit
             return frontier
         w = frontier.shape[1]
-        slices = self._slices
-        window = deque()
-        for i in range(min(self.prefetch, len(slices))):
-            window.append(jax.device_put(slices[i]))
+        # Uploads are issued ahead of compute by the shared prefetch
+        # window: device_put is async, so segment s+prefetch's transfer
+        # overlaps segment s's gather/OR program below.
+        feed = prefetched_uploads(self._slices, jax.device_put, self.prefetch)
         outs = []
         v_prev_ext = _extend(frontier)
-        si = 0
         for segs in self._plan:
             parts = []
             for pieces in segs:
-                cols = window.popleft()
-                # Issue the lookahead upload BEFORE computing on the
-                # current segment: device_put is async, so the transfer
-                # overlaps the gather/OR program below.
-                nxt = si + self.prefetch
-                if nxt < len(slices):
-                    window.append(jax.device_put(slices[nxt]))
-                si += 1
+                cols = next(feed)
                 parts.append(_segment_or(v_prev_ext, cols, pieces))
             if not parts:
                 out = self._empty_planes(w)

@@ -22,6 +22,33 @@ costs
 Per-level traffic is (R + C - 2)/(R * C) of the 1D path's (p - 1)/p —
 the wire diet the make perf-smoke multichip guard pins.
 
+Wire format (round 15).  The dense schedule above ships full word planes
+even when the frontier is one road-graph wavefront occupying a handful
+of words.  Three composed optimizations close that gap, all bit-exact:
+
+  * DENSITY-ADAPTIVE SPARSE WIRE: per level, the active-word count
+    (ops.engine.frontier_activity at word granularity) is compared
+    mesh-wide against a pair budget (MSBFS_WIRE_SPARSE, auto = Lsub*W/8);
+    under budget, the row gather and the col ring both ship budget-padded
+    ``(index, word)`` pairs (:func:`encode_words_sparse`) instead of the
+    dense planes, with an exact dense fallback the moment any device
+    would overflow.  The bytes the taken branch actually moves ride the
+    carry's wire ledger into utils.timing.record_collective_bytes —
+    measured, not modeled.
+  * PIPELINED STRIPES (merge_tree="pipelined"): the word plane splits
+    into MSBFS_WIRE_CHUNKS stripes, each running its own ring row
+    exchange -> tile pass -> ring col reduce chain, so XLA's
+    latency-hiding scheduler overlaps stripe i+1's ppermute hops with
+    stripe i's forest pass.  Same bytes as the ring tree.
+  * STREAMED RESIDENCY (residency="streamed"): the harmonized tile
+    forest stays in host RAM (ops.streamed's double-buffered upload
+    pipeline, prefetch depth MSBFS_STREAM_PREFETCH) so the per-chip tile
+    set may exceed HBM; the first tile uploads are issued right behind
+    the asynchronously-dispatched ICI frontier exchange, overlapping
+    host->device DMA with the collective in flight.  Routed through
+    ops.engine.negotiate_engine with the ``mesh2d`` + ``streamed``
+    capability tokens — a composition, not a seventh engine.
+
 Layout.  Lsub = ceil(n / (R*C)); device (i, j) OWNS the global vertex
 segment s = j*R + i, rows [s*Lsub, (s+1)*Lsub).  That cyclic segment
 numbering makes the level loop transpose-free:
@@ -52,7 +79,7 @@ full-mesh run (BFS level counts are exact integers under any partition).
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -65,12 +92,21 @@ from ..models.csr import CSRGraph
 from ..ops.bitbell import (
     _or_fold,
     bell_hits_or,
-    bit_level_chunk,
+    bit_level_apply,
     bit_level_init,
     pack_queries,
     unpack_counts,
 )
-from ..ops.engine import QueryEngineBase
+from ..ops.engine import QueryEngineBase, frontier_activity
+from ..ops.push import compact_indices
+from ..ops.streamed import (
+    _extend,
+    _final_hits,
+    _segment_or,
+    _stream_status,
+    prefetched_uploads,
+)
+from ..utils import knobs
 from ..utils.faults import trip
 from ..utils.timing import record_collective_bytes, record_dispatch
 from .mesh import COL_AXIS, ROW_AXIS, make_mesh2d
@@ -81,7 +117,16 @@ from .sharded_bell import harmonize_forests
 # is exactly segment s = j*R + i, so device (i, j) holds its own segment.
 _PLANE_SPEC = P((COL_AXIS, ROW_AXIS))
 
-MERGE_TREES = ("auto", "oneshot", "ring", "halving", "none")
+# Streamed-residency intermediates (uploaded col slices, forest level
+# outputs, the padded col-block): every device carries a same-shape block
+# that is NOT a replica of its neighbors', so dim 0 stacks all R*C blocks
+# 'r'-major — per-device state without fighting the replication checker.
+_TILE_SPEC = P((ROW_AXIS, COL_AXIS))
+
+MERGE_TREES = ("auto", "oneshot", "ring", "halving", "pipelined", "none")
+
+# One sparse wire entry = (int32 flat word index, uint32 word).
+WIRE_PAIR_BYTES = 8
 
 
 def select_merge_tree(c_size: int, override: Optional[str] = None) -> str:
@@ -92,12 +137,19 @@ def select_merge_tree(c_size: int, override: Optional[str] = None) -> str:
     (C-1 single-hop steps, same bytes, no power-of-two requirement);
     ``oneshot`` (one all_gather + fold, 1 step but (C-1)*Lr words) is
     explicit-only — it wins only when latency dominates tiny payloads.
-    A degenerate axis (C == 1) needs no reduction at all."""
+    ``pipelined`` (explicit-only, any axis size) stripes the word plane
+    over ring exchanges so transfers overlap the tile pass — ring bytes,
+    software-pipelined schedule (arxiv 2112.01075's chunked
+    redistribution).  A degenerate axis (C == 1) needs no reduction at
+    all — but ``pipelined`` keeps its striped ROW exchange there, so it
+    survives the C == 1 collapse."""
     t = (override or "auto").strip().lower()
     if t not in MERGE_TREES:
         raise ValueError(
             f"merge tree {override!r} not in {MERGE_TREES}"
         )
+    if t == "pipelined":
+        return t
     if c_size <= 1:
         return "none"
     if t == "none":
@@ -114,20 +166,92 @@ def select_merge_tree(c_size: int, override: Optional[str] = None) -> str:
 def level_collective_bytes(
     rows: int, cols: int, lsub: int, words: int, tree: str
 ) -> int:
-    """Whole-mesh wire payload ONE 2D level moves (the analytic quantity
-    utils.timing.record_collective_bytes accounts): every device receives
-    (R-1) segments in the row-axis frontier gather plus the tree's
-    col-axis reduce-scatter traffic — (C-1)*Lsub words on ring/halving,
-    (C-1)*Lr on the one-shot gather-and-fold."""
+    """Whole-mesh wire payload ONE dense 2D level moves (the analytic
+    quantity utils.timing.record_collective_bytes accounts): every device
+    receives (R-1) segments in the row-axis frontier gather plus the
+    tree's col-axis reduce-scatter traffic — (C-1)*Lsub words on
+    ring/halving (``pipelined`` stripes the same ring hops, identical
+    bytes), (C-1)*Lr on the one-shot gather-and-fold."""
     seg = lsub * words * 4
     r_recv = (rows - 1) * seg
-    if tree in ("ring", "halving"):
+    if tree in ("ring", "halving", "pipelined"):
         c_recv = (cols - 1) * seg
     elif tree == "oneshot":
         c_recv = (cols - 1) * cols * seg  # Lr = C * Lsub rows gathered
     else:  # "none": degenerate C == 1 axis
         c_recv = 0
     return rows * cols * (r_recv + c_recv)
+
+
+def resolve_wire_budget(
+    spec: Union[None, int, str], lsub: int, words: int
+) -> int:
+    """MSBFS_WIRE_SPARSE grammar -> the sparse wire budget in (index,
+    word) pairs per (Lsub, W) segment.  Unset / ``auto``: Lsub*W/8 — the
+    ~1/8-active-words density knee where 8-byte pairs beat 4-byte dense
+    words with 2x headroom for the index half.  ``0`` / ``off`` disables
+    the sparse path; a positive integer pins the budget exactly.
+    Malformed values fall back to auto (the registry-wide knob
+    convention: a typo must not silently change which branch runs)."""
+    auto = max(1, (lsub * words) // 8)
+    if spec is None:
+        return auto
+    if isinstance(spec, (int, np.integer)):
+        return max(0, int(spec))
+    s = str(spec).strip().lower()
+    if s in ("", "auto"):
+        return auto
+    if s == "off":
+        return 0
+    try:
+        return max(0, int(s))
+    except ValueError:
+        return auto
+
+
+def active_word_count(plane: jax.Array) -> jax.Array:
+    """Exact nonzero-word count of an (L, W) bit plane: the wire format's
+    density measurement — ops.engine.frontier_activity (the seam every
+    direction decision in the repo shares) applied at WORD granularity by
+    viewing each uint32 word as its own one-lane row, because the sparse
+    encoding ships words and the budget test must be exact: an undetected
+    overflow would silently drop frontier bits, not just waste bytes."""
+    words = plane.reshape(-1, 1)
+    _, cnt, _ = frontier_activity(
+        words, jnp.zeros((words.shape[0],), dtype=jnp.int32)
+    )
+    return cnt
+
+
+def encode_words_sparse(plane: jax.Array, budget: int):
+    """Budget-padded sparse wire encoding of an (L, W) word plane:
+    ``(budget,)`` int32 ascending flat indices of the nonzero words
+    (sentinel L*W beyond the population) and the ``(budget,)`` matching
+    words (zero at sentinels).  EXACT iff the plane has at most
+    ``budget`` nonzero words — ops.push.compact_indices drops the
+    overflow, so callers gate on :func:`active_word_count` BEFORE
+    trusting the encoding; :func:`decode_words_sparse` inverts it
+    bit-for-bit inside the budget."""
+    total = plane.shape[0] * plane.shape[1]
+    flat = plane.reshape(total)
+    idx = compact_indices(flat != 0, budget, fill_value=total)
+    words = jnp.where(
+        idx < total, jnp.take(flat, jnp.minimum(idx, total - 1)), 0
+    ).astype(plane.dtype)
+    return idx, words
+
+
+def decode_words_sparse(idx: jax.Array, words: jax.Array, total: int):
+    """Sparse (index, word) pairs -> the ``(total,)`` flat word buffer.
+    Sentinel entries (index >= total) land on one scratch slot that is
+    sliced off; real indices are unique (one encoder slot per nonzero
+    word), so the scatter is order-independent — ``.max`` rather than
+    ``.add`` keeps it idempotent when callers concatenate several
+    segments' pair lists (the row gather), whose sentinels all collide
+    on the scratch slot."""
+    buf = jnp.zeros((total + 1,), dtype=words.dtype)
+    buf = buf.at[jnp.minimum(idx, total)].max(words)
+    return buf[:total]
 
 
 class Partition2D:
@@ -137,7 +261,11 @@ class Partition2D:
     ``lsub``: rows per owned segment; ``n_pad = R*C*lsub``; ``lr``/``lc``:
     tile output-row / input-col extents; ``lt``: the square padded tile
     space the forests run over.  ``stacked`` leaves carry leading (R, C)
-    axes ready for P('r', 'c') placement."""
+    axes ready for P('r', 'c') placement.  ``device=False`` keeps the
+    per-tile builds AND the stacked leaves host-side (NumPy) for the
+    streamed mesh residency, whose tile set may exceed a chip's HBM and
+    must never be committed wholesale (same contract as
+    models.bell.BellGraph.from_host(device=False))."""
 
     def __init__(
         self,
@@ -146,6 +274,7 @@ class Partition2D:
         cols: int,
         widths: Sequence[int] = DEFAULT_WIDTHS,
         min_bucket_rows: Optional[int] = None,
+        device: bool = True,
     ):
         self.rows, self.cols = rows, cols
         p = rows * cols
@@ -174,6 +303,7 @@ class Partition2D:
                 dedup=False,
                 min_bucket_rows=0,
                 keep_sparse=False,  # the 2D loop is pull-only
+                device=device,
             )
             for i in range(rows)
             for j in range(cols)
@@ -183,6 +313,11 @@ class Partition2D:
         self.stacked = jax.tree.map(
             lambda x: x.reshape(rows, cols, *x.shape[1:]), flat
         )
+        if not device:
+            # harmonize_forests packs onto the default device; pull the
+            # leaves straight back so an over-HBM tile set is only ever
+            # transiently resident, one packed level at a time.
+            self.stacked = jax.tree.map(np.asarray, self.stacked)
 
     def _tile_csr(self, g: CSRGraph, i: int, j: int) -> CSRGraph:
         """Tile (i, j): adjacency rows of row-block i (pull destinations,
@@ -287,68 +422,290 @@ def _or_reduce_scatter(x, c_size: int, lsub: int, tree: str):
     raise ValueError(f"unknown reduction tree {tree!r}")
 
 
-def _mesh2d_expand_own(
-    local: BellGraph, rows: int, cols: int, lsub: int, tree: str
+def _sparse_or_reduce_scatter(x, c_size: int, lsub: int, budget: int):
+    """The ring OR-reduce-scatter with budget-padded sparse hop payloads:
+    identical hop schedule to the dense ring (chunk c travels C-1 single
+    hops, OR-ing each visited device's local chunk), but every hop ships
+    the running partial as (index, word) pairs.  Exact whenever every
+    partial fits the budget — the caller's predicate bounds the union's
+    active words by the col-axis SUM of per-device chunk counts, which
+    dominates every partial OR along the ring."""
+    me = lax.axis_index(COL_AXIS)
+    w = x.shape[1]
+    total = lsub * w
+
+    def chunk_at(idx):
+        return lax.dynamic_slice_in_dim(x, idx * lsub, lsub, axis=0)
+
+    perm = [(t, (t + 1) % c_size) for t in range(c_size)]
+    acc = chunk_at((me + c_size - 1) % c_size)
+    for s in range(c_size - 1):
+        idx, words = encode_words_sparse(acc, budget)
+        idx = lax.ppermute(idx, COL_AXIS, perm)
+        words = lax.ppermute(words, COL_AXIS, perm)
+        acc = decode_words_sparse(idx, words, total).reshape(lsub, w)
+        acc = acc | chunk_at((me + 2 * c_size - 2 - s) % c_size)
+    return acc
+
+
+def _sparse_row_gather(frontier_own, rows: int, lsub: int, budget: int):
+    """Sparse row-axis frontier exchange: each device ships its own
+    (Lsub, W) segment as budget-padded (index, word) pairs; the gathered
+    pair lists are rebased to col-block flat coordinates and scattered
+    into the (Lc, W) col-block in one pass — bit-identical to the tiled
+    dense all_gather whenever every segment fits the budget (the
+    caller's mesh-wide predicate guarantees it)."""
+    w = frontier_own.shape[1]
+    total = lsub * w
+    idx, words = encode_words_sparse(frontier_own, budget)
+    g_idx = lax.all_gather(idx, ROW_AXIS)  # (R, budget)
+    g_words = lax.all_gather(words, ROW_AXIS)
+    offs = jnp.arange(rows, dtype=jnp.int32) * total
+    # Re-clamp sentinels AFTER rebasing: segment i's sentinel (``total``)
+    # plus its offset would alias segment i+1's word 0.
+    glob = jnp.where(g_idx < total, g_idx + offs[:, None], rows * total)
+    flat = decode_words_sparse(
+        glob.reshape(-1), g_words.reshape(-1), rows * total
+    )
+    return flat.reshape(rows * lsub, w)
+
+
+def _pipelined_own_hits(
+    frontier_own, local: BellGraph, rows: int, cols: int, lsub: int,
+    n_stripes: int,
 ):
-    """Own-segment 2D expansion: assemble col-block j's frontier with the
-    row-axis gather, run the tile forest over the padded square space,
-    and reduce-scatter the row-block partial hits back to own segments.
-    The own-segment formulation carries (Lsub, W) planes per device
-    between dispatches — never a full (n_pad, W) replica."""
+    """Software-pipelined dense level: the word plane splits into
+    ``n_stripes`` column stripes, each running its own ring row gather ->
+    tile forest pass -> ring col reduce-scatter chain.  The chains share
+    only the tile forest, so XLA's latency-hiding scheduler can overlap
+    stripe i+1's ppermute hops with stripe i's forest pass — ring-tree
+    bytes, better wire/compute occupancy.  Bit-identity is structural:
+    every stripe computes exactly the dense path restricted to its word
+    columns, and OR never mixes words."""
+    w = frontier_own.shape[1]
+    lc = rows * lsub
+    lr = cols * lsub
+    lt = local.n
+    bounds = [w * t // n_stripes for t in range(n_stripes + 1)]
+    me = lax.axis_index(ROW_AXIS)
+    perm = [(t, (t + 1) % rows) for t in range(rows)]
+    outs = []
+    for t in range(n_stripes):
+        lo, hi = bounds[t], bounds[t + 1]
+        if lo == hi:  # more stripes than words
+            continue
+        stripe = lax.slice_in_dim(frontier_own, lo, hi, axis=1)
+        if rows == 1:
+            block = stripe
+        else:
+            # Ring row gather: after hop s the buffer holds the stripe
+            # of device (me - s - 1) mod R; scatter each arrival into
+            # its segment slot of the col block.
+            block = jnp.zeros((lc, hi - lo), dtype=stripe.dtype)
+            block = lax.dynamic_update_slice_in_dim(
+                block, stripe, me * lsub, axis=0
+            )
+            buf = stripe
+            for s in range(rows - 1):
+                buf = lax.ppermute(buf, ROW_AXIS, perm)
+                src = (me - s - 1) % rows
+                block = lax.dynamic_update_slice_in_dim(
+                    block, buf, src * lsub, axis=0
+                )
+        if lt > lc:
+            block = jnp.pad(block, ((0, lt - lc), (0, 0)))
+        hits = bell_hits_or(block, local)[:lr]
+        outs.append(_or_reduce_scatter(hits, cols, lsub, "ring"))
+    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+
+
+def _mesh2d_expand_wire(
+    local: BellGraph, rows: int, cols: int, lsub: int, tree: str, wire
+):
+    """The wire-format-aware 2D expansion: (visited_own, frontier_own) ->
+    (newly-reached own planes, this level's whole-mesh wire bytes, the
+    sparse-level flag).  ``wire`` = (sparse pair budget, pipelined stripe
+    count), both static.  Every route is bit-identical — only the wire
+    schedule and the byte ledger differ; the predicates are mesh-uniform
+    pmax reductions, so the branch choice and the recorded counters stay
+    replicated (the P() out-spec contract of the drive loop)."""
+    budget, n_stripes = wire
     lc = rows * lsub
     lr = cols * lsub
     lt = local.n
 
-    def expand(visited_own, frontier_own):
-        colblock = lax.all_gather(frontier_own, ROW_AXIS, tiled=True)
+    def pad_block(colblock):
         if lt > lc:
-            colblock = jnp.pad(colblock, ((0, lt - lc), (0, 0)))
-        hits = bell_hits_or(colblock, local)[:lr]
-        own = _or_reduce_scatter(hits, cols, lsub, tree)
-        return own & ~visited_own
+            return jnp.pad(colblock, ((0, lt - lc), (0, 0)))
+        return colblock
+
+    def dense_own(frontier_own):
+        if tree == "pipelined" and n_stripes > 1:
+            return _pipelined_own_hits(
+                frontier_own, local, rows, cols, lsub, n_stripes
+            )
+        colblock = lax.all_gather(frontier_own, ROW_AXIS, tiled=True)
+        hits = bell_hits_or(pad_block(colblock), local)[:lr]
+        # A single-stripe "pipelined" plane degenerates to the ring tree.
+        return _or_reduce_scatter(
+            hits, cols, lsub, "ring" if tree == "pipelined" else tree
+        )
+
+    def expand(visited_own, frontier_own):
+        w = frontier_own.shape[1]
+        dense_bytes = level_collective_bytes(rows, cols, lsub, w, tree)
+        if budget <= 0 or rows * cols == 1:
+            new = dense_own(frontier_own) & ~visited_own
+            return new, jnp.int64(dense_bytes), jnp.int32(0)
+
+        seg_bytes = lsub * w * 4
+        pair = budget * WIRE_PAIR_BYTES
+        row_sparse = rows * cols * (rows - 1) * pair
+        col_sparse = rows * cols * (cols - 1) * pair
+        col_dense_tree = "ring" if tree == "pipelined" else tree
+        col_dense = rows * cols * (cols - 1) * seg_bytes * (
+            cols if col_dense_tree == "oneshot" else 1
+        )
+
+        def sparse_path(args):
+            visited_own, frontier_own = args
+            colblock = (
+                frontier_own
+                if rows == 1
+                else _sparse_row_gather(frontier_own, rows, lsub, budget)
+            )
+            hits = bell_hits_or(pad_block(colblock), local)[:lr]
+            if cols == 1:
+                own = hits
+                col_bytes = jnp.int64(0)
+                flag = jnp.int32(1)
+            else:
+                # Encodability at EVERY ring hop: each partial is an OR
+                # of per-device copies of one chunk, so its active words
+                # are bounded by the col-axis SUM of per-device chunk
+                # counts — if the worst chunk's sum fits, every hop fits.
+                per_chunk = jnp.sum(
+                    (hits != 0).astype(jnp.int32).reshape(cols, lsub * w),
+                    axis=1,
+                )
+                union_bound = lax.psum(per_chunk, COL_AXIS)
+                col_ok = (
+                    lax.pmax(jnp.max(union_bound), (ROW_AXIS, COL_AXIS))
+                    <= budget
+                )
+                own = lax.cond(
+                    col_ok,
+                    lambda h: _sparse_or_reduce_scatter(
+                        h, cols, lsub, budget
+                    ),
+                    lambda h: _or_reduce_scatter(
+                        h, cols, lsub, col_dense_tree
+                    ),
+                    hits,
+                )
+                col_bytes = jnp.where(col_ok, col_sparse, col_dense).astype(
+                    jnp.int64
+                )
+                flag = (
+                    jnp.int32(1)
+                    if rows > 1
+                    else col_ok.astype(jnp.int32)  # R==1: only the col leg
+                )
+            new = own & ~visited_own
+            return new, jnp.int64(row_sparse) + col_bytes, flag
+
+        def dense_path(args):
+            visited_own, frontier_own = args
+            new = dense_own(frontier_own) & ~visited_own
+            return new, jnp.int64(dense_bytes), jnp.int32(0)
+
+        sparse_ok = (
+            lax.pmax(
+                active_word_count(frontier_own), (ROW_AXIS, COL_AXIS)
+            )
+            <= budget
+        )
+        return lax.cond(
+            sparse_ok, sparse_path, dense_path, (visited_own, frontier_own)
+        )
 
     return expand
 
 
+def _wire_level_chunk(carry, expand_wire, chunk, max_levels, counts_of):
+    """ops.bitbell.bit_level_chunk over the 9-slot mesh carry — the
+    shared 7-tuple level loop plus the wire ledger: slot 7 accumulates
+    each level's whole-mesh wire bytes (the branch the density cond
+    ACTUALLY took — measured, not modeled), slot 8 counts the levels the
+    sparse encoding carried."""
+    start = carry[5]
+
+    def cond(c):
+        go = jnp.logical_and(c[6], c[5] < start + chunk)
+        if max_levels is not None:
+            go = jnp.logical_and(go, c[5] < max_levels)
+        return go
+
+    def body(c):
+        new, lvl_bytes, sparse = expand_wire(c[0], c[1])
+        return bit_level_apply(c[:7], new, counts_of) + (
+            c[7] + lvl_bytes,
+            c[8] + sparse,
+        )
+
+    return lax.while_loop(cond, body, carry)
+
+
 @partial(jax.jit, static_argnames=("mesh", "lsub"))
-def _mesh2d_init(mesh: Mesh, forest, queries: jax.Array, lsub: int):
+def _mesh2d_init(mesh: Mesh, queries: jax.Array, lsub: int):
     """Per-device own-segment loop carry: planes (Lsub, W) split over
     ('c','r')-major segments; counters replicated on the whole mesh (the
-    per-level psum spans both axes, so no finish-time merge exists)."""
+    per-level psum spans both axes, so no finish-time merge exists).
+    Slots 7/8 are the wire ledger — int64 bytes moved, int32 sparse
+    levels — shared by both residencies."""
     rows = mesh.shape[ROW_AXIS]
     n_pad = rows * mesh.shape[COL_AXIS] * lsub
 
-    def shard_body(forest, queries):
+    def shard_body(queries):
         frontier0 = pack_queries(n_pad, queries)
         counts0 = unpack_counts(frontier0)
         i = lax.axis_index(ROW_AXIS)
         j = lax.axis_index(COL_AXIS)
         seg = j * rows + i
         own0 = lax.dynamic_slice_in_dim(frontier0, seg * lsub, lsub, axis=0)
-        return bit_level_init(own0, counts0)
+        return bit_level_init(own0, counts0) + (
+            jnp.int64(0),
+            jnp.int32(0),
+        )
 
     return jax.shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(ROW_AXIS, COL_AXIS), P()),
-        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 5,
-    )(forest, queries)
+        in_specs=(P(),),
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 7,
+    )(queries)
 
 
-@partial(jax.jit, static_argnames=("mesh", "lsub", "max_levels", "tree"))
-def _mesh2d_chunk(mesh: Mesh, forest, carry, chunk, lsub: int, max_levels, tree: str):
+@partial(
+    jax.jit, static_argnames=("mesh", "lsub", "max_levels", "tree", "wire")
+)
+def _mesh2d_chunk(
+    mesh: Mesh, forest, carry, chunk, lsub: int, max_levels, tree: str, wire
+):
     """Advance every device's own-segment carry by <= ``chunk`` levels in
     one dispatch.  Per-level discovery counts psum over BOTH mesh axes
     (each segment counted exactly once), so the loop counters — and the
-    convergence flag the host loop syncs — are replicated mesh-wide."""
+    convergence flag the host loop syncs — are replicated mesh-wide.
+    ``wire`` is the static (sparse budget, stripe count) pair keying the
+    compiled wire schedule."""
     rows = mesh.shape[ROW_AXIS]
     cols = mesh.shape[COL_AXIS]
 
     def shard_body(forest, *carry):
         local = jax.tree.map(lambda x: x[0, 0], forest)
-        out = bit_level_chunk(
+        out = _wire_level_chunk(
             carry,
-            _mesh2d_expand_own(local, rows, cols, lsub, tree),
+            _mesh2d_expand_wire(local, rows, cols, lsub, tree, wire),
             chunk,
             max_levels,
             counts_of=lambda new: lax.psum(
@@ -362,8 +719,8 @@ def _mesh2d_chunk(mesh: Mesh, forest, carry, chunk, lsub: int, max_levels, tree:
         mesh=mesh,
         in_specs=(P(ROW_AXIS, COL_AXIS),)
         + (_PLANE_SPEC,) * 2
-        + (P(),) * 5,
-        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 7,
+        + (P(),) * 7,
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 9,
     )(forest, *carry)
 
 
@@ -375,33 +732,141 @@ def _mesh2d_run_chunked(
     max_levels,
     level_chunk: int,
     tree: str,
-    level_bytes: int,
+    wire,
 ):
     """Host-chunked 2D drive loop: bounded per-dispatch work (the same
     high-diameter safety contract as every chunked engine) AND the
-    collective-bytes ledger — the fetched ``max_level`` delta times the
-    analytic per-level wire bytes is exact, not estimated, because the 2D
-    path has a single (gather + reduce-scatter) route per level.  The
-    per-iteration ``trip("dispatch")`` is the chip-loss fault seam: an
-    injected mid-drive device loss surfaces here, between level chunks,
-    exactly where a real ICI failure would."""
-    carry = _mesh2d_init(mesh, forest, queries, lsub)
+    collective-bytes ledger — read from the carry's wire slot, so the
+    recorded bytes are what the density-adaptive branch ACTUALLY moved,
+    per level, not an analytic constant.  The per-iteration
+    ``trip("dispatch")`` is the chip-loss fault seam: an injected
+    mid-drive device loss surfaces here, between level chunks, exactly
+    where a real ICI failure would."""
+    carry = _mesh2d_init(mesh, queries, lsub)
     bound = np.int32(level_chunk)
-    prev = 0
+    prev_bytes = 0
     while True:
         *carry, any_up, max_level = _mesh2d_chunk(
-            mesh, forest, tuple(carry), bound, lsub, max_levels, tree
+            mesh, forest, tuple(carry), bound, lsub, max_levels, tree, wire
         )
         record_dispatch()
         trip("dispatch")
-        now = int(np.asarray(max_level))
-        record_collective_bytes(max(0, now - prev) * level_bytes)
-        prev = now
+        wb = int(np.asarray(carry[7]))
+        record_collective_bytes(max(0, wb - prev_bytes))
+        prev_bytes = wb
         if not int(np.asarray(any_up)):
             break
-        if max_levels is not None and now >= max_levels:
+        if max_levels is not None and int(np.asarray(max_level)) >= max_levels:
             break
     return tuple(carry)
+
+
+# ---- streamed mesh residency (over-HBM tile sets) -------------------------
+
+
+@partial(jax.jit, static_argnames=("mesh", "lsub", "lt"))
+def _mstream_exchange(mesh: Mesh, frontier, lsub: int, lt: int):
+    """Streamed-residency leg A: the row-axis frontier gather (the ICI
+    exchange), dispatched as its own program so the host can issue the
+    first tile uploads while it is in flight — dispatch is async, so the
+    device_put DMA rides behind the collective.  Output is each device's
+    padded (Lt, W) col-block under _TILE_SPEC."""
+    rows = mesh.shape[ROW_AXIS]
+    lc = rows * lsub
+
+    def body(frontier_own):
+        colblock = lax.all_gather(frontier_own, ROW_AXIS, tiled=True)
+        if lt > lc:
+            colblock = jnp.pad(colblock, ((0, lt - lc), (0, 0)))
+        return colblock
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(_PLANE_SPEC,), out_specs=_TILE_SPEC
+    )(frontier)
+
+
+@partial(jax.jit, static_argnames=("mesh", "pieces"))
+def _mstream_level(mesh: Mesh, v_prev, cols, pieces):
+    """Streamed-residency leg B: one forest level's gather/OR over the
+    just-uploaded (R, C, S) col slice — ops.streamed._segment_or on each
+    device's block, sentinel-extended exactly like the single-chip
+    streamed forest pass, so the tile semantics are shared, not cloned."""
+
+    def body(v_prev, cols):
+        return _segment_or(_extend(v_prev), cols[0, 0], pieces)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_TILE_SPEC, P(ROW_AXIS, COL_AXIS)),
+        out_specs=_TILE_SPEC,
+    )(v_prev, cols)
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+def _mstream_empty(mesh: Mesh, like):
+    """(0, W) per-device planes for an empty harmonized forest level (its
+    _extend is the pure sentinel row the next level's padding cols hit)."""
+
+    def body(like_own):
+        return jnp.zeros((0, like_own.shape[-1]), dtype=like_own.dtype)
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(_PLANE_SPEC,), out_specs=_TILE_SPEC
+    )(like)
+
+
+@partial(jax.jit, static_argnames=("mesh", "lsub", "tree"))
+def _mstream_apply(mesh: Mesh, final_slot, carry, outs, lsub: int, tree: str):
+    """Streamed-residency leg C: final-slot gather over the accumulated
+    forest-level outputs, the col-axis OR-reduce-scatter, and the shared
+    carry fold (ops.bitbell.bit_level_apply) — plus the wire ledger and
+    the host loop's [level, updated, bytes] status row in ONE fetchable
+    buffer, so the per-level host sync stays a single blocking read."""
+    rows = mesh.shape[ROW_AXIS]
+    cols = mesh.shape[COL_AXIS]
+    lr = cols * lsub
+    n_carry = len(carry)
+
+    def body(final_slot, *args):
+        c = args[:n_carry]
+        outs_l = args[n_carry:]
+        hits = _final_hits(final_slot[0, 0], *outs_l)[:lr]
+        own = _or_reduce_scatter(
+            hits, cols, lsub, "ring" if tree == "pipelined" else tree
+        )
+        new = own & ~c[0]
+        # The streamed wire is always dense (the sparse encoder saves
+        # nothing once uploads dominate), so the ledger adds the
+        # analytic constant and the sparse counter stays put.
+        lvl_bytes = level_collective_bytes(
+            rows, cols, lsub, new.shape[1], tree
+        )
+        out = bit_level_apply(
+            c[:7],
+            new,
+            counts_of=lambda p: lax.psum(
+                unpack_counts(p), (ROW_AXIS, COL_AXIS)
+            ),
+        ) + (c[7] + jnp.int64(lvl_bytes), c[8])
+        status = jnp.stack(
+            [
+                out[5].astype(jnp.int64),
+                out[6].astype(jnp.int64),
+                out[7],
+            ]
+        )
+        return out + (status,)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS),)
+        + (_PLANE_SPEC,) * 2
+        + (P(),) * 7
+        + (_TILE_SPEC,) * len(outs),
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 8,
+    )(final_slot, *carry, *outs)
 
 
 class Mesh2DEngine(QueryEngineBase):
@@ -411,15 +876,30 @@ class Mesh2DEngine(QueryEngineBase):
     + col-axis reduction tree.
 
     ``merge_tree``: ``auto`` (default policy, :func:`select_merge_tree`)
-    / ``oneshot`` / ``ring`` / ``halving`` — all bit-identical, only the
-    wire schedule differs.  ``level_chunk``: levels per XLA dispatch
-    (always chunked: the host loop is also the byte ledger and the
-    chip-loss seam).  ``w`` is the device count — the supervisor's
-    rebuild cap and survivor accounting read it like every engine."""
+    / ``oneshot`` / ``ring`` / ``halving`` / ``pipelined`` — all
+    bit-identical, only the wire schedule differs.  ``level_chunk``:
+    levels per XLA dispatch (always chunked: the host loop is also the
+    byte ledger and the chip-loss seam).  ``wire_sparse`` /
+    ``wire_chunks`` override MSBFS_WIRE_SPARSE / MSBFS_WIRE_CHUNKS (the
+    density-adaptive wire budget and the pipelined stripe count);
+    ``residency`` overrides MSBFS_MESH_RESIDENCY — ``hbm`` commits the
+    stacked tile forest to the mesh, ``streamed`` keeps it in host RAM
+    and double-buffers uploads behind the ICI exchange (over-HBM tile
+    sets; negotiate with the ``streamed`` capability token).  ``w`` is
+    the device count — the supervisor's rebuild cap and survivor
+    accounting read it like every engine."""
 
     CAPABILITIES = frozenset(
-        {"mesh2d", "vertex_sharded", "reshard", "collective_bytes"}
+        {
+            "mesh2d",
+            "vertex_sharded",
+            "reshard",
+            "collective_bytes",
+            "streamed",
+        }
     )
+
+    RESIDENCIES = ("hbm", "streamed")
 
     def __init__(
         self,
@@ -430,6 +910,9 @@ class Mesh2DEngine(QueryEngineBase):
         min_bucket_rows: Optional[int] = None,
         level_chunk: Optional[int] = None,
         merge_tree: Optional[str] = None,
+        residency: Optional[str] = None,
+        wire_sparse: Union[None, int, str] = None,
+        wire_chunks: Optional[int] = None,
     ):
         if ROW_AXIS not in mesh.shape or COL_AXIS not in mesh.shape:
             raise ValueError(
@@ -450,11 +933,33 @@ class Mesh2DEngine(QueryEngineBase):
         self._widths = widths
         self._min_bucket_rows = min_bucket_rows
         self._merge_tree = merge_tree
-        self.part = Partition2D(
-            graph, self.rows, self.cols, widths, min_bucket_rows
+        res = (
+            residency
+            if residency is not None
+            else (knobs.raw("MSBFS_MESH_RESIDENCY") or "hbm")
         )
-        self.forest = jax.device_put(
-            self.part.stacked, NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+        res = str(res).strip().lower() or "hbm"
+        if res not in self.RESIDENCIES:
+            raise ValueError(
+                f"mesh residency {res!r} not in {self.RESIDENCIES}"
+            )
+        self.residency = res
+        self._wire_spec = (
+            wire_sparse
+            if wire_sparse is not None
+            else knobs.raw("MSBFS_WIRE_SPARSE")
+        )
+        self.wire_chunks = max(
+            1,
+            int(
+                wire_chunks
+                if wire_chunks is not None
+                else knobs.get_int("MSBFS_WIRE_CHUNKS", 4)
+            ),
+        )
+        self.part = Partition2D(
+            graph, self.rows, self.cols, widths, min_bucket_rows,
+            device=(res != "streamed"),
         )
         self.tree = select_merge_tree(self.cols, merge_tree)
         self.max_levels = max_levels
@@ -462,6 +967,42 @@ class Mesh2DEngine(QueryEngineBase):
 
         self.level_chunk = validate_level_chunk(level_chunk) or 8
         self._level_warm_shapes = set()
+        if res == "streamed":
+            # Host-resident forest: final_slot ((R, C, Lt) int32) is the
+            # only committed piece; the flat col slices and their static
+            # piece signatures form the per-level upload schedule, fed
+            # through ops.streamed.prefetched_uploads each BFS level.
+            stacked = self.part.stacked
+            self.forest = None
+            self._stream_sharding = NamedSharding(
+                mesh, P(ROW_AXIS, COL_AXIS)
+            )
+            self._stream_final_slot = jax.device_put(
+                np.asarray(stacked.final_slot), self._stream_sharding
+            )
+            plan: List[Optional[tuple]] = []
+            slices: List[np.ndarray] = []
+            for flat, shapes in zip(
+                stacked.level_cols, stacked.level_shapes
+            ):
+                pieces = tuple((r, wd) for r, wd in shapes if r)
+                if pieces:
+                    plan.append(pieces)
+                    slices.append(
+                        np.ascontiguousarray(np.asarray(flat, np.int32))
+                    )
+                else:
+                    plan.append(None)
+            self._stream_plan = plan
+            self._stream_slices = slices
+            self.prefetch = max(
+                1, knobs.get_int("MSBFS_STREAM_PREFETCH", 2)
+            )
+        else:
+            self.forest = jax.device_put(
+                self.part.stacked,
+                NamedSharding(mesh, P(ROW_AXIS, COL_AXIS)),
+            )
 
     # ---- query prep -------------------------------------------------------
     def _prep(self, queries: np.ndarray):
@@ -483,26 +1024,99 @@ class Mesh2DEngine(QueryEngineBase):
         return placed, k
 
     def level_bytes(self, k: int) -> int:
-        """Analytic whole-mesh wire bytes per level for a K-query batch."""
+        """Analytic whole-mesh DENSE wire bytes per level for a K-query
+        batch — the model the sparse wire's measured ledger is judged
+        against (bench ``detail.multichip.wire.bytes_dense_model``)."""
         words = -(-k // 32)
         return level_collective_bytes(
             self.rows, self.cols, self.part.lsub, words, self.tree
         )
 
+    def _wire_of(self, kpad: int):
+        """The static (sparse budget, stripe count) pair for a padded
+        batch — part of the compiled chunk's cache key."""
+        words = max(1, kpad // 32)
+        budget = resolve_wire_budget(self._wire_spec, self.part.lsub, words)
+        stripes = self.wire_chunks if self.tree == "pipelined" else 0
+        return (budget, stripes)
+
     def _run(self, queries: np.ndarray):
         placed, k = self._prep(queries)
-        carry = _mesh2d_run_chunked(
-            self.mesh,
-            self.forest,
-            placed,
-            self.part.lsub,
-            self.max_levels,
-            self.level_chunk,
-            self.tree,
-            self.level_bytes(k),
-        )
+        if self.residency == "streamed":
+            carry = self._run_streamed(placed)
+        else:
+            carry = _mesh2d_run_chunked(
+                self.mesh,
+                self.forest,
+                placed,
+                self.part.lsub,
+                self.max_levels,
+                self.level_chunk,
+                self.tree,
+                self._wire_of(placed.shape[0]),
+            )
         return carry, k
 
+    # ---- streamed drive ---------------------------------------------------
+    def _stream_level_once(self, carry):
+        """One streamed-residency BFS level: dispatch the ICI exchange,
+        stream the host tile forest through the device BEHIND it (the
+        prefetch window issues uploads before their consumer program,
+        and the exchange itself is still in flight when the first upload
+        starts), then fold the carry.  Returns (carry, status) with
+        ``status`` the device-side (3,) int64 [level, updated, bytes]."""
+        mesh = self.mesh
+        lsub = self.part.lsub
+        colblock = _mstream_exchange(mesh, carry[1], lsub, self.part.lt)
+        feed = prefetched_uploads(
+            self._stream_slices,
+            lambda a: jax.device_put(a, self._stream_sharding),
+            self.prefetch,
+        )
+        v_prev = colblock
+        outs = []
+        for pieces in self._stream_plan:
+            if pieces is None:
+                v_prev = _mstream_empty(mesh, carry[1])
+            else:
+                v_prev = _mstream_level(mesh, v_prev, next(feed), pieces)
+            outs.append(v_prev)
+        *out, status = _mstream_apply(
+            mesh,
+            self._stream_final_slot,
+            tuple(carry),
+            tuple(outs),
+            lsub,
+            self.tree,
+        )
+        return tuple(out), status
+
+    def _run_streamed(self, placed):
+        """The streamed host loop: ONE blocking status fetch per BFS
+        level (the apply's stacked [level, updated, bytes] row), the
+        same convergence contract as the chunked drive, and the same
+        ``trip("dispatch")`` chip-loss seam between levels."""
+        carry = _mesh2d_init(self.mesh, placed, self.part.lsub)
+        status = np.asarray(_stream_status(carry[5], carry[6]))
+        record_dispatch()
+        prev_bytes = 0
+        while True:
+            trip("dispatch")
+            level, updated = int(status[0]), int(status[1])
+            if not updated:
+                break
+            if self.max_levels is not None and level >= self.max_levels:
+                break
+            carry, dev_status = self._stream_level_once(carry)
+            row = np.asarray(dev_status)
+            record_dispatch()
+            wb = int(row[2])
+            record_collective_bytes(max(0, wb - prev_bytes))
+            prev_bytes = wb
+            status = row[:2]
+        return tuple(carry)
+
+    # ---- results ----------------------------------------------------------
     def f_values(self, queries: np.ndarray) -> jax.Array:
         carry, k = self._run(queries)
         return carry[2][:k]
@@ -519,26 +1133,36 @@ class Mesh2DEngine(QueryEngineBase):
 
     def level_stats(self, queries):
         """Per-level trace (MSBFS_STATS=2): the shared stepped driver over
-        this engine's init/chunk programs; counters are replicated, so
+        this engine's init/step programs; counters are replicated, so
         ``finish`` is a read, not a merge."""
         from .distributed import stepped_level_stats
 
         placed, k = self._prep(queries)
+        wire = self._wire_of(placed.shape[0])
 
         def init():
-            return _mesh2d_init(self.mesh, self.forest, placed, self.part.lsub)
+            return _mesh2d_init(self.mesh, placed, self.part.lsub)
 
-        def step(carry):
-            *out, _, _ = _mesh2d_chunk(
-                self.mesh,
-                self.forest,
-                tuple(carry),
-                np.int32(1),
-                self.part.lsub,
-                self.max_levels,
-                self.tree,
-            )
-            return tuple(out)
+        if self.residency == "streamed":
+
+            def step(carry):
+                out, _ = self._stream_level_once(tuple(carry))
+                return out
+
+        else:
+
+            def step(carry):
+                *out, _, _ = _mesh2d_chunk(
+                    self.mesh,
+                    self.forest,
+                    tuple(carry),
+                    np.int32(1),
+                    self.part.lsub,
+                    self.max_levels,
+                    self.tree,
+                    wire,
+                )
+                return tuple(out)
 
         def finish(carry):
             return carry[2][:k], carry[3][:k], carry[4][:k]
@@ -549,6 +1173,61 @@ class Mesh2DEngine(QueryEngineBase):
         self._level_warm_shapes.add(shape)
         return out
 
+    def wire_trace(self, queries):
+        """Per-level wire ledger (bench ``detail.multichip.wire``): drive
+        one level per dispatch and difference the carry's byte / sparse
+        slots, labelling each level by the branch the density cond took.
+        ``bytes_dense_model`` is what the SAME run would have moved with
+        the sparse wire off — the ratio the round-15 perf-smoke row pins
+        at <= 0.5x on the sparse-frontier config."""
+        if self.residency != "hbm":
+            raise ValueError(
+                "wire_trace drives the chunked hbm loop; streamed "
+                "residency records dense bytes by construction"
+            )
+        placed, k = self._prep(queries)
+        wire = self._wire_of(placed.shape[0])
+        carry = _mesh2d_init(self.mesh, placed, self.part.lsub)
+        levels: List[dict] = []
+        prev_b = prev_s = 0
+        while True:
+            *carry, any_up, max_level = _mesh2d_chunk(
+                self.mesh,
+                self.forest,
+                tuple(carry),
+                np.int32(1),
+                self.part.lsub,
+                self.max_levels,
+                self.tree,
+                wire,
+            )
+            record_dispatch()
+            wb = int(np.asarray(carry[7]))
+            sl = int(np.asarray(carry[8]))
+            lvl = int(np.asarray(carry[5]))
+            if lvl > len(levels):  # a level actually ran this dispatch
+                levels.append(
+                    {
+                        "level": lvl,
+                        "encoding": "sparse" if sl > prev_s else "dense",
+                        "bytes": wb - prev_b,
+                    }
+                )
+            prev_b, prev_s = wb, sl
+            if not int(np.asarray(any_up)):
+                break
+            if (
+                self.max_levels is not None
+                and int(np.asarray(max_level)) >= self.max_levels
+            ):
+                break
+        return {
+            "levels": levels,
+            "sparse_levels": prev_s,
+            "bytes_measured": prev_b,
+            "bytes_dense_model": len(levels) * self.level_bytes(k),
+        }
+
     # ---- live resharding --------------------------------------------------
     def without_ranks(self, failed_ranks) -> "Mesh2DEngine":
         """Rebuild the TILED graph on the surviving (R', C) submesh: every
@@ -557,7 +1236,9 @@ class Mesh2DEngine(QueryEngineBase):
         re-cut from the retained host CSR — portable redistribution
         (arxiv 2112.01075): nothing references the lost devices' buffers.
         Raises DeviceError when no full row survives; bit-identity to a
-        from-scratch shard holds by construction (this IS one)."""
+        from-scratch shard holds by construction (this IS one).  The
+        resolved wire format and residency carry over — a reshard must
+        not silently flip the run back to env-derived defaults."""
         from ..runtime.supervisor import DeviceError
 
         failed = {int(r) for r in failed_ranks}
@@ -579,4 +1260,7 @@ class Mesh2DEngine(QueryEngineBase):
             min_bucket_rows=self._min_bucket_rows,
             level_chunk=self.level_chunk,
             merge_tree=self._merge_tree,
+            residency=self.residency,
+            wire_sparse=self._wire_spec,
+            wire_chunks=self.wire_chunks,
         )

@@ -336,6 +336,51 @@ def _mesh2d_1x8(g):
     return Mesh2DEngine(make_mesh2d(1, 8), g)
 
 
+def _mesh2d_sparse(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+        Mesh2DEngine,
+    )
+
+    # Round-15 density-adaptive wire, budget forced high enough that the
+    # sparse (index, word) encoding carries every level of this workload
+    # — both wire legs exercised, bit-identity pinned against the oracle.
+    return Mesh2DEngine(make_mesh2d(2, 4), g, wire_sparse=4096)
+
+
+def _mesh2d_pipelined(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+        Mesh2DEngine,
+    )
+
+    # Round-15 software-pipelined striped exchange (stripes > words on
+    # this K would collapse to one stripe, so chunk at 2 with the sparse
+    # wire off: the pure dense pipelined schedule).
+    return Mesh2DEngine(
+        make_mesh2d(2, 4), g, merge_tree="pipelined", wire_chunks=2,
+        wire_sparse=0,
+    )
+
+
+def _mesh2d_streamed(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+        Mesh2DEngine,
+    )
+
+    # Round-15 over-HBM composition: host-resident tile forest streamed
+    # through the mesh behind the ICI exchange (ops.streamed residency
+    # composed with Partition2D via the negotiated "streamed" token).
+    return Mesh2DEngine(make_mesh2d(2, 4), g, residency="streamed")
+
+
 # The lowk drive-loop variants (chunked/megachunk) and the sub-batch
 # splitter are pinned against the oracle and the bit-plane reference in
 # tests/test_lowk.py; only the base byte-flag arm needs the full
@@ -367,6 +412,9 @@ ENGINES = {
     "mesh2d_ring": _mesh2d_ring,
     "mesh2d_oneshot": _mesh2d_oneshot,
     "mesh2d_1x8": _mesh2d_1x8,
+    "mesh2d_sparse": _mesh2d_sparse,
+    "mesh2d_pipelined": _mesh2d_pipelined,
+    "mesh2d_streamed": _mesh2d_streamed,
 }
 
 
@@ -559,6 +607,8 @@ AUDIT_SLOW = {
     "mesh2d_ring",
     "mesh2d_oneshot",
     "mesh2d_1x8",
+    "mesh2d_pipelined",
+    "mesh2d_streamed",
 }
 
 

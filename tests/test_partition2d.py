@@ -41,7 +41,10 @@ from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.me
 )
 from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
     Mesh2DEngine,
+    decode_words_sparse,
+    encode_words_sparse,
     level_collective_bytes,
+    resolve_wire_budget,
     select_merge_tree,
 )
 from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (
@@ -115,12 +118,61 @@ def test_select_merge_tree_policy():
     assert select_merge_tree(4) == "halving"
     assert select_merge_tree(3) == "ring"
     assert select_merge_tree(2, "oneshot") == "oneshot"
+    # pipelined is explicit-only, works on any axis size, and keeps its
+    # striped row exchange even on a degenerate col axis.
+    assert select_merge_tree(4, "pipelined") == "pipelined"
+    assert select_merge_tree(3, "pipelined") == "pipelined"
+    assert select_merge_tree(1, "pipelined") == "pipelined"
     with pytest.raises(ValueError):
         select_merge_tree(3, "halving")  # not a power of two
     with pytest.raises(ValueError):
         select_merge_tree(4, "none")  # a real axis cannot skip the merge
     with pytest.raises(ValueError):
         select_merge_tree(4, "bogus")
+
+
+def test_resolve_wire_budget_grammar():
+    """The MSBFS_WIRE_SPARSE grammar: auto = Lsub*W/8 pairs, off/0
+    disables, int pins exactly, malformed falls back to auto (a typo
+    must not silently switch the dense fallback off)."""
+    assert resolve_wire_budget(None, 64, 2) == 16
+    assert resolve_wire_budget("auto", 64, 2) == 16
+    assert resolve_wire_budget("", 64, 2) == 16
+    assert resolve_wire_budget("off", 64, 2) == 0
+    assert resolve_wire_budget("0", 64, 2) == 0
+    assert resolve_wire_budget(37, 64, 2) == 37
+    assert resolve_wire_budget(" 37 ", 64, 2) == 37
+    assert resolve_wire_budget("bogus", 64, 2) == 16
+    assert resolve_wire_budget(None, 1, 1) == 1  # auto never hits zero
+
+
+def test_sparse_encoding_roundtrip_density_sweep():
+    """encode/decode property test over the full density range 0 -> 1:
+    the (index, word) encoding is EXACT whenever the plane's nonzero
+    words fit the budget — including the exact boundary budget == active
+    — and detectably lossy one below it (the overflow the drive loop's
+    density gate exists to route around, onto the dense fallback)."""
+    rng = np.random.default_rng(11)
+    rows, words = 24, 3
+    total = rows * words
+    for density in (0.0, 0.05, 1 / 8, 0.25, 0.5, 0.75, 1.0):
+        mask = rng.random((rows, words)) < density
+        vals = rng.integers(1, 1 << 32, size=(rows, words), dtype=np.uint32)
+        plane = np.where(mask, vals, np.uint32(0))
+        active = int((plane != 0).sum())
+        budgets = {max(1, active), active + 3, total + 5, max(1, active - 1)}
+        for budget in budgets:
+            idx, enc = encode_words_sparse(jax.numpy.asarray(plane), budget)
+            out = np.asarray(
+                decode_words_sparse(idx, enc, total)
+            ).reshape(rows, words)
+            if budget >= active:
+                np.testing.assert_array_equal(out, plane)  # exact roundtrip
+            else:
+                # Overflow: compact_indices dropped the tail — lossy, and
+                # visibly so, which is why the engine gates on the exact
+                # active-word count before trusting the encoding.
+                assert (out != plane).any()
 
 
 def test_parse_mesh_spec():
@@ -146,23 +198,74 @@ def test_level_collective_bytes_pins():
     # 1x8 (the 1D layout): lsub = 10 — the col reduce carries it all.
     assert level_collective_bytes(1, 8, 10, 1, "ring") == 2240
     assert level_collective_bytes(1, 8, 10, 1, "oneshot") == 17920
+    # pipelined stripes the ring's hops: identical bytes.
+    assert level_collective_bytes(2, 4, 10, 1, "pipelined") == 1280
     # 1x1: no mesh, no wire.
     assert level_collective_bytes(1, 1, 73, 1, "none") == 0
 
 
 @needs_mesh
 def test_measured_collective_bytes_match_model(workload):
-    """The chunked drive's counter is levels x the per-level model —
-    the same analytic bytes bench detail.multichip and the perf-smoke
-    2D-vs-1D guard consume."""
+    """With the sparse wire OFF the chunked drive's counter is levels x
+    the per-level model — the same analytic bytes bench detail.multichip
+    and the perf-smoke 2D-vs-1D guard consume."""
     g, queries, f, levels, reached = workload
-    eng = Mesh2DEngine(make_mesh2d(2, 4), g, level_chunk=1)
+    eng = Mesh2DEngine(make_mesh2d(2, 4), g, level_chunk=1, wire_sparse=0)
     eng.compile(queries.shape)
     reset_collective_bytes()
     eng.best(queries)
     got = collective_bytes()
     want = int(levels.max()) * eng.level_bytes(queries.shape[0])
     assert got == want, (got, want)
+
+
+@needs_mesh
+def test_sparse_wire_trace_measures_savings(workload):
+    """The density-adaptive wire under the auto budget: the per-level
+    trace labels at least one level sparse on this workload, its byte
+    column sums to the measured total, the total undercuts the dense
+    model, and the drive loop's live counter agrees with the trace —
+    the saving is measured, never modeled."""
+    g, queries, f, levels, reached = workload
+    eng = Mesh2DEngine(make_mesh2d(2, 4), g, level_chunk=1)
+    trace = eng.wire_trace(queries)
+    assert len(trace["levels"]) == int(levels.max())
+    assert trace["sparse_levels"] >= 1
+    assert sum(e["bytes"] for e in trace["levels"]) == trace["bytes_measured"]
+    assert trace["bytes_measured"] < trace["bytes_dense_model"]
+    assert trace["bytes_dense_model"] == int(levels.max()) * eng.level_bytes(
+        queries.shape[0]
+    )
+    # The production drive records the same measured bytes.
+    reset_collective_bytes()
+    np.testing.assert_array_equal(np.asarray(eng.f_values(queries)), f)
+    assert collective_bytes() == trace["bytes_measured"]
+
+
+# Wire-format / residency arms over the tier-1 2x4 mesh: forced-sparse
+# (budget covers every level), forced-overflow (budget 1 pair -> the
+# exact dense fallback on every level that outgrows it), the pipelined
+# striped exchange, and the host-streamed tile residency.
+WIRE_ARMS = [
+    ("sparse", dict(wire_sparse=4096)),
+    ("overflow_fallback", dict(wire_sparse=1)),
+    ("pipelined", dict(merge_tree="pipelined", wire_chunks=2, wire_sparse=0)),
+    ("streamed", dict(residency="streamed")),
+]
+
+
+@needs_mesh
+@pytest.mark.parametrize("label,kw", WIRE_ARMS, ids=[a[0] for a in WIRE_ARMS])
+def test_wire_modes_match_oracle(workload, label, kw):
+    """Every wire schedule and residency is layout, not semantics: F
+    values AND per-query stats bit-match the single-chip oracle."""
+    g, queries, f, levels, reached = workload
+    eng = Mesh2DEngine(make_mesh2d(2, 4), g, **kw)
+    np.testing.assert_array_equal(np.asarray(eng.f_values(queries)), f)
+    ls, rs, fs = (np.asarray(x) for x in eng.query_stats(queries))
+    np.testing.assert_array_equal(ls, levels)
+    np.testing.assert_array_equal(rs, reached)
+    np.testing.assert_array_equal(fs, f)
 
 
 @needs_mesh
@@ -196,14 +299,31 @@ def test_without_ranks_no_survivors_raises(workload):
 
 
 @needs_mesh
-def test_mid_drive_chip_loss_reshards_bit_identical(workload):
+@pytest.mark.parametrize(
+    "label,kw",
+    [
+        ("dense", dict(wire_sparse=0)),
+        ("sparse", dict(wire_sparse=4096)),
+        pytest.param(
+            "pipelined",
+            dict(merge_tree="pipelined", wire_chunks=2),
+            marks=pytest.mark.slow,
+        ),
+        ("streamed", dict(residency="streamed")),
+    ],
+    ids=["dense", "sparse", "pipelined", "streamed"],
+)
+def test_mid_drive_chip_loss_reshards_bit_identical(workload, label, kw):
     """Kill a simulated chip MID-DRIVE (the dispatch fault seam inside
-    the chunked level loop, count 2: the supervisor's own dispatch trip
-    consumes count 1) and assert the supervisor's reshard rung lands on
-    the survivor mesh with bit-identical results to the clean run."""
+    the drive loop, count 2: the supervisor's own dispatch trip consumes
+    count 1) and assert the supervisor's reshard rung lands on the
+    survivor mesh with bit-identical results to the clean run — under
+    every wire format and residency, which must survive the rebuild
+    (without_ranks carries the resolved knobs over)."""
     g, queries, f, levels, reached = workload
     plan = FaultPlan.parse("chip:rank0:2")
-    sup = ChunkSupervisor(Mesh2DEngine(make_mesh2d(2, 2), g), plan=plan)
+    eng = Mesh2DEngine(make_mesh2d(2, 2), g, **kw)
+    sup = ChunkSupervisor(eng, plan=plan)
     with injected(plan):
         got = np.asarray(sup.f_values(queries))
     np.testing.assert_array_equal(got, f)

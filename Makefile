@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe analyze lockwatch
+.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe analyze lockwatch netchaos
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -104,6 +104,16 @@ observe: native
 analyze:
 	python -m $(PKG).analysis.cli
 
+# Network-chaos suite (docs/SERVING.md "Cross-machine transport &
+# fencing"): the message-level fault kinds (net_partition / net_delay /
+# net_dup / net_reorder / half_open) at the frame seam, byte-level
+# frame-reader fuzz, the epoch-fence matrix (equal/stale/future at
+# ring, router and replica), exactly-once mutate dedup, and the TCP
+# transport knobs.  The multi-process partition-heal chain is
+# slow-marked out of this tier (run the file without -m to include it).
+netchaos: native
+	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_netchaos.py -x -q -m "not slow"
+
 # Dynamic lock-order watchdog (docs/ANALYSIS.md "Lock watchdog"): the
 # concurrency-heavy suites run with every threading.Lock/RLock
 # instrumented; any pair of locks ever taken in both orders — the
@@ -112,7 +122,7 @@ analyze:
 lockwatch: native
 	JAX_PLATFORMS=cpu MSBFS_LOCK_WATCHDOG=1 MSBFS_FAULT_SEED=0 python -m pytest \
 	    tests/test_serve.py tests/test_lifecycle.py tests/test_fleet.py \
-	    tests/test_stampede.py -x -q -m "not slow"
+	    tests/test_stampede.py tests/test_netchaos.py -x -q -m "not slow"
 
-test: native analyze resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe
+test: native analyze resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe netchaos
 	python -m pytest tests/ -x -q

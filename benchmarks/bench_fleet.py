@@ -43,10 +43,18 @@ every acked answer audited bit-identical against a single-daemon
 oracle ACROSS scale events (a drain that drops queued work, or a fresh
 replica serving a wrong answer, shows up here).
 
+``BENCH_FLEET_TRANSPORT=tcp`` moves every replica and the oracle onto
+loopback TCP sockets (the real connect/read-timeout/keepalive leg from
+serve/protocol.py) instead of unix sockets — same harness, same SLO
+formulas, separate perf-smoke rows (``fleet-tcp-*`` /
+``stampede-tcp-*``) so the cross-machine transport gets its own
+regression pins without touching the unix baselines.
+
 Run::
 
     JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py
     JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py --stampede
+    BENCH_FLEET_TRANSPORT=tcp JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py
 """
 
 from __future__ import annotations
@@ -68,11 +76,62 @@ CLOSED_PER_CLIENT = int(os.environ.get("BENCH_FLEET_PER_CLIENT", "20"))
 N_VERTICES = int(os.environ.get("BENCH_FLEET_N", "4000"))
 N_EDGES = int(os.environ.get("BENCH_FLEET_M", "16000"))
 DEADLINE_S = float(os.environ.get("BENCH_FLEET_DEADLINE_S", "2.0"))
-# Mean arrival gap ~8 ms with Pareto alpha=1.3: bursty enough that the
-# admission queue fills during flurries on the CPU backend.
-ARRIVAL_SCALE_S = float(os.environ.get("BENCH_FLEET_GAP_S", "0.004"))
+
+
+def _default_gap_s() -> float:
+    """Open-loop arrival gap scale when ``BENCH_FLEET_GAP_S`` is unset.
+
+    The 4 ms scale (mean gap ~17 ms under Pareto alpha=1.3) assumes a
+    few cores' worth of service rate.  On a 1-2 core host the same
+    schedule offers roughly twice the fleet's capacity, so whether the
+    tail of the burst acks inside the deadline is a scheduling coin
+    flip — and the zero-budget lost-ack row stops pinning routing
+    correctness and starts measuring the machine.  Widen the gap with
+    the core deficit instead: the burst keeps its Pareto shape, every
+    SLO formula is unchanged, and the row stays deterministic on small
+    hosts.  An explicit BENCH_FLEET_GAP_S always wins."""
+    cores = os.cpu_count() or 1
+    if cores >= 4:
+        return 0.004
+    return 0.016 / cores
+
+
+# Bursty enough that the admission queue fills during flurries on the
+# CPU backend; see _default_gap_s for the small-host calibration.
+ARRIVAL_SCALE_S = float(
+    os.environ.get("BENCH_FLEET_GAP_S") or _default_gap_s()
+)
 PARETO_ALPHA = 1.3
 K, S = 8, 4
+
+
+def _transport() -> str:
+    """"unix" (default) or "tcp" — read per call, not at import, so
+    perf_smoke's runners can flip it between rows in one process."""
+    t = os.environ.get("BENCH_FLEET_TRANSPORT", "unix").strip().lower()
+    if t not in ("unix", "tcp"):
+        raise ValueError(
+            f"BENCH_FLEET_TRANSPORT must be 'unix' or 'tcp', got {t!r}"
+        )
+    return t
+
+
+def _listen_addr(tmpdir: str, name: str) -> str:
+    """One daemon listen address on the selected transport.  TCP binds
+    port 0 to reserve an ephemeral loopback port; the bind is released
+    before the daemon re-binds it (the standard tiny-race allocator the
+    fleet supervisor also uses)."""
+    if _transport() == "tcp":
+        import socket
+
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            return f"127.0.0.1:{s.getsockname()[1]}"
+        finally:
+            s.close()
+    return f"unix:{os.path.join(tmpdir, name + '.sock')}"
 
 
 def _percentile(samples, p):
@@ -119,12 +178,12 @@ class FleetUnderTest:
         self.servers = {}
         addresses = {}
         for name in names:
-            addr = f"unix:{os.path.join(self.tmp.name, name + '.sock')}"
+            addr = _listen_addr(self.tmp.name, name)
             addresses[name] = addr
             graphs = {"bench": self.gpath} if name in owners else {}
             self.servers[name] = MsbfsServer(listen=addr, graphs=graphs)
             self.servers[name].start()
-        oracle_addr = f"unix:{os.path.join(self.tmp.name, 'oracle.sock')}"
+        oracle_addr = _listen_addr(self.tmp.name, "oracle")
         self.oracle = MsbfsServer(
             listen=oracle_addr, graphs={"bench": self.gpath}
         )
@@ -306,14 +365,19 @@ def smoke():
         "closed_qps", "deadline_ms",
     )}
     detail["router"] = out["router"]
+    detail["transport"] = _transport()
     print(f"fleet SLO detail: {json.dumps(detail, sort_keys=True)}")
     lost = out["lost_acks"] + len(out["open_errors"]) + len(
         out["closed_errors"]
     )
+    # TCP runs pin their own rows (fleet-tcp-*): the loopback TCP leg
+    # carries real connect/read-timeout/keepalive cost that must not
+    # loosen (or hide behind) the unix baselines.
+    prefix = "fleet-tcp" if _transport() == "tcp" else "fleet"
     return [
-        ("fleet-p99-ms", out["deadline_ms"], out["p99_ms"]),
-        ("fleet-shed-rate-pct", 100, out["shed_rate_pct"]),
-        ("fleet-lost-acks", 2 * out["arrivals"], lost),
+        (f"{prefix}-p99-ms", out["deadline_ms"], out["p99_ms"]),
+        (f"{prefix}-shed-rate-pct", 100, out["shed_rate_pct"]),
+        (f"{prefix}-lost-acks", 2 * out["arrivals"], lost),
     ]
 
 
@@ -467,7 +531,7 @@ class ElasticFleet:
         self.stampede_t0 = None  # set by the arrival loop at crowd onset
         self._stop = threading.Event()
         self._spawn_locked_free()  # boots r0 (the pre-seeded ring slot)
-        oracle_addr = f"unix:{os.path.join(self.tmp.name, 'oracle.sock')}"
+        oracle_addr = _listen_addr(self.tmp.name, "oracle")
         self.oracle = MsbfsServer(
             listen=oracle_addr, graphs={"bench": self.gpath}
         )
@@ -487,7 +551,7 @@ class ElasticFleet:
         i = self._next
         self._next += 1
         name = f"r{i}"
-        addr = f"unix:{os.path.join(self.tmp.name, name + '.sock')}"
+        addr = _listen_addr(self.tmp.name, name)
         # Result cache OFF: the stampede is a CAPACITY story, so every
         # admitted query must compute (a cache-hit fleet absorbs any
         # crowd at ~1 ms/query and the autoscaler rightly never fires).
@@ -838,13 +902,15 @@ def smoke_stampede():
     }
     detail["brownout_rung"] = out["brownout"]["rung"]
     detail["brownout_transitions"] = out["brownout"]["transitions"]
+    detail["transport"] = _transport()
     print(f"stampede SLO detail: {json.dumps(detail, sort_keys=True)}")
     lost = out["lost_acks"] + len(out["errors"])
+    prefix = "stampede-tcp" if _transport() == "tcp" else "stampede"
     return [
-        ("stampede-scaleup-heartbeats", 40, out["reaction_heartbeats"]),
-        ("stampede-interactive-p99-ms", out["deadline_ms"],
+        (f"{prefix}-scaleup-heartbeats", 40, out["reaction_heartbeats"]),
+        (f"{prefix}-interactive-p99-ms", out["deadline_ms"],
          out["interactive_p99_ms"]),
-        ("stampede-lost-acks", 2 * out["arrivals"], lost),
+        (f"{prefix}-lost-acks", 2 * out["arrivals"], lost),
     ]
 
 

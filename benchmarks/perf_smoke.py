@@ -172,6 +172,20 @@ BUDGET = {
     "stampede-scaleup-heartbeats": 12,
     "stampede-interactive-p99-ms": 1500,
     "stampede-lost-acks": 0,
+    # Round 16 TCP transport rows (BENCH_FLEET_TRANSPORT=tcp — the same
+    # harnesses over loopback TCP with the serve/protocol.py
+    # connect/read-timeout/keepalive legs live).  Budgets match the unix
+    # rows: loopback TCP costs a few syscalls more per frame but the SLO
+    # story must not change — a transport that can't hold the same p99
+    # and zero-lost-ack pins is not ready for cross-machine fleets.  The
+    # stampede TCP leg runs a reduced arrival count (wall-clock bound),
+    # which leaves the per-query SLOs untouched.
+    "fleet-tcp-p99-ms": 1000,
+    "fleet-tcp-shed-rate-pct": 25,
+    "fleet-tcp-lost-acks": 0,
+    "stampede-tcp-scaleup-heartbeats": 12,
+    "stampede-tcp-interactive-p99-ms": 1500,
+    "stampede-tcp-lost-acks": 0,
     # Round 10 multichip traffic (parallel/partition2d): measured
     # collective bytes of one 4x4-mesh best() on the RMAT-10/K=16
     # fixture.  Deterministic: levels x R*C*((R-1)+(C-1)) x lsub*words*4
@@ -390,6 +404,47 @@ def run_stampede():
     import bench_fleet
 
     return bench_fleet.smoke_stampede()
+
+
+def run_fleet_tcp():
+    """Round-16 TCP transport rows: the same bench_fleet harness with
+    every replica and the oracle on loopback TCP (the real
+    serve/protocol.py connect/read-timeout/keepalive leg).  Separate
+    fleet-tcp-* rows so the cross-machine transport pins its own SLOs
+    without loosening the unix baselines."""
+    import bench_fleet
+
+    prev = os.environ.get("BENCH_FLEET_TRANSPORT")
+    os.environ["BENCH_FLEET_TRANSPORT"] = "tcp"
+    try:
+        return bench_fleet.smoke()
+    finally:
+        if prev is None:
+            os.environ.pop("BENCH_FLEET_TRANSPORT", None)
+        else:
+            os.environ["BENCH_FLEET_TRANSPORT"] = prev
+
+
+def run_stampede_tcp():
+    """Round-16 TCP stampede rows: the elastic flash-crowd harness over
+    loopback TCP.  Arrivals are halved (the schedule is wall-clock
+    bound and the TCP leg runs SECOND in one process) — the per-query
+    SLO rows (reaction heartbeats, interactive p99, lost acks) are
+    arrival-count independent."""
+    import bench_fleet
+
+    prev = os.environ.get("BENCH_FLEET_TRANSPORT")
+    prev_arrivals = bench_fleet.STAMPEDE_ARRIVALS
+    os.environ["BENCH_FLEET_TRANSPORT"] = "tcp"
+    bench_fleet.STAMPEDE_ARRIVALS = min(prev_arrivals, 500)
+    try:
+        return bench_fleet.smoke_stampede()
+    finally:
+        bench_fleet.STAMPEDE_ARRIVALS = prev_arrivals
+        if prev is None:
+            os.environ.pop("BENCH_FLEET_TRANSPORT", None)
+        else:
+            os.environ["BENCH_FLEET_TRANSPORT"] = prev
 
 
 def run_audit():
@@ -793,8 +848,9 @@ def run_trend():
 def main() -> int:
     failures = []
     for run in (run_config1, run_config4, run_stencil_window, run_mxu,
-                run_fleet, run_stampede, run_audit, run_telemetry,
-                run_repair, run_multichip, run_trend, run_analyze):
+                run_fleet, run_stampede, run_fleet_tcp, run_stampede_tcp,
+                run_audit, run_telemetry, run_repair, run_multichip,
+                run_trend, run_analyze):
         rows = run()
         if isinstance(rows, tuple):
             rows = [rows]

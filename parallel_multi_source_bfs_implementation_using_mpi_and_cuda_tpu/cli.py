@@ -320,6 +320,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # pairs, main.cu:216-224), so the batch path below stays
     # reference-exact for every existing invocation.
     if len(argv) > 1 and argv[1] == "serve":
+        # ``--epoch-file`` arms membership fencing: a frame stamped with
+        # a view other than the file's current value is refused with
+        # FencedError, exit code 10 (docs/SERVING.md "Cross-machine
+        # transport & fencing").
         from .serve.server import serve_main
 
         return serve_main(argv[2:])

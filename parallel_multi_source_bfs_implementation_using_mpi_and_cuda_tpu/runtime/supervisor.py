@@ -51,6 +51,7 @@ __all__ = [
     "BackpressureError",
     "PoisonQueryError",
     "CorruptionError",
+    "FencedError",
     "classify",
     "RetryPolicy",
     "call_with_watchdog",
@@ -142,6 +143,26 @@ class CorruptionError(MsbfsError):
     def __init__(self, msg: str, invariants=()):
         super().__init__(msg)
         self.invariants = tuple(invariants)
+
+
+class FencedError(MsbfsError):
+    """A wire frame carried a fleet-membership epoch that does not match
+    the receiver's current view (docs/SERVING.md "Cross-machine
+    transport & fencing"): a partition-healed router, a resurrected
+    replica, or a quarantine-lagged client tried to serve, journal, or
+    vote under a stale topology.  The request was refused WITHOUT being
+    executed — the caller must refresh its view (re-read the fleet
+    epoch) before retrying; blind retries would re-present the same
+    stale view.  Exit 10 so scripting can tell "my membership view is
+    old" from load shedding (7) and infrastructure faults (3/4/5).
+    Carries the two views (``frame_epoch``/``local_epoch``)."""
+
+    exit_code = 10
+
+    def __init__(self, msg: str, frame_epoch=None, local_epoch=None):
+        super().__init__(msg)
+        self.frame_epoch = frame_epoch
+        self.local_epoch = local_epoch
 
 
 _CAPACITY_MARKS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY", "ALLOCATION FAILURE")

@@ -25,8 +25,11 @@ Resilience (docs/SERVING.md "Crash recovery & probes"): a lost
 connection mid-call is wrapped in the same typed :class:`ServerError`
 taxonomy (``TransientError``, exit 5) rather than leaking raw socket
 errors to scripts; *idempotent* verbs (ping/health/stats/query/load —
-load is load-once on the server, so re-sending it is safe) additionally
-reconnect with the PR-1 bounded backoff schedule before giving up.
+load is load-once on the server, so re-sending it is safe — and
+``mutate``, which rides a client-minted idempotency token the server
+dedups, docs/SERVING.md "Cross-machine transport & fencing")
+additionally reconnect with the PR-1 bounded backoff schedule before
+giving up.
 Round 9 decorrelates that schedule: each client instance seeds its own
 backoff jitter (pid + an instance counter), because N clients born
 from one event — a replica restart dropping every connection at once —
@@ -48,6 +51,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import secrets
 import sys
 import threading
 import time
@@ -119,9 +123,16 @@ class MsbfsClient:
         timeout: Optional[float] = 300.0,
         retry: Optional[RetryPolicy] = None,
         reconnect_max_elapsed_s: float = 15.0,
+        epoch: Optional[int] = None,
     ):
         self.address = address
         self.timeout = timeout
+        # Fleet-membership epoch (docs/SERVING.md "Cross-machine
+        # transport & fencing"): when set, every request carries it and
+        # a replica holding a different view refuses with FencedError
+        # instead of serving under a stale membership.  None (the
+        # single-daemon default) sends no epoch — tolerated-absent.
+        self.epoch = None if epoch is None else int(epoch)
         # Bounded reconnect schedule for idempotent calls; PR-1's policy
         # so backoff behavior is one story repo-wide — but seeded per
         # client instance, so a replica restart's dropped connections do
@@ -180,6 +191,20 @@ class MsbfsClient:
         ``reconnect_max_elapsed_s`` of total wall clock (the connect
         attempts themselves burn budget too, so the cap is enforced
         against the clock, not just the planned sleeps)."""
+        if self.epoch is not None and "epoch" not in request:
+            request = dict(request)
+            request["epoch"] = self.epoch
+        # A mutate WITHOUT an idempotency token must never be retried,
+        # whatever the caller claimed: a transport error leaves its
+        # outcome unknown, and a blind re-send could append the delta
+        # twice.  Tokened mutates retry safely — the server's dedup
+        # window re-acks the applied copy (docs/SERVING.md
+        # "Cross-machine transport & fencing").
+        tokenless_mutate = (
+            request.get("op") == "mutate" and not request.get("token")
+        )
+        if tokenless_mutate:
+            idempotent = False
         delays = (
             reconnect_schedule(self.retry, self.reconnect_max_elapsed_s)
             if idempotent
@@ -199,6 +224,16 @@ class MsbfsClient:
                     time.monotonic() - start + delays[attempt]
                     > self.reconnect_max_elapsed_s
                 ):
+                    if tokenless_mutate:
+                        raise ServerError(
+                            "TransientError",
+                            f"mutate to {self.address} had no idempotency"
+                            f" token and its transport failed ({exc}); "
+                            "NOT retried — the outcome is unknown and a "
+                            "blind re-send could double-apply; check "
+                            "'versions' or resend with a token",
+                            5,
+                        ) from exc
                     raise _transport_error(self.address, exc) from exc
                 time.sleep(delays[attempt])
                 attempt += 1
@@ -229,19 +264,28 @@ class MsbfsClient:
         inserts: Sequence[Sequence[int]] = (),
         deletes: Sequence[Sequence[int]] = (),
         graph: str = "default",
+        token: Optional[str] = None,
     ) -> dict:
         """Append one edge-delta batch to ``graph``'s version chain
-        (docs/SERVING.md "Mutations & versions").  NOT idempotent, same
-        contract as :meth:`reload`: each call appends a chain version,
-        so a blind re-send after an ambiguous failure could apply the
-        delta twice."""
+        (docs/SERVING.md "Mutations & versions").  Exactly-once over a
+        lossy transport: every call carries an idempotency ``token``
+        (auto-minted when None) that the server's bounded dedup window
+        remembers, so the retried/hedged/duplicated copy of an applied
+        mutate RE-ACKS the original version+digest instead of appending
+        a second chain version — which is what makes the retry below
+        safe where a blind re-send was not.  Pass ``token`` explicitly
+        to retry an earlier ambiguous call under the same identity."""
+        if token is None:
+            token = secrets.token_hex(16)
         return self.call(
             {
                 "op": "mutate",
                 "graph": graph,
                 "inserts": [[int(u), int(v)] for u, v in inserts],
                 "deletes": [[int(u), int(v)] for u, v in deletes],
-            }
+                "token": str(token),
+            },
+            idempotent=True,
         )
 
     def versions(self, graph: str = "default") -> dict:

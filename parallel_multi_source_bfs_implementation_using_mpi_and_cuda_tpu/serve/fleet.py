@@ -35,6 +35,18 @@ Replicas may advertise a ``host`` label and listen on TCP
 (``transport="tcp"``) so a fleet can span machines; the ring then
 spreads each graph's owner set across distinct hosts.
 
+Epoch fencing (docs/SERVING.md "Cross-machine transport & fencing"):
+the supervisor owns a monotonic **membership epoch**, persisted and
+fsync'd at ``base_dir/epoch`` and bumped on every topology change —
+start, join, retire, quarantine, host kill.  The live value is mirrored
+onto :attr:`PlacementRing.epoch` (routers stamp it on every frame) and
+every replica is spawned with ``--epoch-file`` pointing at the same
+file, so a frame carrying a stale view is refused with a typed
+``FencedError`` (exit code 10) instead of being silently served by a
+replica the sender no longer believes in.  Persistence makes the fence
+survive supervisor resurrection: a new supervisor over an old
+``base_dir`` resumes the counter, it never rewinds.
+
 Chaos seams (docs/RESILIENCE.md): each monitor tick of replica ``i``
 trips fault site ``replica<i>`` (``replica_kill`` -> real SIGKILL), and
 each distinct host label trips its own site, where an armed
@@ -238,11 +250,56 @@ class FleetSupervisor:
         self.graphs: Dict[str, str] = {}  # name -> path
         self.digests: Dict[str, str] = {}  # name -> content digest
         self.refused_graphs: Dict[str, str] = {}  # name -> refusal reason
+        # Membership epoch: durable at base_dir/epoch so a resurrected
+        # supervisor resumes (never rewinds) the fence counter.
+        self.epoch_path = os.path.join(self.base_dir, "epoch")
+        self.epoch = self._load_epoch()
+        self.ring.epoch = self.epoch
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
         self._log_files: List[object] = []
         self.started = False
+
+    # ---- membership epoch -------------------------------------------------
+    def _load_epoch(self) -> int:
+        """Resume the persisted fence counter (0 on first boot).  An
+        unreadable or corrupt file restarts at 0 — strictly worse than
+        resuming, but a fence that refuses to boot is worse still."""
+        try:
+            with open(self.epoch_path, "r", encoding="utf-8") as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def _bump_epoch(self, reason: str) -> int:
+        """Advance the membership epoch for one topology change and make
+        it durable BEFORE it becomes visible: write + fsync + rename,
+        then mirror onto the ring (what routers stamp on frames).  A
+        crash between rename and mirror re-reads the higher value on
+        resurrection — the fence is monotonic either way."""
+        with self._lock:
+            self.epoch += 1
+            tmp = self.epoch_path + ".tmp"
+            try:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    f.write(f"{self.epoch}\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.epoch_path)
+            except OSError as exc:
+                print(
+                    f"msbfs fleet: epoch persist to {self.epoch_path} "
+                    f"failed at {reason}: {exc} (fence continues in "
+                    "memory; resurrection may rewind)",
+                    file=sys.stderr,
+                )
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            self.ring.epoch = self.epoch
+            return self.epoch
 
     def _host_for(self, index: int) -> Optional[str]:
         if index in self._hosts_cfg:
@@ -283,6 +340,9 @@ class FleetSupervisor:
 
                 raise InputError("fleet already started")
             self.started = True
+            # The boot topology is itself a membership change: stamp it
+            # so frames minted against a pre-start view are fenceable.
+            self._bump_epoch("start")
             for r in self.replicas:
                 self._spawn(r)
         self._monitor = threading.Thread(
@@ -383,6 +443,8 @@ class FleetSupervisor:
             r.address,
             "--journal",
             r.journal_path,
+            "--epoch-file",
+            self.epoch_path,
         ] + self._server_args
         log = open(r.log_path, "ab")
         self._log_files.append(log)
@@ -427,6 +489,7 @@ class FleetSupervisor:
             self.replicas.append(r)
             self.addresses[r.name] = r.address
             self.ring.add_member(r.name, weight=r.weight, host=r.host)
+            self._bump_epoch(f"join {r.name}")
             if self.started and not self._stop.is_set():
                 self._spawn(r)
         return r
@@ -470,6 +533,7 @@ class FleetSupervisor:
             r.state = "draining"
             if r.name in self.ring.members:
                 self.ring.remove_member(r.name)
+            self._bump_epoch(f"retire {name}")
         # Promoted owners pick the victim's graphs up while it still
         # answers — the walk order is ring order, so by the time the
         # victim stops accepting, its keys already have live homes.
@@ -550,6 +614,9 @@ class FleetSupervisor:
                     v.proc.wait(timeout=30.0)
                 except OSError:
                     pass
+        # A whole failure domain went dark: one epoch bump for the event
+        # (not one per victim) — routers re-learn the view once.
+        self._bump_epoch(f"host_down {host}")
 
     def _tick(self, r: ReplicaHandle) -> bool:
         """One heartbeat of one replica; True when its readiness flipped
@@ -829,6 +896,9 @@ class FleetSupervisor:
             proc.wait(timeout=30.0)
         except OSError:
             return False
+        # A quarantine is a forced view change: in-flight frames minted
+        # against the pre-quarantine view must be refusable.
+        self._bump_epoch(f"quarantine {victim.name}")
         return True
 
     # ---- observability ----------------------------------------------------
@@ -840,6 +910,7 @@ class FleetSupervisor:
         out = {
             "size": len([r for r in replicas if r.state != "removed"]),
             "slots": self._next_index,
+            "epoch": self.epoch,
             "transport": self.transport,
             "replication": self.ring.replication,
             "refused_graphs": refused,

@@ -14,7 +14,14 @@ Record grammar (one JSON object per line)::
     {"op": "reload", "name": ..., "path": ..., "hash": ...}
     {"op": "warm",   "name": ..., "hash": ..., "k_exec": ..., "s_pad": ...}
     {"op": "mutate", "name": ..., "inserts": [[u, v], ...],
-     "deletes": [[u, v], ...], "digest": ...}
+     "deletes": [[u, v], ...], "digest": ..., "token": ...}
+
+The optional ``token`` on mutate records is the client's idempotency
+token (docs/SERVING.md "Cross-machine transport & fencing"):
+tolerated-absent on replay (pre-token journals stay readable), emitted
+by compaction when present, and folded into the daemon's bounded dedup
+window on restart so a retry that straddles a crash still re-acks
+instead of re-applying.
 
 :meth:`StateJournal.replay` folds the line stream into the reconciled
 end state — last registration per name wins, warm records survive only
@@ -91,16 +98,17 @@ class JournalState:
         out: List[dict] = []
         for n, (p, h) in sorted(self.graphs.items()):
             out.append({"op": "load", "name": n, "path": p, "hash": h})
-            out.extend(
-                {
+            for d in self.deltas.get(n, ()):
+                rec = {
                     "op": "mutate",
                     "name": n,
                     "inserts": d["inserts"],
                     "deletes": d["deletes"],
                     "digest": d["digest"],
                 }
-                for d in self.deltas.get(n, ())
-            )
+                if d.get("token") is not None:
+                    rec["token"] = d["token"]
+                out.append(rec)
         out.extend(
             {"op": "warm", "name": n, "hash": h, "k_exec": k, "s_pad": s}
             for n, h, k, s in sorted(self.warm)
@@ -235,8 +243,12 @@ class StateJournal:
             if not _valid_pairs(inserts) or not _valid_pairs(deletes) or not isinstance(digest, str):
                 state.dropped += 1
                 return False
+            token = record.get("token")
+            if token is not None and not isinstance(token, str):
+                token = None  # corrupt token degrades to absent, not a crash
             state.deltas.setdefault(name, []).append(
-                {"inserts": inserts, "deletes": deletes, "digest": digest}
+                {"inserts": inserts, "deletes": deletes, "digest": digest,
+                 "token": token}
             )
             return True
         # op == "warm"

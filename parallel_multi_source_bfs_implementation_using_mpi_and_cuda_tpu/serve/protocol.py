@@ -58,6 +58,8 @@ import json
 import os
 import socket
 import struct
+import threading
+import time
 import zlib
 from typing import Optional
 
@@ -132,6 +134,29 @@ def encode_frame(obj: dict, crc: Optional[bool] = None) -> bytes:
     )
 
 
+# ``net_reorder`` holds one outbound frame per thread so the NEXT frame
+# overtakes it on the wire; _flush_held delivers stragglers before any
+# read on the same thread (a held request with no successor would
+# otherwise deadlock the request/response pair waiting on itself).
+_REORDER = threading.local()
+
+
+def _flush_held() -> None:
+    held = getattr(_REORDER, "held", None)
+    if not held:
+        return
+    _REORDER.held = []
+    for held_sock, held_frame in held:
+        try:
+            held_sock.sendall(held_frame)
+        except OSError:
+            # The overtaking frame's connection may already be gone —
+            # delivering late to a dead peer is exactly what a reordered
+            # network does; the receiver side's framing survives either
+            # way.
+            pass
+
+
 def send_frame(sock: socket.socket, obj: dict) -> None:
     frame = encode_frame(obj)
     if faults.consume_wire_taint():
@@ -148,7 +173,42 @@ def send_frame(sock: socket.socket, obj: dict) -> None:
             buf = bytearray(frame)
             buf[prefix + (len(buf) - prefix) // 2] ^= 0x10
             frame = bytes(buf)
+    # Network chaos seam (utils/faults.py "Network chaos kinds"): whole-
+    # frame filters armed by the router's trip, consumed here so the
+    # fault fires at the protocol boundary itself — the receiver (and
+    # the dedup window, and the failover walk) sees byte-for-byte what a
+    # lossy network would deliver.
+    dup = False
+    for filt in faults.consume_frame_chaos():
+        mode = filt["mode"]
+        if mode == "drop":
+            _flush_held()
+            faults.raise_partition_drop(
+                filt["replica"], filt["side"], filt["target_side"]
+            )
+        if mode == "delay":
+            time.sleep(filt["delay_ms"] / 1000.0)
+        elif mode == "dup":
+            dup = True
+        elif mode == "reorder":
+            held = getattr(_REORDER, "held", None)
+            if held is None:
+                held = _REORDER.held = []
+            held.append((sock, frame))
+            return
+        elif mode == "half_open":
+            # The peer's SYN/ACK state survived but its process is gone:
+            # our write vanishes (reported as success — TCP buffers it),
+            # and the response never arrives.  Arm the read black hole
+            # and write NOTHING.
+            faults.arm_read_blackhole(filt["replica"])
+            return
     sock.sendall(frame)
+    if dup:
+        # Retransmit-after-lost-ack: the same frame lands twice and the
+        # receiver processes both copies.
+        sock.sendall(frame)
+    _flush_held()
 
 
 def _read_exact(sock: socket.socket, count: int) -> Optional[bytes]:
@@ -171,6 +231,12 @@ def _read_exact(sock: socket.socket, count: int) -> Optional[bytes]:
 
 def recv_frame(sock: socket.socket) -> Optional[dict]:
     """One frame -> dict, or None on clean EOF (peer done)."""
+    # A frame held for reordering must go out before this thread blocks
+    # on a response, or the request/response pair deadlocks on itself.
+    _flush_held()
+    blackhole = faults.consume_read_blackhole()
+    if blackhole is not None:
+        faults.raise_half_open(blackhole)
     header = _read_exact(sock, _LEN.size)
     if header is None:
         return None
@@ -244,10 +310,60 @@ def parse_address(addr: str):
                          "integer") from None
 
 
+def _float_knob(name: str, fallback: float) -> float:
+    raw = knobs.raw(name, str(fallback))
+    try:
+        v = float(raw)
+    except ValueError:
+        return fallback
+    return v if v >= 0 else fallback
+
+
+def net_connect_timeout_s() -> float:
+    """``MSBFS_NET_CONNECT_TIMEOUT_S`` (default 5): bound on the TCP/unix
+    connect handshake when the caller gave no explicit timeout — a
+    partitioned or half-open peer must fail the dial in bounded time,
+    not hang a router walk.  0 disables (blocking connect)."""
+    return _float_knob("MSBFS_NET_CONNECT_TIMEOUT_S", 5.0)
+
+
+def net_read_timeout_s() -> float:
+    """``MSBFS_NET_READ_TIMEOUT_S`` (default 0 = inherit the caller's
+    request timeout): per-read socket timeout after connect.  Non-zero
+    turns a silent half-open peer into a timeout error the taxonomy
+    classifies TRANSIENT, so the router fails over instead of waiting
+    forever."""
+    return _float_knob("MSBFS_NET_READ_TIMEOUT_S", 0.0)
+
+
+def net_keepalive_enabled() -> bool:
+    """``MSBFS_NET_KEEPALIVE`` (default 1): SO_KEEPALIVE on TCP legs so
+    the kernel probes idle cross-machine connections and surfaces dead
+    peers as errors instead of eternal silence.  Unix sockets never need
+    it (a dead peer is an immediate EOF on the same host)."""
+    raw = knobs.raw("MSBFS_NET_KEEPALIVE", "1").strip().lower()
+    return raw not in ("0", "off", "false", "")
+
+
 def connect(addr: str, timeout: Optional[float] = None) -> socket.socket:
+    """Dial ``addr`` with the cross-machine transport discipline
+    (docs/SERVING.md "Cross-machine transport & fencing"): the connect
+    phase is bounded by ``timeout`` (or ``MSBFS_NET_CONNECT_TIMEOUT_S``
+    when None), TCP legs get keepalive, and after the handshake the
+    socket's read timeout is ``MSBFS_NET_READ_TIMEOUT_S`` if set, else
+    the caller's ``timeout`` (None = blocking, the pre-TCP behavior)."""
     family, target = parse_address(addr)
     sock = socket.socket(family, socket.SOCK_STREAM)
-    if timeout is not None:
-        sock.settimeout(timeout)
-    sock.connect(target)
+    try:
+        connect_t = timeout if timeout is not None else net_connect_timeout_s()
+        if connect_t:
+            sock.settimeout(connect_t)
+        sock.connect(target)
+        if family == socket.AF_INET and net_keepalive_enabled():
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+        read_t = net_read_timeout_s()
+        sock.settimeout(read_t if read_t else timeout)
+    except (OSError, ValueError):
+        sock.close()
+        raise
     return sock

@@ -86,7 +86,8 @@ class PlacementRing:
 
     def __init__(self, members: Sequence[str], replication: int = 2,
                  weights: Optional[Dict[str, float]] = None,
-                 hosts: Optional[Dict[str, str]] = None):
+                 hosts: Optional[Dict[str, str]] = None,
+                 epoch: int = 0):
         names = list(members)
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate ring members: {names}")
@@ -107,6 +108,14 @@ class PlacementRing:
         # growing past it restores the asked-for replication.
         self._want_replication = int(replication)
         self.replication = min(self._want_replication, len(names))
+        # Membership epoch (docs/SERVING.md "Cross-machine transport &
+        # fencing"): a monotonic view counter stamped on every wire
+        # frame so a peer holding a stale member list can be refused
+        # (FencedError) instead of silently served.  The supervisor owns
+        # the durable counter and mirrors it here; ringless users (e.g.
+        # bench harnesses) still get intrinsic bumps from
+        # add_member/remove_member below.
+        self.epoch = int(epoch)
 
     # ---- membership (autoscaler seam) ---------------------------------
     def _set_weight(self, member: str, weight) -> None:
@@ -129,6 +138,7 @@ class PlacementRing:
             self.hosts[name] = str(host)
         self.members.append(name)
         self.replication = min(self._want_replication, len(self.members))
+        self.epoch += 1
 
     def remove_member(self, name: str) -> None:
         """Shrink the ring by one member.  Only keys it owned move, each
@@ -141,6 +151,7 @@ class PlacementRing:
         self.weights.pop(name, None)
         self.hosts.pop(name, None)
         self.replication = min(self._want_replication, len(self.members))
+        self.epoch += 1
 
     def weight_of(self, member: str) -> float:
         return self.weights.get(member, 1.0)

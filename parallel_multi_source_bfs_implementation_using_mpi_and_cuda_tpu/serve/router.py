@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import os
 import re
+import secrets
 import socket
 import sys
 import threading
@@ -56,6 +57,7 @@ import numpy as np
 from ..ops.certify import fold_digest
 from ..runtime.supervisor import (
     BackpressureError,
+    FencedError,
     InputError,
     MsbfsError,
     RetryPolicy,
@@ -156,6 +158,8 @@ class FleetRouter:
             "routed": 0,
             "failovers": 0,
             "net_drops": 0,
+            "fenced": 0,
+            "mutations_routed": 0,
             "hedged": 0,
             "shed": 0,
             "votes": 0,
@@ -221,6 +225,13 @@ class FleetRouter:
                 self._index[member] = i
                 self._stats["per_replica"].setdefault(member, 0)
             return i
+
+    def _epoch(self) -> Optional[int]:
+        """The membership epoch every routed frame is stamped with
+        (docs/SERVING.md "Cross-machine transport & fencing").  Rings
+        predating the epoch field stamp nothing — tolerated-absent."""
+        epoch = getattr(self.ring, "epoch", None)
+        return None if epoch is None else int(epoch)
 
     # ---- query path -------------------------------------------------------
     def owners_for(self, graph: str) -> List[str]:
@@ -314,6 +325,7 @@ class FleetRouter:
                         else min(self.timeout, remaining)
                     ),
                     retry=_NO_RETRY,
+                    epoch=self._epoch(),
                 ) as client:
                     out = client.query(
                         queries,
@@ -323,6 +335,16 @@ class FleetRouter:
                         priority=priority,
                         client_id=client_id,
                     )
+            except (faults.SimulatedNetDrop, faults.SimulatedHalfOpen) as nd:
+                # Frame-level chaos fired at the protocol seam — a
+                # partition cut dropped the frame mid-send, or a
+                # half-open peer swallowed it and the read timed out.
+                # Same failover semantics as the pre-wire drop above:
+                # the replica never (usably) saw the query.
+                self._bump("net_drops")
+                failovers += 1
+                last_err = nd
+                continue
             except ServerError as err:
                 if err.type_name == "BackpressureError":
                     saturated += 1
@@ -332,6 +354,15 @@ class FleetRouter:
                 if err.type_name == "TransientError":
                     # Transport loss, drain refusal, injected transient:
                     # the next owner holds the same graph — walk on.
+                    failovers += 1
+                    last_err = err
+                    continue
+                if err.type_name == "FencedError":
+                    # The replica's membership view and ours disagree —
+                    # usually a topology change mid-walk.  Count it and
+                    # walk on: the next attempt re-reads the live ring
+                    # epoch, so a healed view converges within the walk.
+                    self._bump("fenced")
                     failovers += 1
                     last_err = err
                     continue
@@ -374,6 +405,122 @@ class FleetRouter:
             f"no owner of graph {graph!r} answered "
             f"({failovers} attempt(s); last: {last_err})"
         )
+
+    # ---- mutation path ----------------------------------------------------
+    def mutate(
+        self,
+        inserts: Sequence[Sequence[int]] = (),
+        deletes: Sequence[Sequence[int]] = (),
+        graph: str = "default",
+        token: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> dict:
+        """Replicated exactly-once mutate: apply one edge-delta batch to
+        EVERY ring owner of ``graph``, in preference order, under one
+        idempotency ``token`` (minted when None).  Unlike the query
+        walk, failover is wrong here — a mutate must land on ALL owners
+        or the replicas' version chains diverge — so an unreachable
+        owner fails the call typed (TransientError) with the token in
+        the message: retrying the SAME token converges, because owners
+        that already applied re-ack from their dedup window while the
+        missed ones apply for the first time.  Partial application is
+        therefore a transient state, never a divergence."""
+        if token is None:
+            token = secrets.token_hex(16)
+        owners = self.owners_for(graph)
+        if not owners:
+            raise TransientError(
+                f"no live owner for graph {graph!r} "
+                "(fleet booting or all owners down)"
+            )
+        start = time.monotonic()
+        per_owner: Dict[str, dict] = {}
+        with span("route.mutate", graph=graph, owners=len(owners)):
+            for member in owners:
+                remaining = None
+                if deadline_s is not None:
+                    remaining = deadline_s - (time.monotonic() - start)
+                    if remaining <= 0:
+                        raise TransientError(
+                            f"mutate deadline spent after "
+                            f"{len(per_owner)}/{len(owners)} owner(s) of "
+                            f"graph {graph!r}; retry with token {token!r} "
+                            "to converge"
+                        )
+                address = self.addresses.get(member)
+                if address is None:
+                    raise TransientError(
+                        f"owner {member} of graph {graph!r} left the "
+                        f"fleet mid-mutate; retry with token {token!r}"
+                    )
+                try:
+                    faults.trip(f"route{self._route_index(member)}")
+                    with MsbfsClient(
+                        address,
+                        timeout=(
+                            self.timeout if remaining is None
+                            else min(self.timeout, remaining)
+                        ),
+                        retry=_NO_RETRY,
+                        epoch=self._epoch(),
+                    ) as client:
+                        per_owner[member] = client.mutate(
+                            inserts, deletes, graph=graph, token=token
+                        )
+                except (faults.SimulatedNetDrop,
+                        faults.SimulatedHalfOpen) as drop:
+                    # Trip-time drops AND frame-level chaos from the
+                    # protocol seam (partition cut mid-send, half-open
+                    # swallow) land here alike: the leg is lost, the
+                    # token makes the retry safe.
+                    self._bump("net_drops")
+                    raise TransientError(
+                        f"mutate to owner {member} of graph {graph!r} "
+                        f"dropped ({drop}); applied to "
+                        f"{sorted(per_owner)} so far — retry with token "
+                        f"{token!r} to converge"
+                    ) from drop
+                except ServerError as err:
+                    if err.type_name == "FencedError":
+                        self._bump("fenced")
+                    if err.type_name in ("TransientError", "FencedError",
+                                         "BackpressureError"):
+                        raise TransientError(
+                            f"mutate to owner {member} of graph "
+                            f"{graph!r} failed ({err}); applied to "
+                            f"{sorted(per_owner)} so far — retry with "
+                            f"token {token!r} to converge"
+                        ) from err
+                    raise  # InputError etc: the mutation itself is bad
+                except (protocol.ProtocolError, OSError,
+                        socket.timeout) as exc:
+                    raise TransientError(
+                        f"mutate to owner {member} of graph {graph!r} "
+                        f"lost its transport ({exc}); applied to "
+                        f"{sorted(per_owner)} so far — retry with token "
+                        f"{token!r} to converge"
+                    ) from exc
+        self._bump("mutations_routed")
+        primary = per_owner[owners[0]]
+        return {
+            "ok": True,
+            "op": "mutate",
+            "graph": primary.get("graph"),
+            "token": token,
+            "owners": owners,
+            "version": primary.get("version"),
+            "digest": primary.get("digest"),
+            "applied": primary.get("applied"),
+            "deduplicated": bool(primary.get("deduplicated")),
+            "per_owner": {
+                m: {
+                    "version": r.get("version"),
+                    "digest": r.get("digest"),
+                    "deduplicated": bool(r.get("deduplicated")),
+                }
+                for m, r in per_owner.items()
+            },
+        }
 
     # ---- cross-replica voting ---------------------------------------------
     def _vote_suppressed(self) -> bool:
@@ -422,11 +569,13 @@ class FleetRouter:
                     else min(self.timeout, remaining)
                 ),
                 retry=_NO_RETRY,
+                epoch=self._epoch(),
             ) as client:
                 return client.query(queries, graph=graph,
                                     deadline_s=remaining)
         except (
             faults.SimulatedNetDrop,
+            faults.SimulatedHalfOpen,
             ServerError,
             protocol.ProtocolError,
             OSError,
@@ -553,9 +702,11 @@ class FleetFrontend:
     fleet exactly as it talks to one daemon.  Verbs: ``ping``,
     ``health`` (fleet topology + per-replica states), ``load``
     (ring-placed registration via the supervisor), ``query`` (routed),
-    ``stats`` (router + fleet counters), ``trace`` (per-query trace
-    events, fanned out to the replicas and merged), ``metrics``
-    (Prometheus text exposition of the fleet roll-up), ``shutdown``.
+    ``mutate`` (token-fenced, applied to every ring owner —
+    :meth:`FleetRouter.mutate`), ``stats`` (router + fleet counters),
+    ``trace`` (per-query trace events, fanned out to the replicas and
+    merged), ``metrics`` (Prometheus text exposition of the fleet
+    roll-up), ``shutdown``.
 
     Thread names use the ``msbfs-fleet-`` prefix (distinct from the
     single-daemon ledger in tests/conftest.py, which must keep failing
@@ -579,7 +730,10 @@ class FleetFrontend:
             if os.path.exists(target):
                 os.unlink(target)  # front end owns its path (no journal)
         self._sock.bind(target)
-        self._sock.listen(64)
+        # Deep backlog, same reasoning as MsbfsServer.start(): stampede
+        # dials must park in the queue while the acceptor is GIL-starved
+        # rather than time out at the client.
+        self._sock.listen(512)
         self._sock.settimeout(0.2)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="msbfs-fleet-accept", daemon=True
@@ -654,9 +808,34 @@ class FleetFrontend:
         with use_trace(ctx):
             return self._handle(request)
 
+    def _check_epoch(self, frame_epoch) -> None:
+        """Fence an incoming frame's membership view against the live
+        ring (docs/SERVING.md "Cross-machine transport & fencing") —
+        the front end refuses stale views exactly like a replica, so a
+        partition-healed peer holding an old topology cannot route
+        through us under it.  Frames without an epoch pass."""
+        try:
+            frame_epoch = int(frame_epoch)
+        except (TypeError, ValueError):
+            raise InputError(
+                f"frame 'epoch' must be an integer, got {frame_epoch!r}"
+            ) from None
+        local = int(getattr(self.router.ring, "epoch", 0) or 0)
+        if frame_epoch == local:
+            return
+        self.router._bump("fenced")
+        direction = "stale behind" if frame_epoch < local else "ahead of"
+        raise FencedError(
+            f"frame epoch {frame_epoch} is {direction} the fleet's "
+            f"membership epoch {local}; refresh the view and resend",
+            frame_epoch=frame_epoch, local_epoch=local,
+        )
+
     def _handle(self, request: dict) -> dict:
         op = request.get("op")
         try:
+            if "epoch" in request and request["epoch"] is not None:
+                self._check_epoch(request["epoch"])
             if op == "ping":
                 return {"ok": True, "op": "ping", "pid": os.getpid()}
             if op == "health":
@@ -682,6 +861,14 @@ class FleetFrontend:
                 )
                 out["ok"] = True
                 return out
+            if op == "mutate":
+                return self.router.mutate(
+                    request.get("inserts") or [],
+                    request.get("deletes") or [],
+                    graph=request.get("graph", "default"),
+                    token=request.get("token"),
+                    deadline_s=request.get("deadline_s"),
+                )
             if op == "load":
                 if self.supervisor is None:
                     raise InputError(

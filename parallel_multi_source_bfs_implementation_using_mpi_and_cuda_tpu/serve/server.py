@@ -59,6 +59,7 @@ import weakref
 from ..runtime.supervisor import (
     BackpressureError,
     CorruptionError,
+    FencedError,
     InputError,
     MsbfsError,
     PoisonQueryError,
@@ -220,6 +221,7 @@ class MsbfsServer:
         request_timeout_s: Optional[float] = None,
         journal_path: Optional[str] = None,
         drain_deadline_s: Optional[float] = None,
+        epoch_path: Optional[str] = None,
     ):
         self.listen = listen
         self.registry = GraphRegistry()
@@ -267,6 +269,20 @@ class MsbfsServer:
         # order must match the applied order exactly).
         self._mutate_lock = threading.Lock()
         self._mutations = 0
+        # Exactly-once mutate (docs/SERVING.md "Cross-machine transport
+        # & fencing"): applied idempotency tokens, insertion-ordered so
+        # the bounded window evicts oldest-first.  Guarded by
+        # _mutate_lock (the same lock that orders the journal chain).
+        self._mutate_tokens: Dict[str, dict] = {}
+        self._mutate_dedup_window = _env_int("MSBFS_MUTATE_DEDUP_WINDOW",
+                                             1024)
+        self._mutations_deduplicated = 0
+        # Epoch fencing: the fleet supervisor's fsync'd membership
+        # counter, read (stat-cached) per epoch-carrying frame so a
+        # stale peer is refused without a syscall storm.
+        self._epoch_path = epoch_path
+        self._epoch_cache: Tuple[Optional[tuple], int] = (None, 0)
+        self._fenced_requests = 0
         self._requests_repaired = 0
         self._repair_fallbacks = 0
         self._planes_retained = 0
@@ -355,7 +371,13 @@ class MsbfsServer:
         if family == socket.AF_INET:
             self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(target)
-        self._sock.listen(64)
+        # Deep backlog: under a stampede burst the acceptor thread can
+        # be GIL-starved by query compute for whole seconds; a shallow
+        # queue then BLOCKS further unix connect()s until the dialer's
+        # timeout, shedding queries the replica could have served.
+        # Parked-in-backlog dials cost nothing and drain as the acceptor
+        # catches up (the kernel caps this at net.core.somaxconn).
+        self._sock.listen(512)
         # Closing a socket does NOT wake a thread blocked in accept() on
         # Linux; a short accept timeout bounds how long the acceptor can
         # outlive stop() (the leak check in tests/conftest.py watches).
@@ -473,6 +495,12 @@ class MsbfsServer:
                 )
                 self._refuse_replayed_graph(name, reason)
                 return
+            # Restore the dedup window BEFORE the verb gate opens: a
+            # retry whose original landed just before the kill must
+            # re-ack, not re-apply.  The i-th delta produced version
+            # i+1 (version 0 is the base file content).
+            self._record_mutate_token(rec.get("token"), name, i + 1,
+                                      rec["digest"])
 
     def _refuse_replayed_graph(self, name: str, reason: str) -> None:
         self.registry.evict(name)
@@ -649,9 +677,72 @@ class MsbfsServer:
         with use_trace(ctx):
             return self._handle(request)
 
+    def _current_epoch(self, refresh: bool = False) -> int:
+        """The fleet-membership epoch this replica serves under: the
+        supervisor's fsync'd counter file, cached by (mtime_ns, size) so
+        the steady state is one stat per frame, not one read.  No epoch
+        file (single-daemon deployment) = epoch 0."""
+        path = self._epoch_path
+        if path is None:
+            return 0
+        try:
+            st = os.stat(path)
+        except OSError:
+            return self._epoch_cache[1]
+        key = (st.st_mtime_ns, st.st_size)
+        if not refresh and self._epoch_cache[0] == key:
+            return self._epoch_cache[1]
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                val = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            val = self._epoch_cache[1]
+        self._epoch_cache = (key, val)
+        return val
+
+    def _check_epoch(self, frame_epoch) -> None:
+        """Fence a frame's membership view against ours (docs/SERVING.md
+        "Cross-machine transport & fencing").  Equal serves; stale is
+        refused — a partition-healed or resurrected peer must never be
+        served under an old view; FUTURE is also refused (after a
+        cache-busting re-read, in case the supervisor bumped the file
+        an instant ago): this replica's own view is the stale one, and
+        serving would journal/answer under a membership it does not yet
+        hold.  Frames without an epoch (pre-fencing peers, single-daemon
+        clients) pass — tolerated-absent, like the crc flag."""
+        if self._epoch_path is None:
+            return
+        try:
+            frame_epoch = int(frame_epoch)
+        except (TypeError, ValueError):
+            raise InputError(
+                f"frame 'epoch' must be an integer, got {frame_epoch!r}"
+            ) from None
+        local = self._current_epoch()
+        if frame_epoch != local:
+            local = self._current_epoch(refresh=True)
+        if frame_epoch == local:
+            return
+        with self._stats_lock:
+            self._fenced_requests += 1
+        if frame_epoch < local:
+            raise FencedError(
+                f"frame epoch {frame_epoch} is stale: fleet membership "
+                f"is at epoch {local}; refresh the view and resend",
+                frame_epoch=frame_epoch, local_epoch=local,
+            )
+        raise FencedError(
+            f"frame epoch {frame_epoch} is ahead of this replica's view "
+            f"({local}): the sender knows a membership this replica has "
+            "not observed; refusing to serve under a stale local view",
+            frame_epoch=frame_epoch, local_epoch=local,
+        )
+
     def _handle(self, request: dict) -> dict:
         op = request.get("op")
         try:
+            if "epoch" in request and request["epoch"] is not None:
+                self._check_epoch(request["epoch"])
             if op == "ping":
                 return {"ok": True, "op": "ping", "pid": os.getpid()}
             if op == "health":
@@ -718,6 +809,7 @@ class MsbfsServer:
             "version": _pkg_version(),
             "ready": self._ready.is_set(),
             "draining": self._draining,
+            "fleet_epoch": self._current_epoch(),
             "uptime_s": round(time.time() - self.started, 3),
             "graphs": sorted(self.registry.describe()),
             "graphs_warm": len(self.registry.describe()),
@@ -807,7 +899,33 @@ class MsbfsServer:
                 f"{MAX_WIRE_QUERIES * 4} per-request bound; split the "
                 "batch"
             )
+        token = request.get("token")
+        if token is not None and (not isinstance(token, str) or not token):
+            raise InputError("mutate 'token' must be a non-empty string")
         with self._mutate_lock:
+            if token is not None:
+                hit = self._mutate_tokens.get(token)
+                if hit is not None:
+                    # Exactly-once: a retry/hedge/duplicated frame whose
+                    # original already applied re-acks the ORIGINAL
+                    # version+digest — the chain advances once per token,
+                    # however many copies the network delivers.
+                    with self._stats_lock:
+                        self._mutations_deduplicated += 1
+                    entry = self.registry.maybe_get(hit["name"])
+                    record_flight("mutate_dedup", graph=hit["name"],
+                                  version=hit["version"])
+                    return {
+                        "ok": True,
+                        "op": "mutate",
+                        "graph": (entry.describe() if entry is not None
+                                  else {"name": hit["name"]}),
+                        "applied": {"inserts": 0, "deletes": 0},
+                        "deduplicated": True,
+                        "version": hit["version"],
+                        "digest": hit["digest"],
+                        "invalidated_results": 0,
+                    }
             old = self.registry.get(name)
             entry, batch = self.registry.mutate(name, inserts, deletes)
             entry.supervisor.drain_signal = self._drain_signal
@@ -819,19 +937,25 @@ class MsbfsServer:
                 )
                 entry.supervisor.audit_sample = self._posture_audit
             if self.journal is not None:
-                self.journal.append(
-                    {
-                        "op": "mutate",
-                        "name": name,
-                        "inserts": [
-                            [int(u), int(v)] for u, v in batch.inserts
-                        ],
-                        "deletes": [
-                            [int(u), int(v)] for u, v in batch.deletes
-                        ],
-                        "digest": batch.digest,
-                    }
-                )
+                journal_record = {
+                    "op": "mutate",
+                    "name": name,
+                    "inserts": [
+                        [int(u), int(v)] for u, v in batch.inserts
+                    ],
+                    "deletes": [
+                        [int(u), int(v)] for u, v in batch.deletes
+                    ],
+                    "digest": batch.digest,
+                }
+                if token is not None:
+                    # Token rides the journal so a retry that straddles
+                    # a kill -9 still dedups after replay.
+                    journal_record["token"] = token
+                self.journal.append(journal_record)
+            self._record_mutate_token(
+                token, name, entry.delta_version, batch.digest
+            )
         dropped = self.result_cache.drop_where(
             lambda k: isinstance(k, tuple) and k[0] == old.key
         )
@@ -852,8 +976,25 @@ class MsbfsServer:
                 "inserts": int(batch.inserts.shape[0]),
                 "deletes": int(batch.deletes.shape[0]),
             },
+            "deduplicated": False,
+            "version": entry.delta_version,
+            "digest": batch.digest,
             "invalidated_results": dropped,
         }
+
+    def _record_mutate_token(self, token: Optional[str], name: str,
+                             version: int, digest: str) -> None:
+        """Remember an applied token in the bounded dedup window
+        (``MSBFS_MUTATE_DEDUP_WINDOW``, FIFO eviction; <= 0 disables).
+        Caller holds ``_mutate_lock`` (or is the single-threaded replay
+        before the verb gate opens)."""
+        if not token or self._mutate_dedup_window <= 0:
+            return
+        self._mutate_tokens[token] = {
+            "name": name, "version": int(version), "digest": digest,
+        }
+        while len(self._mutate_tokens) > self._mutate_dedup_window:
+            self._mutate_tokens.pop(next(iter(self._mutate_tokens)))
 
     def _op_versions(self, request: dict) -> dict:
         """The graph's version chain: one row per delta version, digests
@@ -1439,6 +1580,11 @@ class MsbfsServer:
             refused = dict(self._refused_graphs)
             dynamic = {
                 "mutations": self._mutations,
+                "mutations_deduplicated": self._mutations_deduplicated,
+                "dedup_window": {
+                    "capacity": self._mutate_dedup_window,
+                    "tokens": len(self._mutate_tokens),
+                },
                 "requests_repaired": self._requests_repaired,
                 "repair_fallbacks": self._repair_fallbacks,
                 "planes_retained": self._planes_retained,
@@ -1490,6 +1636,8 @@ class MsbfsServer:
             "requests_failed": failed,
             "requests_shed": shed,
             "requests_quarantined": quarantined,
+            "fleet_epoch": self._current_epoch(),
+            "fenced_requests": self._fenced_requests,
             "audited": audited,
             "audit_failures": audit_failures,
             "refused_graphs": refused,
@@ -1545,6 +1693,12 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         help="graceful-drain deadline on SIGTERM/SIGINT in seconds "
         "(default MSBFS_SERVE_DRAIN or 10)",
     )
+    ap.add_argument(
+        "--epoch-file", default=None, metavar="PATH",
+        help="fleet-membership epoch file (written by the fleet "
+        "supervisor); frames carrying a different epoch are refused "
+        "with FencedError (exit 10, docs/SERVING.md)",
+    )
     args = ap.parse_args(argv)
     graphs: Dict[str, str] = {}
     for spec in args.graph:
@@ -1561,6 +1715,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             result_cache_size=args.result_cache,
             journal_path=args.journal,
             drain_deadline_s=args.drain_s,
+            epoch_path=args.epoch_file,
         )
         server.start()
     except MsbfsError as err:

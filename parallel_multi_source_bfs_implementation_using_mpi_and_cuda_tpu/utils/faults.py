@@ -87,6 +87,52 @@ journal, serve/journal.py).  Kinds:
                colocated replicas vanish in the same instant, and only
                cross-host placement keeps the graph reachable).
 
+Network chaos kinds (the message-level layer, docs/RESILIENCE.md "The
+network is not reliable"): these trip on the router's forwarding seam
+(``route<r>``) like ``wire_corrupt``, but instead of raising they ARM a
+thread-local *frame filter* that :func:`..serve.protocol.send_frame` /
+``recv_frame`` consume — so whole frames are dropped, delayed,
+duplicated, reordered or black-holed at the protocol seam itself,
+deterministically, composable with every kind above.
+
+``net_partition``  site is ``<groupA|groupB>`` where each group is
+               ``.``-joined route members (e.g.
+               ``net_partition:route0.route1|route2:1``).  From the
+               ``n``-th trip of any member route on, every frame that
+               would CROSS the cut — the sending thread's side (default
+               group A; :class:`net_side` declares B) differs from the
+               target route's group — is dropped at ``send_frame`` with
+               :class:`SimulatedPartitionDrop`.  LATCHED: it keeps
+               firing until :func:`heal` (or ``plan.heal()``) lifts it —
+               a partition is weather, not a one-shot event.
+``net_delay``  site must be ``route<r>``; the third slot is
+               MILLISECONDS, not a trip count (e.g.
+               ``net_delay:route1:250``).  Every frame sent to that
+               route sleeps that long at the protocol seam first — a
+               deterministic slow link (vs ``replica_slow``'s slow
+               replica), for the hedging and read-timeout paths.
+``net_dup``    site must be ``route<r>``; on the ``n``-th trip the next
+               frame this thread sends is transmitted TWICE — the lossy
+               network's retransmit-after-lost-ack, byte-for-byte.  The
+               receiver processes both copies, which is exactly what
+               the ``mutate`` idempotency-token dedup window exists to
+               survive (docs/SERVING.md "Cross-machine transport &
+               fencing").
+``net_reorder``  site must be ``route<r>``; on the ``n``-th trip the
+               next frame this thread sends is HELD, and transmitted
+               after the following frame (whole-frame reordering).  A
+               held frame is flushed before any read on the same thread,
+               so a request/response exchange is delayed, never
+               deadlocked.
+``half_open``  site must be ``route<r>``; on the ``n``-th trip the next
+               frame this thread sends is written into a black hole —
+               ``send_frame`` reports success, the peer never sees the
+               bytes, and the following ``recv_frame`` on this thread
+               raises :class:`SimulatedHalfOpen` (the read-timeout shape
+               of a half-open TCP connection whose peer silently died;
+               the TIMED OUT mark classifies it transient, which is what
+               the keepalive/read-timeout knobs turn into detection).
+
 Example: ``MSBFS_FAULTS="io:load_graph:1,oom:dispatch:2,hang:dispatch:3,
 chip:rank1:1"``.  Trip counters are plain per-site integers, so a given
 plan replays identically for a given call sequence; ``MSBFS_FAULT_SEED``
@@ -105,7 +151,8 @@ from typing import Dict, List, Optional
 
 KINDS = ("io", "corrupt", "oom", "transient", "hang", "chip", "crash",
          "poison", "replica_kill", "replica_slow", "net_drop", "bitflip",
-         "wire_corrupt", "host_down")
+         "wire_corrupt", "host_down", "net_partition", "net_delay",
+         "net_dup", "net_reorder", "half_open")
 
 _RANK_RE = re.compile(r"rank(\d+)\Z")
 _VERTEX_RE = re.compile(r"vertex(\d+)\Z")
@@ -176,6 +223,32 @@ class SimulatedHostDown(RuntimeError):
         self.host = str(host)
 
 
+class SimulatedPartitionDrop(SimulatedNetDrop):
+    """A frame dropped at the partition cut: the sending thread's side
+    and the target route's group sit on opposite shores of an armed
+    ``net_partition``.  A :class:`SimulatedNetDrop` subclass so every
+    existing failover path (router owner walk, client transport wrap)
+    handles it identically; carries both sides for the chain tests."""
+
+    def __init__(self, msg: str, replica: int, side: str, target_side: str):
+        super().__init__(msg, replica)
+        self.side = str(side)
+        self.target_side = str(target_side)
+
+
+class SimulatedHalfOpen(RuntimeError):
+    """A read against a half-open connection: the peer died after the
+    write was accepted, so the bytes went into a black hole and the
+    response never comes.  Raised by ``recv_frame`` when the preceding
+    ``send_frame`` consumed a ``half_open`` filter.  The TIMED OUT mark
+    classifies it :class:`~..runtime.supervisor.TransientError` — the
+    same shape a real ``MSBFS_NET_READ_TIMEOUT_S`` expiry produces."""
+
+    def __init__(self, msg: str, replica: int):
+        super().__init__(msg)
+        self.replica = int(replica)
+
+
 class SimulatedPoison(RuntimeError):
     """A query whose content deterministically kills its dispatch —
     retrying or resizing the batch never helps, only removing the row
@@ -194,7 +267,10 @@ class FaultSpec:
     replica: Optional[int] = None  # fleet faults (replica_kill/slow/net_drop)
     host: Optional[str] = None  # host_down faults only
     fired: bool = False
-    matches: int = 0  # poison: dispatches that contained the vertex
+    matches: int = 0  # poison/partition/delay: matching trips so far
+    groups: Optional[tuple] = None  # net_partition: (frozenset, frozenset)
+    delay_ms: int = 0  # net_delay: injected per-frame latency
+    healed: bool = False  # net_partition: True once heal() lifted the cut
 
     @property
     def trip_site(self) -> str:
@@ -276,7 +352,9 @@ class FaultPlan:
                         "site replica<r> (e.g. replica_kill:replica0:3)"
                     )
                 replica = int(m.group(1))
-            if kind in ("replica_slow", "net_drop", "wire_corrupt"):
+            if kind in ("replica_slow", "net_drop", "wire_corrupt",
+                        "net_dup", "net_reorder", "half_open",
+                        "net_delay"):
                 m = _ROUTE_RE.match(site)
                 if not m:
                     raise ValueError(
@@ -284,6 +362,42 @@ class FaultPlan:
                         f"route<r> (e.g. {kind}:route1:1)"
                     )
                 replica = int(m.group(1))
+            delay_ms = 0
+            if kind == "net_delay":
+                # The third slot is MILLISECONDS, not a trip count: a
+                # delay is a property of the link, applied to every
+                # frame, so there is nothing for a count to select.
+                delay_ms = at
+                at = 1
+            groups = None
+            if kind == "net_partition":
+                halves = site.split("|")
+                if len(halves) != 2 or not all(halves):
+                    raise ValueError(
+                        f"fault spec {raw!r}: net_partition needs site "
+                        "<groupA|groupB> with '.'-joined route members "
+                        "(e.g. net_partition:route0.route1|route2:1)"
+                    )
+                parsed_groups = []
+                for half in halves:
+                    members = set()
+                    for member in half.split("."):
+                        m = _ROUTE_RE.match(member)
+                        if not m:
+                            raise ValueError(
+                                f"fault spec {raw!r}: net_partition "
+                                f"group member {member!r} is not "
+                                "route<r>"
+                            )
+                        members.add(int(m.group(1)))
+                    parsed_groups.append(frozenset(members))
+                if parsed_groups[0] & parsed_groups[1]:
+                    both = sorted(parsed_groups[0] & parsed_groups[1])
+                    raise ValueError(
+                        f"fault spec {raw!r}: routes {both} appear on "
+                        "both sides of the partition"
+                    )
+                groups = tuple(parsed_groups)
             if kind == "bitflip" and site != "dist" \
                     and not _PLANE_RE.match(site):
                 raise ValueError(
@@ -302,7 +416,8 @@ class FaultPlan:
                 host = site
             specs.append(FaultSpec(kind=kind, site=site, at=at, rank=rank,
                                    vertex=vertex, replica=replica,
-                                   host=host))
+                                   host=host, groups=groups,
+                                   delay_ms=delay_ms))
         return cls(specs, hang_seconds=hang_seconds,
                    slow_seconds=slow_seconds)
 
@@ -327,6 +442,7 @@ class FaultPlan:
             for s in self.specs:
                 s.fired = False
                 s.matches = 0
+                s.healed = False
 
     @staticmethod
     def _poison_match(spec: FaultSpec, context) -> bool:
@@ -363,14 +479,19 @@ class FaultPlan:
                 for s in self.specs
                 # bitflip is a mutating fault: it is delivered by
                 # :meth:`corrupt` (which hands back a modified buffer),
-                # never by a raise-style trip.
-                if s.kind not in ("poison", "bitflip")
+                # never by a raise-style trip.  poison and the repeating
+                # network kinds (partition, delay) have their own match
+                # clauses below.
+                if s.kind not in ("poison", "bitflip", "net_partition",
+                                  "net_delay")
                 and s.trip_site == site
                 and s.at == count
                 and not s.fired
             ]
             for s in due:
                 s.fired = True
+            route = _ROUTE_RE.match(site)
+            route_idx = int(route.group(1)) if route else None
             for s in self.specs:
                 if (
                     s.kind == "poison"
@@ -380,8 +501,29 @@ class FaultPlan:
                     s.matches += 1
                     if s.matches >= s.at:
                         due.append(s)
+                elif (
+                    s.kind == "net_delay"
+                    and s.trip_site == site
+                ):
+                    # A slow link delays EVERY frame, never one-shot.
+                    s.matches += 1
+                    s.fired = True
+                    due.append(s)
+                elif (
+                    s.kind == "net_partition"
+                    and not s.healed
+                    and route_idx is not None
+                    and (route_idx in s.groups[0]
+                         or route_idx in s.groups[1])
+                ):
+                    # Latched: from the at-th trip of any member route
+                    # on, every CROSSING frame drops until heal().
+                    s.matches += 1
+                    if s.matches >= s.at and _crosses(s, route_idx):
+                        s.fired = True
+                        due.append(s)
         for s in due:  # outside the lock: hangs sleep, fires raise
-            self._fire(s)
+            self._fire(s, tripped_site=site)
 
     def pending(self) -> List[FaultSpec]:
         with self._lock:
@@ -417,7 +559,17 @@ class FaultPlan:
             return arr
         return _flip_bit(arr, site)
 
-    def _fire(self, s: FaultSpec) -> None:
+    def heal(self) -> None:
+        """Lift every armed ``net_partition`` (the switch comes back, the
+        cable is replugged): crossing frames flow again.  Trip counters
+        and every other spec are untouched — healing a partition must
+        not re-arm unrelated faults."""
+        with self._lock:
+            for s in self.specs:
+                if s.kind == "net_partition":
+                    s.healed = True
+
+    def _fire(self, s: FaultSpec, tripped_site: Optional[str] = None) -> None:
         where = f"at {s.site} (trip {s.at})"
         if s.kind == "io":
             raise IOError(f"injected io fault {where}")
@@ -475,6 +627,31 @@ class FaultPlan:
             # frame actually crosses the wire — the crc32 check on the
             # receiving side is the recovery path under test.
             arm_wire_corruption()
+            return
+        # The frame-level network kinds arm thread-local filters the
+        # protocol seam (serve/protocol.py) consumes — the call must
+        # PROCEED so the drop/delay/dup/reorder/black-hole happens to an
+        # actual frame, at the actual send/recv, not to this trip.
+        if s.kind == "net_partition":
+            m = _ROUTE_RE.match(tripped_site or "")
+            target = int(m.group(1)) if m else (s.replica or 0)
+            side = net_side.current()
+            target_side = "A" if target in s.groups[0] else "B"
+            arm_frame_chaos("drop", replica=target, spec=s,
+                            side=side, target_side=target_side)
+            return
+        if s.kind == "net_delay":
+            arm_frame_chaos("delay", replica=s.replica,
+                            delay_ms=s.delay_ms, spec=s)
+            return
+        if s.kind == "net_dup":
+            arm_frame_chaos("dup", replica=s.replica, spec=s)
+            return
+        if s.kind == "net_reorder":
+            arm_frame_chaos("reorder", replica=s.replica, spec=s)
+            return
+        if s.kind == "half_open":
+            arm_frame_chaos("half_open", replica=s.replica, spec=s)
             return
         raise AssertionError(f"unreachable kind {s.kind!r}")
 
@@ -554,6 +731,137 @@ def consume_wire_taint() -> bool:
     armed = getattr(_WIRE_TAINT, "armed", False)
     _WIRE_TAINT.armed = False
     return armed
+
+
+# ---- frame chaos (net_partition/net_delay/net_dup/net_reorder/half_open) --
+# Same arm-at-the-trip, consume-at-the-seam discipline as the wire taint
+# above, but the payload is a FILTER LIST: one trip can arm several
+# filters (a delayed duplicate, a reordered frame on a partitioned
+# link), and protocol.send_frame applies them in arm order.
+_FRAME_CHAOS = threading.local()
+
+_NET_SIDES = ("A", "B")
+
+
+def _crosses(spec: FaultSpec, route_idx: int) -> bool:
+    """True when the calling thread's declared side and ``route_idx``'s
+    group sit on opposite shores of ``spec``'s partition.  A route in
+    NEITHER group never crosses (the spec simply does not match it)."""
+    side = net_side.current()
+    target_side = "A" if route_idx in spec.groups[0] else "B"
+    return side != target_side
+
+
+class net_side:
+    """``with net_side("B"):`` — declare which shore of an armed
+    ``net_partition`` this thread's traffic originates from.  Default
+    is ``"A"`` (the first group), so single-sided tests need no
+    declaration; the partition-heal chain drives traffic into BOTH
+    sides by running one load thread per shore."""
+
+    def __init__(self, side: str):
+        side = str(side).upper()
+        if side not in _NET_SIDES:
+            raise ValueError(
+                f"net_side {side!r}: want one of {_NET_SIDES}"
+            )
+        self.side = side
+        self._prev: Optional[str] = None
+
+    @staticmethod
+    def current() -> str:
+        return getattr(_FRAME_CHAOS, "side", "A")
+
+    def __enter__(self) -> "net_side":
+        self._prev = getattr(_FRAME_CHAOS, "side", None)
+        _FRAME_CHAOS.side = self.side
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._prev is None:
+            _FRAME_CHAOS.side = "A"
+        else:
+            _FRAME_CHAOS.side = self._prev
+
+
+def arm_frame_chaos(mode: str, replica=None, delay_ms: int = 0,
+                    spec: Optional[FaultSpec] = None, side: str = "A",
+                    target_side: str = "A") -> None:
+    """Arm one thread-local frame filter; the next
+    ``protocol.send_frame`` on this thread consumes the whole list."""
+    pending = getattr(_FRAME_CHAOS, "pending", None)
+    if pending is None:
+        pending = _FRAME_CHAOS.pending = []
+    pending.append({
+        "mode": mode,
+        "replica": replica,
+        "delay_ms": int(delay_ms),
+        "spec": spec,
+        "side": side,
+        "target_side": target_side,
+    })
+
+
+def consume_frame_chaos() -> list:
+    """Check-and-clear the armed filter list (called by
+    ``protocol.send_frame``)."""
+    pending = getattr(_FRAME_CHAOS, "pending", None)
+    _FRAME_CHAOS.pending = []
+    return pending or []
+
+
+def peek_frame_chaos() -> list:
+    """Non-consuming view of the armed filters — lets fast unit tests
+    verify a ``net_delay`` armed WITHOUT paying the sleep a real send
+    would."""
+    return list(getattr(_FRAME_CHAOS, "pending", None) or [])
+
+
+def arm_read_blackhole(replica=None) -> None:
+    """Arm the half-open read black hole: the next ``recv_frame`` on
+    this thread raises :class:`SimulatedHalfOpen` instead of reading
+    (the peer took our bytes and died; the response never comes)."""
+    _FRAME_CHAOS.blackhole = -1 if replica is None else int(replica)
+
+
+def consume_read_blackhole():
+    """Check-and-clear the black hole (called by ``recv_frame``).
+    Returns None when unarmed, else the armed replica index (-1 when
+    unknown)."""
+    armed = getattr(_FRAME_CHAOS, "blackhole", None)
+    _FRAME_CHAOS.blackhole = None
+    return armed
+
+
+def raise_partition_drop(replica, side: str, target_side: str):
+    """Deliver a consumed ``drop`` filter (called by ``send_frame``).
+    Lives here — not at the protocol seam — so every ``Simulated*``
+    raise stays inside this module, the one file the error-contract
+    lint exempts for imitating raw infrastructure failures."""
+    raise SimulatedPartitionDrop(
+        f"simulated network partition: frame to replica {replica} "
+        "crossed the cut and was dropped (UNAVAILABLE)",
+        replica if replica is not None else -1,
+        side, target_side,
+    )
+
+
+def raise_half_open(replica: int):
+    """Deliver a consumed read black hole (called by ``recv_frame``);
+    see :func:`raise_partition_drop` for why the raise lives here."""
+    raise SimulatedHalfOpen(
+        "simulated half-open connection: the request to replica "
+        f"{replica} was swallowed by a dead peer's socket and the "
+        "read TIMED OUT",
+        replica,
+    )
+
+
+def heal() -> None:
+    """Module-level convenience: lift every ``net_partition`` of the
+    active plan (no-op without one)."""
+    if _active is not None:
+        _active.heal()
 
 
 class injected:

@@ -114,6 +114,10 @@ _ALL = (
     _k("MSBFS_FLEET_DIR", None, "path", "fleet replica sockets/journals/logs directory"),
     _k("MSBFS_FLEET_BACKOFF", "0.2", "float", "replica restart base backoff in seconds"),
     _k("MSBFS_VOTE", "off", "spec", "cross-replica vote: off / on / sample rate in (0,1)"),
+    _k("MSBFS_NET_CONNECT_TIMEOUT_S", "5", "float", "socket connect deadline in seconds when the caller gave none; 0 = blocking"),
+    _k("MSBFS_NET_READ_TIMEOUT_S", "0", "float", "per-read socket timeout after connect; 0 = inherit the request timeout"),
+    _k("MSBFS_NET_KEEPALIVE", "1", "flag", "0 disables SO_KEEPALIVE on TCP fleet legs"),
+    _k("MSBFS_MUTATE_DEDUP_WINDOW", "1024", "int", "exactly-once mutate: applied idempotency tokens remembered per daemon"),
     # --- dynamic graphs (dynamic/) ---
     _k("MSBFS_REPAIR_MAX_FRAC", "0.5", "float", "repair-cone fraction above which repair falls back to full recompute"),
     # --- observability (utils/telemetry.py, utils/trace.py) ---

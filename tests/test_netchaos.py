@@ -1,0 +1,962 @@
+"""Network-chaos suite (docs/SERVING.md "Cross-machine transport &
+fencing"): the message-level fault kinds (``net_partition`` /
+``net_delay`` / ``net_dup`` / ``net_reorder`` / ``half_open``) — parse
+grammar, trip semantics (latched partitions, per-frame delays, one-shot
+dup/reorder/half-open), and the protocol-seam delivery over a real
+socketpair; byte-level fuzz of the frame reader under truncation and
+mid-stream duplication/reordering; the epoch-fence matrix (equal /
+stale / future) at the placement ring, the replica, the fleet front
+end, and the router's stamping side; exactly-once mutate (token dedup,
+window eviction, journal replay, the tokenless-retry refusal); the TCP
+transport knobs; and — slow-marked for the tier-1 wall-clock budget —
+the multi-process partition-heal chain over loopback TCP: partition a
+real 3-replica fleet, drive traffic into both shores, heal, and pin
+zero lost acks, zero double-applied mutations, and at least one
+stale-epoch frame provably refused with ``FencedError``.
+"""
+
+import os
+import socket
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from virtual_cpu import virtual_cpu_env  # noqa: E402
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (  # noqa: E402
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (  # noqa: E402
+    FencedError,
+    InputError,
+    TransientError,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve import (  # noqa: E402
+    protocol,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (  # noqa: E402
+    MsbfsClient,
+    ServerError,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.fleet import (  # noqa: E402
+    FleetSupervisor,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.registry import (  # noqa: E402
+    content_hash,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.ring import (  # noqa: E402
+    PlacementRing,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.router import (  # noqa: E402
+    FleetFrontend,
+    FleetRouter,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (  # noqa: E402
+    MsbfsServer,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils import (  # noqa: E402
+    faults,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (  # noqa: E402
+    save_graph_bin,
+)
+
+QS = [[1, 2], [3, 4]]
+
+
+def answer(out: dict):
+    return (out["f_values"], out["min_f"], out["min_k"])
+
+
+@pytest.fixture(autouse=True)
+def _chaos_hygiene():
+    """Every test leaves the process chaos-free: no active plan, no
+    armed thread-local frame filters, no read black hole, no frame held
+    for reordering — a leak here would fire inside an unrelated later
+    test, far from the guilty one."""
+    yield
+    faults.activate(None)
+    faults.consume_frame_chaos()
+    faults.consume_read_blackhole()
+    held = getattr(protocol._REORDER, "held", None)
+    if held:
+        protocol._REORDER.held = []
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+# ---------------------------------------------------------------------------
+# Fault grammar: the five network kinds parse (and refuse) correctly
+# ---------------------------------------------------------------------------
+
+
+def test_parse_net_kind_matrix():
+    plan = faults.FaultPlan.parse(
+        "net_delay:route1:250,net_dup:route0:2,net_reorder:route2:1,"
+        "half_open:route3:4,net_partition:route0.route1|route2:3"
+    )
+    by_kind = {s.kind: s for s in plan.specs}
+    assert set(by_kind) == {"net_delay", "net_dup", "net_reorder",
+                           "half_open", "net_partition"}
+    # net_delay: slot 3 is MILLISECONDS, normalized to an every-frame
+    # (at=1) spec on the named route.
+    d = by_kind["net_delay"]
+    assert d.replica == 1 and d.delay_ms == 250 and d.at == 1
+    assert by_kind["net_dup"].replica == 0 and by_kind["net_dup"].at == 2
+    assert by_kind["net_reorder"].replica == 2
+    assert by_kind["half_open"].replica == 3 and by_kind["half_open"].at == 4
+    p = by_kind["net_partition"]
+    assert p.groups == (frozenset({0, 1}), frozenset({2}))
+    assert p.at == 3 and not p.healed
+
+
+def test_parse_net_kinds_refuse_malformed_specs():
+    # A route on both shores is a contradiction, not a config.
+    with pytest.raises(ValueError, match="both sides"):
+        faults.FaultPlan.parse("net_partition:route0.route1|route1:1")
+    # Group members must be route<r>.
+    with pytest.raises(ValueError, match="is not route<r>"):
+        faults.FaultPlan.parse("net_partition:route0|replica1:1")
+    # One-sided cut is not a partition.
+    with pytest.raises(ValueError, match="net_partition needs site"):
+        faults.FaultPlan.parse("net_partition:route0:1")
+    # The one-shot kinds need a route site, like net_drop before them.
+    for kind in ("net_delay", "net_dup", "net_reorder", "half_open"):
+        with pytest.raises(ValueError, match="route<r>"):
+            faults.FaultPlan.parse(f"{kind}:replica0:1")
+
+
+def test_net_side_validates_and_scopes():
+    assert faults.net_side.current() == "A"
+    with faults.net_side("B"):
+        assert faults.net_side.current() == "B"
+        with faults.net_side("A"):
+            assert faults.net_side.current() == "A"
+        assert faults.net_side.current() == "B"
+    assert faults.net_side.current() == "A"
+    with pytest.raises(ValueError):
+        faults.net_side("C")
+
+
+# ---------------------------------------------------------------------------
+# Trip semantics: what a route trip arms (peeked, never slept)
+# ---------------------------------------------------------------------------
+
+
+def _armed_modes():
+    return [f["mode"] for f in faults.peek_frame_chaos()]
+
+
+def test_net_delay_arms_every_frame_without_sleeping():
+    with faults.injected(faults.FaultPlan.parse("net_delay:route1:250")):
+        faults.trip("route0")
+        assert _armed_modes() == []  # wrong route: untouched
+        for _ in range(3):  # EVERY frame on the slow link, never one-shot
+            faults.trip("route1")
+            armed = faults.peek_frame_chaos()
+            assert [f["mode"] for f in armed] == ["delay"]
+            assert armed[0]["delay_ms"] == 250
+            faults.consume_frame_chaos()
+
+
+def test_one_shot_kinds_fire_on_nth_trip_only():
+    for kind, mode in (("net_dup", "dup"), ("net_reorder", "reorder"),
+                       ("half_open", "half_open")):
+        with faults.injected(faults.FaultPlan.parse(f"{kind}:route2:2")):
+            faults.trip("route2")
+            assert _armed_modes() == []  # first trip: not yet due
+            faults.trip("route2")
+            armed = faults.peek_frame_chaos()
+            assert [f["mode"] for f in armed] == [mode]
+            assert armed[0]["replica"] == 2
+            faults.consume_frame_chaos()
+            faults.trip("route2")
+            assert _armed_modes() == []  # one-shot: spent
+
+
+def test_net_partition_latches_drops_crossing_frames_and_heals():
+    with faults.injected(
+        faults.FaultPlan.parse("net_partition:route0|route1.route2:2")
+    ) as plan:
+        faults.trip("route1")  # 1st member trip: cut not latched yet
+        assert _armed_modes() == []
+        faults.trip("route0")  # 2nd trip latches — but A->A never crosses
+        assert _armed_modes() == []
+        faults.trip("route1")  # A -> B: crosses the cut
+        armed = faults.peek_frame_chaos()
+        assert [f["mode"] for f in armed] == ["drop"]
+        assert armed[0]["side"] == "A" and armed[0]["target_side"] == "B"
+        faults.consume_frame_chaos()
+        with faults.net_side("B"):
+            faults.trip("route2")  # B -> B: same shore
+            assert _armed_modes() == []
+            faults.trip("route0")  # B -> A: crosses
+            assert _armed_modes() == ["drop"]
+            faults.consume_frame_chaos()
+        faults.trip("route7")  # not a member of either group: untouched
+        assert _armed_modes() == []
+        plan.heal()
+        faults.trip("route1")  # the cable is back: nothing drops
+        assert _armed_modes() == []
+        assert all(s.healed for s in plan.specs)
+
+
+# ---------------------------------------------------------------------------
+# The protocol seam: armed filters applied to real frames on a socketpair
+# ---------------------------------------------------------------------------
+
+
+def test_partition_drop_raises_unavailable_and_writes_nothing():
+    a, b = _pair()
+    try:
+        faults.arm_frame_chaos("drop", replica=1, side="A", target_side="B")
+        with pytest.raises(faults.SimulatedPartitionDrop) as ei:
+            protocol.send_frame(a, {"op": "ping"})
+        assert "UNAVAILABLE" in str(ei.value)
+        assert ei.value.replica == 1
+        assert ei.value.side == "A" and ei.value.target_side == "B"
+        assert isinstance(ei.value, faults.SimulatedNetDrop)  # failover path
+        # Nothing crossed the wire, and the seam consumed the filter:
+        # the next frame flows clean.
+        protocol.send_frame(a, {"op": "after"})
+        assert protocol.recv_frame(b) == {"op": "after"}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_net_dup_delivers_the_same_frame_twice():
+    a, b = _pair()
+    try:
+        with faults.injected(faults.FaultPlan.parse("net_dup:route0:1")):
+            faults.trip("route0")
+            protocol.send_frame(a, {"op": "mutate", "token": "t"})
+        first = protocol.recv_frame(b)
+        second = protocol.recv_frame(b)
+        assert first == second == {"op": "mutate", "token": "t"}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_net_reorder_holds_one_frame_until_the_next_overtakes():
+    a, b = _pair()
+    try:
+        faults.arm_frame_chaos("reorder", replica=0)
+        protocol.send_frame(a, {"seq": 1})  # held: nothing on the wire yet
+        b.settimeout(0.2)
+        with pytest.raises(socket.timeout):
+            b.recv(1)
+        b.settimeout(5.0)
+        protocol.send_frame(a, {"seq": 2})  # overtakes, then flushes seq 1
+        assert protocol.recv_frame(b) == {"seq": 2}
+        assert protocol.recv_frame(b) == {"seq": 1}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_net_reorder_flushes_before_a_read_to_avoid_self_deadlock():
+    a, b = _pair()
+    try:
+        faults.arm_frame_chaos("reorder", replica=0)
+        protocol.send_frame(a, {"seq": 1})  # held
+        protocol.send_frame(b, {"pong": True})
+        # The held request goes out before this thread blocks reading —
+        # otherwise a request/response pair would wait on itself.
+        assert protocol.recv_frame(a) == {"pong": True}
+        assert protocol.recv_frame(b) == {"seq": 1}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_half_open_swallows_the_write_and_times_out_the_read():
+    a, b = _pair()
+    try:
+        faults.arm_frame_chaos("half_open", replica=3)
+        protocol.send_frame(a, {"op": "query"})  # reported sent; wrote nothing
+        b.settimeout(0.2)
+        with pytest.raises(socket.timeout):
+            b.recv(1)
+        with pytest.raises(faults.SimulatedHalfOpen) as ei:
+            protocol.recv_frame(a)
+        assert "TIMED OUT" in str(ei.value)
+        assert ei.value.replica == 3
+    finally:
+        a.close()
+        b.close()
+
+
+def test_net_delay_sleeps_then_delivers_intact():
+    a, b = _pair()
+    try:
+        faults.arm_frame_chaos("delay", delay_ms=5)
+        t0 = time.monotonic()
+        protocol.send_frame(a, {"op": "ping"})
+        assert time.monotonic() - t0 >= 0.005
+        assert protocol.recv_frame(b) == {"op": "ping"}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_chaos_composes_with_wire_corrupt():
+    """``net_dup`` + ``wire_corrupt`` on the same frame: both copies of
+    the retransmission carry the flipped bit, and the receiver's crc
+    check refuses each one — composition at the seam, not either kind
+    alone."""
+    a, b = _pair()
+    try:
+        faults.arm_wire_corruption()
+        faults.arm_frame_chaos("dup", replica=0)
+        protocol.send_frame(a, {"op": "query", "queries": QS})
+        for _ in range(2):
+            with pytest.raises(protocol.ProtocolError):
+                protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Byte-level frame-reader fuzz: truncation, duplication, reordering
+# ---------------------------------------------------------------------------
+
+
+def test_recv_frame_truncation_fuzz_every_byte_boundary():
+    frame = protocol.encode_frame({"op": "mutate", "token": "tok-fuzz",
+                                   "inserts": [[1, 2]], "deletes": []})
+    for cut in range(len(frame) + 1):
+        a, b = _pair()
+        try:
+            if cut:
+                a.sendall(frame[:cut])
+            a.close()
+            if cut == 0:
+                assert protocol.recv_frame(b) is None  # clean EOF
+            elif cut < len(frame):
+                with pytest.raises(protocol.ProtocolError):
+                    protocol.recv_frame(b)  # peer vanished mid-frame
+            else:
+                assert protocol.recv_frame(b)["token"] == "tok-fuzz"
+                assert protocol.recv_frame(b) is None
+        finally:
+            b.close()
+
+
+def test_recv_frame_survives_midstream_duplication_and_reordering():
+    f1 = protocol.encode_frame({"seq": 1})
+    f2 = protocol.encode_frame({"seq": 2})
+    # Duplicated frame: framing resynchronizes, both copies decode.
+    a, b = _pair()
+    try:
+        a.sendall(f1 + f1)
+        assert protocol.recv_frame(b) == {"seq": 1}
+        assert protocol.recv_frame(b) == {"seq": 1}
+    finally:
+        a.close()
+        b.close()
+    # Reordered frames: decoded in wire order, each intact.
+    a, b = _pair()
+    try:
+        a.sendall(f2 + f1)
+        assert protocol.recv_frame(b) == {"seq": 2}
+        assert protocol.recv_frame(b) == {"seq": 1}
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Epoch fencing: the equal/stale/future matrix at every layer
+# ---------------------------------------------------------------------------
+
+
+def test_ring_epoch_bumps_on_every_membership_change():
+    ring = PlacementRing(["r0", "r1"], replication=2)
+    assert ring.epoch == 0
+    ring.add_member("r2")
+    assert ring.epoch == 1
+    ring.remove_member("r2")
+    assert ring.epoch == 2
+    assert PlacementRing(["r0"], replication=1, epoch=7).epoch == 7
+
+
+def test_replica_epoch_fence_matrix(tmp_path):
+    epoch_path = str(tmp_path / "epoch")
+    with open(epoch_path, "w", encoding="utf-8") as f:
+        f.write("2\n")
+    srv = MsbfsServer(listen=f"unix:{tmp_path}/unused.sock",
+                      epoch_path=epoch_path)
+    # Equal serves; absent and null-epoch frames pass (tolerated-absent).
+    assert srv.handle({"op": "ping", "epoch": 2})["ok"] is True
+    assert srv.handle({"op": "ping"})["ok"] is True
+    assert srv.handle({"op": "ping", "epoch": None})["ok"] is True
+    # Stale and future are both refused, typed, exit 10, both views
+    # carried in the message.
+    for frame_epoch, mark in ((1, "stale"), (3, "ahead")):
+        out = srv.handle({"op": "ping", "epoch": frame_epoch})
+        assert out["ok"] is False
+        assert out["error"]["type"] == "FencedError"
+        assert out["error"]["exit_code"] == 10
+        assert mark in out["error"]["message"]
+    # Garbage epochs are an input error, not a fence.
+    out = srv.handle({"op": "ping", "epoch": "soon"})
+    assert out["error"]["type"] == "InputError"
+    # A replica with no epoch file serves every view (single-daemon).
+    solo = MsbfsServer(listen=f"unix:{tmp_path}/unused2.sock")
+    assert solo.handle({"op": "ping", "epoch": 99})["ok"] is True
+
+
+def test_replica_epoch_cache_busts_when_the_supervisor_bumps(tmp_path):
+    epoch_path = str(tmp_path / "epoch")
+    with open(epoch_path, "w", encoding="utf-8") as f:
+        f.write("1\n")
+    srv = MsbfsServer(listen=f"unix:{tmp_path}/unused.sock",
+                      epoch_path=epoch_path)
+    assert srv.handle({"op": "ping", "epoch": 1})["ok"] is True
+    # The supervisor bumps the file; a frame already carrying the NEW
+    # view must be served (the mismatch forces one cache-busting
+    # re-read), and the old view is now refused.
+    with open(epoch_path, "w", encoding="utf-8") as f:
+        f.write("2\n")
+    assert srv.handle({"op": "ping", "epoch": 2})["ok"] is True
+    out = srv.handle({"op": "ping", "epoch": 1})
+    assert out["error"]["type"] == "FencedError"
+
+
+def test_frontend_epoch_fence_matrix(tmp_path):
+    ring = PlacementRing(["r0", "r1"], replication=2, epoch=2)
+    addresses = {m: f"unix:{tmp_path}/{m}.sock" for m in ring.members}
+    router = FleetRouter(ring, addresses, {})
+    fe = FleetFrontend(f"unix:{tmp_path}/fe.sock", router)  # never started
+    assert fe.handle({"op": "ping", "epoch": 2})["ok"] is True
+    assert fe.handle({"op": "ping"})["ok"] is True
+    for frame_epoch in (1, 3):
+        out = fe.handle({"op": "ping", "epoch": frame_epoch})
+        assert out["ok"] is False
+        assert out["error"]["type"] == "FencedError"
+        assert out["error"]["exit_code"] == 10
+        assert "refresh the view and resend" in out["error"]["message"]
+    assert router.stats()["fenced"] == 2
+    out = fe.handle({"op": "ping", "epoch": [2]})
+    assert out["error"]["type"] == "InputError"
+
+
+def test_router_stamps_the_live_ring_epoch():
+    addr = {"r0": "unix:unused.sock"}
+    ring = PlacementRing(["r0"], replication=1, epoch=4)
+    assert FleetRouter(ring, addr, {})._epoch() == 4
+    ring.epoch = 5  # live view, not a snapshot
+    assert FleetRouter(ring, addr, {})._epoch() == 5
+
+    class _Legacy:  # a ring predating epochs: stamp nothing
+        members = ["r0"]
+
+        def owners(self, digest, alive=None):
+            return ["r0"]
+
+    assert FleetRouter(_Legacy(), addr, {})._epoch() is None
+
+
+def test_fenced_error_taxonomy():
+    err = FencedError("fence", frame_epoch=1, local_epoch=2)
+    assert err.exit_code == 10
+    assert err.frame_epoch == 1 and err.local_epoch == 2
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once mutation: one live daemon, tokens end to end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def solo(tmp_path_factory):
+    """One live daemon with a journal and an epoch file at 1."""
+    d = tmp_path_factory.mktemp("netchaos_solo")
+    n, edges = generators.gnm_edges(80, 200, seed=11)
+    gpath = str(d / "g.bin")
+    save_graph_bin(gpath, n, edges)
+    epoch_path = str(d / "epoch")
+    with open(epoch_path, "w", encoding="utf-8") as f:
+        f.write("1\n")
+    addr = f"unix:{d}/solo.sock"
+    srv = MsbfsServer(listen=addr, graphs={"default": gpath},
+                      window_s=0.0, request_timeout_s=60.0,
+                      journal_path=str(d / "journal.jsonl"),
+                      epoch_path=epoch_path)
+    srv.start()
+    yield {
+        "server": srv,
+        "address": addr,
+        "graph_path": gpath,
+        "digest": content_hash(gpath),
+        "epoch_path": epoch_path,
+        "dir": d,
+    }
+    srv.stop()
+
+
+def test_same_token_reacks_the_original_version(solo):
+    with MsbfsClient(solo["address"]) as c:
+        first = c.mutate([[1, 2]], [], token="tok-dedup-a")
+        assert first["deduplicated"] is False
+        again = c.mutate([[1, 2]], [], token="tok-dedup-a")
+    assert again["deduplicated"] is True
+    assert again["version"] == first["version"]
+    assert again["digest"] == first["digest"]
+    assert again["applied"] == {"inserts": 0, "deletes": 0}
+    stats = solo["server"].stats()
+    assert stats["dynamic"]["mutations_deduplicated"] >= 1
+    assert stats["dynamic"]["dedup_window"]["capacity"] >= 1
+
+
+def test_client_automints_distinct_tokens(solo):
+    with MsbfsClient(solo["address"]) as c:
+        before = c.versions()["delta_version"]
+        m1 = c.mutate([[2, 3]], [])
+        m2 = c.mutate([[2, 3]], [])
+        after = c.versions()["delta_version"]
+    # No token given: the client minted two DIFFERENT ones, so the same
+    # batch applied twice on purpose — dedup is per-identity, not
+    # per-content.
+    assert m1["deduplicated"] is False and m2["deduplicated"] is False
+    assert after == before + 2
+
+
+def test_wire_epoch_fence_against_a_live_daemon(solo):
+    with MsbfsClient(solo["address"], epoch=1) as c:
+        assert c.ping() is True  # equal view serves
+    for frame_epoch in (0, 7):
+        with MsbfsClient(solo["address"], epoch=frame_epoch) as c:
+            with pytest.raises(ServerError) as ei:
+                c.ping()
+        assert ei.value.type_name == "FencedError"
+        assert ei.value.exit_code == 10
+    assert solo["server"].stats()["fenced_requests"] >= 2
+
+
+def test_router_walks_past_a_fenced_replica(solo):
+    ring = PlacementRing(["r0"], replication=1, epoch=1)
+    router = FleetRouter(ring, {"r0": solo["address"]},
+                         {"default": solo["digest"]}, timeout=60.0)
+    out = router.query(QS)
+    assert out["ok"] is True and out["failovers"] == 0
+    # The router's view moves ahead of the replica's file: the lone
+    # owner refuses the stamped frame, the walk exhausts, and the
+    # refusal is counted — typed transient, never a wrong answer.
+    ring.epoch = 2
+    with pytest.raises(TransientError):
+        router.query(QS)
+    assert router.stats()["fenced"] >= 1
+
+
+def test_dedup_window_survives_restart_via_journal_replay(tmp_path, solo):
+    jpath = str(tmp_path / "journal.jsonl")
+    addr = f"unix:{tmp_path}/replay.sock"
+    srv = MsbfsServer(listen=addr, graphs={"default": solo["graph_path"]},
+                      window_s=0.0, request_timeout_s=60.0,
+                      journal_path=jpath)
+    srv.start()
+    try:
+        with MsbfsClient(addr) as c:
+            first = c.mutate([[3, 4]], [], token="tok-replay")
+    finally:
+        srv.stop()
+    # The restart restores the graph FROM THE JOURNAL (the fleet's
+    # path): re-passing ctor graphs would be a fresh load, which by
+    # reload semantics starts a fresh delta chain.
+    srv2 = MsbfsServer(listen=addr, window_s=0.0, request_timeout_s=60.0,
+                       journal_path=jpath)
+    srv2.start()
+    try:
+        with MsbfsClient(addr) as c:
+            again = c.mutate([[3, 4]], [], token="tok-replay")
+            chain_len = c.versions()["delta_version"]
+    finally:
+        srv2.stop()
+    # The token rode the journal: the restarted daemon re-acks the
+    # pre-crash application instead of appending a second version.
+    assert again["deduplicated"] is True
+    assert again["version"] == first["version"]
+    assert again["digest"] == first["digest"]
+    assert chain_len == first["version"]
+
+
+def test_dedup_window_evicts_oldest_first(tmp_path, solo, monkeypatch):
+    monkeypatch.setenv("MSBFS_MUTATE_DEDUP_WINDOW", "2")
+    addr = f"unix:{tmp_path}/window.sock"
+    srv = MsbfsServer(listen=addr, graphs={"default": solo["graph_path"]},
+                      window_s=0.0, request_timeout_s=60.0)
+    srv.start()
+    try:
+        with MsbfsClient(addr) as c:
+            c.mutate([[1, 2]], [], token="tok-w1")
+            c.mutate([[2, 3]], [], token="tok-w2")
+            assert c.mutate([[2, 3]], [], token="tok-w2")["deduplicated"]
+            c.mutate([[3, 4]], [], token="tok-w3")  # evicts tok-w1
+            # Beyond the window the identity is forgotten: the retry
+            # applies AGAIN — which is why the window must outlive the
+            # longest plausible retry horizon, not why it can be small.
+            out = c.mutate([[1, 2]], [], token="tok-w1")
+            assert out["deduplicated"] is False
+    finally:
+        srv.stop()
+
+
+def test_tokenless_mutate_is_refused_after_transport_failure(tmp_path):
+    # A peer that dies right after the handshake: the mutate's outcome
+    # is genuinely unknowable — exactly the ambiguity the refusal is for.
+    path = str(tmp_path / "dead.sock")
+    lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    lst.bind(path)
+    lst.listen(1)
+    c = MsbfsClient(f"unix:{path}")
+    conn, _ = lst.accept()
+    conn.close()
+    lst.close()
+    try:
+        with pytest.raises(ServerError) as ei:
+            c.call({"op": "mutate", "graph": "default",
+                    "inserts": [[0, 1]], "deletes": []}, idempotent=True)
+        # The claimed idempotency is overridden: without a token the
+        # outcome is unknowable and a blind re-send could double-apply.
+        assert ei.value.type_name == "TransientError"
+        assert ei.value.exit_code == 5
+        assert "NOT retried" in str(ei.value)
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# Routed mutation under a partition: token retry converges (in-process)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def duo(tmp_path_factory):
+    """Two live replica daemons holding the same graph, each with its
+    own journal — the smallest fleet where a partition can separate a
+    mutate's owners."""
+    d = tmp_path_factory.mktemp("netchaos_duo")
+    n, edges = generators.gnm_edges(80, 200, seed=11)
+    gpath = str(d / "g.bin")
+    save_graph_bin(gpath, n, edges)
+    servers = {}
+    addresses = {}
+    for i in range(2):
+        name = f"r{i}"
+        addr = f"unix:{d}/{name}.sock"
+        srv = MsbfsServer(listen=addr, graphs={"default": gpath},
+                          window_s=0.0, request_timeout_s=60.0,
+                          journal_path=str(d / f"{name}.journal"))
+        srv.start()
+        servers[name] = srv
+        addresses[name] = addr
+    yield {
+        "servers": servers,
+        "addresses": addresses,
+        "digest": content_hash(gpath),
+        "dir": d,
+    }
+    for srv in servers.values():
+        srv.stop()
+
+
+def _duo_router(duo):
+    ring = PlacementRing(list(duo["addresses"]), replication=2)
+    return FleetRouter(ring, dict(duo["addresses"]),
+                       {"default": duo["digest"]}, timeout=60.0)
+
+
+def test_query_fails_over_across_the_cut_and_serves_both_shores(duo):
+    router = _duo_router(duo)
+    baseline = answer(router.query(QS))
+    first, second = router.owners_for("default")
+    # Put the PRIMARY owner on shore B: the default (A) sender's first
+    # leg crosses the cut, so the walk must fail over to its own shore.
+    spec = f"net_partition:route{int(second[1:])}|route{int(first[1:])}:1"
+    with faults.injected(faults.FaultPlan.parse(spec)):
+        out = router.query(QS)
+        assert answer(out) == baseline  # acked answer survives the cut
+        assert out["replica"] == second and out["failovers"] >= 1
+        with faults.net_side("B"):  # shore B still reaches the primary
+            out_b = router.query(QS)
+        assert answer(out_b) == baseline
+        assert out_b["replica"] == first and out_b["failovers"] == 0
+    assert router.stats()["net_drops"] >= 1
+    # Healed (plan deactivated): the primary serves shore A again.
+    assert router.query(QS)["replica"] == first
+
+
+def test_partitioned_mutate_fails_typed_and_token_retry_converges(duo):
+    router = _duo_router(duo)
+    owners = router.owners_for("default")
+    first, second = owners
+    pre = router.mutate([[5, 6]], [], token="tok-pre")
+    assert set(pre["per_owner"]) == set(owners)
+    # Cut between the owners, sender on the first owner's shore: the
+    # first leg applies, the second crosses and drops — partial
+    # application, surfaced typed with the token to retry under.
+    spec = f"net_partition:route{int(first[1:])}|route{int(second[1:])}:1"
+    with faults.injected(faults.FaultPlan.parse(spec)):
+        with pytest.raises(TransientError) as ei:
+            router.mutate([[6, 7]], [], token="tok-conv")
+        assert "tok-conv" in str(ei.value)
+        assert f"applied to {[first]}" in str(ei.value)
+        faults.heal()
+        # Same token after heal: the shore that applied re-acks from its
+        # dedup window, the missed shore applies for the first time.
+        out = router.mutate([[6, 7]], [], token="tok-conv")
+    assert out["per_owner"][first]["deduplicated"] is True
+    assert out["per_owner"][second]["deduplicated"] is False
+    versions = {m: out["per_owner"][m]["version"] for m in owners}
+    digests = {m: out["per_owner"][m]["digest"] for m in owners}
+    assert len(set(versions.values())) == 1  # chains converged,
+    assert len(set(digests.values())) == 1  # bit-identically
+    # Zero double-applies: each replica's chain is exactly tok-pre +
+    # tok-conv long, however many legs the retries walked.
+    for name, addr in duo["addresses"].items():
+        with MsbfsClient(addr) as c:
+            v = c.versions()
+        assert v["delta_version"] == 2
+        assert v["digest"] == digests[first]
+
+
+def test_half_open_owner_is_walked_past(duo):
+    router = _duo_router(duo)
+    baseline = answer(router.query(QS))
+    first, second = router.owners_for("default")
+    # The primary's next frame vanishes into a half-open socket: the
+    # read times out (simulated), the walk fails over, the answer lands.
+    with faults.injected(
+        faults.FaultPlan.parse(f"half_open:route{int(first[1:])}:1")
+    ):
+        out = router.query(QS)
+    assert answer(out) == baseline
+    assert out["replica"] == second and out["failovers"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# TCP transport knobs
+# ---------------------------------------------------------------------------
+
+
+def test_net_knob_parsing(monkeypatch):
+    monkeypatch.delenv("MSBFS_NET_CONNECT_TIMEOUT_S", raising=False)
+    monkeypatch.delenv("MSBFS_NET_READ_TIMEOUT_S", raising=False)
+    monkeypatch.delenv("MSBFS_NET_KEEPALIVE", raising=False)
+    assert protocol.net_connect_timeout_s() == 5.0
+    assert protocol.net_read_timeout_s() == 0.0
+    assert protocol.net_keepalive_enabled() is True
+    monkeypatch.setenv("MSBFS_NET_CONNECT_TIMEOUT_S", "2.5")
+    monkeypatch.setenv("MSBFS_NET_READ_TIMEOUT_S", "1.5")
+    assert protocol.net_connect_timeout_s() == 2.5
+    assert protocol.net_read_timeout_s() == 1.5
+    # Garbage and negatives fall back loudly-typed elsewhere; here the
+    # transport must keep dialing, so they degrade to the default.
+    monkeypatch.setenv("MSBFS_NET_CONNECT_TIMEOUT_S", "soon")
+    monkeypatch.setenv("MSBFS_NET_READ_TIMEOUT_S", "-3")
+    assert protocol.net_connect_timeout_s() == 5.0
+    assert protocol.net_read_timeout_s() == 0.0
+    for off in ("0", "off", "false", ""):
+        monkeypatch.setenv("MSBFS_NET_KEEPALIVE", off)
+        assert protocol.net_keepalive_enabled() is False
+    monkeypatch.setenv("MSBFS_NET_KEEPALIVE", "1")
+    assert protocol.net_keepalive_enabled() is True
+
+
+def test_connect_applies_keepalive_and_read_timeout(monkeypatch):
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    addr = f"127.0.0.1:{lst.getsockname()[1]}"
+    accepted = []
+    try:
+        monkeypatch.setenv("MSBFS_NET_READ_TIMEOUT_S", "1.5")
+        sock = protocol.connect(addr, timeout=5.0)
+        accepted.append(lst.accept()[0])
+        try:
+            assert sock.gettimeout() == 1.5  # read knob wins post-connect
+            assert sock.getsockopt(socket.SOL_SOCKET,
+                                   socket.SO_KEEPALIVE) != 0
+        finally:
+            sock.close()
+        monkeypatch.setenv("MSBFS_NET_READ_TIMEOUT_S", "0")
+        monkeypatch.setenv("MSBFS_NET_KEEPALIVE", "0")
+        sock = protocol.connect(addr, timeout=7.0)
+        accepted.append(lst.accept()[0])
+        try:
+            assert sock.gettimeout() == 7.0  # inherits the caller's timeout
+            assert sock.getsockopt(socket.SOL_SOCKET,
+                                   socket.SO_KEEPALIVE) == 0
+        finally:
+            sock.close()
+    finally:
+        for conn in accepted:
+            conn.close()
+        lst.close()
+
+
+def test_connect_refuses_dead_tcp_peer_in_bounded_time():
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    port = lst.getsockname()[1]
+    lst.close()  # nobody listens here any more
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        protocol.connect(f"127.0.0.1:{port}", timeout=2.0)
+    assert time.monotonic() - t0 < 2.5
+
+
+# ---------------------------------------------------------------------------
+# The partition-heal chain: a real TCP fleet, both shores, zero loss
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_tcp_partition_heal_chain(tmp_path):
+    """The PR's acceptance chain over loopback TCP: partition a real
+    3-replica fleet at the frame seam, drive queries into BOTH shores
+    (zero lost acks — every answer bit-identical to a single-daemon
+    oracle), surface a mid-partition mutate as a typed partial with its
+    token, heal, converge the same token (dedup re-ack on the near
+    shore, first application on the far shore, version chains
+    bit-identical everywhere — zero double-applies), then quarantine a
+    replica and pin that a frame minted under the pre-quarantine epoch
+    is refused with ``FencedError`` exit 10."""
+    n, edges = generators.gnm_edges(120, 360, seed=7)
+    gpath = str(tmp_path / "g.bin")
+    save_graph_bin(gpath, n, edges)
+    qsets = [QS, [[5, 6], [7, 8]]]
+    delta = ([[9, 41]], [])
+
+    # Single-daemon oracle: pre-mutate answers, the post-mutate digest,
+    # and post-mutate answers.
+    oracle_srv = MsbfsServer(listen=f"unix:{tmp_path}/oracle.sock",
+                             graphs={"default": gpath},
+                             window_s=0.0, request_timeout_s=60.0)
+    oracle_srv.start()
+    with MsbfsClient(f"unix:{tmp_path}/oracle.sock") as c:
+        oracle_pre = [answer(c.query(q)) for q in qsets]
+        oracle_mut = c.mutate(delta[0], delta[1], token="oracle-token")
+        oracle_post = [answer(c.query(q)) for q in qsets]
+    oracle_srv.stop()
+
+    supervisor = FleetSupervisor(
+        size=3,
+        base_dir=str(tmp_path / "fleet"),
+        replication=3,  # every replica owns the graph: both shores serve
+        heartbeat_s=0.25,
+        transport="tcp",
+        env=virtual_cpu_env(1),
+    )
+    try:
+        supervisor.start(wait_ready_s=240.0)
+        assert supervisor.epoch >= 1  # start() is a topology change
+        for r in supervisor.replicas:
+            assert r.address.startswith("127.0.0.1:")  # real TCP legs
+        supervisor.register("default", gpath)
+        router = FleetRouter.for_fleet(supervisor, timeout=60.0)
+        owners = router.owners_for("default")
+        assert len(owners) == 3
+
+        # Warm every owner so the partitioned phase measures serving.
+        for i, q in enumerate(qsets):
+            assert answer(router.query(q, deadline_s=240.0)) == oracle_pre[i]
+        for member in owners[1:]:
+            addr = supervisor.replicas[int(member[1:])].address
+            with MsbfsClient(addr, timeout=300.0) as c:
+                for i, q in enumerate(qsets):
+                    assert answer(c.query(q)) == oracle_pre[i]
+
+        # Shore A = the preference-order primary; shore B = the rest.
+        first, rest = owners[0], owners[1:]
+        spec = (
+            f"net_partition:route{int(first[1:])}|"
+            + ".".join(f"route{int(m[1:])}" for m in rest)
+            + ":1"
+        )
+        faults.activate(faults.FaultPlan.parse(spec))
+
+        # Queries from both shores, across the cut: every acked answer
+        # must match the oracle (zero lost acks), each served by an
+        # owner on the caller's own shore.
+        acked = 0
+        for _ in range(3):
+            for i, q in enumerate(qsets):
+                out = router.query(q, deadline_s=60.0)
+                assert answer(out) == oracle_pre[i]
+                assert out["replica"] == first
+                acked += 1
+                with faults.net_side("B"):
+                    out_b = router.query(q, deadline_s=60.0)
+                assert answer(out_b) == oracle_pre[i]
+                assert out_b["replica"] in rest
+                acked += 1
+        assert acked == 12
+        assert router.stats()["net_drops"] >= 1
+
+        # A mid-partition mutate is a typed partial, never silent: the
+        # near shore applied, the far shore is unreachable, the token
+        # rides the error so the retry converges.
+        with pytest.raises(TransientError) as ei:
+            router.mutate(delta[0], delta[1], token="tok-chain",
+                          deadline_s=60.0)
+        assert "tok-chain" in str(ei.value)
+
+        faults.heal()
+        out = router.mutate(delta[0], delta[1], token="tok-chain",
+                            deadline_s=120.0)
+        assert out["per_owner"][first]["deduplicated"] is True
+        assert any(not out["per_owner"][m]["deduplicated"] for m in rest)
+
+        # Zero double-applies, fleet-wide and against the oracle: every
+        # replica's chain is exactly one delta long and lands on the
+        # oracle's digest (the chain digest is a pure function of base
+        # graph + canonical batch, so any double-apply shows here).
+        for r in supervisor.replicas:
+            with MsbfsClient(r.address, timeout=60.0) as c:
+                v = c.versions()
+            assert v["delta_version"] == 1
+            assert v["digest"] == oracle_mut["digest"]
+
+        # The healed fleet serves the mutated graph, both shores,
+        # bit-identical to the oracle.
+        assert answer(router.query(qsets[0], deadline_s=240.0)) \
+            == oracle_post[0]
+
+        # Membership fencing: freeze a pre-change view, force a
+        # topology change (quarantine), and pin that a frame minted
+        # under the old view is refused — typed, exit 10.
+        stale_epoch = supervisor.epoch
+        victim = rest[-1]
+        survivor = supervisor.replicas[int(first[1:])]
+        assert supervisor.quarantine(victim) is True
+        assert supervisor.epoch == stale_epoch + 1
+        assert supervisor.ring.epoch == supervisor.epoch
+        with MsbfsClient(survivor.address, timeout=60.0,
+                         epoch=stale_epoch) as c:
+            with pytest.raises(ServerError) as fenced:
+                c.ping()
+        assert fenced.value.type_name == "FencedError"
+        assert fenced.value.exit_code == 10
+        # The router shares the live ring, so its next stamped frame
+        # carries the post-quarantine epoch and still serves.
+        assert answer(router.query(qsets[0], deadline_s=240.0)) \
+            == oracle_post[0]
+        assert supervisor.status()["epoch"] == supervisor.epoch
+    finally:
+        faults.activate(None)
+        supervisor.stop()

@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe analyze lockwatch netchaos
+.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe analyze lockwatch netchaos weighted
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -114,6 +114,17 @@ analyze:
 netchaos: native
 	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_netchaos.py -x -q -m "not slow"
 
+# Weighted distance-to-set suite (docs/SERVING.md "Weighted queries"):
+# the bucketed delta-stepping subsystem (weighted/) — artifact cost
+# sections round-tripped and fuzzed, every negotiated flavor
+# bit-identical to the pure-NumPy Dijkstra oracle, the weighted
+# five-invariant certificate (including under bitflip chaos -> exit 9),
+# certified weighted repair, and the weighted serve round trip — plus
+# the weighted arms of the engines-agreement matrix.
+weighted: native
+	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_weighted.py -x -q -m "not slow"
+	JAX_PLATFORMS=cpu python -m pytest tests/test_engines_agree.py -x -q -k "weighted" -m "not slow"
+
 # Dynamic lock-order watchdog (docs/ANALYSIS.md "Lock watchdog"): the
 # concurrency-heavy suites run with every threading.Lock/RLock
 # instrumented; any pair of locks ever taken in both orders — the
@@ -124,5 +135,5 @@ lockwatch: native
 	    tests/test_serve.py tests/test_lifecycle.py tests/test_fleet.py \
 	    tests/test_stampede.py tests/test_netchaos.py -x -q -m "not slow"
 
-test: native analyze resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe netchaos
+test: native analyze resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe netchaos weighted
 	python -m pytest tests/ -x -q

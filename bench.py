@@ -56,7 +56,7 @@ BASELINE.md; empty disables), BENCH_WAIT_S (device-probe budget, default
 420), BENCH_RUN_S (workload hard deadline, default 1500),
 BENCH_GRAPH (rmat|road — road builds the config-4 grid at side 2^(scale/2)),
 BENCH_CONFIGS (comma list of BASELINE config ids, DEFAULT
-"2,2c,4,1,5,6,6r,7,7t,7l,7s,8,8m": sweep
+"2,2c,4,1,5,6,6r,7,7t,7l,7s,8,8m,9": sweep
 mode — each config runs in its own deadline-bounded child and gets its own
 value/error in detail.sweep; the cumulative record re-emits after every
 config so a partial outage cannot zero what was already measured; the
@@ -74,7 +74,13 @@ family is the round-11 dynamic-graph workload (BENCH_DYNAMIC=1):
 localized-delta incremental BFS repair vs full recompute, host-side, with
 BENCH_DELTA_SIZE/BENCH_DELTA_LOCALITY shaping the seeded delta (gen_cli
 --deltas semantics); rows carry detail.dynamic with the plane-byte
-counters the perf-smoke repair budget pins.  Empty =
+counters the perf-smoke repair budget pins.  Config "9" (round 17) is
+the weighted workload (BENCH_WEIGHTED=1): bucketed delta-stepping
+weighted distance-to-set vs the host Bellman-Ford recompute, with
+BENCH_MAX_COST/BENCH_COST_DIST shaping the costs (gen_cli --weights
+semantics) and BENCH_WEIGHTED_ENGINE picking the flavor; rows carry
+detail.weighted with the bucket counters the perf-smoke weighted
+budget pins.  Empty =
 single-config mode, where the BENCH_SCALE/K/... knobs
 apply directly; BENCH_SCALE_CAP caps the preset scales),
 BENCH_DETAIL_PATH (sweep mode: sidecar file for the FULL cumulative
@@ -415,10 +421,137 @@ def run_dynamic_workload() -> None:
     print(json.dumps(record), flush=True)
 
 
+def run_weighted_workload() -> None:
+    """BENCH_WEIGHTED=1 (config 9): bucketed delta-stepping weighted
+    distance-to-set (weighted/deltastep.py) on a weighted road grid,
+    timed against the untrusted host Bellman-Ford recompute
+    (``reference_weighted_distances``).  The row's value is the
+    measured speedup; detail.weighted carries the bucket accounting
+    the perf-smoke bucket-plane budget pins (delta, buckets, light and
+    heavy relaxation counts, bucket_plane_bytes) plus the bit-identity
+    and weighted-certificate verdicts — a fast-but-wrong row reports
+    an error, not a value."""
+    scale = _env_int("BENCH_SCALE", 18)
+    k = _env_int("BENCH_K", 8)
+    max_s = _env_int("BENCH_MAX_S", 8)
+    repeats = _env_int("BENCH_REPEATS", 3)
+    max_cost = _env_int("BENCH_MAX_COST", 16)
+    cost_dist = os.environ.get("BENCH_COST_DIST", "uniform")
+    flavor = os.environ.get("BENCH_WEIGHTED_ENGINE") or None
+    graph_kind = os.environ.get("BENCH_GRAPH", "road")
+
+    import numpy as np
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+        weighted as weighted_pkg,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+        generators,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+        CSRGraph,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.certify import (
+        certify_weighted_distances,
+        reference_weighted_distances,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+        pad_queries,
+    )
+
+    t0 = time.perf_counter()
+    if graph_kind == "road":
+        side = 1 << (scale // 2)
+        n, edges = generators.road_edges(side, side, seed=46)
+        shape = f"road-{side}x{side} (n={side * side})"
+    else:
+        n, edges = generators.rmat_edges(
+            scale, edge_factor=_env_int("BENCH_EDGE_FACTOR", 16), seed=42
+        )
+        shape = f"RMAT-{scale} (n=2^{scale})"
+    costs = generators.edge_costs(
+        edges.shape[0], dist=cost_dist, max_cost=max_cost, seed=49
+    )
+    graph = CSRGraph.from_edges(n, edges, weights=costs)
+    gen_s = time.perf_counter() - t0
+
+    groups = generators.ensure_giant_sources(
+        generators.random_queries(n, k, max_group=max_s, seed=43),
+        n,
+        edges,
+        seed=43,
+    )
+    rows = pad_queries(groups, pad_to=max_s)
+
+    label, engine = weighted_pkg.negotiate_weighted_engine(
+        graph, flavor=flavor
+    )
+    dist_eng = np.asarray(engine.distances(rows))  # warm compile + caches
+    eng_times = []
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        dist_eng = np.asarray(engine.distances(rows))
+        eng_times.append(time.perf_counter() - t0)
+    engine_s = min(eng_times)
+    wstats = engine.weighted_stats()
+
+    host_times = []
+    for _ in range(max(1, min(repeats, 2))):
+        t0 = time.perf_counter()
+        dist_host = reference_weighted_distances(
+            graph.row_offsets, graph.col_indices, graph.edge_weights, rows
+        )
+        host_times.append(time.perf_counter() - t0)
+    host_s = min(host_times)
+
+    identical = bool(np.array_equal(dist_eng, dist_host))
+    failing = certify_weighted_distances(
+        graph.row_offsets, graph.col_indices, graph.edge_weights,
+        rows, dist_eng,
+    )
+    speedup = round(host_s / engine_s, 3) if engine_s > 0 else None
+    record = {
+        "metric": (
+            f"weighted delta-stepping ({label}) vs host Bellman-Ford, "
+            f"{k}-query weighted distance planes, {shape}, "
+            f"{cost_dist} costs in [1, {max_cost}]"
+        ),
+        "value": speedup if identical and not failing else None,
+        "unit": "x",
+        "vs_baseline": None,
+        "detail": {
+            "gen_s": round(gen_s, 3),
+            "engine_s": round(engine_s, 6),
+            "host_bellman_ford_s": round(host_s, 6),
+            "all_engine_runs_s": [round(t, 6) for t in eng_times],
+            "engine": label,
+            "weighted": {
+                "delta": wstats["delta"],
+                "buckets": wstats["buckets"],
+                "light_relaxations": wstats["light_relaxations"],
+                "heavy_relaxations": wstats["heavy_relaxations"],
+                "bucket_plane_bytes": wstats["bucket_plane_bytes"],
+                "max_cost": max_cost,
+                "cost_dist": cost_dist,
+                "bit_identical": identical,
+                "certificate_failing": failing,
+            },
+        },
+    }
+    if not identical or failing:
+        record["error"] = (
+            "weighted engine planes diverge from the host recompute "
+            f"(bit_identical={identical}, failing={failing})"
+        )
+    print(json.dumps(record), flush=True)
+
+
 def run_workload() -> None:
     """The actual benchmark (child process; assumes a live backend)."""
     if os.environ.get("BENCH_DYNAMIC") == "1":
         return run_dynamic_workload()
+    if os.environ.get("BENCH_WEIGHTED") == "1":
+        return run_weighted_workload()
     scale = _env_int("BENCH_SCALE", 20)
     edge_factor = _env_int("BENCH_EDGE_FACTOR", 16)
     k = _env_int("BENCH_K", 64)
@@ -1218,6 +1351,16 @@ CONFIG_PRESETS = {
            "BENCH_SCALE": "20", "BENCH_K": "8", "BENCH_MAX_S": "8",
            "BENCH_DELTA_SIZE": "24", "BENCH_DELTA_LOCALITY": "0.98",
            "BENCH_REPEATS": "1", "BENCH_EXTRA_KS": ""},
+    # Config 9 (weighted subsystem): bucketed delta-stepping weighted
+    # distance-to-set on the weighted road-512x512 grid (uniform costs
+    # in [1, 16]) vs the host Bellman-Ford recompute.  Rows carry
+    # detail.weighted: delta, buckets, light/heavy relaxation counts,
+    # bucket_plane_bytes — the counters the perf-smoke weighted budget
+    # pins — plus bit-identity/weighted-certificate verdicts.
+    "9": {"BENCH_GRAPH": "road", "BENCH_WEIGHTED": "1",
+          "BENCH_SCALE": "18", "BENCH_K": "8", "BENCH_MAX_S": "8",
+          "BENCH_MAX_COST": "16", "BENCH_COST_DIST": "uniform",
+          "BENCH_EXTRA_KS": ""},
 }
 
 
@@ -1376,10 +1519,12 @@ def run_sweep(configs) -> int:
         # TPU plugin var and pins the device-count flag unambiguously).
         virt = int(preset.pop("BENCH_VIRTUAL_CPU", 0) or 0)
         env = dict(os.environ, BENCH_CHILD="1")
-        # Workload-identity scrub: a stray exported BENCH_DYNAMIC must
-        # not flip a labeled TEPS config into the repair workload — only
-        # the config-8 presets set it.
+        # Workload-identity scrub: a stray exported BENCH_DYNAMIC /
+        # BENCH_WEIGHTED must not flip a labeled TEPS config into the
+        # repair or weighted workload — only the config-8/9 presets set
+        # them.
         env.pop("BENCH_DYNAMIC", None)
+        env.pop("BENCH_WEIGHTED", None)
         env.update(preset)
         if virt:
             from virtual_cpu import virtual_cpu_env
@@ -1426,7 +1571,7 @@ def main() -> int:
     configs = [
         c.strip()
         for c in os.environ.get(
-            "BENCH_CONFIGS", "2,2c,4,1,5,6,6r,7,7t,7l,7s,8,8m"
+            "BENCH_CONFIGS", "2,2c,4,1,5,6,6r,7,7t,7l,7s,8,8m,9"
         ).split(",")
         if c.strip()
     ]

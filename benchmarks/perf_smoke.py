@@ -223,6 +223,18 @@ BUDGET = {
     # localization or the invalidation frontier stopped biting, and the
     # serve path would be better off falling back to full recompute.
     "repair-plane-bytes": 1_388_544,
+    # Round 17 weighted delta-stepping (weighted/): bucket-plane bytes
+    # (light+heavy passes x K x n_state x 4 B — the DeltaStep stats
+    # counter detail.weighted reports) for the deterministic weighted
+    # road-64x64 / uniform-[1,16] fixture at the AUTO delta (mean cost),
+    # vs the SAME engine forced to delta=1 (Dial degeneration: one
+    # bucket per cost unit).  Measured today: 63,700,992 B at delta=8
+    # vs 216,793,088 B at delta=1 (3.4x; the generic opt*2<=base gate
+    # pins <= 0.5x).  The counters are analytic and the fixture seeded,
+    # so the budget is measured + ~4% slack — growth past it means the
+    # bucket width derivation or the light-edge fixpoint stopped
+    # biting.
+    "weighted-bucket-bytes": 66_000_000,
     # Round 10 audit overhead (ops/certify.py): one full certification
     # (host recompute + four invariants + F compare) as a PERCENT of the
     # warm query wall it guards, on the high-diameter chunked workload.
@@ -658,6 +670,70 @@ def run_repair():
     )
 
 
+def run_weighted():
+    """Round-17 weighted delta-stepping row: on the deterministic
+    weighted road fixture, the bucket-plane bytes at the auto-derived
+    delta (mean edge cost) must stay at/below half of the same
+    engine's traffic at delta=1 (Dial degeneration — the bucketing
+    null hypothesis).  Both counters are analytic (DeltaStep stats —
+    the same numbers `detail.weighted` reports), so a CPU run pins the
+    TPU traffic; and the row only counts if the auto-delta plane is
+    bit-identical to the host Bellman-Ford recompute AND passes the
+    weighted certificate — "fast but wrong" must fail loudly."""
+    import numpy as np
+
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (  # noqa: E501
+        weighted as weighted_pkg,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops import (  # noqa: E501
+        certify,
+    )
+
+    n, edges = generators.road_edges(64, 64, seed=46)
+    costs = generators.edge_costs(
+        edges.shape[0], dist="uniform", max_cost=16, seed=49
+    )
+    graph = CSRGraph.from_edges(n, edges, weights=costs)
+    queries = pad_queries(
+        generators.random_queries(n, 8, max_group=8, seed=43), pad_to=8
+    )
+    _, auto_eng = weighted_pkg.negotiate_weighted_engine(
+        graph, flavor="bitbell"
+    )
+    dist = np.asarray(auto_eng.distances(queries))
+    auto_stats = auto_eng.weighted_stats()
+    ref = certify.reference_weighted_distances(
+        graph.row_offsets, graph.col_indices, graph.edge_weights, queries
+    )
+    assert np.array_equal(dist, ref), (
+        "auto-delta weighted plane is not bit-identical to the host "
+        "Bellman-Ford recompute"
+    )
+    failing = certify.certify_weighted_distances(
+        graph.row_offsets, graph.col_indices, graph.edge_weights,
+        queries, dist,
+    )
+    assert not failing, (
+        f"weighted plane flunked its certificate: {failing}"
+    )
+    _, dial_eng = weighted_pkg.negotiate_weighted_engine(
+        graph, flavor="bitbell", delta=1
+    )
+    dial_eng.distances(queries)
+    dial_stats = dial_eng.weighted_stats()
+    print(
+        f"  weighted: delta={auto_stats['delta']} "
+        f"buckets={auto_stats['buckets']} "
+        f"bytes={auto_stats['bucket_plane_bytes']}B "
+        f"dial(delta=1)={dial_stats['bucket_plane_bytes']}B"
+    )
+    return (
+        "weighted-bucket-bytes",
+        dial_stats["bucket_plane_bytes"],
+        auto_stats["bucket_plane_bytes"],
+    )
+
+
 def run_analyze():
     """Round-13 analyzer wall-clock row: one full static-analysis run
     (the `make analyze` gate) in a fresh interpreter — import cost is
@@ -849,8 +925,8 @@ def main() -> int:
     failures = []
     for run in (run_config1, run_config4, run_stencil_window, run_mxu,
                 run_fleet, run_stampede, run_fleet_tcp, run_stampede_tcp,
-                run_audit, run_telemetry, run_repair, run_multichip,
-                run_trend, run_analyze):
+                run_audit, run_telemetry, run_repair, run_weighted,
+                run_multichip, run_trend, run_analyze):
         rows = run()
         if isinstance(rows, tuple):
             rows = [rows]

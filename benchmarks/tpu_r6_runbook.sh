@@ -95,6 +95,21 @@ MSBFS_MESH=2x4 MSBFS_FAULT=chip:rank0:2 MSBFS_FAULT_SEED=0 MSBFS_STATS=1 \
     timeout 1800 python main.py -g data/rmat20.bin -q data/q64.bin -gn 8 \
     2>&1 | tee "$RAW/reshard_pause.txt" || true
 
+echo "== 4b. weighted delta-stepping on real chips (round 17, bench config 9)"
+# The weighted road workload (bucketed delta-stepping vs the host
+# Bellman-Ford recompute).  On CPU the speedup column is dominated by
+# dispatch overhead; real HBM bandwidth is what the bucket-plane diet
+# (detail.weighted.bucket_plane_bytes, pinned by the perf-smoke
+# weighted-bucket-bytes row) was designed for.  Flavor sweep: the
+# negotiated default (bitbell), the hot-band stencil, and the 2D mesh.
+for WENG in bitbell stencil mesh2d; do
+  BENCH_CONFIGS= BENCH_WEIGHTED=1 BENCH_GRAPH=road BENCH_SCALE=18 \
+      BENCH_K=8 BENCH_MAX_S=8 BENCH_WEIGHTED_ENGINE=$WENG \
+      BENCH_REPEATS=3 BENCH_EXTRA_KS= BENCH_RUN_S=3600 python bench.py \
+      2> "$RAW/weighted_${WENG}.stderr" \
+      | tee "$RAW/weighted_${WENG}.json" || true
+done
+
 echo "== 5. simulated-mesh twin for the archive (byte-exact, any host)"
 BENCH_CONFIGS=7,7t,7l,7s BENCH_RUN_S=3600 \
     BENCH_DETAIL_PATH="$RAW/multichip_sim_detail.json" python bench.py \

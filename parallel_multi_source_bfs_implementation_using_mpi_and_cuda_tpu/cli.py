@@ -246,6 +246,12 @@ def verify_main(argv: List[str]) -> int:
         "JSON file (e.g. a stored response's f_values).  Default: run "
         "the stock engine under a full audit and certify its output.",
     )
+    ap.add_argument(
+        "--weighted", action="store_true",
+        help="certify against the weighted (edge-cost) invariants; "
+        "also implied by MSBFS_WEIGHTED=1.  The graph must carry a "
+        "cost section.",
+    )
     args = ap.parse_args(argv)
 
     from .ops import certify
@@ -253,12 +259,21 @@ def verify_main(argv: List[str]) -> int:
     from .utils.io import load_graph_bin, load_query_bin, pad_queries
     from .utils.report import format_failure
 
+    from .utils import knobs
+
+    weighted = args.weighted or knobs.raw("MSBFS_WEIGHTED", "") == "1"
     try:
         try:
             graph = load_graph_bin(args.graph)
             queries = pad_queries(load_query_bin(args.query))
         except (OSError, ValueError) as exc:
             raise InputError(str(exc)) from exc
+        if weighted and not graph.has_weights:
+            raise InputError(
+                f"--weighted verify of {args.graph}: the artifact "
+                "carries no edge-cost section (regenerate with "
+                "gen_cli --weights)"
+            )
         if args.expect_f is not None:
             raw = args.expect_f
             if raw.startswith("@"):
@@ -274,6 +289,19 @@ def verify_main(argv: List[str]) -> int:
                     f"--expect-f is not a JSON int list: {exc}"
                 ) from exc
             source = "stored F values"
+        elif weighted:
+            from .serve.registry import build_supervised_weighted_engine
+
+            supervisor = build_supervised_weighted_engine(graph)
+            # Full audit regardless of MSBFS_AUDIT: verification is the
+            # entire point of this verb, not a sampled overhead trade.
+            if supervisor.auditor is None:
+                supervisor.auditor = certify.make_weighted_auditor(graph)
+            supervisor.audit_sample = 1.0
+            f_claimed = np.asarray(
+                supervisor.f_values(queries), dtype=np.int64
+            )
+            source = "weighted engine output"
         else:
             from .serve.registry import build_supervised_engine
 
@@ -287,9 +315,15 @@ def verify_main(argv: List[str]) -> int:
                 supervisor.f_values(queries), dtype=np.int64
             )
             source = "engine output"
-        failing = certify.audit_f_values(
-            graph.row_offsets, graph.col_indices, queries, f_claimed
-        )
+        if weighted:
+            failing = certify.audit_weighted_f_values(
+                graph.row_offsets, graph.col_indices, graph.edge_weights,
+                queries, f_claimed,
+            )
+        else:
+            failing = certify.audit_f_values(
+                graph.row_offsets, graph.col_indices, queries, f_claimed
+            )
         if failing:
             raise CorruptionError(
                 f"verification of {source} FAILED for {args.graph} / "
@@ -517,7 +551,35 @@ def main(argv: Optional[List[str]] = None) -> int:
         # routes that have a documented smaller-footprint fallback.
         ladder_rungs = []
         mesh_spec = knobs.raw("MSBFS_MESH", "").strip()
-        if n_chips > 1 and mesh_spec:
+        weighted_route = knobs.raw("MSBFS_WEIGHTED", "") == "1"
+        if weighted_route:
+            # MSBFS_WEIGHTED=1: integer-cost distance-to-set through the
+            # bucketed delta-stepping subsystem (weighted/).  F(U) becomes
+            # a COST sum; the graph artifact must carry a cost section
+            # (gen_cli --weights) or the route refuses with the typed
+            # input error.  Flavor selection (MSBFS_WEIGHTED_ENGINE:
+            # auto/bitbell/stencil/mesh2d) goes through the same
+            # capability-token negotiation as the 2D mesh route — an
+            # impossible ask fails loud naming the missing tokens.
+            from . import weighted as weighted_pkg
+            from .runtime.supervisor import InputError
+
+            try:
+                wlabel, engine = weighted_pkg.negotiate_weighted_engine(
+                    graph
+                )
+            except InputError as err:
+                print(format_failure(err), file=sys.stderr)
+                return err.exit_code
+            except (TypeError, ValueError) as exc:
+                print(str(exc), file=sys.stderr)
+                return 1
+            print(
+                f"weighted route: {wlabel}, delta={engine.delta} "
+                "(MSBFS_WEIGHTED_ENGINE / MSBFS_DELTA override)",
+                file=sys.stderr,
+            )
+        elif n_chips > 1 and mesh_spec:
             # MSBFS_MESH=RxC selects the 2D adjacency partition
             # (parallel/partition2d.py): the CSR is tiled over an (R, C)
             # device mesh so each chip holds an n/R x n/C adjacency tile,
@@ -1074,6 +1136,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             ladder=ladder_rungs,
             plan=fault_plan,
         )
+        if weighted_route:
+            # MSBFS_AUDIT on the weighted route certifies every sampled
+            # F against the weighted five-invariant certificate
+            # (ops.certify.WEIGHTED_INVARIANTS) — a flunk escalates to
+            # CorruptionError exit 9 exactly like the unit-cost serve
+            # path.
+            from .ops.certify import make_weighted_auditor
+            from .serve.registry import audit_sample_rate
+
+            audit_rate = audit_sample_rate()
+            if audit_rate > 0.0:
+                engine.auditor = make_weighted_auditor(graph)
+                engine.audit_sample = audit_rate
         stats_env = knobs.raw("MSBFS_STATS", "")
         stats_mode = stats_env in ("1", "2")
         # MSBFS_STATS=2: additionally trace each BFS level (frontier size,

@@ -130,6 +130,11 @@ class DeltaLog:
         self.n = int(n)
         self.base_digest = str(base_digest)
         self._base_keys = np.asarray(base_keys, dtype=np.int64)
+        # Weighted bases (weighted/): canonical key -> cost, parallel
+        # sorted arrays.  None = weightless base; apply() then rebuilds
+        # weightless CSRs exactly as before.
+        self._weight_keys: Optional[np.ndarray] = None
+        self._weight_vals: Optional[np.ndarray] = None
         self._batches: List[DeltaBatch] = []
         # Edge-key snapshot per version: repair and apply() both need
         # arbitrary-version access, and the snapshots share memory with
@@ -141,12 +146,41 @@ class DeltaLog:
     @staticmethod
     def from_graph(graph: CSRGraph, base_digest: str) -> "DeltaLog":
         """Open a log over a loaded CSR: the base key set is the CSR's
-        canonical undirected edge set (directed slots collapsed)."""
+        canonical undirected edge set (directed slots collapsed).  A
+        weighted base additionally snapshots the canonical key -> cost
+        map (parallel edges at min cost, the dedup contract), so
+        ``apply()`` rebuilds weighted CSRs: kept edges keep their cost,
+        inserted edges default to cost 1 — a mutation batch names
+        pairs, not costs, and 1 is the weightless-compatible floor."""
         degrees = np.diff(graph.row_offsets)
         u_all = np.repeat(np.arange(graph.n, dtype=np.int64), degrees)
         v_all = np.asarray(graph.col_indices, dtype=np.int64)
         keys = canonical_edge_keys(np.stack([u_all, v_all], axis=1))
-        return DeltaLog(graph.n, keys, base_digest)
+        log = DeltaLog(graph.n, keys, base_digest)
+        if getattr(graph, "has_weights", False):
+            du, dv, dw, _ = graph.deduped_weighted()
+            half = du < dv  # each undirected record once
+            log._weight_keys = (
+                du[half].astype(np.int64) << 32
+            ) | dv[half].astype(np.int64)
+            log._weight_vals = dw[half].astype(np.int32)
+        return log
+
+    @property
+    def weighted(self) -> bool:
+        return self._weight_keys is not None
+
+    def _weights_for(self, keys: np.ndarray) -> np.ndarray:
+        """Costs for a canonical key set: base map hits keep their
+        cost, misses (edges inserted after the base) cost 1."""
+        out = np.ones(keys.size, dtype=np.int32)
+        wk, wv = self._weight_keys, self._weight_vals
+        if wk is not None and wk.size and keys.size:
+            idx = np.searchsorted(wk, keys)
+            idx = np.minimum(idx, wk.size - 1)
+            hit = wk[idx] == keys
+            out[hit] = wv[idx[hit]]
+        return out
 
     @property
     def version(self) -> int:
@@ -197,7 +231,10 @@ class DeltaLog:
         scratch on the mutated edge list."""
         v = self.version if version is None else int(version)
         keys = self.keys_at(v)
-        graph = CSRGraph.from_edges(self.n, keys_to_pairs(keys))
+        weights = self._weights_for(keys) if self.weighted else None
+        graph = CSRGraph.from_edges(
+            self.n, keys_to_pairs(keys), weights=weights
+        )
         return graph, (self.base_digest, v)
 
     def net_delta(

@@ -46,11 +46,16 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..ops.certify import reference_distances
+from ..ops.certify import reference_distances, reference_weighted_distances
 from ..utils import knobs
 from ..utils.timing import record_plane_pass
 
-__all__ = ["RepairStats", "repair_cost_estimate", "repair_distances"]
+__all__ = [
+    "RepairStats",
+    "repair_cost_estimate",
+    "repair_distances",
+    "repair_weighted_distances",
+]
 
 # Fallback threshold: repair estimated to touch more than this fraction
 # of the full-recompute plane bytes falls back to the full sweep (the
@@ -197,6 +202,267 @@ def _invalidate_row(
             cand &= ~_in_sorted(insert_keys, _pair_keys(lost[owner_l], nbrs_l))
         enqueue(np.unique(nbrs_l[cand]))
     return valid, scanned
+
+
+def _wsegments(
+    row_offsets: np.ndarray,
+    col_indices: np.ndarray,
+    weights: np.ndarray,
+    verts: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """:func:`_segments` plus the per-slot edge cost: (owner_index,
+    neighbor, cost) for every directed slot of ``verts``."""
+    deg = (row_offsets[verts + 1] - row_offsets[verts]).astype(np.int64)
+    total = int(deg.sum())
+    if total == 0:
+        e = np.zeros(0, dtype=np.int64)
+        return e, e, e
+    starts = row_offsets[verts].astype(np.int64)
+    seg_base = np.cumsum(deg) - deg
+    pos = np.arange(total, dtype=np.int64) + np.repeat(starts - seg_base, deg)
+    owner = np.repeat(np.arange(verts.size, dtype=np.int64), deg)
+    return (
+        owner,
+        col_indices[pos].astype(np.int64),
+        weights[pos].astype(np.int64),
+    )
+
+
+def _invalidate_row_weighted(
+    row_offsets: np.ndarray,
+    col_indices: np.ndarray,
+    weights: np.ndarray,
+    dist: np.ndarray,
+    delete_pairs: np.ndarray,
+    insert_keys: np.ndarray,
+) -> Tuple[np.ndarray, int]:
+    """Weighted Phase 1 for one query row: the certify weighted-witness
+    invariant applied incrementally — a reached vertex at cost c stays
+    valid iff some KEPT slot offers a valid neighbor with
+    ``dist[neighbor] + w == c``.  Deleted edges carry no cost in the
+    net delta (the old graph is gone), so BOTH reached endpoints of
+    every deleted edge seed the candidate set — over-seeding is safe
+    (an intact witness survives the check), under-seeding is not.
+    Ascending-cost order is a fixpoint: validity at c depends only on
+    validity at c - w with w >= 1."""
+    valid = dist >= 0
+    scanned = 0
+    if delete_pairs.size == 0:
+        return valid, scanned
+    buckets: Dict[int, List[np.ndarray]] = {}
+    queued = np.zeros(dist.size, dtype=bool)
+
+    def enqueue(verts: np.ndarray) -> None:
+        verts = verts[~queued[verts]]
+        if verts.size == 0:
+            return
+        queued[verts] = True
+        for d in np.unique(dist[verts]):
+            buckets.setdefault(int(d), []).append(verts[dist[verts] == d])
+
+    ends = np.unique(delete_pairs.reshape(-1)).astype(np.int64)
+    enqueue(ends[(dist[ends] >= 1)])  # sources witness themselves
+
+    while buckets:
+        d = min(buckets)
+        verts = np.unique(np.concatenate(buckets.pop(d)))
+        verts = verts[valid[verts] & (dist[verts] == d)]
+        if verts.size == 0:
+            continue
+        owner, nbrs, w = _wsegments(row_offsets, col_indices, weights, verts)
+        scanned += nbrs.size
+        ok = valid[nbrs] & (dist[nbrs] + w == d) & (dist[nbrs] >= 0)
+        if insert_keys.size and ok.any():
+            # Inserted slots exist only in the new graph — they cannot
+            # witness an OLD cost.
+            ok &= ~_in_sorted(insert_keys, _pair_keys(verts[owner], nbrs))
+        has_witness = np.zeros(verts.size, dtype=bool)
+        np.logical_or.at(has_witness, owner, ok)
+        lost = verts[~has_witness]
+        if lost.size == 0:
+            continue
+        valid[lost] = False
+        # Dependents leaned on the lost vertices: kept-slot neighbors
+        # whose old cost is exactly dist[lost] + w (strictly larger, so
+        # they land in a later bucket).
+        owner_l, nbrs_l, w_l = _wsegments(
+            row_offsets, col_indices, weights, lost
+        )
+        scanned += nbrs_l.size
+        cand = valid[nbrs_l] & (dist[nbrs_l] == dist[lost[owner_l]] + w_l)
+        if insert_keys.size and cand.any():
+            cand &= ~_in_sorted(
+                insert_keys, _pair_keys(lost[owner_l], nbrs_l)
+            )
+        enqueue(np.unique(nbrs_l[cand]))
+    return valid, scanned
+
+
+def repair_weighted_distances(
+    graph_new,
+    rows: np.ndarray,
+    old_dist: np.ndarray,
+    inserts: np.ndarray,
+    deletes: np.ndarray,
+    max_frac: Optional[float] = None,
+) -> Tuple[np.ndarray, "RepairStats"]:
+    """Weighted twin of :func:`repair_distances`: repair cached
+    delta-stepping cost planes across one net edge delta.
+
+    Same two cone-proportional phases, hop arithmetic replaced by cost
+    arithmetic.  Phase 1 invalidates by the incremental
+    weighted-witness check (:func:`_invalidate_row_weighted`) — the
+    invalidation cone is seeded with the TENTATIVE COSTS the surviving
+    plane entries already hold.  Phase 2 is a lazy best-first settle
+    (host Dijkstra with stale-entry skips) seeded from (a) inserted-
+    slot relaxations off settled endpoints — the cost-decrease cone —
+    and (b) the still-valid fringe adjacent to the invalidated region —
+    the recompute cone.  Survivor costs are achievable in the new graph
+    (their witness chains use kept slots only), so they are exact upper
+    bounds, and an unchanged interior vertex never needs to settle: its
+    kept-slot relaxations were already tight in the old field.  With
+    positive costs the SSSP fixpoint is unique, so the result is
+    bit-identical to a cold :func:`ops.certify.
+    reference_weighted_distances` run — which the weighted certificate
+    pins.
+
+    ``graph_new`` must carry ``edge_weights`` (ValueError otherwise);
+    inserted slots take their cost from the NEW graph's CSR.  The cost
+    model and fallback contract mirror the unit-cost path
+    (``MSBFS_REPAIR_MAX_FRAC``); stats reuse :class:`RepairStats` with
+    ``levels`` = max settle-heap cost bucket processed.
+    """
+    if getattr(graph_new, "edge_weights", None) is None:
+        raise ValueError("repair_weighted_distances: graph has no edge_weights")
+    row_offsets = np.asarray(graph_new.row_offsets, dtype=np.int64)
+    col_indices = np.asarray(graph_new.col_indices, dtype=np.int64)
+    weights = np.asarray(graph_new.edge_weights, dtype=np.int64)
+    n = row_offsets.size - 1
+    rows = np.asarray(rows)
+    if rows.ndim == 1:
+        rows = rows[None, :]
+    old_dist = np.asarray(old_dist, dtype=np.int32)
+    if old_dist.ndim == 1:
+        old_dist = old_dist[None, :]
+    k_total = rows.shape[0]
+    inserts = np.asarray(inserts, dtype=np.int64).reshape(-1, 2)
+    deletes = np.asarray(deletes, dtype=np.int64).reshape(-1, 2)
+    insert_keys = (
+        np.unique(_pair_keys(inserts[:, 0], inserts[:, 1]))
+        if inserts.size
+        else np.zeros(0, dtype=np.int64)
+    )
+    frac = _max_frac() if max_frac is None else float(max_frac)
+    stats = RepairStats()
+    # Hop-count proxy for the dense-baseline level estimate: eccentricity
+    # in cost units deflated by the mean slot cost.
+    w_mean = float(weights.mean()) if weights.size else 1.0
+    est_levels = max(1, int(old_dist.max(initial=0) / max(1.0, w_mean)))
+    avg_degree = float(col_indices.size) / max(1, n)
+
+    # ---- Phase 1: weighted invalidation, all rows ------------------------
+    valids: List[np.ndarray] = []
+    scanned_slots = 0
+    for k in range(k_total):
+        valid, scanned = _invalidate_row_weighted(
+            row_offsets, col_indices, weights, old_dist[k], deletes,
+            insert_keys,
+        )
+        valids.append(valid)
+        scanned_slots += scanned
+        stats.invalidated += int((~valid & (old_dist[k] >= 0)).sum())
+
+    seed_count = 0
+    for k in range(k_total):
+        invalid_count = int((~valids[k] & (old_dist[k] >= 0)).sum())
+        seed_count += 2 * inserts.shape[0] + invalid_count  # upper bound
+    stats.seeds = seed_count
+    est_repair, full_bytes = repair_cost_estimate(
+        n, k_total, est_levels, stats.invalidated, seed_count, avg_degree
+    )
+    est_repair += scanned_slots * 4
+    stats.full_plane_bytes = full_bytes
+    if est_repair > frac * full_bytes:
+        dist_new = reference_weighted_distances(
+            row_offsets, col_indices, weights, rows
+        )
+        stats.fallback = True
+        stats.levels = max(0, int(dist_new.max(initial=0)))
+        stats.repaired_plane_bytes = full_bytes
+        record_plane_pass(stats.repaired_plane_bytes)
+        return dist_new, stats
+
+    # ---- Phase 2: lazy best-first settle, per row ------------------------
+    import heapq
+
+    touched = scanned_slots
+    dist_new = old_dist.copy()
+    for k in range(k_total):
+        dist = dist_new[k].astype(np.int64)
+        valid = valids[k]
+        invalid = ~valid & (old_dist[k] >= 0)
+        big = np.int64(1) << np.int64(62)
+        dist[invalid] = big
+        dist[dist < 0] = big  # never-reached entries are candidates too
+        cone = invalid.copy()
+        heap: List[Tuple[int, int]] = []
+
+        # (a) inserted-slot relaxations off settled endpoints: walk each
+        # insert endpoint's row in the NEW graph (which holds the
+        # inserted slots and their costs) and offer dist + w.
+        if inserts.size:
+            ends = np.unique(inserts.reshape(-1))
+            ends = ends[dist[ends] < big]
+            if ends.size:
+                owner, nbrs, w = _wsegments(
+                    row_offsets, col_indices, weights, ends
+                )
+                touched += nbrs.size
+                keyed = _in_sorted(
+                    insert_keys, _pair_keys(ends[owner], nbrs)
+                )
+                cand = dist[ends[owner]] + w
+                improve = keyed & (cand < dist[nbrs])
+                for tgt, c in zip(nbrs[improve], cand[improve]):
+                    if c < dist[tgt]:
+                        dist[tgt] = c
+                        heapq.heappush(heap, (int(c), int(tgt)))
+        # (b) the still-valid fringe around the invalidated region.
+        inv_verts = invalid.nonzero()[0]
+        if inv_verts.size:
+            _, fringe, _ = _wsegments(
+                row_offsets, col_indices, weights, inv_verts
+            )
+            touched += fringe.size
+            fringe = np.unique(fringe[dist[fringe] < big])
+            for f in fringe:
+                heapq.heappush(heap, (int(dist[f]), int(f)))
+
+        while heap:
+            d, u = heapq.heappop(heap)
+            if d != dist[u]:
+                continue  # stale entry
+            stats.levels = max(stats.levels, d)
+            lo, hi = int(row_offsets[u]), int(row_offsets[u + 1])
+            touched += hi - lo + 1
+            for pos in range(lo, hi):
+                v = int(col_indices[pos])
+                nd = d + int(weights[pos])
+                if nd < dist[v]:
+                    dist[v] = nd
+                    cone[v] = True
+                    heapq.heappush(heap, (nd, v))
+        dist[dist >= big] = -1
+        dist_new[k] = dist.astype(np.int32)
+        stats.cone_size += int(cone.sum())
+    stats.full_plane_bytes = _full_sweep_bytes(
+        n,
+        k_total,
+        max(1, int(dist_new.max(initial=0) / max(1.0, w_mean))),
+    )
+    stats.repaired_plane_bytes = touched * 4
+    record_plane_pass(stats.repaired_plane_bytes)
+    return dist_new, stats
 
 
 def repair_cost_estimate(

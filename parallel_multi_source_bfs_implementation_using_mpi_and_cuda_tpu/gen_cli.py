@@ -75,6 +75,20 @@ def main(argv=None) -> int:
         default=0.9,
         help="0..1: 1 = street-closure-sized patch, 0 = whole-graph churn",
     )
+    ap.add_argument(
+        "--weights",
+        choices=("uniform", "zipf"),
+        default=None,
+        metavar="DIST",
+        help="also emit a trailing integer edge-cost section (uniform or "
+        "zipf, seeded; the weighted/ subsystem's artifact)",
+    )
+    ap.add_argument(
+        "--max-cost",
+        type=int,
+        default=16,
+        help="--weights cost ceiling (costs drawn in [1, max-cost])",
+    )
     ap.add_argument("--seed", type=int, default=42)
     args = ap.parse_args(argv)
 
@@ -102,6 +116,9 @@ def main(argv=None) -> int:
             "--delta-locality in [0, 1]",
             file=sys.stderr,
         )
+        return 2
+    if args.weights and args.max_cost < 1:
+        print("--max-cost must be >= 1", file=sys.stderr)
         return 2
 
     from .models import generators
@@ -149,8 +166,18 @@ def main(argv=None) -> int:
         n, edges = generators.gnm_edges(
             n, args.edge_factor * n, seed=args.seed
         )
-    save_graph_bin(args.graph, n, edges)
-    print(f"wrote {args.graph}: n={n} m={len(edges)}", file=sys.stderr)
+    weights = None
+    if args.weights:
+        # Cost stream is seeded off --seed + 3 so adding --weights to an
+        # existing fixture recipe keeps the graph/query/delta streams
+        # byte-identical (same convention as the +1/+2 offsets below).
+        weights = generators.edge_costs(
+            len(edges), dist=args.weights, max_cost=args.max_cost,
+            seed=args.seed + 3,
+        )
+    save_graph_bin(args.graph, n, edges, weights=weights)
+    wnote = f" weights={args.weights}[1,{args.max_cost}]" if args.weights else ""
+    print(f"wrote {args.graph}: n={n} m={len(edges)}{wnote}", file=sys.stderr)
 
     if args.queries:
         qs = generators.random_queries(

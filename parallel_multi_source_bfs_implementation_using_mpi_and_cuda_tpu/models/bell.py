@@ -131,6 +131,7 @@ class BellGraph:
         level_sizes,
         fill,
         sparse=None,
+        sparse_weights=None,
     ):
         self.level_cols = list(level_cols)  # list[jax.Array (..., S_li) i32]
         self.level_shapes = tuple(tuple(s) for s in level_shapes)
@@ -144,6 +145,12 @@ class BellGraph:
         # frontier-sparse levels scatter through (ops.bitbell.sparse
         # expand).  None when not kept (e.g. sharded sub-layouts).
         self.sparse = sparse
+        # Optional parallel cost array ((E,) int32) aligned with
+        # ``sparse[2]`` (item_vals): the dedup CSR's per-slot edge cost,
+        # min per parallel edge — the weighted/ subsystem's relaxation
+        # seam.  Only present when the host CSR carries edge_weights and
+        # the sparse CSR was kept.
+        self.sparse_weights = sparse_weights
 
     @property
     def levels(self):
@@ -310,26 +317,38 @@ class BellGraph:
         # ---- level 0: owners = vertices, items = CSR slots -> frontier ids.
         # Gathering from the frontier: item value array = frontier (n rows)
         # + sentinel zero row at index n.
+        slot_weights = None
         if dedup and e:
-            _, item_vals, item_count = g.deduped_pairs()
+            if g.has_weights:
+                # Weighted dedup keeps the parallel cost array aligned
+                # with the dedup slots (min cost per parallel edge) —
+                # the weighted/ subsystem's relaxation data.
+                _, item_vals, slot_weights, item_count = g.deduped_weighted()
+            else:
+                _, item_vals, item_count = g.deduped_pairs()
             item_start = np.zeros(n, dtype=np.int64)
             np.cumsum(item_count[:-1], out=item_start[1:])
         else:
             item_vals = np.asarray(g.col_indices, dtype=np.int64)
             item_start = np.asarray(g.row_offsets[:-1], dtype=np.int64)
             item_count = np.asarray(g.degrees, dtype=np.int64)
+            if g.has_weights:
+                slot_weights = np.asarray(g.edge_weights, dtype=np.int32)
         widths = BellGraph.resolve_widths(
             widths, item_count, n, e, min_bucket_rows
         )
 
         item_count_0 = item_count
         sparse = None
+        sparse_weights = None
         if device and keep_sparse and n and item_vals.shape[0] < (1 << 31):
             sparse = (
                 jnp.asarray(item_start.astype(np.int32)),
                 jnp.asarray(item_count.astype(np.int32)),
                 jnp.asarray(item_vals.astype(np.int32)),
             )
+            if slot_weights is not None:
+                sparse_weights = jnp.asarray(slot_weights.astype(np.int32))
         level_cols: List[jax.Array] = []
         level_shapes: List[tuple] = []
         level_sizes: List[int] = []
@@ -420,6 +439,7 @@ class BellGraph:
             # gathered from the frontier, post-dedup) over all padded slots.
             fill=int(np.sum(item_count_0)) / max(padded_slots, 1),
             sparse=sparse,
+            sparse_weights=sparse_weights,
         )
 
     def expand_frontier(self, dist, level):
@@ -435,14 +455,27 @@ class BellGraph:
             self.level_sizes,
             self.fill,
             self.sparse is not None,
+            self.sparse_weights is not None,
         )
         sparse = tuple(self.sparse) if self.sparse is not None else ()
-        return tuple(self.level_cols) + (self.final_slot,) + sparse, aux
+        weights = (
+            (self.sparse_weights,) if self.sparse_weights is not None else ()
+        )
+        return (
+            tuple(self.level_cols) + (self.final_slot,) + sparse + weights,
+            aux,
+        )
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        level_shapes, n, n_pad, level_sizes, fill, has_sparse = aux
+        (
+            level_shapes, n, n_pad, level_sizes, fill, has_sparse,
+            has_weights,
+        ) = aux
         children = list(children)
+        sparse_weights = None
+        if has_weights:
+            sparse_weights = children.pop()
         sparse = None
         if has_sparse:
             sparse = tuple(children[-3:])
@@ -450,7 +483,7 @@ class BellGraph:
         final_slot = children.pop()
         return cls(
             children, level_shapes, final_slot, n, n_pad, level_sizes, fill,
-            sparse,
+            sparse, sparse_weights,
         )
 
     def __repr__(self):

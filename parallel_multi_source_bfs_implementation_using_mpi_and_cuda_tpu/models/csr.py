@@ -38,6 +38,11 @@ class CSRGraph:
     m: int  # undirected edge records
     row_offsets: np.ndarray  # (n+1,) int64
     col_indices: np.ndarray  # (2m,) int32
+    # Optional parallel cost array: one int32 weight per DIRECTED slot,
+    # aligned with ``col_indices`` (both directions of a record carry the
+    # record's weight).  None = weightless (hop-distance objective); the
+    # weighted/ subsystem (delta-stepping) is the only consumer.
+    edge_weights: Optional[np.ndarray] = None
 
     @property
     def num_directed_edges(self) -> int:
@@ -47,8 +52,14 @@ class CSRGraph:
     def degrees(self) -> np.ndarray:
         return np.diff(self.row_offsets)
 
+    @property
+    def has_weights(self) -> bool:
+        return self.edge_weights is not None
+
     @staticmethod
-    def from_edges(n: int, edges: np.ndarray) -> "CSRGraph":
+    def from_edges(
+        n: int, edges: np.ndarray, weights: Optional[np.ndarray] = None
+    ) -> "CSRGraph":
         """Build CSR from an (m, 2) int array of undirected edge records.
 
         Reproduces the reference's insertion-order adjacency exactly
@@ -56,6 +67,12 @@ class CSRGraph:
         u to adj[v], in file order.  A stable counting sort over the
         interleaved directed sequence [(u0,v0),(v0,u0),(u1,v1),...] yields the
         identical CSR without materializing per-vertex lists.
+
+        ``weights`` is an optional (m,) array of positive integer edge
+        costs; each record's weight rides both directed slots through the
+        SAME stable sort, so ``edge_weights[i]`` is the cost of the slot
+        ``col_indices[i]``.  Weights force the NumPy build (the native
+        CSR builder has no cost column).
         """
         edges = np.asarray(edges)
         m = edges.shape[0]
@@ -63,21 +80,37 @@ class CSRGraph:
             # The reference indexes adj[u]/adj[v] unchecked (main.cu:114-115)
             # — undefined behavior on a corrupt file; fail loudly instead.
             raise ValueError(f"edge endpoint out of range [0, {n})")
+        if weights is not None:
+            weights = np.asarray(weights, dtype=np.int32)
+            if weights.shape != (m,):
+                raise ValueError(
+                    f"weights must be ({m},) to match the edge records, "
+                    f"got {weights.shape}"
+                )
+            if m and weights.min() < 1:
+                # Delta-stepping's bucket invariant needs strictly positive
+                # integer costs; zero/negative would silently corrupt the
+                # settled-bucket proof, so refuse at build time.
+                raise ValueError("edge weights must be >= 1")
         if m == 0:
             return CSRGraph(
                 n=n,
                 m=0,
                 row_offsets=np.zeros(n + 1, dtype=np.int64),
                 col_indices=np.zeros(0, dtype=np.int32),
+                edge_weights=(
+                    np.zeros(0, dtype=np.int32) if weights is not None else None
+                ),
             )
-        from ..runtime import native_loader  # lazy: avoid import cycle
+        if weights is None:
+            from ..runtime import native_loader  # lazy: avoid import cycle
 
-        native = native_loader.csr_from_edges(n, edges)
-        if native is not None:
-            row_offsets, col_indices = native
-            return CSRGraph(
-                n=n, m=m, row_offsets=row_offsets, col_indices=col_indices
-            )
+            native = native_loader.csr_from_edges(n, edges)
+            if native is not None:
+                row_offsets, col_indices = native
+                return CSRGraph(
+                    n=n, m=m, row_offsets=row_offsets, col_indices=col_indices
+                )
         # Interleave (u,v) and (v,u) so directed slot order matches the
         # reference's per-record double push_back.
         src = np.empty(2 * m, dtype=np.int64)
@@ -91,7 +124,19 @@ class CSRGraph:
         np.cumsum(counts, out=row_offsets[1:])
         order = np.argsort(src, kind="stable")
         col_indices = dst[order]
-        return CSRGraph(n=n, m=m, row_offsets=row_offsets, col_indices=col_indices)
+        edge_weights = None
+        if weights is not None:
+            w2 = np.empty(2 * m, dtype=np.int32)
+            w2[0::2] = weights
+            w2[1::2] = weights
+            edge_weights = w2[order]
+        return CSRGraph(
+            n=n,
+            m=m,
+            row_offsets=row_offsets,
+            col_indices=col_indices,
+            edge_weights=edge_weights,
+        )
 
     def deduped_pairs(self):
         """Directed slots with duplicate neighbors and self-loops removed:
@@ -123,6 +168,40 @@ class CSRGraph:
         u = pairs // n
         v = pairs % n
         return u, v, np.bincount(u, minlength=n)
+
+    def deduped_weighted(self):
+        """Weighted analog of :meth:`deduped_pairs`: directed slots with
+        self-loops removed and parallel edges collapsed to their MINIMUM
+        cost — (src, dst, weight, per-vertex counts), sorted by
+        (src, dst).
+
+        Min-per-pair is the weighted counterpart of the set predicate: a
+        shortest path never takes the more expensive copy of a parallel
+        edge, and a positive-cost self-loop can never improve its own
+        tentative distance, so the collapsed list has the same SSSP
+        fixpoint as the raw slots.  Always the NumPy path — the native
+        dedup has no cost column.
+        """
+        if not self.has_weights:
+            raise ValueError("deduped_weighted() needs edge_weights")
+        n = self.n
+        src = np.repeat(
+            np.arange(n, dtype=np.int64), self.degrees.astype(np.int64)
+        )
+        dst = np.asarray(self.col_indices, dtype=np.int64)
+        w = np.asarray(self.edge_weights, dtype=np.int32)
+        keep = src != dst
+        if n == 0 or not keep.any():
+            z = np.zeros(0, dtype=np.int64)
+            return z, z, z.astype(np.int32), np.zeros(n, dtype=np.int64)
+        keys = src[keep] * n + dst[keep]
+        order = np.argsort(keys, kind="stable")
+        ks, ws = keys[order], w[keep][order]
+        uniq, start = np.unique(ks, return_index=True)
+        wmin = np.minimum.reduceat(ws, start)
+        u = uniq // n
+        v = uniq % n
+        return u, v, wmin.astype(np.int32), np.bincount(u, minlength=n)
 
     def to_device(self, sharding=None) -> "DeviceCSR":
         return DeviceCSR.from_host(self, sharding=sharding)

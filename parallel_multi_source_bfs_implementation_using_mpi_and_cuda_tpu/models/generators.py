@@ -170,6 +170,36 @@ def gnm_edges(n: int, m: int, seed: int = 0) -> Tuple[int, np.ndarray]:
     return n, edges
 
 
+def edge_costs(
+    m: int,
+    dist: str = "uniform",
+    max_cost: int = 16,
+    seed: int = 0,
+    zipf_a: float = 1.6,
+) -> np.ndarray:
+    """Deterministic positive integer edge costs for the weighted/
+    subsystem: (m,) int32 in [1, max_cost].
+
+    ``uniform`` draws each cost uniformly (road-style travel costs);
+    ``zipf`` draws a heavy-tailed Zipf(``zipf_a``) clipped to
+    ``max_cost`` (latency-graph style: most links cheap, a few
+    expensive).  Same seed -> same costs, independent of the platform's
+    BLAS/thread count (pure ``default_rng`` streams).
+    """
+    if m < 0:
+        raise ValueError(f"m must be >= 0, got {m}")
+    if max_cost < 1:
+        raise ValueError(f"max_cost must be >= 1, got {max_cost}")
+    rng = np.random.default_rng(seed)
+    if dist == "uniform":
+        w = rng.integers(1, max_cost + 1, size=m, dtype=np.int64)
+    elif dist == "zipf":
+        w = np.minimum(rng.zipf(zipf_a, size=m), max_cost)
+    else:
+        raise ValueError(f"unknown cost distribution {dist!r}")
+    return w.astype(np.int32)
+
+
 def delta_batches(
     n: int,
     edges: np.ndarray,

@@ -45,12 +45,17 @@ import numpy as np
 
 __all__ = [
     "INVARIANTS",
+    "WEIGHTED_INVARIANTS",
     "fold_digest",
     "reference_distances",
     "certify_distances",
     "f_from_distances",
     "audit_f_values",
     "make_auditor",
+    "reference_weighted_distances",
+    "certify_weighted_distances",
+    "audit_weighted_f_values",
+    "make_weighted_auditor",
     "start_plane_trail",
     "stop_plane_trail",
     "plane_trail",
@@ -65,6 +70,26 @@ INVARIANTS = (
     "witness",
     "f-mismatch",
 )
+
+#: The weighted certificate (weighted/ delta-stepping outputs): same
+#: one-pass self-certifying structure, hop bounds replaced by cost
+#: bounds.  ``weighted-relaxation`` is the triangle inequality over
+#: every directed CSR slot — dist[v] <= dist[u] + w(u, v) with u
+#: reached forcing v reached (both slot directions carry the record's
+#: cost, so this pins |dist[u] - dist[v]| <= w from both sides);
+#: ``weighted-witness`` demands every reached non-source v have a
+#: neighbor u with dist[u] + w(u, v) == dist[v] (a tight predecessor).
+#: An int field satisfying all five IS the weighted distance-to-set
+#: field — positive costs make the SSSP fixpoint unique.
+WEIGHTED_INVARIANTS = (
+    "source-zero",
+    "zero-is-source",
+    "weighted-relaxation",
+    "weighted-witness",
+    "f-mismatch",
+)
+
+_W_INF = np.int64(1) << np.int64(62)  # audit-side unreached sentinel
 
 _MIX_A = np.uint32(0x9E3779B9)  # golden-ratio index salt
 _MIX_B = np.uint32(0x7FEB352D)  # 2-round integer-hash finalizer
@@ -320,6 +345,191 @@ def audit_f_values(
     if not bool(np.array_equal(f_ref, f_claimed)):
         failing.append("f-mismatch")
     return failing
+
+
+def reference_weighted_distances(
+    row_offsets: np.ndarray,
+    col_indices: np.ndarray,
+    edge_weights: np.ndarray,
+    rows: np.ndarray,
+    endpoints=None,
+) -> np.ndarray:
+    """Untrusted weighted audit recompute: (K, n) int32 weighted
+    distance-to-set fields by a vectorized host Jacobi Bellman-Ford
+    sweep over the CSR — per pass, every row pulls
+    ``min(dist[neighbor] + w)`` via one contiguous gather plus one
+    ``minimum.reduceat``, iterated to fixpoint.  Deliberately a
+    DIFFERENT formulation from the engines' bucketed delta-stepping
+    (no buckets, no light/heavy split, no JAX): with positive costs
+    both converge to the unique SSSP fixpoint, and
+    :func:`certify_weighted_distances` validates this recompute before
+    anything is compared against it, so the recompute stays untrusted.
+    Each pass extends shortest paths by at least one edge, so the sweep
+    terminates within n - 1 passes (far fewer in practice)."""
+    row_offsets = np.asarray(row_offsets)
+    n = row_offsets.size - 1
+    _, v_all = (
+        _edge_endpoints(row_offsets, col_indices)
+        if endpoints is None else endpoints
+    )
+    w_all = np.asarray(edge_weights, dtype=np.int64)
+    rows = np.asarray(rows)
+    if rows.ndim == 1:
+        rows = rows[None, :]
+    k_total = rows.shape[0]
+    # Same (n, K) transposed layout as the unit-cost sweep: the gather
+    # is an axis-0 take of contiguous K-wide rows.
+    dist_t = np.full((n, k_total), _W_INF, dtype=np.int64)
+    live = _valid_sources(rows, n)
+    k_idx = np.repeat(np.arange(k_total), live.sum(axis=1))
+    dist_t[rows[live], k_idx] = 0
+    if v_all.size and k_total:
+        starts = np.asarray(row_offsets[:-1], dtype=np.intp)
+        empty = np.diff(row_offsets) == 0
+        pad = np.full((1, k_total), _W_INF, dtype=np.int64)
+        w_col = w_all[:, None]
+        for _ in range(max(1, n - 1)):
+            offers = np.minimum.reduceat(
+                np.concatenate([dist_t[v_all] + w_col, pad]),
+                starts,
+                axis=0,
+            )
+            offers[empty] = _W_INF
+            new = np.minimum(dist_t, offers)
+            if np.array_equal(new, dist_t):
+                break
+            dist_t = new
+    out = np.where(dist_t >= _W_INF, np.int64(-1), dist_t)
+    return np.ascontiguousarray(out.T).astype(np.int32)
+
+
+def certify_weighted_distances(
+    row_offsets: np.ndarray,
+    col_indices: np.ndarray,
+    edge_weights: np.ndarray,
+    rows: np.ndarray,
+    dist: np.ndarray,
+    endpoints=None,
+) -> List[str]:
+    """The O(E) weighted certificate: check ``dist`` ((K, n) int)
+    against :data:`WEIGHTED_INVARIANTS` for the padded query batch
+    ``rows``.  Returns the failing invariant names ([] = ``dist`` IS
+    the weighted distance field — positive costs make it unique)."""
+    row_offsets = np.asarray(row_offsets)
+    n = row_offsets.size - 1
+    u_all, v_all = (
+        _edge_endpoints(row_offsets, col_indices)
+        if endpoints is None else endpoints
+    )
+    w_all = np.asarray(edge_weights, dtype=np.int64)
+    rows = np.asarray(rows)
+    if rows.ndim == 1:
+        rows = rows[None, :]
+    dist = np.asarray(dist)
+    if dist.ndim == 1:
+        dist = dist[None, :]
+    k_total = rows.shape[0]
+    live = _valid_sources(rows, n)
+    failing: List[str] = []
+
+    # canonical-unreached: same encoding pin as the unit-cost
+    # certificate — unreached is exactly -1, nothing else.
+    if bool((dist < -1).any()):
+        failing.append("canonical-unreached")
+
+    is_source = np.zeros((k_total, n), dtype=bool)
+    k_idx = np.repeat(np.arange(k_total), live.sum(axis=1))
+    is_source[k_idx, rows[live]] = True
+    if not bool((dist[is_source] == 0).all()):
+        failing.append("source-zero")
+    if bool(((dist == 0) & ~is_source).any()):
+        failing.append("zero-is-source")
+
+    if v_all.size == 0 or k_total == 0:
+        if bool((dist >= 1).any()):
+            failing.append("weighted-witness")  # reached with no edges
+        return failing
+    # Both checks in one (E, K) transposed pass.  int64 throughout:
+    # du + w must never wrap, whatever garbage a flipped bit wrote.
+    d_t = np.ascontiguousarray(dist.T).astype(np.int64)
+    du = d_t[u_all]
+    dv = d_t[v_all]
+    w_col = w_all[:, None]
+    reached_u = du >= 0
+    # Triangle inequality over every directed slot; a reached ->
+    # unreached slot is a violation by itself.
+    if bool((reached_u & ((dv < 0) | (dv > du + w_col))).any()):
+        failing.append("weighted-relaxation")
+    # weighted-witness[u, k]: some slot in u's row has a reached
+    # neighbor v with dv + w == du — a tight predecessor (both slot
+    # directions carry the record's cost, so checking from the row-
+    # owner side covers every vertex).  Same pad-row reduceat as the
+    # unit-cost certificate.
+    starts = np.asarray(row_offsets[:-1], dtype=np.intp)
+    empty = np.diff(row_offsets) == 0
+    witness = np.maximum.reduceat(
+        np.concatenate(
+            [(du >= 1) & (dv >= 0) & (dv + w_col == du),
+             np.zeros((1, k_total), dtype=bool)]
+        ),
+        starts,
+        axis=0,
+    )
+    witness[empty] = False
+    if bool(((d_t >= 1) & ~witness).any()):
+        failing.append("weighted-witness")
+    return failing
+
+
+def audit_weighted_f_values(
+    row_offsets: np.ndarray,
+    col_indices: np.ndarray,
+    edge_weights: np.ndarray,
+    rows: np.ndarray,
+    f_claimed: np.ndarray,
+    endpoints=None,
+) -> List[str]:
+    """End-to-end weighted audit of a claimed F vector: recompute the
+    weighted distance fields, certify the recompute, compare F.
+    Returns failing invariant names ([] = certified correct)."""
+    dist = reference_weighted_distances(
+        row_offsets, col_indices, edge_weights, rows, endpoints=endpoints
+    )
+    failing = certify_weighted_distances(
+        row_offsets, col_indices, edge_weights, rows, dist,
+        endpoints=endpoints,
+    )
+    f_ref = f_from_distances(dist)
+    f_claimed = np.asarray(f_claimed, dtype=np.int64).reshape(f_ref.shape)
+    if not bool(np.array_equal(f_ref, f_claimed)):
+        failing.append("f-mismatch")
+    return failing
+
+
+def make_weighted_auditor(graph) -> Callable[[object, object], List[str]]:
+    """The weighted twin of :func:`make_auditor`: a ChunkSupervisor
+    auditor closure over one weighted host graph's CSR + cost buffers.
+    Raises ValueError on a weightless graph — building a weighted
+    auditor over a graph with no costs is a wiring bug, not a runtime
+    condition."""
+    if not getattr(graph, "has_weights", False):
+        raise ValueError("make_weighted_auditor: graph has no edge_weights")
+    row_offsets = np.asarray(graph.row_offsets)
+    col_indices = np.asarray(graph.col_indices)
+    edge_weights = np.asarray(graph.edge_weights)
+    endpoints = _edge_endpoints(row_offsets, col_indices)
+
+    def auditor(queries, f) -> List[str]:
+        return audit_weighted_f_values(
+            row_offsets,
+            col_indices,
+            edge_weights,
+            np.asarray(queries),
+            np.asarray(f),
+            endpoints=endpoints,
+        )
+
+    return auditor
 
 
 def make_auditor(graph) -> Callable[[object, object], List[str]]:

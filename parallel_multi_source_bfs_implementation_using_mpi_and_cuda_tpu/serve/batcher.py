@@ -107,9 +107,16 @@ def pow2_pad(x: int) -> int:
     return 1 << max(0, (max(1, int(x)) - 1).bit_length())
 
 
-def bucket_label(graph_key: str, k_exec: int, s_pad: int) -> str:
-    """Stable stats key for one executable bucket."""
-    return f"{graph_key}:{k_exec}x{s_pad}"
+def bucket_label(
+    graph_key: str, k_exec: int, s_pad: int, weighted: bool = False
+) -> str:
+    """Stable stats key for one executable bucket.  Weighted batches
+    (delta-stepping cost answers) get their own ``:w`` bucket: they run
+    a different engine against the same graph, so their compile ledger,
+    latency profile and stats must never blend with hop-count
+    traffic."""
+    stem = f"{graph_key}:{k_exec}x{s_pad}"
+    return stem + ":w" if weighted else stem
 
 
 @dataclass
@@ -136,6 +143,10 @@ class QueryRequest:
     # caller's self-declared client id for per-client rate limiting.
     priority: str = "interactive"
     client_id: Optional[str] = None
+    # Weighted (delta-stepping) query: routed to the entry's weighted
+    # supervisor and NEVER coalesced with hop-count requests — the
+    # answers come from different engines.
+    weighted: bool = False
     # Monotonic admission stamp (set by submit()): sojourn time for the
     # CoDel controller and the health verb's queue-age gauge must not
     # jump when the wall clock steps.
@@ -455,6 +466,7 @@ class MicroBatcher:
                 same = (
                     req.graph_key == head.graph_key
                     and req.s_pad == head.s_pad
+                    and req.weighted == head.weighted
                 )
                 if same and rows + req.k <= self.max_rows:
                     batch.append(req)

@@ -301,9 +301,14 @@ class MsbfsClient:
         hedge_after_s: Optional[float] = None,
         priority: Optional[str] = None,
         client_id: Optional[str] = None,
+        weighted: bool = False,
     ) -> dict:
         qs = [[int(v) for v in group] for group in queries]
         request = {"op": "query", "graph": graph, "queries": qs}
+        if weighted:
+            # Absent = unit-cost: legacy servers never see the field, so
+            # old deployments keep answering exactly as before.
+            request["weighted"] = True
         if deadline_s is not None:
             request["deadline_s"] = float(deadline_s)
         if priority is not None:
@@ -467,6 +472,9 @@ def query_main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--hedge-ms", type=float, default=None,
                     help="hedge the query on a second connection after "
                     "this many ms without an answer")
+    ap.add_argument("--weighted", action="store_true",
+                    help="answer with weighted distance-to-set (integer "
+                    "edge costs); the graph must carry a cost section")
     ap.add_argument("--stats", action="store_true",
                     help="print the daemon's stats report")
     ap.add_argument("--ping", action="store_true", help="liveness check")
@@ -551,6 +559,7 @@ def query_main(argv: Optional[List[str]] = None) -> int:
                         None if args.hedge_ms is None
                         else args.hedge_ms / 1000.0
                     ),
+                    weighted=args.weighted,
                 )
                 # The reference report's selection lines, 1-based winner
                 # (main.cu:409) — stdout carries results only.
@@ -570,6 +579,8 @@ def query_main(argv: Optional[List[str]] = None) -> int:
                         f"{' (compiled)' if out.get('compiled') else ''}; "
                         f"latency {out.get('latency_ms', 0)} ms"
                     )
+                if out.get("weighted"):
+                    note += "; weighted"
                 if out.get("hedged"):
                     note += "; answered by the hedge connection"
                 print(f"bucket {k_exec}x{s_pad}; {note}", file=sys.stderr)

@@ -293,6 +293,37 @@ def build_supervised_engine(graph, content_digest: Optional[str] = None) -> Chun
     )
 
 
+def build_supervised_weighted_engine(graph) -> ChunkSupervisor:
+    """The weighted serving route (``weighted: true`` queries): a
+    delta-stepping engine negotiated by capability token
+    (``MSBFS_WEIGHTED_ENGINE``), supervised with the same retry/
+    watchdog knobs as the unit-cost route, audited — when
+    ``MSBFS_AUDIT`` is armed — against the weighted five-invariant
+    certificate (``ops.certify.WEIGHTED_INVARIANTS``).  Raises
+    InputError on a weightless graph (the caller surfaces it as the
+    typed query refusal)."""
+    from ..weighted import negotiate_weighted_engine
+
+    _, engine = negotiate_weighted_engine(graph)
+    sample = audit_sample_rate()
+    auditor = None
+    if sample > 0.0:
+        from ..ops.certify import make_weighted_auditor
+
+        auditor = make_weighted_auditor(graph)
+    return ChunkSupervisor(
+        engine,
+        policy=RetryPolicy(
+            max_retries=_env_int("MSBFS_RETRIES", 2),
+            base_delay=_env_float("MSBFS_BACKOFF", 0.1),
+            seed=_env_int("MSBFS_FAULT_SEED", 0),
+        ),
+        watchdog=_env_float("MSBFS_WATCHDOG", 0.0) or None,
+        auditor=auditor,
+        audit_sample=sample,
+    )
+
+
 @dataclass
 class GraphEntry:
     """One registered graph: host CSR + supervised device engine.
@@ -315,6 +346,26 @@ class GraphEntry:
     lock: threading.Lock = field(default_factory=threading.Lock)
     deltas: Optional[object] = None  # dynamic.delta.DeltaLog
     delta_version: int = 0
+    # Lazily-built weighted supervisor (weighted: true queries): most
+    # registered graphs never see a weighted query, so the
+    # delta-stepping engine build is deferred to first use.
+    weighted_supervisor: Optional[ChunkSupervisor] = None
+
+    def get_weighted_supervisor(self) -> ChunkSupervisor:
+        """The entry's weighted serving engine, built on first use
+        under the entry lock.  Raises InputError (via the negotiation)
+        when the graph carries no cost section — a ``weighted: true``
+        query against a weightless graph is the caller's typed
+        refusal."""
+        sup = self.weighted_supervisor
+        if sup is not None:
+            return sup
+        with self.lock:
+            if self.weighted_supervisor is None:
+                self.weighted_supervisor = build_supervised_weighted_engine(
+                    self.graph
+                )
+            return self.weighted_supervisor
 
     @property
     def digest(self) -> str:
@@ -369,6 +420,7 @@ class GraphEntry:
             "digest": self.digest,
             "n": int(self.graph.n),
             "directed_edges": int(self.graph.num_directed_edges),
+            "weighted": bool(getattr(self.graph, "has_weights", False)),
             "loaded_at": round(self.loaded_at, 3),
         }
 

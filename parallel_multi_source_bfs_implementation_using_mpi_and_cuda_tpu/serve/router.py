@@ -104,7 +104,9 @@ def _answer_digest(out: dict) -> int:
     digest, query batch)."""
     f = np.asarray(out.get("f_values", []), dtype=np.int64)
     best = np.asarray(
-        [out.get("min_f", -1), out.get("min_k", -1)], dtype=np.int64
+        [out.get("min_f", -1), out.get("min_k", -1),
+         1 if out.get("weighted") else 0],
+        dtype=np.int64,
     )
     return fold_digest(f, best)
 
@@ -252,12 +254,13 @@ class FleetRouter:
         hedge_after_s: Optional[float] = None,
         priority: Optional[str] = None,
         client_id: Optional[str] = None,
+        weighted: bool = False,
     ) -> dict:
         """Forward one query batch; returns the replica's response dict
         plus routing metadata (``replica``, ``failovers``).  The
-        admission-control fields (``priority``, ``client_id``) ride
-        through unchanged — shedding decisions belong to the replica's
-        batcher, not the router."""
+        admission-control fields (``priority``, ``client_id``) and the
+        ``weighted`` answer mode ride through unchanged — shedding
+        decisions belong to the replica's batcher, not the router."""
         with span("route.query", graph=graph) as sp:
             out = self._query_walk(
                 queries,
@@ -266,6 +269,7 @@ class FleetRouter:
                 hedge_after_s=hedge_after_s,
                 priority=priority,
                 client_id=client_id,
+                weighted=weighted,
             )
             sp.set(
                 replica=out.get("replica", ""),
@@ -282,6 +286,7 @@ class FleetRouter:
         hedge_after_s: Optional[float] = None,
         priority: Optional[str] = None,
         client_id: Optional[str] = None,
+        weighted: bool = False,
     ) -> dict:
         owners = self.owners_for(graph)
         if not owners:
@@ -334,6 +339,7 @@ class FleetRouter:
                         hedge_after_s=hedge_after_s,
                         priority=priority,
                         client_id=client_id,
+                        weighted=weighted,
                     )
             except (faults.SimulatedNetDrop, faults.SimulatedHalfOpen) as nd:
                 # Frame-level chaos fired at the protocol seam — a
@@ -390,7 +396,7 @@ class FleetRouter:
                         None if deadline_s is None else start + deadline_s
                     )
                     out = self._vote(member, owners, queries, graph,
-                                     deadline, out)
+                                     deadline, out, weighted=weighted)
             return out
         if saturated and saturated >= failovers:
             # Every owner we reached said "queue full": the fleet is
@@ -549,7 +555,12 @@ class FleetRouter:
         return False
 
     def _shadow_query(
-        self, member: str, queries, graph: str, remaining: Optional[float]
+        self,
+        member: str,
+        queries,
+        graph: str,
+        remaining: Optional[float],
+        weighted: bool = False,
     ) -> Optional[dict]:
         """One best-effort vote leg to ``member``; None when the leg is
         unavailable (down, saturated, dropped, deadline spent).  An
@@ -572,7 +583,8 @@ class FleetRouter:
                 epoch=self._epoch(),
             ) as client:
                 return client.query(queries, graph=graph,
-                                    deadline_s=remaining)
+                                    deadline_s=remaining,
+                                    weighted=weighted)
         except (
             faults.SimulatedNetDrop,
             faults.SimulatedHalfOpen,
@@ -601,6 +613,7 @@ class FleetRouter:
         graph: str,
         deadline: Optional[float],
         out: dict,
+        weighted: bool = False,
     ) -> dict:
         """Shadow-route the answered batch to the next live owner and
         compare answer digests; on disagreement recompute on a third
@@ -622,7 +635,8 @@ class FleetRouter:
             "route.vote", graph=graph, primary=primary, shadow=shadow_member
         ) as sp:
             shadow = self._shadow_query(
-                shadow_member, queries, graph, remaining()
+                shadow_member, queries, graph, remaining(),
+                weighted=weighted,
             )
             if shadow is None:
                 return out
@@ -645,7 +659,8 @@ class FleetRouter:
         out["vote_mismatch"] = True
         arbiter_member, arbiter = None, None
         for m in later[1:]:
-            arbiter = self._shadow_query(m, queries, graph, remaining())
+            arbiter = self._shadow_query(m, queries, graph, remaining(),
+                                         weighted=weighted)
             if arbiter is not None:
                 arbiter_member = m
                 break
@@ -858,6 +873,7 @@ class FleetFrontend:
                     hedge_after_s=request.get("hedge_after_s"),
                     priority=request.get("priority"),
                     client_id=request.get("client_id"),
+                    weighted=bool(request.get("weighted", False)),
                 )
                 out["ok"] = True
                 return out

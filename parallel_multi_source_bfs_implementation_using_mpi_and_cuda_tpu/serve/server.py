@@ -516,7 +516,7 @@ class MsbfsServer:
         label = bucket_label(entry.key, k_exec, s_pad)
         try:
             self.executables.warm(
-                (entry.key, k_exec, s_pad),
+                (entry.key, k_exec, s_pad, False),
                 label,
                 lambda: entry.supervisor.compile((k_exec, s_pad)),
             )
@@ -1066,9 +1066,23 @@ class MsbfsServer:
         client_id = request.get("client_id")
         if client_id is not None and not isinstance(client_id, str):
             raise InputError("client_id must be a string")
+        weighted = request.get("weighted", False)
+        if not isinstance(weighted, bool):
+            raise InputError("weighted must be a boolean")
+        if weighted and not getattr(entry.graph, "has_weights", False):
+            # Fail at admission, before the queue: a weighted ask
+            # against a weightless artifact can never be answered.
+            raise InputError(
+                f"weighted query against weightless graph {name!r}: the "
+                "artifact carries no edge-cost section (regenerate with "
+                "gen_cli --weights, or drop the weighted flag)"
+            )
         with self._stats_lock:
             self._requests_total += 1
-        cache_key = (entry.key, rows.shape, rows.tobytes())
+        # ``weighted`` is part of the answer's identity: the same rows
+        # against the same graph yield different F under unit vs edge
+        # costs, so the result cache must never alias the two.
+        cache_key = (entry.key, rows.shape, rows.tobytes(), weighted)
         cached = self.result_cache.get(cache_key)
         if cached is not None:
             sp.set(cached=True)
@@ -1093,7 +1107,9 @@ class MsbfsServer:
             # earlier delta version is repaired across the net delta on
             # the host — the affected cone only — instead of paying a
             # full device sweep.  None = no usable seed; fall through.
-            repaired = self._try_repair(entry, name, rows, s_pad, cache_key)
+            repaired = self._try_repair(
+                entry, name, rows, s_pad, cache_key, weighted
+            )
             if repaired is not None:
                 return repaired
         deadline = None
@@ -1118,11 +1134,13 @@ class MsbfsServer:
             deadline=deadline,
             priority=priority,
             client_id=client_id,
+            weighted=weighted,
             # The batcher consumer thread re-installs this context so
             # batch/supervisor/engine spans land on the query's trace.
             trace=telemetry.current_trace(),
         )
-        sp.set(k=int(rows.shape[0]), s_pad=s_pad, priority=priority)
+        sp.set(k=int(rows.shape[0]), s_pad=s_pad, priority=priority,
+               weighted=weighted)
         self.batcher.submit(req)  # raises BackpressureError when full
         if not req.done.wait(self.request_timeout_s):
             with self._stats_lock:
@@ -1137,7 +1155,7 @@ class MsbfsServer:
             raise req.error
         response = req.result
         self.result_cache.put(cache_key, response)
-        self._maybe_retain_plane(entry, name, rows)
+        self._maybe_retain_plane(entry, name, rows, weighted)
         out = dict(response)
         out["cached"] = False
         return out
@@ -1150,19 +1168,22 @@ class MsbfsServer:
         rows: np.ndarray,
         s_pad: int,
         cache_key,
+        weighted: bool = False,
     ) -> Optional[dict]:
         """Answer a query by repairing a cached distance plane across
         the delta span from its certified version to the live one.
         Returns the response dict, or None when there is no usable seed
         (plane cache miss, or a seed from a different content chain).
         The repair is exact — bit-identical to a cold recompute (BFS
-        distance fields are unique) — and the cost model inside
-        ``repair_distances`` already degrades to the full host sweep
-        when the cone is too large, so the answer contract never depends
-        on which path ran."""
+        distance fields are unique; positive costs make the weighted
+        field unique too) — and the cost model inside the repair
+        routines already degrades to the full host sweep when the cone
+        is too large, so the answer contract never depends on which
+        path ran.  Weighted and unit-cost planes live under DISJOINT
+        cache keys: the same rows seed different fields."""
         if self.planes.max_bytes <= 0:
             return None
-        pkey = (name, rows.shape, rows.tobytes())
+        pkey = (name, rows.shape, rows.tobytes(), weighted)
         hit = self.planes.get(pkey)
         if hit is None:
             return None
@@ -1178,14 +1199,23 @@ class MsbfsServer:
             self.planes.drop_where(lambda k: k == pkey)
             return None
         started = time.time()
-        from ..dynamic.repair import repair_distances
-        from ..ops.certify import certify_distances, f_from_distances
+        from ..dynamic.repair import repair_distances, repair_weighted_distances
+        from ..ops.certify import (
+            certify_distances,
+            certify_weighted_distances,
+            f_from_distances,
+        )
 
         inserts, deletes = log.net_delta(plane_version, entry.delta_version)
         try:
-            dist, rstats = repair_distances(
-                entry.graph, rows, plane, inserts, deletes
-            )
+            if weighted:
+                dist, rstats = repair_weighted_distances(
+                    entry.graph, rows, plane, inserts, deletes
+                )
+            else:
+                dist, rstats = repair_distances(
+                    entry.graph, rows, plane, inserts, deletes
+                )
         except (MsbfsError, ValueError, MemoryError) as exc:
             print(
                 f"msbfs serve: plane repair for {name!r} failed "
@@ -1196,15 +1226,25 @@ class MsbfsServer:
         audited = False
         if random.random() < float(entry.supervisor.audit_sample):
             # Same sampled-certification contract as the engine path's
-            # output audit: the repaired plane must pass the full BFS
-            # certificate against the live CSR.
+            # output audit: the repaired plane must pass the full
+            # (weighted, when asked weighted) certificate against the
+            # live CSR.
             audited = True
-            failing = certify_distances(
-                entry.graph.row_offsets,
-                entry.graph.col_indices,
-                rows,
-                dist,
-            )
+            if weighted:
+                failing = certify_weighted_distances(
+                    entry.graph.row_offsets,
+                    entry.graph.col_indices,
+                    entry.graph.edge_weights,
+                    rows,
+                    dist,
+                )
+            else:
+                failing = certify_distances(
+                    entry.graph.row_offsets,
+                    entry.graph.col_indices,
+                    rows,
+                    dist,
+                )
             with self._stats_lock:
                 self._repair_audited += 1
                 if failing:
@@ -1246,6 +1286,7 @@ class MsbfsServer:
             "compiled": False,
             "batched_with": 0,
             "audited": audited,
+            "weighted": weighted,
             "repaired": True,
             "dynamic": rstats.as_dict(),
             "latency_ms": round(latency_ms, 3),
@@ -1256,7 +1297,11 @@ class MsbfsServer:
         return out
 
     def _maybe_retain_plane(
-        self, entry: GraphEntry, name: str, rows: np.ndarray
+        self,
+        entry: GraphEntry,
+        name: str,
+        rows: np.ndarray,
+        weighted: bool = False,
     ) -> None:
         """Repair-aware warm plane retention (``MSBFS_SERVE_PLANES``):
         after an engine answer, keep the query's host distance plane so
@@ -1269,7 +1314,7 @@ class MsbfsServer:
             return
         if policy == "auto" and entry.deltas is None:
             return
-        pkey = (name, rows.shape, rows.tobytes())
+        pkey = (name, rows.shape, rows.tobytes(), weighted)
         have = self.planes.get(pkey)
         if (
             have is not None
@@ -1277,12 +1322,23 @@ class MsbfsServer:
             and have[1] == entry.digest
         ):
             return  # seed already version-fresh
-        from ..ops.certify import reference_distances
+        from ..ops.certify import (
+            reference_distances,
+            reference_weighted_distances,
+        )
 
         try:
-            dist = reference_distances(
-                entry.graph.row_offsets, entry.graph.col_indices, rows
-            )
+            if weighted:
+                dist = reference_weighted_distances(
+                    entry.graph.row_offsets,
+                    entry.graph.col_indices,
+                    entry.graph.edge_weights,
+                    rows,
+                )
+            else:
+                dist = reference_distances(
+                    entry.graph.row_offsets, entry.graph.col_indices, rows
+                )
         except MemoryError:
             return  # retention is an optimization, never a failure
         self.planes.put(pkey, entry.delta_version, entry.digest, dist)
@@ -1377,14 +1433,21 @@ class MsbfsServer:
         batch, offsets = pack_padded_requests(
             [r.rows for r in requests], k_exec, s_pad
         )
-        supervisor = entry.supervisor
-        label = bucket_label(entry.key, k_exec, s_pad)
+        weighted = requests[0].weighted  # coalescing never mixes modes
+        supervisor = (
+            entry.get_weighted_supervisor() if weighted else entry.supervisor
+        )
+        label = bucket_label(entry.key, k_exec, s_pad, weighted=weighted)
         compiled = self.executables.warm(
-            (entry.key, k_exec, s_pad),
+            (entry.key, k_exec, s_pad, weighted),
             label,
             lambda: supervisor.compile((k_exec, s_pad)),
         )
-        if compiled and self.journal is not None:
+        if compiled and self.journal is not None and not weighted:
+            # Weighted warms are deliberately NOT journaled: the warm
+            # record grammar is a 4-tuple shared with older journals,
+            # and a restart that loses weighted warmth only re-pays a
+            # compile, never an answer.
             self.journal.append(
                 {"op": "warm", "name": entry.name, "hash": entry.hash,
                  "k_exec": k_exec, "s_pad": s_pad}
@@ -1519,7 +1582,10 @@ class MsbfsServer:
         audited: bool = False,
     ) -> None:
         """Scatter one successful dispatch back to its requests."""
-        label = bucket_label(requests[0].graph_key, k_exec, s_pad)
+        label = bucket_label(
+            requests[0].graph_key, k_exec, s_pad,
+            weighted=requests[0].weighted,
+        )
         now = time.time()
         with self._stats_lock:
             stats = self._buckets.setdefault(label, _BucketStats())
@@ -1552,6 +1618,7 @@ class MsbfsServer:
                 "compiled": bool(compiled),
                 "batched_with": len(requests) - 1,
                 "audited": bool(audited),
+                "weighted": bool(req.weighted),
                 "latency_ms": round(latency_ms, 3),
             }
             req.done.set()

@@ -60,12 +60,16 @@ journal, serve/journal.py).  Kinds:
                the connection to that replica "dies" before the request
                is sent, so the router must fail over to the next ring
                owner without the replica ever seeing the query.
-``bitflip``    site must be ``plane<i>`` or ``dist``; a *mutating* fault:
+``bitflip``    site must be ``plane<i>``, ``dist`` or ``wplane``; a
+               *mutating* fault:
                instead of raising, it flips one deterministic bit in a
                live buffer.  ``plane<i>`` fires at the ``i``-th chunk
                boundary of the host drive loop (ops/bfs.py) and corrupts
                the BFS state carry; ``dist`` fires at the supervisor's
-               result-materialize seam and corrupts the F buffer.  The
+               result-materialize seam and corrupts the F buffer;
+               ``wplane`` fires at the weighted engines' tentative-plane
+               materialize seam (weighted/deltastep.py) and corrupts the
+               delta-stepping cost field.  The
                seams call :func:`corrupt` (not :func:`trip`) because the
                fault's effect is data, not control flow — silent data
                corruption, byte-for-byte what a flaky HBM cell or a bad
@@ -398,12 +402,12 @@ class FaultPlan:
                         "both sides of the partition"
                     )
                 groups = tuple(parsed_groups)
-            if kind == "bitflip" and site != "dist" \
+            if kind == "bitflip" and site not in ("dist", "wplane") \
                     and not _PLANE_RE.match(site):
                 raise ValueError(
                     f"fault spec {raw!r}: bitflip faults need site "
-                    "plane<i> or dist (e.g. bitflip:plane0:1, "
-                    "bitflip:dist:1)"
+                    "plane<i>, dist or wplane (e.g. bitflip:plane0:1, "
+                    "bitflip:dist:1, bitflip:wplane:1)"
                 )
             host = None
             if kind == "host_down":

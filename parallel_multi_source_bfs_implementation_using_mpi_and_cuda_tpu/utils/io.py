@@ -30,28 +30,19 @@ from ..models.csr import CSRGraph
 
 GRAPH_HEADER = struct.Struct("<iq")  # int32 n, int64 m
 
+# Optional trailing weight section (the weighted/ subsystem's cost
+# artifact): after the m edge records, a 4-byte magic then m x int32
+# positive costs, one per record.  Weightless readers that validate the
+# edge count strictly (this loader pre-PR-17, the native C++ loader)
+# never see it because they stop at 8m bytes; this loader recognizes the
+# magic and refuses anything else trailing — a truncated or bit-flipped
+# weight section must fail loud, never load as a weightless graph.
+WEIGHT_MAGIC = b"MSBW"
 
-def load_graph_bin(path: str | os.PathLike, native: Optional[bool] = None) -> CSRGraph:
-    """Load a reference-format binary graph into a host CSR.
 
-    ``native=True`` forces the C++ runtime loader, ``False`` the NumPy path,
-    ``None`` auto-selects (native when the shared library is built).
-    """
-    from .faults import trip
-
-    trip("load_graph")  # fault seam (utils.faults): injectable load failure
-    if native is None or native:
-        from ..runtime import native_loader
-
-        if native_loader.available():
-            return native_loader.load_graph_csr(os.fspath(path))
-        if native:
-            from ..runtime.supervisor import InputError
-
-            raise InputError(
-                "native loader requested but librt_loader.so is not built "
-                "(run `make -C runtime` / `make native`)"
-            )
+def _graph_bin_layout(path: str | os.PathLike):
+    """(n, m, weighted) after full fail-before-allocate validation of
+    the header, the edge-list size, and any trailing weight section."""
     with open(path, "rb") as f:
         header = f.read(GRAPH_HEADER.size)
         if len(header) < GRAPH_HEADER.size:
@@ -70,20 +61,114 @@ def load_graph_bin(path: str | os.PathLike, native: Optional[bool] = None) -> CS
                 f"truncated edge list in {path}: header claims {m} edges "
                 f"({8 * m} bytes), file has {remaining}"
             )
+        extra = remaining - 8 * m
+        if extra == 0:
+            return n, m, False
+        # Anything after the edge records must be EXACTLY one complete
+        # weight section: magic + m costs.  Short sections, long
+        # sections and wrong magic all refuse — same posture as the
+        # header check above.
+        if extra != len(WEIGHT_MAGIC) + 4 * m:
+            raise IOError(
+                f"corrupt weight section in {path}: {extra} trailing "
+                f"bytes, expected {len(WEIGHT_MAGIC) + 4 * m} "
+                f"(magic + {m} int32 costs) or none"
+            )
+        f.seek(GRAPH_HEADER.size + 8 * m)
+        magic = f.read(len(WEIGHT_MAGIC))
+        if magic != WEIGHT_MAGIC:
+            raise IOError(
+                f"corrupt weight section in {path}: bad magic {magic!r}"
+            )
+        return n, m, True
+
+
+def load_graph_bin(path: str | os.PathLike, native: Optional[bool] = None) -> CSRGraph:
+    """Load a reference-format binary graph into a host CSR.
+
+    ``native=True`` forces the C++ runtime loader, ``False`` the NumPy path,
+    ``None`` auto-selects (native when the shared library is built).
+    Weighted files (trailing :data:`WEIGHT_MAGIC` cost section) always
+    decode on the NumPy path — the native loader has no cost column, and
+    silently dropping weights would serve wrong distances; ``native=True``
+    on a weighted file is a typed routing error.
+    """
+    from .faults import trip
+
+    trip("load_graph")  # fault seam (utils.faults): injectable load failure
+    if native:
+        # A forced-native request with no library is a typed routing
+        # error regardless of what (or whether) the file is — checked
+        # before touching the path, like the pre-PR-17 loader.
+        from ..runtime import native_loader
+        from ..runtime.supervisor import InputError
+
+        if not native_loader.available():
+            raise InputError(
+                "native loader requested but librt_loader.so is not built "
+                "(run `make -C runtime` / `make native`)"
+            )
+    n, m, weighted = _graph_bin_layout(path)
+    if weighted and native:
+        from ..runtime.supervisor import InputError
+
+        raise InputError(
+            f"{path} carries a weight section, which the native loader "
+            "does not decode; use native=False (the NumPy path keeps "
+            "the cost array)"
+        )
+    if not weighted and (native is None or native):
+        from ..runtime import native_loader
+
+        if native_loader.available():
+            return native_loader.load_graph_csr(os.fspath(path))
+    with open(path, "rb") as f:
+        f.seek(GRAPH_HEADER.size)
         edges = np.fromfile(f, dtype=np.int32, count=2 * m)
-    if edges.size != 2 * m:
-        raise IOError(f"truncated edge list in {path}: wanted {2*m} ints, got {edges.size}")
-    return CSRGraph.from_edges(n, edges.reshape(m, 2))
+        if edges.size != 2 * m:
+            raise IOError(
+                f"truncated edge list in {path}: wanted {2*m} ints, "
+                f"got {edges.size}"
+            )
+        weights = None
+        if weighted:
+            f.seek(len(WEIGHT_MAGIC), os.SEEK_CUR)
+            weights = np.fromfile(f, dtype=np.int32, count=m)
+            if weights.size != m:
+                raise IOError(f"truncated weight section in {path}")
+            if m and weights.min() < 1:
+                raise IOError(
+                    f"corrupt weight section in {path}: costs must be >= 1"
+                )
+    return CSRGraph.from_edges(n, edges.reshape(m, 2), weights=weights)
 
 
-def save_graph_bin(path: str | os.PathLike, n: int, edges: np.ndarray) -> None:
-    """Write the reference graph format from an (m, 2) int array."""
+def save_graph_bin(
+    path: str | os.PathLike,
+    n: int,
+    edges: np.ndarray,
+    weights: Optional[np.ndarray] = None,
+) -> None:
+    """Write the reference graph format from an (m, 2) int array, with
+    an optional trailing :data:`WEIGHT_MAGIC` cost section ((m,) positive
+    int32 costs, one per record) for the weighted/ subsystem."""
     edges = np.ascontiguousarray(np.asarray(edges, dtype=np.int32))
     if edges.ndim != 2 or edges.shape[1] != 2:
         raise ValueError("edges must be (m, 2)")
+    if weights is not None:
+        weights = np.ascontiguousarray(np.asarray(weights, dtype=np.int32))
+        if weights.shape != (edges.shape[0],):
+            raise ValueError(
+                f"weights must be ({edges.shape[0]},), got {weights.shape}"
+            )
+        if weights.size and weights.min() < 1:
+            raise ValueError("edge weights must be >= 1")
     with open(path, "wb") as f:
         f.write(GRAPH_HEADER.pack(int(n), int(edges.shape[0])))
         edges.tofile(f)
+        if weights is not None:
+            f.write(WEIGHT_MAGIC)
+            weights.tofile(f)
 
 
 def load_query_bin(path: str | os.PathLike) -> List[np.ndarray]:
@@ -157,6 +242,22 @@ def _canonical_undirected(edges: np.ndarray) -> np.ndarray:
     return np.stack([keys >> 32, keys & 0xFFFFFFFF], axis=1).astype(np.int32)
 
 
+def _canonical_undirected_weighted(edges: np.ndarray, weights: np.ndarray):
+    """Weighted :func:`_canonical_undirected`: unique undirected pairs
+    plus the MINIMUM cost seen across a pair's arcs (both directions of
+    a road segment list the same cost in the DIMACS files; where inputs
+    disagree, min is the only choice that preserves shortest paths)."""
+    lo = np.minimum(edges[:, 0], edges[:, 1]).astype(np.int64)
+    hi = np.maximum(edges[:, 0], edges[:, 1]).astype(np.int64)
+    keys = (lo << 32) | hi
+    order = np.argsort(keys, kind="stable")
+    ks, ws = keys[order], np.asarray(weights, dtype=np.int64)[order]
+    uniq, start = np.unique(ks, return_index=True)
+    wmin = np.minimum.reduceat(ws, start) if uniq.size else ws[:0]
+    pairs = np.stack([uniq >> 32, uniq & 0xFFFFFFFF], axis=1).astype(np.int32)
+    return pairs, wmin.astype(np.int32)
+
+
 def _native_text_parse(path, native, parse, label):
     """The ONE native-dispatch policy for the text converters
     (load_dimacs_gr / load_edgelist): auto-select the C++ parser when
@@ -186,24 +287,43 @@ def _native_text_parse(path, native, parse, label):
     return None
 
 
-def load_dimacs_gr(path: str | os.PathLike, native: Optional[bool] = None):
+def load_dimacs_gr(
+    path: str | os.PathLike,
+    native: Optional[bool] = None,
+    keep_weights: bool = False,
+):
     """Parse a DIMACS shortest-path ``.gr`` file (USA-road-d family) into
     (n, edges) for :func:`save_graph_bin`.
 
     Format: comment lines ``c ...``, one ``p sp <n> <m>`` header, and arc
     lines ``a <u> <v> <w>`` with 1-based endpoints; weights are dropped
-    (the objective is hop-distance, reference main.cu:30-32).  Arcs are
-    canonicalized to unique undirected edges.
+    (the objective is hop-distance, reference main.cu:30-32) unless
+    ``keep_weights=True``, which returns (n, edges, weights) for the
+    weighted/ subsystem instead — Python path only (the native parser
+    has no cost column, so ``native=True`` + ``keep_weights`` is a typed
+    routing error).  Arcs are canonicalized to unique undirected edges
+    (min cost per pair when kept).
 
     ``native=True`` forces the C++ parser (plain-text files only; ~40x the
     Python line loop on a 23M-arc file), ``False`` the Python path,
     ``None`` auto-selects (native when built and the file is not .gz).
     """
-    parsed = _native_text_parse(
-        path,
-        native,
-        lambda nl: nl.load_gr_arcs(os.fspath(path)),
-        "DIMACS .gr",
+    if keep_weights and native:
+        from ..runtime.supervisor import InputError
+
+        raise InputError(
+            "native DIMACS .gr parser drops the cost column; "
+            "keep_weights needs native=False"
+        )
+    parsed = (
+        None
+        if keep_weights
+        else _native_text_parse(
+            path,
+            native,
+            lambda nl: nl.load_gr_arcs(os.fspath(path)),
+            "DIMACS .gr",
+        )
     )
     if parsed is not None:
         n, arcs = parsed
@@ -211,14 +331,18 @@ def load_dimacs_gr(path: str | os.PathLike, native: Optional[bool] = None):
     n = None
     us: List[np.ndarray] = []
     vs: List[np.ndarray] = []
+    wsl: List[np.ndarray] = []
     chunk_u: List[int] = []
     chunk_v: List[int] = []
+    chunk_w: List[int] = []
     with _open_text(path) as f:
         for line in f:
             if line.startswith("a "):
-                _, u, v, *_ = line.split()
+                _, u, v, *rest = line.split()
                 chunk_u.append(int(u))
                 chunk_v.append(int(v))
+                if keep_weights:
+                    chunk_w.append(int(rest[0]) if rest else 1)
                 if len(chunk_u) >= 1 << 20:
                     # int32 buffers: ids fit (the reference format is
                     # int32, main.cu:102), and USA-road-d's 58M arcs would
@@ -226,7 +350,8 @@ def load_dimacs_gr(path: str | os.PathLike, native: Optional[bool] = None):
                     # raise OverflowError here (fail loud, never wrap).
                     us.append(np.asarray(chunk_u, dtype=np.int32))
                     vs.append(np.asarray(chunk_v, dtype=np.int32))
-                    chunk_u, chunk_v = [], []
+                    wsl.append(np.asarray(chunk_w, dtype=np.int32))
+                    chunk_u, chunk_v, chunk_w = [], [], []
             elif line.startswith("p "):
                 parts = line.split()
                 n = int(parts[2])
@@ -234,10 +359,17 @@ def load_dimacs_gr(path: str | os.PathLike, native: Optional[bool] = None):
         raise ValueError(f"{path}: no 'p sp <n> <m>' header line")
     us.append(np.asarray(chunk_u, dtype=np.int32))
     vs.append(np.asarray(chunk_v, dtype=np.int32))
+    wsl.append(np.asarray(chunk_w, dtype=np.int32))
     arcs = np.stack([np.concatenate(us), np.concatenate(vs)], axis=1) - 1
     if arcs.size and (arcs.min() < 0 or arcs.max() >= n):
         raise ValueError(f"{path}: arc endpoint outside 1..{n}")
-    return n, _canonical_undirected(arcs)
+    if not keep_weights:
+        return n, _canonical_undirected(arcs)
+    weights = np.concatenate(wsl)
+    if weights.size and weights.min() < 1:
+        raise ValueError(f"{path}: arc costs must be >= 1 for keep_weights")
+    pairs, wmin = _canonical_undirected_weighted(arcs, weights)
+    return n, pairs, wmin
 
 
 def save_dimacs_gr(

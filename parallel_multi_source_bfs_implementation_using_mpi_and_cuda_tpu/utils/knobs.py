@@ -120,6 +120,10 @@ _ALL = (
     _k("MSBFS_MUTATE_DEDUP_WINDOW", "1024", "int", "exactly-once mutate: applied idempotency tokens remembered per daemon"),
     # --- dynamic graphs (dynamic/) ---
     _k("MSBFS_REPAIR_MAX_FRAC", "0.5", "float", "repair-cone fraction above which repair falls back to full recompute"),
+    # --- weighted distance-to-set (weighted/) ---
+    _k("MSBFS_WEIGHTED", None, "flag", "1 routes the CLI batch run through the weighted delta-stepping engines (graph must carry a cost section)"),
+    _k("MSBFS_WEIGHTED_ENGINE", "auto", "str", "weighted engine flavor: auto/bitbell/stencil/mesh2d (capability-token negotiated; impossible asks fail loud)"),
+    _k("MSBFS_DELTA", "0", "int", "delta-stepping bucket width; 0/unset auto-derives from the mean edge cost"),
     # --- observability (utils/telemetry.py, utils/trace.py) ---
     _k("MSBFS_STATS", None, "str", "1 = per-query stats table, 2 = + per-level trace"),
     _k("MSBFS_TRACE", None, "flag", "1 mints a per-query distributed trace at the client edge"),

@@ -1,0 +1,99 @@
+"""Weighted distance-to-set: the bucketed delta-stepping subsystem.
+
+Engines here speak the same :class:`ops.engine.QueryEngineBase`
+contract as the unit-cost fleet — ``f_values`` is a cost sum instead of
+a hop sum — and are negotiated onto representations through the same
+capability-token seam (:func:`ops.engine.negotiate_engine`): the
+``weighted`` token plus ``windowed`` / ``mesh2d`` structure tokens.
+Asking for a combination no flavor provides fails loud naming the
+missing tokens, never silently serving hop counts as costs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..ops.engine import negotiate_engine
+from ..runtime.supervisor import InputError
+from ..utils import knobs
+from .deltastep import (
+    INF,
+    DeltaStepEngineBase,
+    WeightedBitBellEngine,
+    WeightedMesh2DEngine,
+    WeightedStencilEngine,
+    resolve_delta,
+)
+
+__all__ = [
+    "INF",
+    "DeltaStepEngineBase",
+    "WeightedBitBellEngine",
+    "WeightedStencilEngine",
+    "WeightedMesh2DEngine",
+    "resolve_delta",
+    "weighted_candidates",
+    "negotiate_weighted_engine",
+]
+
+#: flavor name -> extra capability tokens beyond the base ``weighted``.
+_FLAVOR_TOKENS = {
+    "auto": frozenset(),
+    "bitbell": frozenset(),
+    "stencil": frozenset({"windowed"}),
+    "mesh2d": frozenset({"mesh2d"}),
+}
+
+
+def weighted_candidates(graph, delta: Optional[int] = None):
+    """(label, engine_cls, factory) triples in preference order for
+    :func:`ops.engine.negotiate_engine` — losers never build."""
+    return [
+        (
+            "weighted-bitbell",
+            WeightedBitBellEngine,
+            lambda: WeightedBitBellEngine(graph, delta=delta),
+        ),
+        (
+            "weighted-stencil",
+            WeightedStencilEngine,
+            lambda: WeightedStencilEngine(graph, delta=delta),
+        ),
+        (
+            "weighted-mesh2d",
+            WeightedMesh2DEngine,
+            lambda: WeightedMesh2DEngine(graph, delta=delta),
+        ),
+    ]
+
+
+def negotiate_weighted_engine(
+    graph, flavor: Optional[str] = None, delta: Optional[int] = None
+):
+    """Negotiate a weighted engine for ``graph``.
+
+    ``flavor`` (default: the ``MSBFS_WEIGHTED_ENGINE`` knob, default
+    ``auto``) maps to required capability tokens: ``auto``/``bitbell``
+    require just ``weighted``; ``stencil`` adds ``windowed``; ``mesh2d``
+    adds ``mesh2d``.  Returns ``(label, engine)``.
+
+    Raises :class:`InputError` on a weightless graph or unknown flavor,
+    and lets :func:`negotiate_engine`'s ValueError (naming each
+    candidate's missing tokens) propagate on an unsatisfiable ask.
+    """
+    if not getattr(graph, "has_weights", False):
+        raise InputError(
+            "weighted query against a weightless graph: the artifact has "
+            "no edge-cost section (regenerate with gen_cli --weights, or "
+            "convert with load_dimacs_gr(keep_weights=True))"
+        )
+    if flavor is None:
+        flavor = knobs.raw("MSBFS_WEIGHTED_ENGINE", "auto") or "auto"
+    flavor = flavor.strip().lower() or "auto"
+    if flavor not in _FLAVOR_TOKENS:
+        raise InputError(
+            f"unknown weighted engine flavor {flavor!r} "
+            f"(MSBFS_WEIGHTED_ENGINE: auto, bitbell, stencil, mesh2d)"
+        )
+    required = frozenset({"weighted"}) | _FLAVOR_TOKENS[flavor]
+    return negotiate_engine(required, weighted_candidates(graph, delta))

@@ -1,0 +1,413 @@
+"""Bucketed delta-stepping: distance-to-set over integer edge costs.
+
+The level loop of every unit-cost engine in this repo is a degenerate
+delta-stepping run with delta = 1: each "bucket" is one BFS level, every
+edge is light, and the per-level OR over bit planes is the relaxation.
+This module generalizes that loop to positive integer costs (Meyer &
+Sanders' delta-stepping) while keeping the repo's execution shape:
+
+* tentative distances are **word planes** — a (K, n) int32 array, one
+  row per query group, exactly the layout the donation/megachunk/chunk-
+  supervisor discipline already manages for bit planes;
+* the drive loop walks buckets ``b = tent // delta`` in ascending
+  order.  Within a bucket, **light** edges (cost <= delta) relax to a
+  fixpoint — the bucket's frontier re-enters while improvements land in
+  the same bucket, the weighted analog of the level loop's frontier OR;
+* **heavy** edges (cost > delta) relax ONCE at bucket close: a heavy
+  relaxation lands at least ``delta + 1`` past the bucket floor, so it
+  can never re-open the bucket;
+* the relaxation itself is the existing scatter-min seam
+  (``tent.at[:, v].min(candidates)``) over the dedup CSR's parallel
+  cost array — built by ``BellGraph.from_host`` /
+  ``CSRGraph.deduped_weighted``.
+
+With positive integer costs any label-correcting relaxation order
+converges to the unique SSSP fixpoint, so every flavor here is
+bit-identical to a host Dijkstra by construction — which is what the
+weighted certificate (ops.certify) and the engines-agree matrix pin.
+
+``MSBFS_DELTA`` overrides the bucket width; unset auto-derives it from
+the mean edge cost (delta ~ mean cost keeps the light set near the
+whole edge set on uniform costs — the measured sweet spot for
+bucket-count vs re-relaxation on the road fixtures).
+
+Three flavors, negotiated through ``ops.engine.negotiate_engine``
+capability tokens (see ``weighted/__init__``):
+
+* :class:`WeightedBitBellEngine` — full-edge relaxation over the
+  BellGraph dedup CSR + cost array (the bit-plane engines' sparse
+  seam);
+* :class:`WeightedStencilEngine` — ``windowed``: each relaxation
+  gathers only the active row band's slot window (banded/road graphs:
+  the frontier band is narrow, so most slots never move);
+* :class:`WeightedMesh2DEngine` — ``mesh2d``: the vertex axis is split
+  into row tiles mirroring parallel.partition2d's row-block ownership;
+  each tile scatter-mins only its own rows from a global gather (the
+  per-device partial + min-combine shape; runs tile-sequential on one
+  chip, the real-mesh execution is the runbook's silicon leg).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.csr import CSRGraph
+from ..ops.engine import QueryEngineBase
+from ..runtime.supervisor import InputError
+from ..utils import faults, knobs
+from ..utils.timing import record_dispatch
+
+# Unreached sentinel for the tentative word planes.  int32 planes with
+# headroom: every candidate is tent + w with tent <= INF and w bounded
+# by the build-time guard below, so the sum never wraps.
+INF = np.int32(1 << 30)
+
+
+def resolve_delta(weights: np.ndarray) -> int:
+    """The bucket width: ``MSBFS_DELTA`` when set to a positive int,
+    else max(1, round(mean cost)) — delta ~ mean keeps roughly half the
+    edges light on uniform costs, degenerating to the unit-cost level
+    loop (delta = 1) on weightless-style all-ones costs."""
+    override = knobs.get_int("MSBFS_DELTA", 0)
+    if override > 0:
+        return override
+    if weights is None or len(weights) == 0:
+        return 1
+    return max(1, int(round(float(np.mean(np.asarray(weights))))))
+
+
+@jax.jit
+def _relax_scatter_min(tent, active, u, v, w, sel):
+    """One relaxation pass over an edge-slot array: for every slot
+    (u -> v, cost w) with ``sel`` set and u active, offer
+    ``tent[:, u] + w`` to v; commit by scatter-min.  Scatter-min is
+    order-independent (min is associative/commutative), so the result
+    is deterministic regardless of XLA's scatter schedule — the same
+    property the bit planes' scatter-OR leans on."""
+    cand = jnp.where(
+        active[:, u] & sel[None, :],
+        tent[:, u] + w[None, :],
+        jnp.int32(INF),
+    )
+    return tent.at[:, v].min(cand)
+
+
+@jax.jit
+def _min_pending(tent, settled):
+    return jnp.min(jnp.where(settled, jnp.int32(INF), tent))
+
+
+@jax.jit
+def _bucket_frontier(tent, settled, b, delta):
+    in_bucket = (tent < jnp.int32(INF)) & (tent // delta == b)
+    return in_bucket & ~settled
+
+
+def _pow2(x: int) -> int:
+    return 1 << max(0, int(x - 1).bit_length()) if x > 1 else 1
+
+
+class DeltaStepEngineBase(QueryEngineBase):
+    """Shared drive loop; flavors override :meth:`_relax` (and the
+    relax-array build).  Satisfies the :class:`ops.engine.
+    QueryEngineBase` contract — ``f_values`` is the weighted objective
+    (cost sum over reached vertices), so ``best``/``compile``/the
+    supervisor/the serving stack all apply unchanged."""
+
+    CAPABILITIES = frozenset({"weighted"})
+
+    def __init__(self, graph: CSRGraph, delta: Optional[int] = None):
+        if not isinstance(graph, CSRGraph) or not graph.has_weights:
+            raise InputError(
+                "weighted engines need a CSRGraph with edge_weights "
+                "(generate costs with gen_cli --weights, or load a "
+                "weighted .bin/.gr artifact)"
+            )
+        self.graph = graph
+        self.n = int(graph.n)
+        self.n_state = self.n  # flavors may pad (mesh tiles)
+        u, v, w = self._relax_arrays(graph)
+        max_w = int(w.max()) if w.size else 1
+        if int(self.n - 1) * max_w >= int(INF):
+            raise InputError(
+                f"weighted diameter bound (n-1)*max_cost = "
+                f"{(self.n - 1) * max_w} exceeds the int32 tentative-plane "
+                f"range ({int(INF)}); reduce costs or graph size"
+            )
+        self.delta = int(delta) if delta else resolve_delta(w)
+        if self.delta < 1:
+            raise InputError(f"delta must be >= 1, got {self.delta}")
+        self.max_cost = max_w
+        # Host copies (the windowed flavor slices them per step) +
+        # device residency for the full-edge flavors.
+        self._u_host = u.astype(np.int32)
+        self._v_host = v.astype(np.int32)
+        self._w_host = w.astype(np.int32)
+        self._light_host = self._w_host <= self.delta
+        self._finalize_arrays()
+        self.last_stats: dict = {}
+
+    # -- flavor hooks --------------------------------------------------
+    def _relax_arrays(
+        self, graph: CSRGraph
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(u, v, w) dedup edge slots, sorted by (u, v)."""
+        u, v, w, _ = graph.deduped_weighted()
+        return u, v, w
+
+    def _finalize_arrays(self) -> None:
+        """Upload whatever the flavor's :meth:`_relax` reads."""
+        self._u = jnp.asarray(self._u_host)
+        self._v = jnp.asarray(self._v_host)
+        self._w = jnp.asarray(self._w_host)
+        self._sel_light = jnp.asarray(self._light_host)
+        self._sel_heavy = jnp.asarray(~self._light_host)
+
+    def _relax(self, tent, active, light: bool):
+        """One relaxation pass; returns (new tent, slots examined)."""
+        sel = self._sel_light if light else self._sel_heavy
+        out = _relax_scatter_min(tent, active, self._u, self._v, self._w, sel)
+        return out, int(self._u_host.size)
+
+    # -- drive loop ----------------------------------------------------
+    def distances(self, rows) -> np.ndarray:
+        """(K, S) -1-padded source rows -> (K, n) int32 weighted
+        distance-to-set fields, -1 = unreached.  Exact SSSP (= Dijkstra
+        bit-identical); ``last_stats`` records the bucket accounting."""
+        rows = np.asarray(rows, dtype=np.int32)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        K = rows.shape[0]
+        n, ns = self.n, self.n_state
+        stats = {
+            "delta": int(self.delta),
+            "buckets": 0,
+            "light_relaxations": 0,
+            "heavy_relaxations": 0,
+            "bucket_plane_bytes": 0,
+        }
+        if K == 0:
+            self.last_stats = stats
+            return np.zeros((0, n), dtype=np.int32)
+        tent0 = np.full((K, ns), INF, dtype=np.int32)
+        valid = (rows >= 0) & (rows < n)
+        k_idx = np.repeat(np.arange(K), valid.sum(axis=1))
+        tent0[k_idx, rows[valid]] = 0
+        tent = jnp.asarray(tent0)
+        settled = jnp.zeros((K, ns), dtype=bool)
+        delta = jnp.int32(self.delta)
+        plane_bytes = K * ns * 4  # one int32 tentative plane pass
+        while True:
+            m = int(_min_pending(tent, settled))
+            record_dispatch()
+            if m >= int(INF):
+                break
+            b = jnp.int32(m // self.delta)
+            frontier = _bucket_frontier(tent, settled, b, delta)
+            bucket_members = frontier
+            # Light fixpoint: improvements landing back in bucket b
+            # re-enter the frontier (the weighted frontier OR).
+            while bool(frontier.any()):
+                bucket_members = bucket_members | frontier
+                new_tent, slots = self._relax(tent, frontier, light=True)
+                improved = new_tent < tent
+                tent = new_tent
+                frontier = improved & (tent // delta == b)
+                record_dispatch()
+                stats["light_relaxations"] += K * slots
+                stats["bucket_plane_bytes"] += plane_bytes
+            # Heavy close: one pass from everything the bucket touched.
+            tent, slots = self._relax(tent, bucket_members, light=False)
+            record_dispatch()
+            stats["heavy_relaxations"] += K * slots
+            stats["bucket_plane_bytes"] += plane_bytes
+            settled = settled | bucket_members
+            stats["buckets"] += 1
+        dist = np.asarray(tent[:, :n]).copy()
+        dist[dist >= int(INF)] = -1
+        if faults.corruption_armed():
+            # Plane-materialize seam (``bitflip:wplane``): the weighted
+            # planes get the same injectable corruption the bit planes
+            # have — the certificate must flunk it (exit 9 through the
+            # supervisor), never serve it.
+            dist = np.asarray(faults.corrupt("wplane", dist))
+        self.last_stats = stats
+        return dist
+
+    def f_values(self, queries) -> jax.Array:
+        """(K, S) padded rows -> (K,) int64 weighted cost sums: F(U) =
+        sum over reached v of dist(U, v) — the same objective contract
+        as the unit-cost engines, distances now being travel costs."""
+        dist = self.distances(queries)
+        f = np.where(dist >= 0, dist, 0).sum(axis=1, dtype=np.int64)
+        return jnp.asarray(f)
+
+    def query_stats(self, queries):
+        """(levels, reached, F) with ``levels`` = buckets processed —
+        the weighted analog the serving trace spans record."""
+        dist = self.distances(queries)
+        f = np.where(dist >= 0, dist, 0).sum(axis=1, dtype=np.int64)
+        reached = (dist >= 0).sum(axis=1).astype(np.int32)
+        levels = np.full(
+            dist.shape[0], self.last_stats.get("buckets", 0), dtype=np.int32
+        )
+        return levels, reached, f
+
+    def weighted_stats(self) -> dict:
+        """Bucket accounting of the LAST run: delta, buckets, light/
+        heavy relaxation candidates, tentative-plane bytes."""
+        return dict(self.last_stats)
+
+
+class WeightedBitBellEngine(DeltaStepEngineBase):
+    """Full-edge relaxation over the BellGraph dedup CSR and its
+    parallel cost array (``BellGraph.sparse`` / ``sparse_weights``) —
+    the weighted twin of the bitbell engines' sparse expand seam."""
+
+    CAPABILITIES = frozenset({"weighted"})
+
+    def _relax_arrays(self, graph):
+        from ..models.bell import BellGraph
+
+        bell = BellGraph.from_host(graph)
+        if bell.sparse is not None and bell.sparse_weights is not None:
+            _, count, vals = bell.sparse
+            count_h = np.asarray(count, dtype=np.int64)
+            u = np.repeat(np.arange(graph.n, dtype=np.int64), count_h)
+            return (
+                u,
+                np.asarray(vals, dtype=np.int64),
+                np.asarray(bell.sparse_weights, dtype=np.int32),
+            )
+        return super()._relax_arrays(graph)
+
+
+class WeightedStencilEngine(DeltaStepEngineBase):
+    """``windowed``: per relaxation pass, only the active row band's
+    contiguous slot window is gathered (dedup slots are sorted by row,
+    so rows [lo, hi) own slots [start[lo], start[hi]) exactly — the
+    stencil engine's active-window discipline).  Window lengths are
+    padded to powers of two so XLA compiles O(log E) programs, not one
+    per band."""
+
+    CAPABILITIES = frozenset({"weighted", "windowed"})
+
+    def _finalize_arrays(self) -> None:
+        super()._finalize_arrays()
+        self._slot_start = np.zeros(self.n + 1, dtype=np.int64)
+        np.cumsum(
+            np.bincount(self._u_host, minlength=self.n),
+            out=self._slot_start[1:],
+        )
+
+    def _relax(self, tent, active, light: bool):
+        rows = np.asarray(active).any(axis=0)
+        hot = np.flatnonzero(rows)
+        if hot.size == 0:
+            return tent, 0
+        lo, hi = int(hot[0]), int(hot[-1]) + 1
+        s0, s1 = int(self._slot_start[lo]), int(self._slot_start[hi])
+        width = s1 - s0
+        if width == 0:
+            return tent, 0
+        pad = _pow2(width)
+        sel_band = (
+            self._light_host[s0:s1] if light else ~self._light_host[s0:s1]
+        )
+        # Sentinel padding: sel=False slots offer INF to vertex 0 — a
+        # no-op under scatter-min, so padded windows stay exact.
+        u_w = np.zeros(pad, dtype=np.int32)
+        v_w = np.zeros(pad, dtype=np.int32)
+        w_w = np.ones(pad, dtype=np.int32)
+        sel_w = np.zeros(pad, dtype=bool)
+        u_w[:width] = self._u_host[s0:s1]
+        v_w[:width] = self._v_host[s0:s1]
+        w_w[:width] = self._w_host[s0:s1]
+        sel_w[:width] = sel_band
+        out = _relax_scatter_min(tent, active, u_w, v_w, w_w, sel_w)
+        return out, int(width)
+
+
+def _mesh_relax_build(tile: int):
+    @jax.jit
+    def relax(tent, active, U, VL, W, SEL):
+        K = tent.shape[0]
+        tiles = U.shape[0]
+
+        def per_tile(cols, vl, w, sel, tent_tile):
+            cand = jnp.where(
+                active[:, cols] & sel[None, :],
+                tent[:, cols] + w[None, :],
+                jnp.int32(INF),
+            )
+            return tent_tile.at[:, vl].min(cand)
+
+        tent_tiles = tent.reshape(K, tiles, tile)
+        new_tiles = jax.vmap(
+            per_tile, in_axes=(0, 0, 0, 0, 1), out_axes=1
+        )(U, VL, W, SEL, tent_tiles)
+        return new_tiles.reshape(K, tiles * tile)
+
+    return relax
+
+
+class WeightedMesh2DEngine(DeltaStepEngineBase):
+    """``mesh2d``: the vertex axis splits into ``tiles`` row blocks
+    (parallel.partition2d's row ownership); each block gathers offers
+    from the GLOBAL tentative plane but scatter-mins only its own rows
+    — the per-device partial + min-combine shape, run tile-sequential
+    on one chip (the virtual-mesh rehearsal; real-mesh execution is the
+    TPU runbook's weighted leg).  Jacobi-style: every tile reads the
+    pre-pass plane, which still converges to the same fixpoint because
+    relaxations only ever lower valid upper bounds and the bucket loop
+    runs to fixpoint."""
+
+    CAPABILITIES = frozenset({"weighted", "mesh2d"})
+
+    def __init__(self, graph, delta=None, tiles: int = 4):
+        self.tiles = max(1, int(tiles))
+        super().__init__(graph, delta=delta)
+
+    def _finalize_arrays(self) -> None:
+        n, T = self.n, self.tiles
+        tile = -(-max(n, 1) // T)
+        self.tile = tile
+        self.n_state = T * tile
+        owner = self._v_host // tile if len(self._v_host) else self._v_host
+        order = np.argsort(owner, kind="stable")
+        u_s = self._u_host[order]
+        v_s = self._v_host[order]
+        w_s = self._w_host[order]
+        light_s = self._light_host[order]
+        counts = np.bincount(owner, minlength=T) if len(owner) else np.zeros(T, np.int64)
+        L = _pow2(int(counts.max())) if counts.size and counts.max() else 1
+        U = np.zeros((T, L), dtype=np.int32)
+        VL = np.zeros((T, L), dtype=np.int32)
+        W = np.ones((T, L), dtype=np.int32)
+        SEL_L = np.zeros((T, L), dtype=bool)
+        SEL_H = np.zeros((T, L), dtype=bool)
+        off = 0
+        for t in range(T):
+            c = int(counts[t])
+            sl = slice(off, off + c)
+            U[t, :c] = u_s[sl]
+            VL[t, :c] = v_s[sl] - t * tile
+            W[t, :c] = w_s[sl]
+            SEL_L[t, :c] = light_s[sl]
+            SEL_H[t, :c] = ~light_s[sl]
+            off += c
+        self._U = jnp.asarray(U)
+        self._VL = jnp.asarray(VL)
+        self._W = jnp.asarray(W)
+        self._SEL_L = jnp.asarray(SEL_L)
+        self._SEL_H = jnp.asarray(SEL_H)
+        self._mesh_relax = _mesh_relax_build(tile)
+
+    def _relax(self, tent, active, light: bool):
+        sel = self._SEL_L if light else self._SEL_H
+        out = self._mesh_relax(tent, active, self._U, self._VL, self._W, sel)
+        return out, int(self._u_host.size)

@@ -56,6 +56,41 @@ def oracle_f(dist: np.ndarray) -> int:
     return int(dist[dist >= 0].sum())
 
 
+def oracle_dijkstra(
+    n: int,
+    edges: np.ndarray,
+    weights: np.ndarray,
+    sources: Sequence[int],
+) -> np.ndarray:
+    """Weighted distance-to-set by textbook lazy-deletion Dijkstra over
+    the same undirected adjacency as :func:`oracle_bfs` — the weighted
+    subsystem's independent oracle (no buckets, no JAX, no vectorized
+    sweeps).  Unreached is -1, matching the BFS encoding."""
+    import heapq
+
+    adj: List[List[Tuple[int, int]]] = [[] for _ in range(n)]
+    for (u, v), w in zip(np.asarray(edges), np.asarray(weights)):
+        adj[int(u)].append((int(v), int(w)))
+        adj[int(v)].append((int(u), int(w)))
+    dist = np.full(n, -1, dtype=np.int64)
+    heap = []
+    for s in sources:
+        s = int(s)
+        if 0 <= s < n and dist[s] != 0:
+            dist[s] = 0
+            heapq.heappush(heap, (0, s))
+    while heap:
+        d, u = heapq.heappop(heap)
+        if d != dist[u]:
+            continue  # stale entry: u settled cheaper already
+        for v, w in adj[u]:
+            nd = d + w
+            if dist[v] < 0 or nd < dist[v]:
+                dist[v] = nd
+                heapq.heappush(heap, (nd, v))
+    return dist
+
+
 def oracle_best(f_values: Sequence[int]) -> Tuple[int, int]:
     min_f, min_k = -1, -1
     for i, f in enumerate(f_values):

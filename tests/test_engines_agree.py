@@ -714,3 +714,98 @@ def test_engine_output_audits(workload, name):
     assert (
         certify.audit_f_values(g.row_offsets, g.col_indices, padded, f) == []
     )
+
+
+# The weighted arm of the same matrix (round 17, weighted/): every
+# negotiated delta-stepping flavor on the same weighted road fixture,
+# bit-identical distance planes AND F against the pure-Python lazy
+# Dijkstra oracle — a third formulation, independent of both the
+# engines' buckets and the certificate's Bellman-Ford recompute.
+# Tier-1 keeps one arm per flavor at the auto delta; the forced-delta
+# drive variants (Dial degeneration, one-bucket) ride `make weighted`.
+def _weighted_factory(flavor, delta=None):
+    def build(g):
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu import (
+            weighted as weighted_pkg,
+        )
+
+        _, eng = weighted_pkg.negotiate_weighted_engine(
+            g, flavor=flavor, delta=delta
+        )
+        return eng
+
+    return build
+
+
+WEIGHTED_ENGINES = {
+    "weighted_bitbell": _weighted_factory("bitbell"),
+    "weighted_stencil": _weighted_factory("stencil"),
+    "weighted_mesh2d": _weighted_factory("mesh2d"),
+    # Dial degeneration (delta=1: every bucket one cost unit) and the
+    # single-bucket extreme (delta >= max cost: all edges light, one
+    # fixpoint) — the two ends of the bucket-width dial, bit-identical
+    # by the label-correcting argument.
+    "weighted_bitbell_dial": _weighted_factory("bitbell", delta=1),
+    "weighted_stencil_onebucket": _weighted_factory("stencil", delta=10_000),
+    "weighted_mesh2d_dial": _weighted_factory("mesh2d", delta=1),
+}
+
+WEIGHTED_SLOW = {
+    "weighted_bitbell_dial",
+    "weighted_stencil_onebucket",
+    "weighted_mesh2d_dial",
+}
+
+
+@pytest.fixture(scope="module")
+def weighted_workload():
+    from oracle import oracle_dijkstra, oracle_f
+
+    n, edges = generators.road_edges(18, 21, seed=803)
+    costs = generators.edge_costs(
+        edges.shape[0], dist="uniform", max_cost=9, seed=806
+    )
+    g = CSRGraph.from_edges(n, edges, weights=costs)
+    queries = generators.random_queries(n, 9, max_group=5, seed=804)
+    queries[2] = np.zeros(0, dtype=np.int32)
+    queries[5] = np.array([-1, n + 3], dtype=np.int32)
+    padded = pad_queries(queries)
+    planes = np.stack(
+        [oracle_dijkstra(n, edges, costs, q) for q in queries]
+    )
+    reference = np.asarray(
+        [oracle_f(p) for p in planes], dtype=np.int64
+    )
+    return g, padded, planes, reference
+
+
+@pytest.mark.parametrize(
+    "name", _arms(WEIGHTED_ENGINES, slow=WEIGHTED_SLOW)
+)
+def test_engine_agrees_weighted(weighted_workload, name):
+    g, padded, planes, reference = weighted_workload
+    eng = WEIGHTED_ENGINES[name](g)
+    dist = np.asarray(eng.distances(padded), dtype=np.int64)
+    np.testing.assert_array_equal(dist[:, : g.n], planes)
+    np.testing.assert_array_equal(
+        np.asarray(eng.f_values(padded), dtype=np.int64), reference
+    )
+
+
+@pytest.mark.parametrize(
+    "name", _arms(WEIGHTED_ENGINES, slow=WEIGHTED_SLOW)
+)
+def test_engine_output_audits_weighted(weighted_workload, name):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops import (
+        certify,
+    )
+
+    g, padded, _planes, _reference = weighted_workload
+    eng = WEIGHTED_ENGINES[name](g)
+    f = np.asarray(eng.f_values(padded), dtype=np.int64)
+    assert (
+        certify.audit_weighted_f_values(
+            g.row_offsets, g.col_indices, g.edge_weights, padded, f
+        )
+        == []
+    )

@@ -1,0 +1,586 @@
+"""Weighted distance-to-set tests (docs/SERVING.md "Weighted queries").
+
+The bucketed delta-stepping subsystem end to end, bottom up:
+
+* the cost artifact: .bin weight sections round-tripped, fuzzed at
+  every truncation point, DIMACS .gr keep_weights, gen_cli --weights
+  determinism;
+* engine negotiation fail-loud (weightless graph, unknown flavor) and
+  the MSBFS_DELTA precedence chain (ctor > knob > mean-cost auto);
+* the weighted five-invariant certificate: clean on a hand-checked
+  field, flunking named invariants on tampered cells, and catching a
+  single injected bitflip at both weighted seams (wplane materialize,
+  supervisor dist) — escalating to CorruptionError exit 9 when the
+  corruption persists;
+* certified weighted repair: bit-identical to the cold recompute across
+  a mutation batch, on both the cone path and the fallback path, with
+  the DeltaLog carrying costs through apply();
+* the product surfaces: CLI weighted route (MSBFS_WEIGHTED=1), msbfs
+  verify --weighted, and the serving daemon answering ``weighted:
+  true`` queries with separated caches and the typed refusal on a
+  weightless graph.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.cli import (
+    main as cli_main,
+    verify_main,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.dynamic.delta import (
+    DeltaLog,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.dynamic.repair import (
+    repair_weighted_distances,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.gen_cli import (
+    main as gen_main,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (
+    generators,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models.csr import (
+    CSRGraph,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops import (
+    certify,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.runtime.supervisor import (
+    ChunkSupervisor,
+    CorruptionError,
+    InputError,
+    RetryPolicy,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (
+    MsbfsClient,
+    ServerError,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (
+    MsbfsServer,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils import (
+    faults,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (
+    WEIGHT_MAGIC,
+    load_dimacs_gr,
+    load_graph_bin,
+    pad_queries,
+    save_graph_bin,
+    save_query_bin,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.weighted import (
+    WeightedBitBellEngine,
+    negotiate_weighted_engine,
+    resolve_delta,
+)
+
+from oracle import oracle_dijkstra
+
+
+def _small_weighted(seed=11, n=96, m=260, max_cost=9):
+    nn, edges = generators.gnm_edges(n, m, seed=seed)
+    costs = generators.edge_costs(len(edges), "uniform", max_cost, seed + 1)
+    return nn, edges, costs, CSRGraph.from_edges(nn, edges, weights=costs)
+
+
+def _dij_planes(n, edges, costs, queries):
+    return np.stack(
+        [oracle_dijkstra(n, edges, costs, q) for q in queries]
+    ).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# Artifact: .bin weight section round trip + fuzz, .gr, gen_cli
+# ---------------------------------------------------------------------------
+
+
+def test_bin_weight_section_roundtrip(tmp_path):
+    n, edges, costs, g = _small_weighted()
+    p = str(tmp_path / "w.bin")
+    save_graph_bin(p, n, edges, weights=costs)
+    loaded = load_graph_bin(p)
+    assert loaded.has_weights
+    np.testing.assert_array_equal(loaded.col_indices, g.col_indices)
+    np.testing.assert_array_equal(loaded.edge_weights, g.edge_weights)
+    # A weightless file stays weightless: no phantom cost column.
+    p2 = str(tmp_path / "uw.bin")
+    save_graph_bin(p2, n, edges)
+    assert not load_graph_bin(p2).has_weights
+
+
+def test_bin_weight_section_fuzz_fails_loud(tmp_path):
+    n, edges, costs, _ = _small_weighted(n=12, m=18)
+    p = tmp_path / "w.bin"
+    save_graph_bin(p, n, edges, weights=costs)
+    blob = p.read_bytes()
+    m = len(edges)
+    edge_end = 12 + 8 * m  # int32 n + int64 m header, then 8-byte records
+    # Truncations inside the weight section: mid-magic, mid-costs, one
+    # byte short — every cut must refuse, never load weightless.
+    for cut in (edge_end + 2, edge_end + 4 + 2 * m, len(blob) - 1):
+        bad = tmp_path / f"cut{cut}.bin"
+        bad.write_bytes(blob[:cut])
+        with pytest.raises(IOError, match="weight section"):
+            load_graph_bin(bad)
+    # Trailing junk after a complete section.
+    long = tmp_path / "long.bin"
+    long.write_bytes(blob + b"xx")
+    with pytest.raises(IOError, match="weight section"):
+        load_graph_bin(long)
+    # Bit-flipped magic.
+    wrong = tmp_path / "magic.bin"
+    wrong.write_bytes(
+        blob[:edge_end] + b"XSBW" + blob[edge_end + len(WEIGHT_MAGIC):]
+    )
+    with pytest.raises(IOError, match="weight section"):
+        load_graph_bin(wrong)
+    # A zeroed cost violates the positive-cost contract.
+    zeroed = bytearray(blob)
+    zeroed[edge_end + len(WEIGHT_MAGIC): edge_end + len(WEIGHT_MAGIC) + 4] = (
+        b"\x00\x00\x00\x00"
+    )
+    zp = tmp_path / "zero.bin"
+    zp.write_bytes(bytes(zeroed))
+    with pytest.raises(IOError, match=">= 1"):
+        load_graph_bin(zp)
+    # The native loader has no cost column: forcing it is a typed error.
+    with pytest.raises(InputError, match="native"):
+        load_graph_bin(p, native=True)
+
+
+def test_dimacs_gr_keep_weights(tmp_path):
+    p = tmp_path / "toy.gr"
+    p.write_text(
+        "c toy road\n"
+        "p sp 4 5\n"
+        "a 1 2 5\n"
+        "a 2 1 5\n"  # reverse arc of the same segment
+        "a 2 3 2\n"
+        "a 3 4 7\n"
+        "a 1 3 9\n"
+    )
+    n, edges, weights = load_dimacs_gr(p, native=False, keep_weights=True)
+    assert n == 4
+    got = {(int(u), int(v)): int(w) for (u, v), w in zip(edges, weights)}
+    assert got == {(0, 1): 5, (0, 2): 9, (1, 2): 2, (2, 3): 7}
+    # keep_weights needs the Python path: the native parser drops costs.
+    with pytest.raises(InputError, match="keep_weights"):
+        load_dimacs_gr(p, native=True, keep_weights=True)
+
+
+def test_gen_cli_weights_deterministic(tmp_path):
+    args = [
+        "--kind", "gnm", "--scale", "8", "--edge-factor", "4",
+        "--seed", "7", "--weights", "uniform", "--max-cost", "9",
+    ]
+    p1, p2 = str(tmp_path / "a.bin"), str(tmp_path / "b.bin")
+    assert gen_main(args + ["--graph", p1]) == 0
+    assert gen_main(args + ["--graph", p2]) == 0
+    b1 = open(p1, "rb").read()
+    assert b1 == open(p2, "rb").read()  # same seed -> identical bytes
+    g = load_graph_bin(p1)
+    assert g.has_weights
+    w = np.asarray(g.edge_weights)
+    assert w.min() >= 1 and w.max() <= 9
+    # Dropping --weights reproduces the same edge bytes (the cost stream
+    # is seeded independently off --seed + 3), just without the section.
+    p3 = str(tmp_path / "c.bin")
+    assert gen_main([
+        "--kind", "gnm", "--scale", "8", "--edge-factor", "4",
+        "--seed", "7", "--graph", p3,
+    ]) == 0
+    b3 = open(p3, "rb").read()
+    assert b1[: len(b3)] == b3 and len(b1) > len(b3)
+    # zipf costs generate and load too.
+    p4 = str(tmp_path / "z.bin")
+    assert gen_main([
+        "--kind", "gnm", "--scale", "8", "--edge-factor", "4",
+        "--seed", "7", "--graph", p4, "--weights", "zipf",
+    ]) == 0
+    assert load_graph_bin(p4).has_weights
+
+
+# ---------------------------------------------------------------------------
+# Negotiation + delta precedence
+# ---------------------------------------------------------------------------
+
+
+def test_negotiation_fails_loud(monkeypatch):
+    n, edges = generators.gnm_edges(32, 64, seed=3)
+    weightless = CSRGraph.from_edges(n, edges)
+    with pytest.raises(InputError, match="weightless"):
+        negotiate_weighted_engine(weightless)
+    _, _, _, g = _small_weighted(n=32, m=64)
+    with pytest.raises(InputError, match="flavor"):
+        negotiate_weighted_engine(g, flavor="quantum")
+    # Knob-driven flavor selection, and the malformed knob fails loud
+    # rather than silently serving the default.
+    monkeypatch.setenv("MSBFS_WEIGHTED_ENGINE", "stencil")
+    label, _ = negotiate_weighted_engine(g)
+    assert label == "weighted-stencil"
+    monkeypatch.setenv("MSBFS_WEIGHTED_ENGINE", "nope")
+    with pytest.raises(InputError, match="MSBFS_WEIGHTED_ENGINE"):
+        negotiate_weighted_engine(g)
+
+
+def test_flavor_labels():
+    _, _, _, g = _small_weighted(n=32, m=64)
+    for flavor, label in (
+        ("auto", "weighted-bitbell"),
+        ("bitbell", "weighted-bitbell"),
+        ("stencil", "weighted-stencil"),
+        ("mesh2d", "weighted-mesh2d"),
+    ):
+        got, engine = negotiate_weighted_engine(g, flavor=flavor)
+        assert got == label
+        assert engine.delta >= 1
+
+
+def test_delta_precedence(monkeypatch):
+    monkeypatch.delenv("MSBFS_DELTA", raising=False)
+    assert resolve_delta(np.array([2, 4, 6])) == 4  # mean-cost auto
+    assert resolve_delta(np.array([], dtype=np.int32)) == 1
+    monkeypatch.setenv("MSBFS_DELTA", "7")
+    assert resolve_delta(np.array([2, 4, 6])) == 7  # knob overrides auto
+    _, _, _, g = _small_weighted(n=32, m=64)
+    assert WeightedBitBellEngine(g).delta == 7
+    assert WeightedBitBellEngine(g, delta=3).delta == 3  # ctor beats knob
+
+
+def test_overflow_guard_refuses_at_build():
+    g = CSRGraph.from_edges(
+        3,
+        np.array([[0, 1], [1, 2]]),
+        weights=np.array([1 << 29, 1 << 29], dtype=np.int64),
+    )
+    with pytest.raises(InputError, match="int32"):
+        WeightedBitBellEngine(g)
+
+
+# ---------------------------------------------------------------------------
+# Engine vs oracle + the weighted certificate
+# ---------------------------------------------------------------------------
+
+
+def test_hand_checked_path_graph():
+    # 0 --2-- 1 --5-- 2, vertex 3 isolated: dist from {0} = [0, 2, 7, -1].
+    g = CSRGraph.from_edges(
+        4, np.array([[0, 1], [1, 2]]), weights=np.array([2, 5])
+    )
+    _, eng = negotiate_weighted_engine(g)
+    dist = np.asarray(eng.distances(np.array([[0]], dtype=np.int32)))
+    np.testing.assert_array_equal(dist, [[0, 2, 7, -1]])
+    assert int(np.asarray(eng.f_values(np.array([[0]])))[0]) == 9
+    failing = certify.certify_weighted_distances(
+        g.row_offsets, g.col_indices, g.edge_weights, np.array([[0]]), dist
+    )
+    assert failing == []
+
+
+def test_certificate_flunks_tampered_cells():
+    g = CSRGraph.from_edges(
+        4, np.array([[0, 1], [1, 2]]), weights=np.array([2, 5])
+    )
+    rows = np.array([[0]])
+    good = np.array([[0, 2, 7, -1]], dtype=np.int32)
+
+    def failing(d):
+        return certify.certify_weighted_distances(
+            g.row_offsets, g.col_indices, g.edge_weights, rows, d
+        )
+
+    under = good.copy()
+    under[0, 2] = 6  # no tight predecessor offers 6
+    assert "weighted-witness" in failing(under)
+    over = good.copy()
+    over[0, 2] = 8  # violates dist[2] <= dist[1] + 5
+    assert "weighted-relaxation" in failing(over)
+    unreached = good.copy()
+    unreached[0, 1] = -1  # reached vertex 0 has an unreached neighbor
+    assert "weighted-relaxation" in failing(unreached)
+    nonsource = good.copy()
+    nonsource[0, 3] = 0
+    assert "zero-is-source" in failing(nonsource)
+    # End-to-end F audit catches a wrong cost sum.
+    assert "f-mismatch" in certify.audit_weighted_f_values(
+        g.row_offsets, g.col_indices, g.edge_weights, rows, np.array([8])
+    )
+    assert certify.audit_weighted_f_values(
+        g.row_offsets, g.col_indices, g.edge_weights, rows, np.array([9])
+    ) == []
+
+
+def test_reference_weighted_matches_oracle():
+    n, edges, costs, g = _small_weighted(seed=21)
+    rng = np.random.default_rng(22)
+    queries = [rng.integers(0, n, size=4).tolist() for _ in range(5)]
+    queries[2] = []  # empty group
+    queries[4] = [-3, n + 7]  # out-of-range only
+    padded = pad_queries([np.asarray(q, dtype=np.int32) for q in queries])
+    ref = certify.reference_weighted_distances(
+        g.row_offsets, g.col_indices, g.edge_weights, padded
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref, dtype=np.int64), _dij_planes(n, edges, costs, queries)
+    )
+    assert certify.certify_weighted_distances(
+        g.row_offsets, g.col_indices, g.edge_weights, padded, ref
+    ) == []
+
+
+# ---------------------------------------------------------------------------
+# Bitflip chaos -> detection -> escalation
+# ---------------------------------------------------------------------------
+
+
+def test_wplane_bitflip_flunks_certificate():
+    _, _, _, g = _small_weighted(seed=31, n=48, m=120)
+    _, eng = negotiate_weighted_engine(g)
+    rows = np.array([[0, 5], [7, 9]], dtype=np.int32)
+    clean = np.asarray(eng.distances(rows))
+    with faults.injected(faults.FaultPlan.parse("bitflip:wplane:1")):
+        flipped = np.asarray(eng.distances(rows))
+    assert not np.array_equal(clean, flipped)
+    assert certify.certify_weighted_distances(
+        g.row_offsets, g.col_indices, g.edge_weights, rows, flipped
+    ) != []
+
+
+def test_supervisor_audit_catches_and_recovers():
+    _, _, _, g = _small_weighted(seed=32, n=48, m=120)
+    rows = np.array([[0, 5], [7, 9]], dtype=np.int32)
+    want = np.asarray(WeightedBitBellEngine(g).f_values(rows))
+    with faults.injected(faults.FaultPlan.parse("bitflip:dist:1")):
+        sup = ChunkSupervisor(
+            WeightedBitBellEngine(g),
+            auditor=certify.make_weighted_auditor(g),
+            audit_sample=1.0,
+        )
+        audited = np.asarray(sup.f_values(rows))
+    np.testing.assert_array_equal(audited, want)  # retry served the truth
+    assert sup.audit_failures_total == 1
+    assert sup.audited_total == 2
+    assert [e["action"] for e in sup.events] == ["audit_fail"]
+
+
+def test_persistent_corruption_escalates_exit_9():
+    _, _, _, g = _small_weighted(seed=33, n=48, m=120)
+    rows = np.array([[0, 5]], dtype=np.int32)
+    plan = ",".join(f"bitflip:dist:{i}" for i in range(1, 9))
+    with faults.injected(faults.FaultPlan.parse(plan)):
+        sup = ChunkSupervisor(
+            WeightedBitBellEngine(g),
+            policy=RetryPolicy(max_retries=1, base_delay=0.0, seed=0),
+            auditor=certify.make_weighted_auditor(g),
+            audit_sample=1.0,
+        )
+        with pytest.raises(CorruptionError) as exc:
+            sup.f_values(rows)
+    assert exc.value.exit_code == 9
+
+
+def test_weightless_auditor_is_a_wiring_bug():
+    n, edges = generators.gnm_edges(16, 30, seed=5)
+    with pytest.raises(ValueError, match="edge_weights"):
+        certify.make_weighted_auditor(CSRGraph.from_edges(n, edges))
+
+
+# ---------------------------------------------------------------------------
+# Certified weighted repair + the weight-carrying DeltaLog
+# ---------------------------------------------------------------------------
+
+
+def test_deltalog_carries_costs_through_apply():
+    g = CSRGraph.from_edges(
+        4, np.array([[0, 1], [1, 2]]), weights=np.array([5, 7])
+    )
+    log = DeltaLog.from_graph(g, "wbase")
+    assert log.weighted
+    log.append([[2, 3]], [[0, 1]])
+    g1, (_, v) = log.apply()
+    assert v == 1 and g1.has_weights
+    u, vv, w, _ = g1.deduped_weighted()
+    got = {
+        (int(a), int(b)): int(c) for a, b, c in zip(u, vv, w) if a < b
+    }
+    # Kept edge keeps its cost; the inserted pair defaults to cost 1.
+    assert got == {(1, 2): 7, (2, 3): 1}
+
+
+def _repair_case(seed, max_frac=None):
+    n, edges, costs, g0 = _small_weighted(seed=seed, n=140, m=420)
+    rng = np.random.default_rng(seed + 1)
+    rows = pad_queries([
+        rng.integers(0, n, size=3),
+        rng.integers(0, n, size=5),
+        np.asarray([], dtype=np.int32),
+    ])
+    old = certify.reference_weighted_distances(
+        g0.row_offsets, g0.col_indices, g0.edge_weights, rows
+    )
+    log = DeltaLog.from_graph(g0, f"repair{seed}")
+    u, v, w, _ = g0.deduped_weighted()
+    existing = [[int(a), int(b)] for a, b in zip(u[:6], v[:6]) if a < b][:3]
+    log.append([[0, n - 1], [3, n // 2]], existing)
+    g1, _ = log.apply()
+    ins, dels = log.net_delta(0)
+    got, stats = repair_weighted_distances(
+        g1, rows, old, ins, dels, max_frac=max_frac
+    )
+    want = certify.reference_weighted_distances(
+        g1.row_offsets, g1.col_indices, g1.edge_weights, rows
+    )
+    np.testing.assert_array_equal(got, want)
+    assert certify.certify_weighted_distances(
+        g1.row_offsets, g1.col_indices, g1.edge_weights, rows, got
+    ) == []
+    return stats
+
+
+def test_weighted_repair_bit_identical():
+    stats = _repair_case(41)
+    assert not stats.fallback
+
+
+def test_weighted_repair_fallback_still_exact():
+    stats = _repair_case(42, max_frac=0.0)  # cost model forces recompute
+    assert stats.fallback
+
+
+# ---------------------------------------------------------------------------
+# Product surfaces: CLI route, verify verb, serving daemon
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def weighted_files(tmp_path):
+    """A weighted artifact (all costs 3, so F_w = 3 * F_unit — the
+    cache-separation tests can tell the modes apart), a weightless twin,
+    and a query file."""
+    n, edges = generators.gnm_edges(96, 288, seed=51)
+    costs = np.full(len(edges), 3, dtype=np.int32)
+    wp, up, qp = (
+        str(tmp_path / "w.bin"),
+        str(tmp_path / "uw.bin"),
+        str(tmp_path / "q.bin"),
+    )
+    save_graph_bin(wp, n, edges, weights=costs)
+    save_graph_bin(up, n, edges)
+    rng = np.random.default_rng(52)
+    queries = [rng.integers(0, n, size=3).tolist() for _ in range(3)]
+    save_query_bin(qp, queries)
+    return n, edges, costs, queries, wp, up, qp
+
+
+def test_cli_weighted_route(weighted_files, monkeypatch, capsys):
+    _, _, _, _, wp, up, qp = weighted_files
+    monkeypatch.setenv("MSBFS_WEIGHTED", "1")
+    monkeypatch.setenv("MSBFS_AUDIT", "full")
+    try:
+        assert cli_main(["main.py", "-g", wp, "-q", qp, "-gn", "1"]) == 0
+        # The same route on the weightless twin is the typed input error.
+        assert (
+            cli_main(["main.py", "-g", up, "-q", qp, "-gn", "1"])
+            == InputError("x").exit_code
+        )
+    finally:
+        faults.activate(None)
+
+
+def test_cli_weighted_route_exit_9_on_persistent_flips(
+    weighted_files, monkeypatch, tmp_path, capsys
+):
+    # The checkpointed runner dispatches f_values per chunk — the
+    # audited method — so persistent flips at the supervisor's dist
+    # seam must exhaust the escalation ladder into exit 9.
+    _, _, _, _, wp, _, qp = weighted_files
+    monkeypatch.setenv("MSBFS_WEIGHTED", "1")
+    monkeypatch.setenv("MSBFS_AUDIT", "full")
+    monkeypatch.setenv("MSBFS_RETRIES", "0")
+    monkeypatch.setenv("MSBFS_BACKOFF", "0.0")
+    monkeypatch.setenv("MSBFS_CHECKPOINT", str(tmp_path / "ckpt.jsonl"))
+    monkeypatch.setenv(
+        "MSBFS_FAULTS", ",".join(f"bitflip:dist:{i}" for i in range(1, 13))
+    )
+    try:
+        assert cli_main(["main.py", "-g", wp, "-q", qp, "-gn", "1"]) == 9
+    finally:
+        faults.activate(None)
+
+
+def test_verify_main_weighted(weighted_files, monkeypatch, capsys):
+    n, edges, costs, queries, wp, up, qp = weighted_files
+    monkeypatch.delenv("MSBFS_WEIGHTED", raising=False)
+    assert verify_main(["-g", wp, "-q", qp, "--weighted"]) == 0
+    # A weightless artifact cannot satisfy a weighted verify.
+    assert (
+        verify_main(["-g", up, "-q", qp, "--weighted"])
+        == InputError("x").exit_code
+    )
+    # Stored-F certification: the oracle's cost sums certify, a nudged
+    # claim is CorruptionError exit 9.
+    planes = _dij_planes(n, edges, costs, queries)
+    f_true = [int(np.where(p >= 0, p, 0).sum()) for p in planes]
+    assert verify_main(
+        ["-g", wp, "-q", qp, "--weighted", "--expect-f", json.dumps(f_true)]
+    ) == 0
+    f_bad = [f_true[0] + 1] + f_true[1:]
+    assert verify_main(
+        ["-g", wp, "-q", qp, "--weighted", "--expect-f", json.dumps(f_bad)]
+    ) == 9
+
+
+@pytest.fixture
+def weighted_server(weighted_files, tmp_path, monkeypatch):
+    _, _, _, _, wp, up, _ = weighted_files
+    monkeypatch.setenv("MSBFS_RETRIES", "0")
+    monkeypatch.delenv("MSBFS_FAULTS", raising=False)
+    monkeypatch.delenv("MSBFS_WEIGHTED", raising=False)
+    sock = str(tmp_path / "msbfs.sock")
+    srv = MsbfsServer(
+        listen=f"unix:{sock}",
+        graphs={"w": wp, "uw": up},
+        queue_capacity=4,
+        window_s=0.0,
+        request_timeout_s=60.0,
+    )
+    srv.start()
+    yield srv, f"unix:{sock}"
+    faults.activate(None)
+    srv.stop()
+
+
+def test_serve_weighted_round_trip(weighted_server, weighted_files):
+    n, edges, costs, queries, *_ = weighted_files
+    planes = _dij_planes(n, edges, costs, queries)
+    f_w = [int(np.where(p >= 0, p, 0).sum()) for p in planes]
+    _, addr = weighted_server
+    with MsbfsClient(addr) as c:
+        rw = c.query(queries, graph="w", weighted=True)
+        assert rw["ok"] and rw["weighted"]
+        assert rw["f_values"] == f_w
+        # Unit-cost on the SAME graph and rows: a different answer from
+        # a different cache entry (all costs are 3, so F_w = 3 * F_hop).
+        ru = c.query(queries, graph="w")
+        assert not ru["weighted"]
+        assert [3 * f for f in ru["f_values"]] == f_w
+        # Both modes repeat from their own result-cache entries.
+        assert c.query(queries, graph="w", weighted=True)["cached"]
+        assert c.query(queries, graph="w")["cached"]
+        # Weighted against the weightless twin: typed refusal, and the
+        # daemon keeps serving afterwards.
+        with pytest.raises(ServerError) as exc:
+            c.query(queries, graph="uw", weighted=True)
+        assert exc.value.type_name == "InputError"
+        assert c.query(queries, graph="uw")["ok"]
+        # The field itself is validated, not truthiness-coerced.
+        with pytest.raises(ServerError) as exc2:
+            c.call({
+                "op": "query", "graph": "w", "queries": [[0]],
+                "weighted": "yes",
+            })
+        assert exc2.value.type_name == "InputError"

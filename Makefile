@@ -3,7 +3,7 @@ PKG := parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu
 CXX ?= g++
 CXXFLAGS ?= -O3 -march=native -std=c++17 -fPIC -Wall -Wextra -pthread
 
-.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe analyze lockwatch netchaos weighted
+.PHONY: native clean test resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe analyze lockwatch netchaos weighted shards
 
 native: $(PKG)/runtime/librt_loader.so
 
@@ -135,5 +135,18 @@ lockwatch: native
 	    tests/test_serve.py tests/test_lifecycle.py tests/test_fleet.py \
 	    tests/test_stampede.py tests/test_netchaos.py -x -q -m "not slow"
 
-test: native analyze resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe netchaos weighted
+# Sharded-graph suite (docs/SERVING.md "Sharded graphs"): the shard
+# planner (edge-balanced row splits, deterministic artifact digests),
+# per-shard minimal-movement placement properties, the shard-manifest
+# journal record fuzzed at every byte truncation, the shard_step verb's
+# partial-adjacency guard, router scatter/gather bit-identical to the
+# whole-graph oracle (including surviving-copy retry, typed
+# ShardUnavailableError exit 11, and the degraded opt-in), and the
+# disk_full chaos kinds -> typed StorageError exit 12.  The
+# multi-process SIGKILL-mid-scatter reheal chain is slow-marked out of
+# this tier (run the file without -m to include it).
+shards: native
+	JAX_PLATFORMS=cpu MSBFS_FAULT_SEED=0 python -m pytest tests/test_shards.py -x -q -m "not slow"
+
+test: native analyze resilience serve lifecycle perf-smoke mxu fleet audit stampede multichip dynamic observe netchaos weighted shards
 	python -m pytest tests/ -x -q

@@ -43,6 +43,19 @@ every acked answer audited bit-identical against a single-daemon
 oracle ACROSS scale events (a drain that drops queued work, or a fresh
 replica serving a wrong answer, shows up here).
 
+Round 18 adds the **sharded** harness (``--sharded``): the same graph
+written at ~2x the per-replica byte cap on a 4-member fleet, so the
+planner (serve/shards.py) MUST split it into row-range shards (2
+copies each, host-spread ring placement) and every query takes the
+router's scatter/gather path.  Three phases: steady scatter (the p99
+row), a shard owner stopped mid-traffic while still listed alive —
+every ack must stay complete and bit-identical to a whole-graph oracle
+through the surviving-copy retry (zero-budget lost-ack row) — and the
+reheal loop, counting heartbeats until a ring stand-in serves the lost
+shard and a complete answer flows again.  ``smoke_sharded()`` returns
+the rows `make perf-smoke` pins: shard-scatter-p99-ms /
+shard-lost-acks / shard-reheal-heartbeats.
+
 ``BENCH_FLEET_TRANSPORT=tcp`` moves every replica and the oracle onto
 loopback TCP sockets (the real connect/read-timeout/keepalive leg from
 serve/protocol.py) instead of unix sockets — same harness, same SLO
@@ -54,6 +67,7 @@ Run::
 
     JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py
     JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py --stampede
+    JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py --sharded
     BENCH_FLEET_TRANSPORT=tcp JAX_PLATFORMS=cpu python benchmarks/bench_fleet.py
 """
 
@@ -914,6 +928,264 @@ def smoke_stampede():
     ]
 
 
+# ---- round 18: sharded graphs (docs/SERVING.md "Sharded graphs") -----------
+
+# A graph whose artifact is ~2x the per-replica cap on a 4-member
+# fleet: the planner MUST shard it, queries take the scatter/gather
+# path, and the rows pin the scatter tail, zero lost acks across a
+# mid-run owner loss (surviving-copy retry), and reheal convergence in
+# heartbeats.
+SHARD_MEMBERS = int(os.environ.get("BENCH_SHARD_MEMBERS", "4"))
+SHARD_ARRIVALS = int(os.environ.get("BENCH_SHARD_ARRIVALS", "60"))
+
+
+class ShardedFleet:
+    """4 in-process members serving one oversized graph as row-range
+    shards (each shard loaded ONLY on its ring owners — a stand-in does
+    not secretly hold every shard), plus a whole-graph oracle daemon."""
+
+    def __init__(self):
+        import numpy as np
+
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.registry import (  # noqa: E501
+            content_hash,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.ring import (  # noqa: E501
+            PlacementRing,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.router import (  # noqa: E501
+            FleetRouter,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.server import (  # noqa: E501
+            MsbfsServer,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.shards import (  # noqa: E501
+            plan_shards,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.models import (  # noqa: E501
+            generators,
+        )
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.io import (  # noqa: E501
+            save_graph_bin,
+        )
+
+        self.rng = np.random.default_rng(31)
+        self.tmp = tempfile.TemporaryDirectory(prefix="msbfs_bench_shard_")
+        self.gpath = os.path.join(self.tmp.name, "big.bin")
+        self.n, edges = generators.gnm_edges(N_VERTICES, N_EDGES, seed=37)
+        save_graph_bin(self.gpath, self.n, edges)
+        digest = content_hash(self.gpath)
+        # The ISSUE's sizing: the artifact is 2x what one replica may
+        # hold, so serving it whole is impossible by construction.
+        cap = max(1, os.path.getsize(self.gpath) // 2)
+        self.plan = plan_shards(
+            "bench", self.gpath, os.path.join(self.tmp.name, "shards"),
+            max_bytes=cap,
+        )
+        assert self.plan is not None and len(self.plan.shards) >= 2
+        members = [f"r{i}" for i in range(SHARD_MEMBERS)]
+        self.sring = PlacementRing(members, replication=2)
+        placement = {m: {} for m in members}
+        for s in self.plan.shards:
+            for owner in self.sring.owners(s.digest):
+                placement[owner][s.name] = s.path
+        self.servers = {}
+        addresses = {}
+        for m in members:
+            addr = _listen_addr(self.tmp.name, m)
+            addresses[m] = addr
+            self.servers[m] = MsbfsServer(listen=addr, graphs=placement[m])
+            self.servers[m].start()
+        self.addresses = addresses
+        oracle_addr = _listen_addr(self.tmp.name, "oracle")
+        self.oracle = MsbfsServer(
+            listen=oracle_addr, graphs={"bench": self.gpath}
+        )
+        self.oracle.start()
+        self.oracle_addr = oracle_addr
+        self.alive = set(members)
+        self.router = FleetRouter(
+            ring=PlacementRing(members, replication=2),
+            addresses=addresses,
+            digests={"bench": digest},
+            alive_fn=lambda: set(self.alive),
+            timeout=DEADLINE_S * 4,
+            shard_plans={"bench": self.plan},
+            shard_ring=self.sring,
+        )
+
+    def fresh_query(self):
+        return [
+            [int(v) for v in self.rng.integers(0, self.n, size=S)]
+            for _ in range(K)
+        ]
+
+    def oracle_answer(self, queries):
+        from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (  # noqa: E501
+            MsbfsClient,
+        )
+
+        with MsbfsClient(self.oracle_addr) as c:
+            out = c.query(queries, graph="bench")
+        return (out["f_values"], out["min_f"], out["min_k"])
+
+    def close(self):
+        for s in self.servers.values():
+            s.stop()
+        self.oracle.stop()
+        self.tmp.cleanup()
+
+
+def measure_sharded():
+    """Three phases: steady scatter (the p99 sample), a mid-run owner
+    SIGKILL-equivalent (server stopped while still listed alive — every
+    ack must stay oracle-identical through the surviving-copy retry),
+    and the reheal loop (heartbeats until a stand-in holds the lost
+    shard and a complete answer flows again)."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.serve.client import (  # noqa: E501
+        MsbfsClient,
+    )
+
+    fut = ShardedFleet()
+    try:
+        # Warm: one scattered query compiles the shard-step bucket on
+        # every owner; one oracle call does the same for the baseline.
+        warm_q = fut.fresh_query()
+        fut.router.query(warm_q, graph="bench", deadline_s=DEADLINE_S * 8)
+        fut.oracle_answer(warm_q)
+        latencies_ms = []
+        lost = 0
+        acked = 0
+
+        def drive(count):
+            nonlocal lost, acked
+            for _ in range(count):
+                q = fut.fresh_query()
+                t0 = time.perf_counter()
+                out = fut.router.query(
+                    q, graph="bench", deadline_s=DEADLINE_S * 4
+                )
+                latencies_ms.append((time.perf_counter() - t0) * 1e3)
+                acked += 1
+                got = (out["f_values"], out["min_f"], out["min_k"])
+                if got != fut.oracle_answer(q) or out["degraded"]:
+                    lost += 1
+
+        # Phase 1: steady scatter.
+        drive(SHARD_ARRIVALS)
+        # Phase 2: one shard owner dies mid-traffic, still listed
+        # alive (the between-heartbeats window).  The walk must reach
+        # the surviving copy; acks stay complete and oracle-identical.
+        victim_shard = fut.plan.shards[0]
+        victim = fut.sring.owners(victim_shard.digest)[0]
+        fut.servers[victim].stop()
+        drive(max(SHARD_ARRIVALS // 4, 8))
+        retries = fut.router.stats()["scatter_retries"]
+        # Phase 3: reheal.  Each heartbeat = mark the victim dead +
+        # one reconcile pass (load lost shards onto their ring
+        # stand-ins — the fleet supervisor's loop, inlined); converged
+        # when a complete non-degraded answer flows again.
+        fut.alive.discard(victim)
+        heartbeats = 0
+        probe = fut.fresh_query()
+        while heartbeats < 40:
+            heartbeats += 1
+            for s in fut.plan.shards:
+                for owner in fut.sring.owners(s.digest, alive=fut.alive):
+                    with MsbfsClient(fut.addresses[owner]) as c:
+                        c.load(s.path, graph=s.name)
+            out = fut.router.query(
+                probe, graph="bench", deadline_s=DEADLINE_S * 4
+            )
+            if not out["degraded"] and (
+                out["f_values"], out["min_f"], out["min_k"]
+            ) == fut.oracle_answer(probe):
+                break
+        router_stats = fut.router.stats()
+    finally:
+        fut.close()
+    return {
+        "p50_ms": round(_percentile(latencies_ms, 50), 3),
+        "p99_ms": round(_percentile(latencies_ms, 99), 3),
+        "acked": acked,
+        "lost_acks": lost,
+        "scatter_retries": retries,
+        "reheal_heartbeats": heartbeats,
+        "shards": len(fut.plan.shards),
+        "deadline_ms": DEADLINE_S * 4 * 1e3,
+        "router": router_stats,
+    }
+
+
+def smoke_sharded():
+    """`make perf-smoke` rows (guard: opt * 2 <= base, opt <= BUDGET):
+
+    * shard-scatter-p99-ms     scattered-query tail against the wire
+                               deadline — the fan-out/merge rounds must
+                               not eat the latency budget.
+    * shard-lost-acks          exact zero pin: every ack across the
+                               owner-loss window is complete and
+                               bit-identical to the whole-graph oracle
+                               (a degraded or diverging ack counts).
+    * shard-reheal-heartbeats  heartbeats from owner death to a
+                               stand-in serving the lost shard again.
+    """
+    out = measure_sharded()
+    detail = {k: out[k] for k in (
+        "p50_ms", "p99_ms", "acked", "scatter_retries", "shards",
+        "reheal_heartbeats", "deadline_ms",
+    )}
+    detail["router"] = out["router"]
+    print(f"sharded SLO detail: {json.dumps(detail, sort_keys=True)}")
+    return [
+        ("shard-scatter-p99-ms", out["deadline_ms"], out["p99_ms"]),
+        ("shard-lost-acks", 2 * out["acked"], out["lost_acks"]),
+        ("shard-reheal-heartbeats", 40, out["reheal_heartbeats"]),
+    ]
+
+
+def sharded_main() -> int:
+    out = measure_sharded()
+    tag = (
+        f"{SHARD_MEMBERS} members, {out['shards']} shards x 2 copies, "
+        f"G(n={N_VERTICES}, m={N_EDGES}), K={K}, S={S}"
+    )
+    print(json.dumps({
+        "metric": f"sharded scatter p99 latency, {tag}",
+        "value": out["p99_ms"],
+        "unit": "ms",
+        "detail": {
+            "p50_ms": out["p50_ms"],
+            "acked": out["acked"],
+            "deadline_ms": out["deadline_ms"],
+            "router": out["router"],
+        },
+    }))
+    print(json.dumps({
+        "metric": f"sharded acked-answer integrity across owner loss, {tag}",
+        "value": out["lost_acks"],
+        "unit": "lost acks",
+        "detail": {
+            "acked": out["acked"],
+            "scatter_retries": out["scatter_retries"],
+        },
+    }))
+    print(json.dumps({
+        "metric": f"sharded reheal convergence, {tag}",
+        "value": out["reheal_heartbeats"],
+        "unit": "heartbeats",
+        "detail": {"shards": out["shards"]},
+    }))
+    if out["lost_acks"]:
+        print(
+            f"bench_fleet --sharded: integrity failures: "
+            f"lost={out['lost_acks']}",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def stampede_main() -> int:
     out = run_stampede()
     tag = (
@@ -973,6 +1245,8 @@ def stampede_main() -> int:
 def main() -> int:
     if "--stampede" in sys.argv[1:]:
         return stampede_main()
+    if "--sharded" in sys.argv[1:]:
+        return sharded_main()
     out = measure()
     tag = (
         f"{REPLICAS} replicas (replication {REPLICATION}), "

@@ -172,6 +172,24 @@ BUDGET = {
     "stampede-scaleup-heartbeats": 12,
     "stampede-interactive-p99-ms": 1500,
     "stampede-lost-acks": 0,
+    # Round 18 sharded-graph SLOs (bench_fleet.smoke_sharded — an
+    # artifact at ~2x the per-replica cap on a 4-member fleet, so every
+    # query takes the scatter/gather path; docs/SERVING.md "Sharded
+    # graphs").  Scatter p99 rides a widened 8 s wire deadline (each
+    # query is rounds x fragments of shard_step RPCs; warm CPU scatters
+    # sit near 150 ms, and 1 s keeps the fan-out/merge from eating the
+    # budget even with scheduling jitter).  Lost-acks is the zero-budget
+    # exact pin THROUGH a shard owner stopped mid-traffic while still
+    # listed alive: an ack that is degraded, diverges from the
+    # whole-graph oracle, or vanishes counts — the surviving-copy walk
+    # must make the loss invisible.  Reheal is heartbeats from marking
+    # the owner dead to a stand-in serving the lost shard with a
+    # complete oracle-identical answer; one reconcile pass suffices
+    # today, 12 leaves room for load-ordering jitter (base 40 = the
+    # probe window).
+    "shard-scatter-p99-ms": 1000,
+    "shard-lost-acks": 0,
+    "shard-reheal-heartbeats": 12,
     # Round 16 TCP transport rows (BENCH_FLEET_TRANSPORT=tcp — the same
     # harnesses over loopback TCP with the serve/protocol.py
     # connect/read-timeout/keepalive legs live).  Budgets match the unix
@@ -416,6 +434,17 @@ def run_stampede():
     import bench_fleet
 
     return bench_fleet.smoke_stampede()
+
+
+def run_sharded():
+    """Round-18 sharded-graph rows: defer to the sharded harness's
+    smoke_sharded() (bench_fleet plans an oversized graph into
+    row-range shards on a 4-member fleet, drives the scatter/gather
+    path through a mid-run owner loss and the reheal loop, and prints
+    the SLO detail block before returning the rows)."""
+    import bench_fleet
+
+    return bench_fleet.smoke_sharded()
 
 
 def run_fleet_tcp():
@@ -924,9 +953,9 @@ def run_trend():
 def main() -> int:
     failures = []
     for run in (run_config1, run_config4, run_stencil_window, run_mxu,
-                run_fleet, run_stampede, run_fleet_tcp, run_stampede_tcp,
-                run_audit, run_telemetry, run_repair, run_weighted,
-                run_multichip, run_trend, run_analyze):
+                run_fleet, run_stampede, run_sharded, run_fleet_tcp,
+                run_stampede_tcp, run_audit, run_telemetry, run_repair,
+                run_weighted, run_multichip, run_trend, run_analyze):
         rows = run()
         if isinstance(rows, tuple):
             rows = [rows]

@@ -129,6 +129,33 @@ MERGE_TREES = ("auto", "oneshot", "ring", "halving", "pipelined", "none")
 WIRE_PAIR_BYTES = 8
 
 
+def edge_balanced_row_splits(row_offsets, num_parts: int) -> List[int]:
+    """Row boundaries splitting a CSR's vertex space into ``num_parts``
+    contiguous ranges of roughly equal DIRECTED-EDGE weight: boundary k
+    is the first row whose cumulative edge count reaches k/num_parts of
+    the total.  Returns ``num_parts + 1`` monotone boundaries with
+    ``[0] ... [n]`` at the ends — range i is ``[out[i], out[i+1])``.
+
+    Shared seam for every row-range partitioner: the in-process 2D mesh
+    splits rows uniformly today (lsub padding wants equal ROW counts for
+    the collective layout), but the fleet shard planner
+    (serve/shards.py) splits by edges — a power-law graph split by rows
+    would land the whole hub block in one shard, and a shard IS its
+    adjacency bytes.  Degenerate rows (n < num_parts) yield empty
+    trailing ranges rather than an error; callers drop empty ranges."""
+    ro = np.asarray(row_offsets, dtype=np.int64)
+    n = ro.shape[0] - 1
+    if num_parts < 1:
+        raise ValueError(f"num_parts must be >= 1, got {num_parts}")
+    total = int(ro[-1])
+    targets = (total * np.arange(1, num_parts, dtype=np.int64)) // num_parts
+    cuts = np.searchsorted(ro, targets, side="left")
+    out = [0] + [int(min(c, n)) for c in cuts] + [n]
+    for i in range(1, len(out)):  # monotone under ties/empty rows
+        out[i] = max(out[i], out[i - 1])
+    return out
+
+
 def select_merge_tree(c_size: int, override: Optional[str] = None) -> str:
     """Per-axis reduction-tree policy for the col-axis OR-reduce-scatter.
 

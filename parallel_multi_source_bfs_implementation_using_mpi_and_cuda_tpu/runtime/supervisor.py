@@ -52,6 +52,8 @@ __all__ = [
     "PoisonQueryError",
     "CorruptionError",
     "FencedError",
+    "ShardUnavailableError",
+    "StorageError",
     "classify",
     "RetryPolicy",
     "call_with_watchdog",
@@ -163,6 +165,41 @@ class FencedError(MsbfsError):
         super().__init__(msg)
         self.frame_epoch = frame_epoch
         self.local_epoch = local_epoch
+
+
+class ShardUnavailableError(MsbfsError):
+    """Every copy of at least one graph shard is unreachable
+    (docs/SERVING.md "Sharded graphs"): the scatter/gather router walked
+    all ring owners of the shard and none answered, so the exact
+    distance-to-set answer cannot be assembled — a hole in the graph,
+    not a hole in capacity.  The query was refused rather than answered
+    wrong; callers that can tolerate a lower-bound F may opt in to a
+    ``degraded: true`` partial answer instead (``degraded`` request
+    flag).  Retryable only after the supervisor re-replicates the shard
+    (it does so automatically from the registered artifact).  Exit 11
+    so scripting can tell "part of the graph is gone" from load
+    shedding (7) and whole-replica transients (5).  Carries the missing
+    shard names (``shards``)."""
+
+    exit_code = 11
+
+    def __init__(self, msg: str, shards=()):
+        super().__init__(msg)
+        self.shards = tuple(shards)
+
+
+class StorageError(MsbfsError):
+    """Durable storage failed underneath a write the daemon promised —
+    a journal append or a shard-artifact write hit ENOSPC / a short
+    write (docs/RESILIENCE.md "Disk exhaustion").  The daemon stays up
+    and keeps answering reads; the failed WRITE is reported typed
+    instead of crashing the process or silently dropping durability,
+    and the health verb degrades (``journal_writable: false``) until an
+    append succeeds again.  Retryable only after the operator frees
+    disk.  Exit 12 so scripting can tell "the disk is full" from input
+    errors (1) and corruption (9)."""
+
+    exit_code = 12
 
 
 _CAPACITY_MARKS = ("RESOURCE_EXHAUSTED", "OUT OF MEMORY", "ALLOCATION FAILURE")

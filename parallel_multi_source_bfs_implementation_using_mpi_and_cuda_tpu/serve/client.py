@@ -302,6 +302,7 @@ class MsbfsClient:
         priority: Optional[str] = None,
         client_id: Optional[str] = None,
         weighted: bool = False,
+        degraded: bool = False,
     ) -> dict:
         qs = [[int(v) for v in group] for group in queries]
         request = {"op": "query", "graph": graph, "queries": qs}
@@ -309,6 +310,13 @@ class MsbfsClient:
             # Absent = unit-cost: legacy servers never see the field, so
             # old deployments keep answering exactly as before.
             request["weighted"] = True
+        if degraded:
+            # Sharded-graph opt-in (docs/SERVING.md "Sharded graphs"):
+            # when every copy of a shard is down, accept a PARTIAL
+            # answer flagged ``degraded: true`` instead of the typed
+            # ShardUnavailableError refusal.  Absent = exact-or-refuse;
+            # single-daemon and whole-graph fleets ignore the field.
+            request["degraded"] = True
         if deadline_s is not None:
             request["deadline_s"] = float(deadline_s)
         if priority is not None:
@@ -343,6 +351,25 @@ class MsbfsClient:
         if hedge_after_s is None:
             return self.call(request, idempotent=True)
         return self._hedged_call(request, float(hedge_after_s))
+
+    def shard_step(
+        self, graph: str, rows: Sequence[int],
+        frontier: Sequence[Sequence[int]],
+    ) -> dict:
+        """One scatter/gather frontier expansion against a row-range
+        shard registered on this daemon (docs/SERVING.md "Sharded
+        graphs").  Read-only and deterministic, hence idempotent —
+        re-sending a lost fragment is exactly the router's surviving-
+        copy retry."""
+        return self.call(
+            {
+                "op": "shard_step",
+                "graph": graph,
+                "rows": [int(rows[0]), int(rows[1])],
+                "frontier": [[int(v) for v in g] for g in frontier],
+            },
+            idempotent=True,
+        )
 
     def stats(self) -> dict:
         return self.call({"op": "stats"}, idempotent=True)["stats"]

@@ -70,13 +70,20 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set
 
-from ..runtime.supervisor import CorruptionError, RetryPolicy, TransientError
+from ..runtime.supervisor import (
+    CorruptionError,
+    RetryPolicy,
+    StorageError,
+    TransientError,
+)
 from ..utils import faults, knobs
 from .autoscale import AutoscalePolicy, ReplicaSignal
 from .brownout import BrownoutLadder
 from .client import MsbfsClient, ServerError
+from .journal import StateJournal
 from .registry import content_hash
 from .ring import PlacementRing
+from .shards import ShardPlan, is_shard_name, plan_shards
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -184,6 +191,8 @@ class FleetSupervisor:
         brownout: Optional[BrownoutLadder] = None,
         shed_fn: Optional[Callable[[], int]] = None,
         drain_timeout_s: float = 60.0,
+        shard_max_bytes: Optional[int] = None,
+        shard_replicas: Optional[int] = None,
     ):
         if size < 1:
             raise ValueError(f"fleet size must be >= 1, got {size}")
@@ -250,11 +259,56 @@ class FleetSupervisor:
         self.graphs: Dict[str, str] = {}  # name -> path
         self.digests: Dict[str, str] = {}  # name -> content digest
         self.refused_graphs: Dict[str, str] = {}  # name -> refusal reason
+        # ---- cross-replica sharding (serve/shards.py) -------------------
+        # Oversized graphs split into "<name>#shard<i>" entries that live
+        # in the SAME graphs/digests tables — reconcile, digest gates and
+        # journal replay apply to a shard exactly as to a whole graph.
+        # Placement uses a second ring over the same members so a shard's
+        # copy count (MSBFS_SHARD_REPLICAS) is independent of the whole-
+        # graph replication factor.
+        self.shard_max_bytes = (
+            int(shard_max_bytes)
+            if shard_max_bytes is not None
+            else knobs.get_int("MSBFS_SHARD_MAX_BYTES", 0)
+        )
+        self.shard_replicas = (
+            int(shard_replicas)
+            if shard_replicas is not None
+            else knobs.get_int("MSBFS_SHARD_REPLICAS", 2)
+        )
+        if self.shard_replicas < 1:
+            raise ValueError(
+                f"shard replicas must be >= 1, got {self.shard_replicas}"
+            )
+        self.shard_ring = PlacementRing(
+            [r.name for r in self.replicas],
+            replication=self.shard_replicas,
+            weights={r.name: r.weight for r in self.replicas},
+            hosts={r.name: r.host for r in self.replicas if r.host},
+        )
+        self.shard_plans: Dict[str, ShardPlan] = {}  # parent -> plan
+        self.shard_reheals = 0  # shards re-replicated after owner loss
+        # parent -> {shard name -> live-owner tuple}: last placement each
+        # reconcile converged to; a diff against it IS the reheal event.
+        self._shard_view: Dict[str, Dict[str, tuple]] = {}
+        # Fleet manifest journal: shard topology must survive supervisor
+        # resurrection (the per-replica journals only know shard NAMES,
+        # not which parent they reassemble into).
+        self.manifest = StateJournal(
+            os.path.join(self.base_dir, "fleet.journal")
+        )
+        for parent, rec in sorted(self.manifest.replay().shards.items()):
+            plan = ShardPlan.from_manifest(parent, rec)
+            self.shard_plans[parent] = plan
+            for s in plan.shards:
+                self.graphs[s.name] = s.path
+                self.digests[s.name] = s.digest
         # Membership epoch: durable at base_dir/epoch so a resurrected
         # supervisor resumes (never rewinds) the fence counter.
         self.epoch_path = os.path.join(self.base_dir, "epoch")
         self.epoch = self._load_epoch()
         self.ring.epoch = self.epoch
+        self.shard_ring.epoch = self.epoch
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._monitor: Optional[threading.Thread] = None
@@ -299,6 +353,7 @@ class FleetSupervisor:
                 except OSError:
                     pass
             self.ring.epoch = self.epoch
+            self.shard_ring.epoch = self.epoch
             return self.epoch
 
     def _host_for(self, index: int) -> Optional[str]:
@@ -489,6 +544,7 @@ class FleetSupervisor:
             self.replicas.append(r)
             self.addresses[r.name] = r.address
             self.ring.add_member(r.name, weight=r.weight, host=r.host)
+            self.shard_ring.add_member(r.name, weight=r.weight, host=r.host)
             self._bump_epoch(f"join {r.name}")
             if self.started and not self._stop.is_set():
                 self._spawn(r)
@@ -533,6 +589,8 @@ class FleetSupervisor:
             r.state = "draining"
             if r.name in self.ring.members:
                 self.ring.remove_member(r.name)
+            if r.name in self.shard_ring.members:
+                self.shard_ring.remove_member(r.name)
             self._bump_epoch(f"retire {name}")
         # Promoted owners pick the victim's graphs up while it still
         # answers — the walk order is ring order, so by the time the
@@ -809,13 +867,64 @@ class FleetSupervisor:
     # ---- placement --------------------------------------------------------
     def register(self, name: str, path: str) -> List[str]:
         """Register ``path`` under ``name`` on the graph's ring owners.
-        Returns the owner names.  Safe to call again (load-once)."""
+        Returns the owner names.  Safe to call again (load-once).
+
+        When ``shard_max_bytes`` is armed and the artifact exceeds it,
+        the graph is planned into row-range shards instead (serve/
+        shards.py): each shard registers under its derived name on the
+        shard ring, the manifest journals the topology BEFORE placement
+        (a supervisor crash mid-register resurrects the plan, and the
+        shard artifacts it points at are already on disk), and the
+        return value is the union of shard owners.  A manifest append
+        that hits a full disk propagates the typed ``StorageError`` —
+        the registration promise was durability, not a hint; nothing is
+        placed, and re-registering after freeing disk re-plans
+        deterministically onto the same artifact digests."""
         digest = content_hash(path)
+        plan = None
+        if self.shard_max_bytes > 0 and not is_shard_name(name):
+            plan = plan_shards(
+                name,
+                path,
+                out_dir=os.path.join(
+                    self.base_dir, "shards", name.replace(os.sep, "_")
+                ),
+                max_bytes=self.shard_max_bytes,
+                replicas=self.shard_replicas,
+                digest=digest,
+            )
+        if plan is None:
+            with self._lock:
+                self.graphs[name] = path
+                self.digests[name] = digest
+            self._reconcile()
+            return self.ring.owners(digest)
+        self.manifest.append(plan.to_record())  # StorageError propagates
         with self._lock:
-            self.graphs[name] = path
-            self.digests[name] = digest
+            self.shard_plans[name] = plan
+            # A re-registration with a new split drops stale shard rows.
+            for gname in [
+                g
+                for g in self.graphs
+                if is_shard_name(g)
+                and g.split("#", 1)[0] == name
+                and g not in {s.name for s in plan.shards}
+            ]:
+                self.graphs.pop(gname, None)
+                self.digests.pop(gname, None)
+            for s in plan.shards:
+                self.graphs[s.name] = s.path
+                self.digests[s.name] = s.digest
         self._reconcile()
-        return self.ring.owners(digest)
+        owners: Set[str] = set()
+        for s in plan.shards:
+            owners.update(self.shard_ring.owners(s.digest))
+        return sorted(owners)
+
+    def _ring_for(self, name: str) -> PlacementRing:
+        """Shard entries place on the shard ring (their own replication
+        factor); whole graphs on the stock ring."""
+        return self.shard_ring if is_shard_name(name) else self.ring
 
     def ready_names(self) -> Set[str]:
         return {r.name for r in self.replicas if r.state == "ready"}
@@ -834,7 +943,9 @@ class FleetSupervisor:
             # converge to different stand-ins for the same outage).
             ready = {r.name: r for r in self.replicas if r.state == "ready"}
         for name, path in todo:
-            owners = self.ring.owners(digests[name], alive=ready.keys())
+            owners = self._ring_for(name).owners(
+                digests[name], alive=ready.keys()
+            )
             pending = [
                 ready[o] for o in owners if name not in ready[o].registered
             ]
@@ -870,6 +981,50 @@ class FleetSupervisor:
                     r.registered.add(name)
                 except (ServerError, OSError, ValueError):
                     pass  # next reconcile pass retries
+        self._note_shard_moves(ready.keys())
+
+    def _note_shard_moves(self, alive) -> None:
+        """Detect shard re-replication: the reconcile loop above already
+        DID the copy (a shard is just a graph; a dead owner's key walks
+        to the ring stand-in and gets the digest-verified load), so all
+        that is left is to make the move durable and fenceable — append
+        the manifest again (journal-recorded) and bump the membership
+        epoch so frames minted against the old placement are refused.
+        The trigger is a placement DIFF against the last converged view,
+        not a death event: a reheal and a recovery are both topology
+        changes, and counting diffs makes the chaos chain's
+        ``shard_reheals`` assertion deterministic."""
+        alive = set(alive)
+        with self._lock:
+            plans = dict(self.shard_plans)
+        for parent, plan in plans.items():
+            view = {
+                s.name: tuple(self.shard_ring.owners(s.digest, alive=alive))
+                for s in plan.shards
+            }
+            with self._lock:
+                prev = self._shard_view.get(parent)
+                self._shard_view[parent] = view
+            if prev is None or view == prev:
+                continue
+            moved = sorted(sn for sn in view if view[sn] != prev.get(sn))
+            with self._lock:
+                self.shard_reheals += len(moved)
+            try:
+                self.manifest.append(plan.to_record())
+            except StorageError as exc:
+                # The copies themselves landed; only the manifest
+                # re-append is lost.  Resurrection re-plans from the
+                # parent artifact, so degrade loudly, don't crash the
+                # monitor thread (docs/RESILIENCE.md "Disk exhaustion").
+                print(
+                    f"msbfs fleet: shard reheal for {parent!r} not "
+                    f"journaled: {exc}",
+                    file=sys.stderr,
+                )
+            self._bump_epoch(
+                f"shard-reheal {parent}: {','.join(moved)}"
+            )
 
     # ---- corruption response ----------------------------------------------
     def quarantine(self, name_or_index) -> bool:
@@ -907,25 +1062,55 @@ class FleetSupervisor:
             digests = dict(self.digests)
             refused = dict(self.refused_graphs)
             replicas = list(self.replicas)
+            plans = dict(self.shard_plans)
+            reheals = self.shard_reheals
+        ready = self.ready_names()
+        shards = {}
+        for parent, plan in plans.items():
+            rows = []
+            under = 0
+            for s in plan.shards:
+                live = self.shard_ring.owners(s.digest, alive=ready)
+                if len(live) < min(plan.replicas, len(ready) or 1):
+                    under += 1
+                rows.append(
+                    {
+                        "name": s.name,
+                        "digest": s.digest,
+                        "rows": [s.lo, s.hi],
+                        "owners": self.shard_ring.owners(s.digest),
+                        "live_owners": live,
+                    }
+                )
+            shards[parent] = {
+                "digest": plan.digest,
+                "n": plan.n,
+                "replicas": plan.replicas,
+                "under_replicated": under,
+                "shards": rows,
+            }
         out = {
             "size": len([r for r in replicas if r.state != "removed"]),
             "slots": self._next_index,
             "epoch": self.epoch,
             "transport": self.transport,
             "replication": self.ring.replication,
+            "shard_replicas": self.shard_replicas,
+            "shard_reheals": reheals,
             "refused_graphs": refused,
-            "ready": sorted(self.ready_names()),
+            "ready": sorted(ready),
             "replicas": [r.describe() for r in replicas],
             "graphs": {
                 name: {
                     "digest": digest,
-                    "owners": self.ring.owners(digest),
-                    "live_owners": self.ring.owners(
-                        digest, alive=self.ready_names()
+                    "owners": self._ring_for(name).owners(digest),
+                    "live_owners": self._ring_for(name).owners(
+                        digest, alive=ready
                     ),
                 }
                 for name, digest in digests.items()
             },
+            "shards": shards,
         }
         if self.autoscale is not None:
             out["autoscale"] = self.autoscale.describe()

@@ -59,7 +59,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..utils import faults, knobs
 
-_OPS = ("load", "reload", "warm", "mutate")
+_OPS = ("load", "reload", "warm", "mutate", "shard")
 
 
 def _valid_pairs(pairs) -> bool:
@@ -90,6 +90,12 @@ class JournalState:
     # for the live registration; order IS the version chain, so these
     # replay (and compact) strictly after the graph's load record
     deltas: Dict[str, List[dict]] = field(default_factory=dict)
+    # name -> shard manifest record for a fleet-sharded graph: the
+    # parent file's hash plus the ordered shard table ({"name", "path",
+    # "hash", "lo", "hi"} each).  Last write wins — a re-plan (or a
+    # reheal re-append) replaces the whole manifest, so replay restores
+    # exactly the current shard topology (serve/shards.py).
+    shards: Dict[str, dict] = field(default_factory=dict)
     replayed: int = 0  # records applied
     dropped: int = 0  # malformed/torn/stale lines skipped
 
@@ -113,6 +119,17 @@ class JournalState:
             {"op": "warm", "name": n, "hash": h, "k_exec": k, "s_pad": s}
             for n, h, k, s in sorted(self.warm)
         )
+        out.extend(
+            {
+                "op": "shard",
+                "name": n,
+                "hash": m["hash"],
+                "n": m["n"],
+                "replicas": m["replicas"],
+                "shards": m["shards"],
+            }
+            for n, m in sorted(self.shards.items())
+        )
         return out
 
 
@@ -128,6 +145,9 @@ class StateJournal:
             max_bytes = knobs.get_int("MSBFS_JOURNAL_MAX_BYTES", 1 << 20)
         self.max_bytes = int(max_bytes)
         self.compactions = 0
+        # Latched health gauge: False from the moment an append fails
+        # until one lands again (the daemon's ``journal_writable``).
+        self.writable = True
 
     def bytes(self) -> int:
         """Current journal size on disk (0 when it does not exist yet) —
@@ -141,26 +161,34 @@ class StateJournal:
     def append(self, record: dict) -> None:
         """Durably append one record: write + flush + fsync, so the
         record survives a process kill the moment append returns.  A
-        failed append is reported once to stderr and swallowed — journal
-        loss degrades restart warmth, it must never fail a request.
-        Past ``max_bytes`` the file is auto-compacted down to the
-        reconciled state (which keeps THIS record: compaction runs after
-        the durable append, so a crash between the two still replays)."""
-        faults.trip("journal_append")
+        failed append — ENOSPC, a short write, a yanked volume — raises
+        the typed :class:`~..runtime.supervisor.StorageError` (exit 12,
+        docs/RESILIENCE.md "Disk exhaustion") and latches ``writable``
+        False for the health verb; the DAEMON stays up (each caller
+        decides whether its record was a durability promise or a warmth
+        hint), and the first append that lands after the disk frees
+        flips ``writable`` back.  Past ``max_bytes`` the file is
+        auto-compacted down to the reconciled state (which keeps THIS
+        record: compaction runs after the durable append, so a crash
+        between the two still replays)."""
+        from ..runtime.supervisor import StorageError
+
         line = json.dumps(record, separators=(",", ":"), sort_keys=True)
         try:
+            faults.trip("journal_append")
             with open(self.path, "a", encoding="utf-8") as f:
                 f.write(line + "\n")
                 f.flush()
                 os.fsync(f.fileno())
                 size = f.tell()
         except OSError as exc:
-            print(
-                f"msbfs serve: journal append to {self.path} failed: {exc}"
-                " (restart will not restore this state)",
-                file=sys.stderr,
-            )
-            return
+            self.writable = False
+            raise StorageError(
+                f"journal append to {self.path} failed: {exc} — the "
+                "record is NOT durable (a restart will not restore this "
+                "state); free disk and retry"
+            ) from exc
+        self.writable = True
         if self.max_bytes > 0 and size > self.max_bytes:
             self.compact(self._replay(trip=False))
             self.compactions += 1
@@ -250,6 +278,53 @@ class StateJournal:
                 {"inserts": inserts, "deletes": deletes, "digest": digest,
                  "token": token}
             )
+            return True
+        if op == "shard":
+            # Fleet shard manifest (serve/shards.py): structural check
+            # field by field — a torn or hand-mangled manifest must drop
+            # (the supervisor re-plans from the registered parent), not
+            # crash replay or resurrect a half-table.
+            digest = record.get("hash")
+            table = record.get("shards")
+            if (
+                not isinstance(digest, str)
+                or not isinstance(table, list)
+                or not table  # a sharded graph with no shards is torn
+            ):
+                state.dropped += 1
+                return False
+            try:
+                total_n = int(record["n"])
+                replicas = int(record["replicas"])
+            except (KeyError, TypeError, ValueError):
+                state.dropped += 1
+                return False
+            if isinstance(total_n, bool) or total_n < 0 or replicas < 1:
+                state.dropped += 1
+                return False
+            for row in table:
+                if not isinstance(row, dict):
+                    state.dropped += 1
+                    return False
+                if not all(
+                    isinstance(row.get(k), str) and row.get(k)
+                    for k in ("name", "path", "hash")
+                ):
+                    state.dropped += 1
+                    return False
+                lo, hi = row.get("lo"), row.get("hi")
+                if not all(
+                    isinstance(x, int) and not isinstance(x, bool)
+                    for x in (lo, hi)
+                ) or not (0 <= lo < hi <= total_n):
+                    state.dropped += 1
+                    return False
+            state.shards[name] = {
+                "hash": digest,
+                "n": total_n,
+                "replicas": replicas,
+                "shards": table,
+            }
             return True
         # op == "warm"
         digest = record.get("hash")

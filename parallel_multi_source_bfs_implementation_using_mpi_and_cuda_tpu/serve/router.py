@@ -44,6 +44,7 @@ primary's answer stands.
 from __future__ import annotations
 
 import os
+import queue
 import re
 import secrets
 import socket
@@ -61,6 +62,7 @@ from ..runtime.supervisor import (
     InputError,
     MsbfsError,
     RetryPolicy,
+    ShardUnavailableError,
     TransientError,
 )
 from ..utils import faults, knobs
@@ -76,6 +78,7 @@ from ..utils.telemetry import (
 from . import observe, protocol
 from .client import MsbfsClient, ServerError
 from .ring import PlacementRing
+from .shards import ShardPlan, or_merge_fragments, scatter_frontier
 
 
 def vote_rate_from_env() -> float:
@@ -134,6 +137,8 @@ class FleetRouter:
         vote_rate: Optional[float] = None,
         quarantine_fn=None,
         brownout_fn=None,
+        shard_plans: Optional[Dict[str, ShardPlan]] = None,
+        shard_ring: Optional[PlacementRing] = None,
     ):
         missing = [m for m in ring.members if m not in addresses]
         if missing:
@@ -153,6 +158,18 @@ class FleetRouter:
         # callable answering "suppress voting right now?".  Rung 1 of
         # the ladder turns the vote's shadow traffic off router-side.
         self.brownout_fn = brownout_fn
+        # Sharded graphs (serve/shards.py): parent name -> ShardPlan and
+        # the shard-replication ring.  A fleet router shares the
+        # supervisor's live tables (for_fleet below); both empty means
+        # every graph routes whole — the scatter path never engages.
+        self.shard_plans: Dict[str, ShardPlan] = (
+            shard_plans if shard_plans is not None else {}
+        )
+        self.shard_ring = shard_ring
+        self.shard_fragment_timeout_s = knobs.get_float(
+            "MSBFS_SHARD_FRAGMENT_TIMEOUT_S", 30.0
+        )
+        self.shard_hedge_ms = knobs.get_float("MSBFS_SHARD_HEDGE_MS", 0.0)
         self._vote_acc = 0.0
         self._index = {m: i for i, m in enumerate(ring.members)}
         self._lock = threading.Lock()
@@ -169,6 +186,12 @@ class FleetRouter:
             "vote_mismatches": 0,
             "vote_unresolved": 0,
             "quarantined": 0,
+            "scatter_queries": 0,
+            "scatter_rounds": 0,
+            "scatter_fragments": 0,
+            "scatter_retries": 0,
+            "scatter_degraded": 0,
+            "scatter_shard_lost": 0,
             "per_replica": {m: 0 for m in ring.members},
         }
 
@@ -203,6 +226,15 @@ class FleetRouter:
         addresses = getattr(supervisor, "addresses", None)
         if addresses is not None:
             router.addresses = addresses
+        # Same live-share for shard topology: a graph sharded after
+        # construction scatters immediately, and the shard ring tracks
+        # elastic membership through the supervisor's mirroring.
+        plans = getattr(supervisor, "shard_plans", None)
+        if plans is not None:
+            router.shard_plans = plans
+        sring = getattr(supervisor, "shard_ring", None)
+        if sring is not None:
+            router.shard_ring = sring
         return router
 
     def _bump(self, key: str, member: Optional[str] = None) -> None:
@@ -255,12 +287,41 @@ class FleetRouter:
         priority: Optional[str] = None,
         client_id: Optional[str] = None,
         weighted: bool = False,
+        degraded: bool = False,
     ) -> dict:
         """Forward one query batch; returns the replica's response dict
         plus routing metadata (``replica``, ``failovers``).  The
         admission-control fields (``priority``, ``client_id``) and the
         ``weighted`` answer mode ride through unchanged — shedding
-        decisions belong to the replica's batcher, not the router."""
+        decisions belong to the replica's batcher, not the router.
+
+        A graph with a shard plan takes the scatter/gather path instead
+        (docs/SERVING.md "Sharded graphs"); ``degraded`` is the client's
+        opt-in to a *partial* answer when every copy of some shard is
+        gone — without it, total shard loss is the typed
+        :class:`~..runtime.supervisor.ShardUnavailableError` (exit 11),
+        never a silently wrong F."""
+        plan = self.shard_plans.get(graph)
+        if plan is not None:
+            with span("route.scatter", graph=graph) as sp:
+                if weighted:
+                    raise InputError(
+                        f"graph {graph!r} is served sharded; weighted "
+                        "distance-to-set is whole-graph only (raise "
+                        "MSBFS_SHARD_MAX_BYTES to serve it whole)"
+                    )
+                out = self._scatter_query(
+                    graph,
+                    plan,
+                    queries,
+                    deadline_s=deadline_s,
+                    degraded=degraded,
+                )
+                sp.set(
+                    rounds=int(out.get("rounds", 0)),
+                    degraded=bool(out.get("degraded")),
+                )
+                return out
         with span("route.query", graph=graph) as sp:
             out = self._query_walk(
                 queries,
@@ -411,6 +472,304 @@ class FleetRouter:
             f"no owner of graph {graph!r} answered "
             f"({failovers} attempt(s); last: {last_err})"
         )
+
+    # ---- sharded scatter/gather (docs/SERVING.md "Sharded graphs") --------
+    def _scatter_query(
+        self,
+        graph: str,
+        plan: ShardPlan,
+        queries: Sequence[Sequence[int]],
+        deadline_s: Optional[float] = None,
+        degraded: bool = False,
+    ) -> dict:
+        """Level-synchronous distance-to-set over the shard fleet: each
+        BFS round splits the frontier by owning shard
+        (:func:`~.shards.scatter_frontier`), fans the fragments to their
+        ring owners concurrently, and OR-merges the returned neighbor
+        sets — the :class:`~..parallel.partition2d.Partition2D`
+        row-gather/OR-merge discipline rebuilt over the wire.  Distances
+        and the F objective are computed router-side exactly as the
+        single daemon's engine computes them (sum of reached distances,
+        lowest-index argmin tie-break), so the merged answer is
+        bit-identical to the whole-graph oracle.
+
+        A fragment whose every copy is gone raises the typed
+        :class:`ShardUnavailableError` — unless the client opted into
+        ``degraded``, in which case the shard is dropped for the REST of
+        the query (its rows never expand), and the answer carries
+        ``degraded: true`` plus ``missing_shards``: explicitly partial,
+        never silently wrong."""
+        if self.shard_ring is None:
+            raise InputError(
+                f"graph {graph!r} has a shard plan but this router has "
+                "no shard ring; route through the fleet front end"
+            )
+        # Validation mirrors the daemon's _parse_queries bound for bound
+        # so a malformed batch gets the SAME typed verdict whether the
+        # graph happens to be sharded or whole.
+        if not isinstance(queries, (list, tuple)) or not len(queries):
+            raise InputError(
+                "query needs 'queries': a non-empty list of vertex-id "
+                "lists"
+            )
+        k = len(queries)
+        n = plan.n
+        start = time.monotonic()
+        deadline = None if deadline_s is None else start + float(deadline_s)
+        dist = np.full((k, n), -1, dtype=np.int64)
+        frontier: List[np.ndarray] = []
+        for qi, group in enumerate(queries):
+            if not isinstance(group, (list, tuple)) or not len(group):
+                raise InputError(
+                    f"query group {qi} must be a non-empty list"
+                )
+            try:
+                verts = np.unique(np.asarray(list(group), dtype=np.int64))
+            except (TypeError, ValueError, OverflowError) as exc:
+                raise InputError(
+                    f"query group {qi}: source ids must be integers "
+                    f"({exc})"
+                ) from None
+            if verts.min() < 0 or verts.max() >= n:
+                raise InputError(
+                    f"query group {qi}: source ids must be in [0, {n})"
+                )
+            dist[qi, verts] = 0
+            frontier.append(verts)
+        self._bump("scatter_queries")
+        missing: Dict[int, str] = {}  # shard index -> name (degraded)
+        rounds = 0
+        fragments = 0
+        while any(f.size for f in frontier):
+            fan = {
+                si: rows
+                for si, rows in scatter_frontier(plan, frontier).items()
+                if si not in missing
+            }
+            if not fan:
+                break  # every live frontier row belongs to a lost shard
+            results: "queue.Queue" = queue.Queue()
+
+            def run(si: int, rows: List[List[int]], rq=results) -> None:
+                try:
+                    rq.put((si, "ok", self._fragment_call(
+                        plan.shards[si], rows, deadline
+                    )))
+                except MsbfsError as err:
+                    rq.put((si, "err", err))
+                except Exception as err:  # noqa: BLE001 — typed or bust
+                    rq.put((si, "err", MsbfsError(str(err))))
+
+            for si, rows in sorted(fan.items()):
+                threading.Thread(
+                    target=run,
+                    args=(si, rows),
+                    name="msbfs-fleet-scatter",
+                    daemon=True,
+                ).start()
+            outs: List[List[List[int]]] = []
+            for _ in range(len(fan)):
+                si, kind, payload = results.get()
+                if kind == "ok":
+                    outs.append(payload)
+                    continue
+                if isinstance(payload, ShardUnavailableError) and degraded:
+                    missing[si] = plan.shards[si].name
+                    self._bump("scatter_shard_lost")
+                    continue
+                raise payload
+            fragments += len(fan)
+            nxt: List[np.ndarray] = []
+            for qi, cand in enumerate(or_merge_fragments(n, outs, k)):
+                new = cand[dist[qi, cand] < 0] if cand.size else cand
+                if new.size:
+                    dist[qi, new] = rounds + 1
+                nxt.append(new)
+            frontier = nxt
+            rounds += 1
+            self._bump("scatter_rounds")
+        # F and selection mirror the engine and the daemon's
+        # _finish_batch exactly: f = sum of distances over REACHED
+        # vertices (ops/objective.py f_of_u), argmin with the
+        # lowest-index tie-break, (-1, -1) for an empty batch.
+        f_vals = np.where(dist >= 0, dist, 0).sum(axis=1).astype(np.int64)
+        if k:
+            keyed = np.where(
+                f_vals >= 0, f_vals, np.iinfo(np.int64).max
+            )
+            min_k = int(np.argmin(keyed))
+            min_f = int(f_vals[min_k])
+        else:
+            min_f, min_k = -1, -1
+        if missing:
+            self._bump("scatter_degraded")
+        return {
+            "ok": True,
+            "op": "query",
+            "graph": graph,
+            "n": int(n),
+            "k": int(k),
+            "f_values": [int(v) for v in f_vals],
+            "min_f": min_f,
+            "min_k": min_k,
+            "weighted": False,
+            "sharded": True,
+            "shards": len(plan.shards),
+            "rounds": rounds,
+            "fragments": fragments,
+            "degraded": bool(missing),
+            "missing_shards": sorted(missing.values()),
+            "latency_s": time.monotonic() - start,
+        }
+
+    def _fragment_call(self, shard, rows_frontier, deadline):
+        """One shard fragment, delivered or typed: walk the shard's ring
+        owners with the query walk's full failover taxonomy, one attempt
+        thread at a time, racing a second copy after
+        ``MSBFS_SHARD_HEDGE_MS`` when armed (the fragment analog of the
+        client's straggler hedge — results are deterministic, either
+        answer is THE answer, and the OR-merge is idempotent).
+        ``deadline`` is absolute ``time.monotonic()``; spending it is a
+        :class:`TransientError` (the copies may be fine — the budget is
+        not), while exhausting every copy is the typed
+        :class:`ShardUnavailableError` naming the shard."""
+        alive = self.alive_fn() if self.alive_fn is not None else None
+        owners = self.shard_ring.owners(shard.digest, alive=alive)
+        if not owners:
+            raise ShardUnavailableError(
+                f"no live owner for shard {shard.name!r} (rows "
+                f"[{shard.lo}, {shard.hi})): every copy is gone; "
+                "re-replication converges when a member recovers",
+                shards=(shard.name,),
+            )
+        hedge_s = (
+            self.shard_hedge_ms / 1000.0 if self.shard_hedge_ms > 0 else None
+        )
+        results: "queue.Queue" = queue.Queue()
+
+        def attempt(member: str) -> None:
+            results.put(
+                self._fragment_attempt(member, shard, rows_frontier, deadline)
+            )
+
+        launched = 0
+        done = 0
+        saturated = 0
+        failures: List[str] = []
+        while True:
+            if launched < len(owners) and launched == done:
+                # Walk: everything in flight has failed — next copy.
+                threading.Thread(
+                    target=attempt,
+                    args=(owners[launched],),
+                    name="msbfs-fleet-scatter",
+                    daemon=True,
+                ).start()
+                launched += 1
+            if done >= launched and launched >= len(owners):
+                break
+            wait = None
+            if deadline is not None:
+                wait = deadline - time.monotonic()
+                if wait <= 0:
+                    raise TransientError(
+                        f"deadline spent mid-scatter on shard "
+                        f"{shard.name!r} ({done}/{launched} attempt(s) "
+                        "returned)"
+                    )
+            if hedge_s is not None and launched < len(owners):
+                wait = hedge_s if wait is None else min(wait, hedge_s)
+            try:
+                kind, member, payload = results.get(timeout=wait)
+            except queue.Empty:
+                if hedge_s is not None and launched < len(owners):
+                    # Straggler: race the next copy WITHOUT abandoning
+                    # the in-flight one; first success wins.
+                    self._bump("hedged")
+                    threading.Thread(
+                        target=attempt,
+                        args=(owners[launched],),
+                        name="msbfs-fleet-scatter",
+                        daemon=True,
+                    ).start()
+                    launched += 1
+                continue
+            done += 1
+            if kind == "ok":
+                if failures:
+                    with self._lock:
+                        self._stats["scatter_retries"] += len(failures)
+                self._bump("scatter_fragments", member)
+                return payload
+            if kind == "raise":
+                raise payload
+            if kind == "backpressure":
+                saturated += 1
+            failures.append(member)
+        if saturated and saturated >= len(failures):
+            raise BackpressureError(
+                f"all {saturated} live owner(s) of shard {shard.name!r} "
+                "are saturated; retry with backoff or grow the fleet"
+            )
+        raise ShardUnavailableError(
+            f"all {len(owners)} live owner(s) of shard {shard.name!r} "
+            f"(rows [{shard.lo}, {shard.hi})) failed "
+            f"({', '.join(failures)}): every copy is unreachable; "
+            "re-replication converges when a member recovers",
+            shards=(shard.name,),
+        )
+
+    def _fragment_attempt(self, member, shard, rows_frontier, deadline):
+        """One owner, one wire call; never raises — the hedged walk in
+        :meth:`_fragment_call` consumes ``(kind, member, payload)``
+        verdicts from its attempt threads.  The taxonomy is the query
+        walk's: drops/transients/fenced walk on, backpressure is
+        counted, deterministic failures surface (``raise``) — except
+        ``InputError``, which for ``shard_step`` can only mean the
+        shard is not loaded on a freshly promoted stand-in yet
+        (reconcile lag; the router validated the frontier against the
+        plan before fanning out), so it walks to the surviving copy."""
+        remaining = None if deadline is None else deadline - time.monotonic()
+        if remaining is not None and remaining <= 0:
+            return ("fail", member, TransientError("deadline spent"))
+        try:
+            faults.trip(f"route{self._route_index(member)}")
+        except faults.SimulatedNetDrop as drop:
+            self._bump("net_drops")
+            return ("fail", member, drop)
+        address = self.addresses.get(member)
+        if address is None:
+            return ("fail", member, KeyError(member))
+        timeout = min(self.timeout, self.shard_fragment_timeout_s)
+        if remaining is not None:
+            timeout = min(timeout, remaining)
+        try:
+            with span(
+                "route.fragment", member=member, shard=shard.name
+            ), MsbfsClient(
+                address,
+                timeout=timeout,
+                retry=_NO_RETRY,
+                epoch=self._epoch(),
+            ) as client:
+                out = client.shard_step(
+                    shard.name, (shard.lo, shard.hi), rows_frontier
+                )
+        except (faults.SimulatedNetDrop, faults.SimulatedHalfOpen) as nd:
+            self._bump("net_drops")
+            return ("fail", member, nd)
+        except ServerError as err:
+            if err.type_name == "BackpressureError":
+                return ("backpressure", member, err)
+            if err.type_name == "FencedError":
+                self._bump("fenced")
+                return ("fail", member, err)
+            if err.type_name in ("TransientError", "InputError"):
+                return ("fail", member, err)
+            return ("raise", member, err)
+        except (protocol.ProtocolError, OSError, socket.timeout) as exc:
+            return ("fail", member, exc)
+        return ("ok", member, out.get("frontier_out") or [])
 
     # ---- mutation path ----------------------------------------------------
     def mutate(
@@ -874,6 +1233,7 @@ class FleetFrontend:
                     priority=request.get("priority"),
                     client_id=request.get("client_id"),
                     weighted=bool(request.get("weighted", False)),
+                    degraded=bool(request.get("degraded", False)),
                 )
                 out["ok"] = True
                 return out
@@ -989,6 +1349,16 @@ class FleetFrontend:
             per, totals = self._rollup()
             out["replicas"] = per
             out["totals"] = totals
+            # Shard topology, surfaced top-level so an operator's first
+            # `stats` answers "how is this graph cut, where do the
+            # pieces live, is anything under-replicated" without
+            # spelunking the fleet blob.
+            shards = out["fleet"].get("shards") or {}
+            if shards:
+                out["shards"] = shards
+                totals["under_replicated_shards"] = sum(
+                    g.get("under_replicated", 0) for g in shards.values()
+                )
         return out
 
     # Per-replica stats fields summed into the fleet-wide roll-up; the
@@ -1001,6 +1371,7 @@ class FleetFrontend:
         "audited",
         "audit_failures",
         "journal_bytes",
+        "shard_steps",
     )
     _ROLLUP_QUEUE_KEYS = (
         "depth",
@@ -1122,6 +1493,15 @@ def fleet_main(argv: Optional[List[str]] = None) -> int:
         "the ring then spreads each graph's owners across labels",
     )
     ap.add_argument(
+        "--shard-max-bytes", type=int, default=None, metavar="BYTES",
+        help="shard graphs whose artifact exceeds BYTES across the "
+        "fleet (default MSBFS_SHARD_MAX_BYTES; 0 = serve whole)",
+    )
+    ap.add_argument(
+        "--shard-replicas", type=int, default=None, metavar="N",
+        help="copies per shard (default MSBFS_SHARD_REPLICAS, 2)",
+    )
+    ap.add_argument(
         "--autoscale-max", type=int, default=0, metavar="N",
         help="arm the autoscaler: grow from --size up to N replicas "
         "under load, shrink back when quiet (0 = fixed size)",
@@ -1166,6 +1546,8 @@ def fleet_main(argv: Optional[List[str]] = None) -> int:
             host_pool=host_pool or None,
             autoscale=autoscale,
             brownout=brownout,
+            shard_max_bytes=args.shard_max_bytes,
+            shard_replicas=args.shard_replicas,
         )
         supervisor.start(
             wait_ready_s=args.wait_ready_s or None

@@ -63,6 +63,7 @@ from ..runtime.supervisor import (
     InputError,
     MsbfsError,
     PoisonQueryError,
+    StorageError,
     TransientError,
     classify,
 )
@@ -261,6 +262,7 @@ class MsbfsServer:
         self._recovery_events: List[dict] = []
         self._failed_requests = 0
         self._requests_total = 0
+        self._shard_steps = 0
         self._shed_requests = 0
         self._shed_brownout = 0
         self._quarantined_requests = 0
@@ -335,10 +337,19 @@ class MsbfsServer:
             )
             entry.supervisor.audit_sample = self._posture_audit
         if self.journal is not None and (known is None or known is not entry):
-            self.journal.append(
-                {"op": "load", "name": name, "path": path,
-                 "hash": entry.hash}
-            )
+            try:
+                self.journal.append(
+                    {"op": "load", "name": name, "path": path,
+                     "hash": entry.hash}
+                )
+            except StorageError:
+                # The refusal must unwind the in-memory registration too:
+                # keeping the entry would make a retry after freeing disk
+                # hit load-once and skip the append forever — registered,
+                # acked, and still invisible to the next journal replay
+                # (docs/RESILIENCE.md "Disk exhaustion").
+                self.registry.evict(name)
+                raise
         return entry
 
     # ---- lifecycle --------------------------------------------------------
@@ -747,7 +758,8 @@ class MsbfsServer:
                 return {"ok": True, "op": "ping", "pid": os.getpid()}
             if op == "health":
                 return self._op_health()
-            if op in ("load", "reload", "query", "mutate", "versions"):
+            if op in ("load", "reload", "query", "mutate", "versions",
+                      "shard_step"):
                 if self._draining and op != "versions":
                     # versions is read-only (like stats) and stays
                     # answerable while draining; the rest is refused.
@@ -769,6 +781,8 @@ class MsbfsServer:
                 return self._op_reload(request)
             if op == "query":
                 return self._op_query(request)
+            if op == "shard_step":
+                return self._op_shard_step(request)
             if op == "mutate":
                 return self._op_mutate(request)
             if op == "versions":
@@ -833,6 +847,13 @@ class MsbfsServer:
                 "replay_done": self._ready.is_set(),
                 **journal_stats,
             },
+            # Disk-exhaustion gauge (docs/RESILIENCE.md "Disk
+            # exhaustion"): latched False by a failed append until one
+            # lands again.  A daemon with no journal is vacuously
+            # writable — there is nothing to lose.
+            "journal_writable": (
+                self.journal.writable if self.journal else True
+            ),
         }
 
     def _op_load(self, request: dict) -> dict:
@@ -872,6 +893,91 @@ class MsbfsServer:
             "op": "reload",
             "graph": entry.describe(),
             "invalidated_results": dropped,
+        }
+
+    def _op_shard_step(self, request: dict) -> dict:
+        """Expand one scatter/gather frontier round against a locally
+        registered row-range shard (docs/SERVING.md "Sharded graphs").
+        The fleet router drives the level-synchronous BFS and owns the
+        distance state; this verb is one fragment of one level — for
+        each query, the union of the neighbors of the given frontier
+        vertices.  Every frontier vertex must fall inside the shard's
+        declared row range [lo, hi): a shard artifact carries complete
+        adjacency only for its own rows (out-of-range rows exist in the
+        loaded CSR as loader-doubled reverse records, i.e. PARTIAL
+        adjacency), so expanding one would return a silently wrong
+        neighbor set — exactly the class of bug this check fails loud
+        on."""
+        name = request.get("graph", "default")
+        entry = self.registry.get(name)
+        g = entry.graph
+        rows = request.get("rows")
+        if (
+            not isinstance(rows, (list, tuple))
+            or len(rows) != 2
+            or not all(
+                isinstance(x, int) and not isinstance(x, bool) for x in rows
+            )
+        ):
+            raise InputError("shard_step needs 'rows': [lo, hi]")
+        lo, hi = int(rows[0]), int(rows[1])
+        if not (0 <= lo < hi <= g.n):
+            raise InputError(
+                f"shard_step rows [{lo}, {hi}) fall outside graph "
+                f"{name!r}'s vertex space [0, {g.n})"
+            )
+        frontier = request.get("frontier")
+        if not isinstance(frontier, list):
+            raise InputError(
+                "shard_step needs 'frontier': one vertex list per query"
+            )
+        ro = np.asarray(g.row_offsets, dtype=np.int64)
+        ci = np.asarray(g.col_indices, dtype=np.int64)
+        frontier_out: List[List[int]] = []
+        expanded = 0
+        for i, group in enumerate(frontier):
+            if not isinstance(group, list):
+                raise InputError(
+                    f"shard_step frontier group {i} is not a list"
+                )
+            try:
+                verts = np.asarray(group, dtype=np.int64)
+            except (TypeError, ValueError, OverflowError):
+                raise InputError(
+                    f"shard_step frontier group {i} has a non-int vertex"
+                ) from None
+            if verts.size == 0:
+                frontier_out.append([])
+                continue
+            if int(verts.min()) < lo or int(verts.max()) >= hi:
+                raise InputError(
+                    f"shard_step frontier group {i} has vertices outside "
+                    f"the shard's row range [{lo}, {hi}); the router must "
+                    "scatter each row to the shard that owns it"
+                )
+            starts = ro[verts]
+            counts = ro[verts + 1] - starts
+            total = int(counts.sum())
+            if total == 0:
+                frontier_out.append([])
+                continue
+            # Vectorized ragged gather: edge index = per-vertex start
+            # repeated over its degree, plus the within-row offset.
+            within = np.arange(total, dtype=np.int64) - np.repeat(
+                np.cumsum(counts) - counts, counts
+            )
+            neigh = np.unique(ci[np.repeat(starts, counts) + within])
+            frontier_out.append([int(v) for v in neigh])
+            expanded += total
+        with self._stats_lock:
+            self._shard_steps += 1
+        return {
+            "ok": True,
+            "op": "shard_step",
+            "graph": name,
+            "rows": [lo, hi],
+            "frontier_out": frontier_out,
+            "edges_expanded": expanded,
         }
 
     def _op_mutate(self, request: dict) -> dict:
@@ -1448,10 +1554,21 @@ class MsbfsServer:
             # record grammar is a 4-tuple shared with older journals,
             # and a restart that loses weighted warmth only re-pays a
             # compile, never an answer.
-            self.journal.append(
-                {"op": "warm", "name": entry.name, "hash": entry.hash,
-                 "k_exec": k_exec, "s_pad": s_pad}
-            )
+            try:
+                self.journal.append(
+                    {"op": "warm", "name": entry.name, "hash": entry.hash,
+                     "k_exec": k_exec, "s_pad": s_pad}
+                )
+            except StorageError as exc:
+                # A warm record is a restart-warmth HINT, not a promise:
+                # a full disk must not fail the admitted batch riding
+                # this compile.  Health already degrades via the
+                # journal's latched writable flag; the durable verbs
+                # (load/reload/mutate) still fail typed.
+                print(
+                    f"msbfs serve: warm hint not journaled: {exc}",
+                    file=sys.stderr,
+                )
         f = np.asarray(supervisor.f_values(batch)).astype(np.int64)
         # MSBFS_AUDIT: the supervisor just audited (or sampled past)
         # this dispatch; carry the verdict to the per-request responses.
@@ -1641,6 +1758,7 @@ class MsbfsServer:
             recovery = list(self._recovery_events)
             failed = self._failed_requests
             total = self._requests_total
+            shard_steps = self._shard_steps
             shed = self._shed_requests
             shed_brownout = self._shed_brownout
             quarantined = self._quarantined_requests
@@ -1701,6 +1819,7 @@ class MsbfsServer:
             "buckets": buckets,
             "requests_total": total,
             "requests_failed": failed,
+            "shard_steps": shard_steps,
             "requests_shed": shed,
             "requests_quarantined": quarantined,
             "fleet_epoch": self._current_epoch(),

@@ -128,6 +128,17 @@ deterministically, composable with every kind above.
                held frame is flushed before any read on the same thread,
                so a request/response exchange is delayed, never
                deadlocked.
+``disk_full``  site must be ``journal`` or ``shard``; trips at the
+               durable-write seam it names — ``journal`` fires inside
+               :meth:`..serve.journal.StateJournal.append` (the
+               ``journal_append`` trip), ``shard`` inside the shard
+               artifact writer (``shard_write``, serve/shards.py) —
+               and raises :class:`SimulatedDiskFull`, an ``OSError``
+               with the ENOSPC shape.  The seam owner converts it into
+               the typed ``StorageError`` (exit 12) instead of crashing
+               the daemon, and the health verb reports
+               ``journal_writable: false`` until an append succeeds
+               (docs/RESILIENCE.md "Disk exhaustion").
 ``half_open``  site must be ``route<r>``; on the ``n``-th trip the next
                frame this thread sends is written into a black hole —
                ``send_frame`` reports success, the peer never sees the
@@ -156,7 +167,12 @@ from typing import Dict, List, Optional
 KINDS = ("io", "corrupt", "oom", "transient", "hang", "chip", "crash",
          "poison", "replica_kill", "replica_slow", "net_drop", "bitflip",
          "wire_corrupt", "host_down", "net_partition", "net_delay",
-         "net_dup", "net_reorder", "half_open")
+         "net_dup", "net_reorder", "half_open", "disk_full")
+
+# disk_full's site names the durable-write SEAM, not the trip string:
+# the journal's trip site predates this kind and must keep its name (old
+# plans pin it), so the spec site maps onto it here.
+_DISK_FULL_SITES = {"journal": "journal_append", "shard": "shard_write"}
 
 _RANK_RE = re.compile(r"rank(\d+)\Z")
 _VERTEX_RE = re.compile(r"vertex(\d+)\Z")
@@ -253,6 +269,20 @@ class SimulatedHalfOpen(RuntimeError):
         self.replica = int(replica)
 
 
+class SimulatedDiskFull(OSError):
+    """A durable write that hit the end of the disk: ENOSPC from the
+    filesystem, byte-for-byte what a full volume hands ``write()``.
+    An ``OSError`` subclass so the owning seam's existing OSError
+    handling catches it unchanged — the seam (journal append, shard
+    artifact writer) converts it into the typed ``StorageError`` rather
+    than crashing the daemon (docs/RESILIENCE.md "Disk exhaustion")."""
+
+    def __init__(self, msg: str):
+        import errno
+
+        super().__init__(errno.ENOSPC, msg)
+
+
 class SimulatedPoison(RuntimeError):
     """A query whose content deterministically kills its dispatch —
     retrying or resizing the batch never helps, only removing the row
@@ -280,7 +310,11 @@ class FaultSpec:
     def trip_site(self) -> str:
         # Chips die during dispatches, and poison is a property of the
         # dispatched data; both specs' sites name WHICH rank/vertex.
-        return "dispatch" if self.kind in ("chip", "poison") else self.site
+        if self.kind in ("chip", "poison"):
+            return "dispatch"
+        if self.kind == "disk_full":
+            return _DISK_FULL_SITES[self.site]
+        return self.site
 
 
 class FaultPlan:
@@ -408,6 +442,12 @@ class FaultPlan:
                     f"fault spec {raw!r}: bitflip faults need site "
                     "plane<i>, dist or wplane (e.g. bitflip:plane0:1, "
                     "bitflip:dist:1, bitflip:wplane:1)"
+                )
+            if kind == "disk_full" and site not in _DISK_FULL_SITES:
+                raise ValueError(
+                    f"fault spec {raw!r}: disk_full faults need site "
+                    f"{' or '.join(sorted(_DISK_FULL_SITES))} "
+                    "(e.g. disk_full:journal:1)"
                 )
             host = None
             if kind == "host_down":
@@ -625,6 +665,10 @@ class FaultPlan:
         if s.kind == "host_down":
             raise SimulatedHostDown(
                 f"injected host down: host {s.host} {where}", s.host
+            )
+        if s.kind == "disk_full":
+            raise SimulatedDiskFull(
+                f"injected disk full: no space left on device {where}"
             )
         if s.kind == "wire_corrupt":
             # Not a raise: the routed call must PROCEED so the corrupt

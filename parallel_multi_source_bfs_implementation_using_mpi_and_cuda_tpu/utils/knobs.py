@@ -114,6 +114,10 @@ _ALL = (
     _k("MSBFS_FLEET_DIR", None, "path", "fleet replica sockets/journals/logs directory"),
     _k("MSBFS_FLEET_BACKOFF", "0.2", "float", "replica restart base backoff in seconds"),
     _k("MSBFS_VOTE", "off", "spec", "cross-replica vote: off / on / sample rate in (0,1)"),
+    _k("MSBFS_SHARD_MAX_BYTES", "0", "int", "shard graphs whose artifact exceeds this many bytes across the fleet; 0 serves every graph whole"),
+    _k("MSBFS_SHARD_REPLICAS", "2", "int", "copies per shard on the shard placement ring"),
+    _k("MSBFS_SHARD_FRAGMENT_TIMEOUT_S", "30", "float", "per-attempt wire deadline for one scatter fragment"),
+    _k("MSBFS_SHARD_HEDGE_MS", "0", "float", "race a shard fragment's second copy after this many ms; 0 disables hedging"),
     _k("MSBFS_NET_CONNECT_TIMEOUT_S", "5", "float", "socket connect deadline in seconds when the caller gave none; 0 = blocking"),
     _k("MSBFS_NET_READ_TIMEOUT_S", "0", "float", "per-read socket timeout after connect; 0 = inherit the request timeout"),
     _k("MSBFS_NET_KEEPALIVE", "1", "flag", "0 disables SO_KEEPALIVE on TCP fleet legs"),

@@ -56,7 +56,7 @@ BASELINE.md; empty disables), BENCH_WAIT_S (device-probe budget, default
 420), BENCH_RUN_S (workload hard deadline, default 1500),
 BENCH_GRAPH (rmat|road — road builds the config-4 grid at side 2^(scale/2)),
 BENCH_CONFIGS (comma list of BASELINE config ids, DEFAULT
-"2,2c,4,1,5,6,6r,7,7t,7l,7s,8,8m,9": sweep
+"2,2c,4,1,5,6,6r,7,7t,7l,7s,7a,8,8m,9": sweep
 mode — each config runs in its own deadline-bounded child and gets its own
 value/error in detail.sweep; the cumulative record re-emits after every
 config so a partial outage cannot zero what was already measured; the
@@ -69,7 +69,9 @@ The "7" family is the round-10 multi-chip scale-out: BENCH_ENGINE=mesh2d
 a forced 8-virtual-device CPU mesh; rows carry detail.multichip.  "7s"
 (round 15) is the sparse-frontier road workload whose
 detail.multichip.wire ledger records the density-adaptive encoding per
-level and measured-vs-dense-model bytes.  The "8"
+level and measured-vs-dense-model bytes; "7a" (round 19) reruns it with
+BENCH_ASYNC_LEVELS=4 (the bounded-staleness drive) and records the
+measured collective-round diet in detail.multichip.async.  The "8"
 family is the round-11 dynamic-graph workload (BENCH_DYNAMIC=1):
 localized-delta incremental BFS repair vs full recompute, host-side, with
 BENCH_DELTA_SIZE/BENCH_DELTA_LOCALITY shaping the seeded delta (gen_cli
@@ -589,10 +591,12 @@ def run_workload() -> None:
     )
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (
         collective_bytes,
+        collective_rounds,
         dispatch_count,
         mxu_tile_counts,
         plane_pass_bytes,
         reset_collective_bytes,
+        reset_collective_rounds,
         reset_dispatch_count,
         reset_mxu_tiles,
         reset_plane_pass,
@@ -710,6 +714,9 @@ def run_workload() -> None:
                     os.environ.get("BENCH_MESH", "2x4")
                 )
                 wire_chunks_env = os.environ.get("BENCH_WIRE_CHUNKS", "")
+                # Round 19: BENCH_ASYNC_LEVELS=k > 1 switches the engine
+                # to the bounded-staleness drive (config 7a pins k=4).
+                async_env = os.environ.get("BENCH_ASYNC_LEVELS", "")
                 return Mesh2DEngine(
                     make_mesh2d(rows, cols),
                     g,
@@ -720,6 +727,7 @@ def run_workload() -> None:
                     wire_chunks=(
                         int(wire_chunks_env) if wire_chunks_env else None
                     ),
+                    async_levels=int(async_env) if async_env else None,
                 )
             except ValueError as e:
                 sys.exit(f"BENCH_ENGINE=mesh2d: {e}")
@@ -796,7 +804,7 @@ def run_workload() -> None:
         engine.compile(queries.shape)  # compile outside the timed span
         compile_s = time.perf_counter() - t0
         times = []
-        dispatches = plane_bytes = coll_bytes = None
+        dispatches = plane_bytes = coll_bytes = coll_rounds = None
         for _ in range(repeats):
             # MEASURED dispatch count (round 6): every host-blocking
             # commit in the timed span rides utils.timing.record_dispatch,
@@ -810,12 +818,14 @@ def run_workload() -> None:
             reset_plane_pass()
             reset_mxu_tiles()
             reset_collective_bytes()
+            reset_collective_rounds()
             t0 = time.perf_counter()
             min_f, min_k = engine.best(queries)
             times.append(time.perf_counter() - t0)
             dispatches = dispatch_count()
             plane_bytes = plane_pass_bytes()
             coll_bytes = collective_bytes()
+            coll_rounds = collective_rounds()
         best_s = min(times)
         teps = num_queries * e_directed / best_s
         return (
@@ -829,6 +839,7 @@ def run_workload() -> None:
             dispatches,
             plane_bytes,
             coll_bytes,
+            coll_rounds,
         )
 
     (
@@ -842,6 +853,7 @@ def run_workload() -> None:
         measured_dispatches,
         measured_plane_bytes,
         measured_coll_bytes,
+        measured_coll_rounds,
     ) = measure(k)
 
     # MXU tile accounting (round 8): read the last timed repeat's counters
@@ -979,6 +991,17 @@ def run_workload() -> None:
         lv = np.asarray(stats[0])
         levels_sum = int(lv.sum())
         levels_max = int(lv.max()) if lv.size else 0
+    # Round 19: the async round ledger — measured reconciling rounds of
+    # the timed best() vs the synchronous model (one round per executed
+    # level = levels_max, since all K advance together as bit planes).
+    # The round diet is the mode's whole claim, so it rides the detail.
+    if multichip_detail is not None and getattr(engine, "async_levels", 1) > 1:
+        multichip_detail["async"] = {
+            "async_levels": engine.async_levels,
+            "collective_rounds": measured_coll_rounds,
+            "rounds_sync_model": levels_max,
+            "bytes_measured": measured_coll_bytes,
+        }
     vs_range = vs_flips = None
     if levels_sum is not None:
         ref_t, ref_teps = reference_model(n, e_directed, k, levels_sum)
@@ -1214,8 +1237,8 @@ def run_workload() -> None:
     for xk in extra_ks:
         if xk == k:
             continue
-        x_teps, x_best, _, x_compile, _, _, _, x_dispatches, _, _ = measure(
-            xk
+        x_teps, x_best, _, x_compile, _, _, _, x_dispatches, _, _, _ = (
+            measure(xk)
         )
         extra_metrics.append(
             {
@@ -1333,6 +1356,18 @@ CONFIG_PRESETS = {
            "BENCH_SCALE": "16", "BENCH_K": "32", "BENCH_MAX_S": "8",
            "BENCH_MESH": "2x4", "BENCH_REPEATS": "1",
            "BENCH_EXTRA_KS": "", "BENCH_VIRTUAL_CPU": "8"},
+    # 7a (round 19): the bounded-staleness async arm — 7s's road
+    # workload (hundreds of levels = hundreds of synchronous barriers)
+    # with MSBFS_ASYNC_LEVELS=4 via BENCH_ASYNC_LEVELS: each mesh tile
+    # runs 4 local level steps per reconciling collective round, and
+    # detail.multichip.async records the measured round diet
+    # (collective_rounds vs the one-round-per-level sync model) that
+    # benchmarks/trend.py gates config-matched.
+    "7a": {"BENCH_GRAPH": "road", "BENCH_ENGINE": "mesh2d",
+           "BENCH_SCALE": "16", "BENCH_K": "32", "BENCH_MAX_S": "8",
+           "BENCH_MESH": "2x4", "BENCH_REPEATS": "1",
+           "BENCH_EXTRA_KS": "", "BENCH_VIRTUAL_CPU": "8",
+           "BENCH_ASYNC_LEVELS": "4"},
     # Config 8 family (round 11): dynamic graphs — localized-delta
     # incremental BFS repair (dynamic/repair.py) vs full recompute,
     # host-side.  "8" is the street-closure scenario on the road grid
@@ -1571,7 +1606,7 @@ def main() -> int:
     configs = [
         c.strip()
         for c in os.environ.get(
-            "BENCH_CONFIGS", "2,2c,4,1,5,6,6r,7,7t,7l,7s,8,8m,9"
+            "BENCH_CONFIGS", "2,2c,4,1,5,6,6r,7,7t,7l,7s,7a,8,8m,9"
         ).split(",")
         if c.strip()
     ]

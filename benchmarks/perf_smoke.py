@@ -225,6 +225,17 @@ BUDGET = {
     # predicts).  The budget allows ~15% jitter — growth past it means
     # the density gate or a leg's encodability fallback stopped biting.
     "sparse-wire-bytes": 3_600_000,
+    # Round 19 bounded-staleness drive (parallel/partition2d): measured
+    # reconciling collective rounds of one 4x4-mesh best() at
+    # async_levels=4 on the same grid-64x64/K=16 corner-source deep-BFS
+    # fixture, vs the synchronous drive's one-round-per-level count
+    # (127).  The generic opt*2<=base gate IS the ISSUE's >= 2x round
+    # cut; measured today: 33 rounds (0.26x — each exchange advances
+    # the global frontier one level plus up to 3 segment-local levels,
+    # and the band partition gives local waves real work).  The budget
+    # allows ~45% jitter — growth past it means the local waves or the
+    # quiet-round termination stopped biting.
+    "async-collective-rounds": 48,
     # Round 15 cross-round trend (benchmarks/trend.py): violations is
     # the count of gated configs whose latest BENCH_r*.json value
     # dropped >10% below their best prior round; exact zero-budget pin
@@ -814,7 +825,9 @@ def _multichip_child() -> int:
     )
     from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (  # noqa: E501
         collective_bytes,
+        collective_rounds,
         reset_collective_bytes,
+        reset_collective_rounds,
     )
 
     n, edges = generators.rmat_edges(10, edge_factor=8, seed=42)
@@ -881,6 +894,25 @@ def _multichip_child() -> int:
     want_r, wire_dense = rcoll(wire_sparse=0)
     got_r, wire_sparse = rcoll()  # auto budget, the product default
     assert got_r == want_r, f"sparse wire {got_r} != dense {want_r}"
+
+    # Round 19 leg: the bounded-staleness drive on the same deep-BFS
+    # grid fixture — ~127 synchronous levels means ~127 collective
+    # barriers, the regime the async mode exists to shrink.  Both runs
+    # are measured through record_collective_rounds (the sync drive
+    # records one round per executed level, the async drive one per
+    # reconciling exchange), and the bit-plane results must agree: the
+    # quiet-round termination argument is a correctness claim, so the
+    # round diet only counts if the answer is identical.
+    def rrounds(**kw):
+        engine = Mesh2DEngine(make_mesh2d(4, 4), rhost, **kw)
+        engine.compile(rqueries.shape)
+        reset_collective_rounds()
+        got = engine.best(rqueries)
+        return got, collective_rounds()
+
+    want_a, rounds_k1 = rrounds()
+    got_a, rounds_k4 = rrounds(async_levels=4)
+    assert got_a == want_a, f"async k=4 {got_a} != sync {want_a}"
     print(
         json.dumps(
             {
@@ -888,6 +920,8 @@ def _multichip_child() -> int:
                 "bytes_2d": two_d,
                 "wire_dense": wire_dense,
                 "wire_sparse": wire_sparse,
+                "rounds_k1": rounds_k1,
+                "rounds_k4": rounds_k4,
             }
         ),
         flush=True,
@@ -923,6 +957,7 @@ def run_multichip():
     return [
         ("multichip-frontier-bytes-ratio", rec["bytes_1d"], rec["bytes_2d"]),
         ("sparse-wire-bytes", rec["wire_dense"], rec["wire_sparse"]),
+        ("async-collective-rounds", rec["rounds_k1"], rec["rounds_k4"]),
     ]
 
 

@@ -81,6 +81,22 @@ for CHUNKS in 1 2 4 8; do
       2> "$RAW/pipe_${CHUNKS}.stderr" | tee "$RAW/pipe_${CHUNKS}.json" || true
 done
 
+echo "== 2d. bounded-staleness async rounds on real ICI (round 19)"
+# The road workload again — hundreds of levels means hundreds of
+# synchronous barriers, the async drive's home regime.  k=1 is the
+# level-synchronous control; k in {2,4,8} trades per-round wire bytes
+# (int32 neg planes vs bit planes) for a 1/k-ish barrier count
+# (detail.multichip.async.collective_rounds, pinned <= 0.5x at k=4 on
+# CPU by the perf-smoke async-collective-rounds row).  Only real links
+# can say where the byte-vs-barrier tradeoff nets out in wall clock.
+for ALEVELS in 1 2 4 8; do
+  BENCH_CONFIGS= BENCH_ENGINE=mesh2d BENCH_MESH=2x4 BENCH_GRAPH=road \
+      BENCH_SCALE=20 BENCH_K=32 BENCH_MAX_S=8 BENCH_ASYNC_LEVELS=$ALEVELS \
+      BENCH_REPEATS=2 BENCH_EXTRA_KS= BENCH_RUN_S=3600 python bench.py \
+      2> "$RAW/async_${ALEVELS}.stderr" \
+      | tee "$RAW/async_${ALEVELS}.json" || true
+done
+
 echo "== 3. 2D-vs-1D wall clock on real ICI (the headline scale-out claim)"
 # The 1D row: the same workload through the vertex-sharded dense-halo
 # engine (MSBFS_VSHARD) via the CLI for an apples-to-apples product path.
@@ -111,7 +127,7 @@ for WENG in bitbell stencil mesh2d; do
 done
 
 echo "== 5. simulated-mesh twin for the archive (byte-exact, any host)"
-BENCH_CONFIGS=7,7t,7l,7s BENCH_RUN_S=3600 \
+BENCH_CONFIGS=7,7t,7l,7s,7a BENCH_RUN_S=3600 \
     BENCH_DETAIL_PATH="$RAW/multichip_sim_detail.json" python bench.py \
     2> "$RAW/multichip_sim.stderr" | tee "$RAW/multichip_sim.json" || true
 

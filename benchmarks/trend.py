@@ -45,7 +45,7 @@ import sys
 # BASELINE headline workload; the others each anchor a subsystem round.
 # Diagnostic variants (2c, 7t, 7l, ...) ride the table but not the gate
 # — they exist to explain the anchors, not to pin them.
-GATED_CONFIGS = ("2", "4", "5", "6", "7", "7s", "8", "9")
+GATED_CONFIGS = ("2", "4", "5", "6", "7", "7s", "7a", "8", "9")
 
 
 def load_rounds(root):

@@ -610,16 +610,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                 residency = (
                     knobs.raw("MSBFS_MESH_RESIDENCY") or "hbm"
                 ).strip().lower()
+                async_levels = max(
+                    1, knobs.get_int("MSBFS_ASYNC_LEVELS", 1)
+                )
                 required = {"mesh2d", "reshard"}
                 if residency == "streamed":
                     required.add("streamed")
+                if async_levels > 1:
+                    # The bounded-staleness drive is a negotiated mode,
+                    # not a new engine class — same pattern as streamed.
+                    required.add("async")
+                label = (
+                    "mesh2d+streamed"
+                    if residency == "streamed"
+                    else "mesh2d"
+                )
+                if async_levels > 1:
+                    label += f"+async{async_levels}"
                 _, engine = negotiate_engine(
                     required,
                     [
                         (
-                            "mesh2d+streamed"
-                            if residency == "streamed"
-                            else "mesh2d",
+                            label,
                             Mesh2DEngine,
                             lambda: Mesh2DEngine(
                                 make_mesh2d(
@@ -632,6 +644,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                     or None
                                 ),
                                 residency=residency,
+                                async_levels=async_levels,
                             ),
                         ),
                     ],

@@ -161,6 +161,67 @@ def pack_byte_planes(bytes_: jax.Array) -> jax.Array:
     return (b << shifts[None, None, :]).sum(axis=2, dtype=jnp.uint32)
 
 
+# --- Negated-distance planes (round 19, the async drive's lattice) -----------
+# The bounded-staleness 2D drive (parallel.partition2d, MSBFS_ASYNC_LEVELS)
+# reconciles tiles that ran AHEAD of each other, and a pure OR of per-level
+# bit planes is NOT a safe merge there: a vertex tagged at distance L' > L
+# by a tile's local run-ahead would keep the wrong level (OR never lowers a
+# set bit).  Distance itself IS a monotone min-lattice though, so the async
+# planes carry neg(v, q) = NEG_BASE - dist(v, q) for reached vertices and 0
+# for unreached: elementwise MAX on neg planes is exactly scatter-min on
+# distances, 0 is both the max identity and the forest sentinel-row value
+# (ops.bell.forest_hits appends a zero row), and any relaxation order
+# converges to the same fixed point — the exact BFS distances (asynchronous
+# Bellman-Ford on unit weights).  That fixed-point argument, not a merge
+# trick, is what makes the async schedule bit-identical to the synchronous
+# one (docs/MULTIHOST.md "Asynchronous rounds").
+
+NEG_BASE = 1 << 30  # > any level count, and NEG_BASE + 1 fits int32
+
+
+def neg_from_planes(frontier0: jax.Array) -> jax.Array:
+    """(m, W) uint32 source bit planes -> (m, W*32) int32 neg-distance
+    planes: sources at distance 0 (= NEG_BASE), everything else 0."""
+    return unpack_byte_planes(frontier0).astype(jnp.int32) * jnp.int32(
+        NEG_BASE
+    )
+
+
+def neg_commit(neg: jax.Array, cand: jax.Array):
+    """Commit candidate neg planes via the idempotent max-merge.
+
+    Returns ``(merged, delta)`` where ``delta`` marks entries the commit
+    improved (distance lowered / vertex newly reached) — the monotone
+    progress signal every async drive decision (local-wave early exit,
+    quiet-round termination) is built on."""
+    return jnp.maximum(neg, cand), cand > neg
+
+
+def neg_relax_chunk(neg: jax.Array, delta: jax.Array, relax, steps):
+    """Up to ``steps`` local relax waves with early exit — the async dual
+    of :func:`bit_level_chunk`.
+
+    ``relax(neg, delta)`` returns candidate neg planes (>= 0) computed from
+    the delta-masked sources; each wave commits via :func:`neg_commit` and
+    continues while the previous wave improved anything.  Returns the
+    relaxed planes and the OR of all wave deltas — exactly what the next
+    collective reconcile must ship.  ``relax`` must be collective-free
+    (the whole point is that these waves happen between barriers)."""
+
+    def cond(c):
+        return jnp.logical_and(jnp.any(c[1]), c[3] < steps)
+
+    def body(c):
+        neg_, d, acc, s = c
+        neg_, nd = neg_commit(neg_, relax(neg_, d))
+        return (neg_, nd, acc | nd, s + jnp.int32(1))
+
+    out = lax.while_loop(
+        cond, body, (neg, delta, jnp.zeros_like(delta), jnp.int32(0))
+    )
+    return out[0], out[2]
+
+
 def sparse_hits_or(
     frontier: jax.Array, graph: BellGraph, budget: int
 ) -> jax.Array:

@@ -140,7 +140,12 @@ class QueryEngineBase:
         stream through the device per level (over-HBM residency: the
         single-chip ops.streamed engine, and Mesh2DEngine's
         ``residency="streamed"`` composition — routes ask for
-        ``mesh2d`` + ``streamed`` together rather than a bespoke engine).
+        ``mesh2d`` + ``streamed`` together rather than a bespoke engine);
+      * ``async`` — the engine supports a bounded-staleness drive
+        (MSBFS_ASYNC_LEVELS > 1: several local level steps per
+        reconciling collective round, bit-identical results via
+        quiet-round termination) — like ``streamed``, a mode negotiated
+        on Mesh2DEngine rather than a bespoke engine class.
     """
 
     CAPABILITIES: frozenset = frozenset()

@@ -113,20 +113,33 @@ def _extend(planes: jax.Array) -> jax.Array:
     return jnp.concatenate([planes, zero], axis=0)
 
 
-@partial(jax.jit, static_argnames=("pieces",))
-def _segment_or(v_prev_ext, cols, pieces):
+@partial(jax.jit, static_argnames=("pieces", "fold"))
+def _segment_fold(v_prev_ext, cols, pieces, fold="or"):
     """One streamed segment: gather the uploaded ``cols`` slice out of the
-    sentinel-extended previous-level value planes and OR-fold each bucket
+    sentinel-extended previous-level value planes and fold each bucket
     piece's fixed width.  ``pieces`` = ((rows, width), ...) is static, so
-    every segment signature is one compiled program reused per level."""
+    every segment signature is one compiled program reused per level.
+    ``fold`` selects the reduction semiring: ``or`` for the uint32 bit
+    planes, ``max`` for the int32 neg-distance planes of the async mesh
+    drive (parallel.partition2d, round 19) — both have identity 0, so the
+    sentinel row and padded slots stay inert either way."""
     g = jnp.take(v_prev_ext, cols, axis=0)
     parts = []
     off = 0
     for rc, wb in pieces:
         seg = lax.slice_in_dim(g, off, off + rc * wb, axis=0)
-        parts.append(_or_fold(seg.reshape(rc, wb, g.shape[1]), 1))
+        seg = seg.reshape(rc, wb, g.shape[1])
+        parts.append(
+            _or_fold(seg, 1) if fold == "or" else jnp.max(seg, axis=1)
+        )
         off += rc * wb
     return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)
+
+
+def _segment_or(v_prev_ext, cols, pieces):
+    """The OR-semiring :func:`_segment_fold` (the single-chip streamed
+    engine's only fold)."""
+    return _segment_fold(v_prev_ext, cols, pieces, "or")
 
 
 @jax.jit

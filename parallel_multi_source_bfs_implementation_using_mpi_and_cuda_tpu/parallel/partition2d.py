@@ -67,6 +67,31 @@ the forest runs over the square padded space Lt = max(Lr, Lc) so
 all R*C tile forests are harmonized (parallel.sharded_bell.
 harmonize_forests) into one SPMD program.
 
+Bounded-staleness async drive (round 19, MSBFS_ASYNC_LEVELS=k).  The
+level-synchronous schedule pays one row-gather + col-reduce-scatter
+barrier PER BFS LEVEL — on high-diameter graphs (road: hundreds of
+levels) that collective/dispatch floor dominates.  Under k > 1 every
+tile instead runs up to k-1 LOCAL relax waves (expanding only through
+the adjacency rows it owns — no collectives) between reconciling
+exchanges, so a round advances several levels for one barrier.  The
+planes switch representation for this: per-entry NEGATED DISTANCES
+(ops.bitbell.NEG_BASE - dist, 0 = unreached) instead of visited bits,
+because elementwise max on neg planes is the idempotent scatter-min
+merge distance needs — a pure OR of run-ahead bit planes could tag a
+vertex at an overshot level and never lower it, while the neg-max
+lattice makes any relaxation order converge to the exact distances
+(asynchronous Bellman-Ford on unit weights).  The drive terminates
+only after a full QUIET ROUND — an exchange whose globally-merged
+delta is empty — at which point every edge satisfies the BFS triangle
+inequality and the planes equal the synchronous schedule's bit for
+bit (docs/MULTIHOST.md "Asynchronous rounds" carries the argument).
+The async exchange rides the SAME wire seams: density-adaptive sparse
+pairs (deltas are thinner than frontiers, so sparse wins harder), the
+pipelined stripe schedule, and streamed residency; negotiated via the
+``async`` capability token, and every reconcile commit records
+utils.timing.record_collective_rounds — the ground truth the
+perf-smoke async-collective-rounds row pins at >= 2x fewer barriers.
+
 Live resharding (arxiv 2112.01075's portable redistribution): on chip
 loss, :meth:`Mesh2DEngine.without_ranks` drops every mesh ROW containing
 a failed device and rebuilds the graph tiles from the retained host CSR
@@ -89,11 +114,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.bell import DEFAULT_WIDTHS, BellGraph
 from ..models.csr import CSRGraph
+from ..ops.bell import forest_hits
 from ..ops.bitbell import (
+    NEG_BASE,
     _or_fold,
     bell_hits_or,
     bit_level_apply,
     bit_level_init,
+    neg_commit,
+    neg_from_planes,
+    neg_relax_chunk,
+    pack_byte_planes,
     pack_queries,
     unpack_counts,
 )
@@ -102,13 +133,17 @@ from ..ops.push import compact_indices
 from ..ops.streamed import (
     _extend,
     _final_hits,
-    _segment_or,
+    _segment_fold,
     _stream_status,
     prefetched_uploads,
 )
 from ..utils import knobs
 from ..utils.faults import trip
-from ..utils.timing import record_collective_bytes, record_dispatch
+from ..utils.timing import (
+    record_collective_bytes,
+    record_collective_rounds,
+    record_dispatch,
+)
 from .mesh import COL_AXIS, ROW_AXIS, make_mesh2d
 from .sharded_bell import harmonize_forests
 
@@ -398,14 +433,29 @@ class Partition2D:
         )
 
 
-def _or_reduce_scatter(x, c_size: int, lsub: int, tree: str):
-    """Col-axis OR-reduce-scatter of the (Lr, W) row-block partial hits:
-    device at col j receives chunk j — its own segment — fully OR-reduced
-    over all C col-blocks.  All three trees compute the identical result
-    (OR is associative, commutative and bit-exact), so tree choice is
-    pure topology tuning and the engines-agree matrix pins equality."""
+def _merge_op(op: str):
+    """The reduce-scatter combine for one static merge semiring: ``or``
+    (uint32 bit planes — the synchronous schedule) or ``max`` (int32
+    neg-distance planes — the async schedule's idempotent scatter-min).
+    Both are associative, commutative, idempotent, and share identity 0,
+    so every reduction tree below is exact under either."""
+    if op == "or":
+        return (lambda a, b: a | b), (lambda full: _or_fold(full, 0))
+    if op == "max":
+        return jnp.maximum, (lambda full: jnp.max(full, axis=0))
+    raise ValueError(f"unknown merge op {op!r}")
+
+
+def _or_reduce_scatter(x, c_size: int, lsub: int, tree: str, op: str = "or"):
+    """Col-axis reduce-scatter of the (Lr, W) row-block partials under
+    the ``op`` merge semiring (:func:`_merge_op`): device at col j
+    receives chunk j — its own segment — fully reduced over all C
+    col-blocks.  All three trees compute the identical result (the merge
+    is associative, commutative and bit-exact), so tree choice is pure
+    topology tuning and the engines-agree matrix pins equality."""
     if c_size == 1:
         return x
+    combine, fold = _merge_op(op)
     me = lax.axis_index(COL_AXIS)
 
     def chunk_at(idx):
@@ -414,18 +464,18 @@ def _or_reduce_scatter(x, c_size: int, lsub: int, tree: str):
     if tree == "oneshot":
         full = lax.all_gather(x, COL_AXIS)  # (C, Lr, W)
         return lax.dynamic_slice_in_dim(
-            _or_fold(full, 0), me * lsub, lsub, axis=0
+            fold(full), me * lsub, lsub, axis=0
         )
     if tree == "ring":
         # Chunk c starts at device c+1 and travels C-1 single hops
-        # d -> d+1, OR-ing each visited device's local chunk c; after
+        # d -> d+1, merging each visited device's local chunk c; after
         # step s device d holds chunk (d - 2 - s) mod C, ending with its
         # own chunk d fully reduced.
         perm = [(t, (t + 1) % c_size) for t in range(c_size)]
         acc = chunk_at((me + c_size - 1) % c_size)
         for s in range(c_size - 1):
             acc = lax.ppermute(acc, COL_AXIS, perm)
-            acc = acc | chunk_at((me + 2 * c_size - 2 - s) % c_size)
+            acc = combine(acc, chunk_at((me + 2 * c_size - 2 - s) % c_size))
         return acc
     if tree == "halving":
         # Recursive halving (C a power of two): log2 C pairwise
@@ -442,21 +492,25 @@ def _or_reduce_scatter(x, c_size: int, lsub: int, tree: str):
             recv = lax.ppermute(
                 send, COL_AXIS, [(t, t ^ h) for t in range(c_size)]
             )
-            buf = jnp.where(keep_lo, lo, hi) | recv
+            buf = combine(jnp.where(keep_lo, lo, hi), recv)
             span //= 2
             h //= 2
         return buf
     raise ValueError(f"unknown reduction tree {tree!r}")
 
 
-def _sparse_or_reduce_scatter(x, c_size: int, lsub: int, budget: int):
-    """The ring OR-reduce-scatter with budget-padded sparse hop payloads:
+def _sparse_or_reduce_scatter(
+    x, c_size: int, lsub: int, budget: int, op: str = "or"
+):
+    """The ring reduce-scatter with budget-padded sparse hop payloads:
     identical hop schedule to the dense ring (chunk c travels C-1 single
-    hops, OR-ing each visited device's local chunk), but every hop ships
+    hops, merging each visited device's local chunk), but every hop ships
     the running partial as (index, word) pairs.  Exact whenever every
-    partial fits the budget — the caller's predicate bounds the union's
+    partial fits the budget — the caller's predicate bounds the partial's
     active words by the col-axis SUM of per-device chunk counts, which
-    dominates every partial OR along the ring."""
+    dominates every partial merge along the ring (a nonzero of or/max is
+    a nonzero of an operand)."""
+    combine, _ = _merge_op(op)
     me = lax.axis_index(COL_AXIS)
     w = x.shape[1]
     total = lsub * w
@@ -471,7 +525,7 @@ def _sparse_or_reduce_scatter(x, c_size: int, lsub: int, budget: int):
         idx = lax.ppermute(idx, COL_AXIS, perm)
         words = lax.ppermute(words, COL_AXIS, perm)
         acc = decode_words_sparse(idx, words, total).reshape(lsub, w)
-        acc = acc | chunk_at((me + 2 * c_size - 2 - s) % c_size)
+        acc = combine(acc, chunk_at((me + 2 * c_size - 2 - s) % c_size))
     return acc
 
 
@@ -499,7 +553,7 @@ def _sparse_row_gather(frontier_own, rows: int, lsub: int, budget: int):
 
 def _pipelined_own_hits(
     frontier_own, local: BellGraph, rows: int, cols: int, lsub: int,
-    n_stripes: int,
+    n_stripes: int, hits_fn=None, op: str = "or",
 ):
     """Software-pipelined dense level: the word plane splits into
     ``n_stripes`` column stripes, each running its own ring row gather ->
@@ -508,11 +562,17 @@ def _pipelined_own_hits(
     stripe i+1's ppermute hops with stripe i's forest pass — ring-tree
     bytes, better wire/compute occupancy.  Bit-identity is structural:
     every stripe computes exactly the dense path restricted to its word
-    columns, and OR never mixes words."""
+    columns, and neither merge semiring mixes columns.  ``hits_fn`` maps
+    one padded (Lt, stripe) block to its (Lr, stripe) partials (default:
+    the OR forest pass); the async drive passes its max-fold relax and
+    ``op="max"`` — per-query-lane stripes work identically to word
+    stripes because every column is independent."""
     w = frontier_own.shape[1]
     lc = rows * lsub
     lr = cols * lsub
     lt = local.n
+    if hits_fn is None:
+        hits_fn = lambda block: bell_hits_or(block, local)[:lr]  # noqa: E731
     bounds = [w * t // n_stripes for t in range(n_stripes + 1)]
     me = lax.axis_index(ROW_AXIS)
     perm = [(t, (t + 1) % rows) for t in range(rows)]
@@ -541,8 +601,8 @@ def _pipelined_own_hits(
                 )
         if lt > lc:
             block = jnp.pad(block, ((0, lt - lc), (0, 0)))
-        hits = bell_hits_or(block, local)[:lr]
-        outs.append(_or_reduce_scatter(hits, cols, lsub, "ring"))
+        hits = hits_fn(block)
+        outs.append(_or_reduce_scatter(hits, cols, lsub, "ring", op=op))
     return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
 
 
@@ -771,7 +831,7 @@ def _mesh2d_run_chunked(
     where a real ICI failure would."""
     carry = _mesh2d_init(mesh, queries, lsub)
     bound = np.int32(level_chunk)
-    prev_bytes = 0
+    prev_bytes = prev_levels = 0
     while True:
         *carry, any_up, max_level = _mesh2d_chunk(
             mesh, forest, tuple(carry), bound, lsub, max_levels, tree, wire
@@ -781,11 +841,323 @@ def _mesh2d_run_chunked(
         wb = int(np.asarray(carry[7]))
         record_collective_bytes(max(0, wb - prev_bytes))
         prev_bytes = wb
+        # One collective round per executed level: the synchronous
+        # schedule's barrier count, the baseline the async drive's
+        # record_collective_rounds diet is measured against.
+        lvl = int(np.asarray(carry[5]))
+        record_collective_rounds(max(0, lvl - prev_levels))
+        prev_levels = lvl
         if not int(np.asarray(any_up)):
             break
         if max_levels is not None and int(np.asarray(max_level)) >= max_levels:
             break
     return tuple(carry)
+
+
+# ---- bounded-staleness async drive (round 19) -----------------------------
+
+
+def _async_cand(m, max_levels):
+    """Candidate neg values from gathered in-neighbor maxima: one more
+    hop costs one level (neg goes DOWN by one), unreached stays 0, and
+    the static ``max_levels`` horizon zeroes any candidate beyond it —
+    the async dual of the synchronous loop's ``level < max_levels`` bound
+    (exact for every vertex within the horizon: along a shortest path
+    every prefix distance also passes the filter)."""
+    cand = jnp.maximum(m - 1, 0)
+    if max_levels is not None:
+        cand = jnp.where(
+            cand >= jnp.int32(NEG_BASE - max_levels), cand, 0
+        )
+    return cand
+
+
+def _mesh2d_async_relax(
+    local: BellGraph, rows: int, cols: int, lsub: int, tree: str, wire,
+    max_levels,
+):
+    """The async drive's two relaxation primitives over one tile:
+
+    ``exchange(neg, changed)`` — the reconciling collective round: every
+    device ships its changed entries' neg values (dense planes or the
+    density-adaptive sparse pairs, same seams as the synchronous wire),
+    the tile forest max-folds the gathered col-block (every edge out of
+    every changed vertex relaxes, cross- AND intra-segment), and the
+    col-axis max-reduce-scatter + :func:`ops.bitbell.neg_commit` lands
+    each device exactly its own improved segment.  Returns
+    ``(neg', delta, wire_bytes, sparse_flag)``.
+
+    ``local_relax(neg, delta)`` — one collective-free wave: the device's
+    own delta-masked segment embedded at its col-block offset, one forest
+    pass, own destination rows sliced back out — expanding only through
+    adjacency rows the tile owns (own-segment -> own-segment edges).
+    Run-ahead overshoot is safe: the neg-max lattice lowers any overshot
+    distance when the true one arrives at the next exchange."""
+    budget, n_stripes = wire
+    lc = rows * lsub
+    lr = cols * lsub
+    lt = local.n
+
+    def pad_block(colblock):
+        if lt > lc:
+            return jnp.pad(colblock, ((0, lt - lc), (0, 0)))
+        return colblock
+
+    def forest_max(block):
+        return forest_hits(block, local, lambda g: jnp.max(g, axis=1))
+
+    def cand_hits(block):
+        # cand before the reduce-scatter: _async_cand is monotone, so it
+        # commutes with max — per-tile application matches the pipelined
+        # per-stripe structure and ships already-decremented values.
+        return _async_cand(forest_max(block)[:lr], max_levels)
+
+    def local_relax(neg, delta):
+        src = jnp.where(delta, neg, 0)
+        i = lax.axis_index(ROW_AXIS)
+        block = jnp.zeros((lc, neg.shape[1]), dtype=neg.dtype)
+        block = lax.dynamic_update_slice_in_dim(
+            block, src, i * lsub, axis=0
+        )
+        hits = cand_hits(pad_block(block))
+        j = lax.axis_index(COL_AXIS)
+        return lax.dynamic_slice_in_dim(hits, j * lsub, lsub, axis=0)
+
+    def dense_exchange(send):
+        if tree == "pipelined" and n_stripes > 1:
+            return _pipelined_own_hits(
+                send, local, rows, cols, lsub, n_stripes,
+                hits_fn=cand_hits, op="max",
+            )
+        colblock = lax.all_gather(send, ROW_AXIS, tiled=True)
+        return _or_reduce_scatter(
+            cand_hits(pad_block(colblock)), cols, lsub,
+            "ring" if tree == "pipelined" else tree, op="max",
+        )
+
+    def exchange(neg, changed):
+        kp = neg.shape[1]
+        send = jnp.where(changed, neg, 0)
+        dense_bytes = level_collective_bytes(rows, cols, lsub, kp, tree)
+        if budget <= 0 or rows * cols == 1:
+            merged, delta = neg_commit(neg, dense_exchange(send))
+            return merged, delta, jnp.int64(dense_bytes), jnp.int32(0)
+
+        seg_bytes = lsub * kp * 4
+        pair = budget * WIRE_PAIR_BYTES
+        row_sparse = rows * cols * (rows - 1) * pair
+        col_sparse = rows * cols * (cols - 1) * pair
+        col_dense_tree = "ring" if tree == "pipelined" else tree
+        col_dense = rows * cols * (cols - 1) * seg_bytes * (
+            cols if col_dense_tree == "oneshot" else 1
+        )
+
+        def sparse_path(send):
+            colblock = (
+                send
+                if rows == 1
+                else _sparse_row_gather(send, rows, lsub, budget)
+            )
+            cand = cand_hits(pad_block(colblock))
+            if cols == 1:
+                own = cand
+                col_bytes = jnp.int64(0)
+                flag = jnp.int32(1)
+            else:
+                # Same union bound as the synchronous wire: a nonzero of
+                # any max partial is a nonzero of some device's chunk,
+                # so the col-axis SUM of per-device chunk counts bounds
+                # every hop's encoding.
+                per_chunk = jnp.sum(
+                    (cand != 0).astype(jnp.int32).reshape(
+                        cols, lsub * kp
+                    ),
+                    axis=1,
+                )
+                union_bound = lax.psum(per_chunk, COL_AXIS)
+                col_ok = (
+                    lax.pmax(jnp.max(union_bound), (ROW_AXIS, COL_AXIS))
+                    <= budget
+                )
+                own = lax.cond(
+                    col_ok,
+                    lambda h: _sparse_or_reduce_scatter(
+                        h, cols, lsub, budget, op="max"
+                    ),
+                    lambda h: _or_reduce_scatter(
+                        h, cols, lsub, col_dense_tree, op="max"
+                    ),
+                    cand,
+                )
+                col_bytes = jnp.where(col_ok, col_sparse, col_dense).astype(
+                    jnp.int64
+                )
+                flag = (
+                    jnp.int32(1)
+                    if rows > 1
+                    else col_ok.astype(jnp.int32)
+                )
+            return own, jnp.int64(row_sparse) + col_bytes, flag
+
+        def dense_path(send):
+            return dense_exchange(send), jnp.int64(dense_bytes), jnp.int32(0)
+
+        sparse_ok = (
+            lax.pmax(active_word_count(send), (ROW_AXIS, COL_AXIS))
+            <= budget
+        )
+        cand_own, lvl_bytes, flag = lax.cond(
+            sparse_ok, sparse_path, dense_path, send
+        )
+        merged, delta = neg_commit(neg, cand_own)
+        return merged, delta, lvl_bytes, flag
+
+    return exchange, local_relax
+
+
+@partial(jax.jit, static_argnames=("mesh", "lsub"))
+def _mesh2d_async_init(mesh: Mesh, queries: jax.Array, lsub: int):
+    """The async loop carry: per-device own-segment (Lsub, Kpad) int32
+    neg-distance planes + the changed-since-last-exchange mask, plus the
+    replicated drive scalars — go flag (any source anywhere), executed
+    rounds, and the wire ledger (int64 bytes, int32 sparse rounds)."""
+    rows = mesh.shape[ROW_AXIS]
+    n_pad = rows * mesh.shape[COL_AXIS] * lsub
+
+    def shard_body(queries):
+        frontier0 = pack_queries(n_pad, queries)
+        i = lax.axis_index(ROW_AXIS)
+        j = lax.axis_index(COL_AXIS)
+        seg = j * rows + i
+        own0 = lax.dynamic_slice_in_dim(
+            frontier0, seg * lsub, lsub, axis=0
+        )
+        neg = neg_from_planes(own0)
+        changed = neg > 0
+        go = lax.pmax(
+            jnp.any(changed).astype(jnp.int32), (ROW_AXIS, COL_AXIS)
+        )
+        return (
+            neg,
+            changed,
+            go,
+            jnp.int32(0),
+            jnp.int64(0),
+            jnp.int32(0),
+        )
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(),),
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 4,
+    )(queries)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "lsub", "max_levels", "tree", "wire", "k_levels"),
+)
+def _mesh2d_async_chunk(
+    mesh: Mesh, forest, carry, chunk, lsub: int, max_levels, tree: str,
+    wire, k_levels: int,
+):
+    """Advance the async carry by <= ``chunk`` ROUNDS in one dispatch:
+    each round is one reconciling exchange followed by up to k-1
+    collective-free local waves (ops.bitbell.neg_relax_chunk, early-exit
+    on local quiescence).  The go flag is the quiet-round test — pmax of
+    the exchange delta over both axes — so every device agrees on
+    termination and the host loop syncs one replicated scalar."""
+    rows = mesh.shape[ROW_AXIS]
+    cols = mesh.shape[COL_AXIS]
+
+    def shard_body(forest, *carry):
+        local = jax.tree.map(lambda x: x[0, 0], forest)
+        exchange, local_relax = _mesh2d_async_relax(
+            local, rows, cols, lsub, tree, wire, max_levels
+        )
+        start = carry[3]
+
+        def cond(c):
+            return jnp.logical_and(c[2] > 0, c[3] < start + chunk)
+
+        def body(c):
+            neg, changed, _, rounds, wb, sp = c
+            neg, ex_delta, lvl_bytes, sparse = exchange(neg, changed)
+            if k_levels > 1:
+                neg, loc_acc = neg_relax_chunk(
+                    neg, ex_delta, local_relax, k_levels - 1
+                )
+                changed = ex_delta | loc_acc
+            else:
+                changed = ex_delta
+            go = lax.pmax(
+                jnp.any(ex_delta).astype(jnp.int32), (ROW_AXIS, COL_AXIS)
+            )
+            return (
+                neg,
+                changed,
+                go,
+                rounds + 1,
+                wb + lvl_bytes,
+                sp + sparse,
+            )
+
+        return lax.while_loop(cond, body, carry)
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS),)
+        + (_PLANE_SPEC,) * 2
+        + (P(),) * 4,
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 4,
+    )(forest, *carry)
+
+
+@partial(jax.jit, static_argnames=("mesh", "lsub"))
+def _mesh2d_async_finalize(mesh: Mesh, neg, wire_bytes, sparse_rounds, lsub):
+    """Fold the quiesced neg planes into the synchronous drive's 9-slot
+    carry so every downstream consumer (f_values, query_stats, best, the
+    certify audit) reads the async result through the identical seam.
+    The arithmetic mirrors ops.bitbell.bit_level_init/apply exactly:
+    sources contribute distance 0 to F, a reached query's levels slot is
+    its deepest distance + 1, an empty query stays 0 — both-axis psums
+    make every counter replicated, like the synchronous loop's."""
+
+    def shard_body(neg, wb, sp):
+        mask = neg > 0
+        dist = jnp.where(mask, jnp.int32(NEG_BASE) - neg, 0)
+        reached = lax.psum(
+            mask.astype(jnp.int32).sum(axis=0), (ROW_AXIS, COL_AXIS)
+        )
+        f = lax.psum(
+            jnp.sum(dist.astype(jnp.int64), axis=0), (ROW_AXIS, COL_AXIS)
+        )
+        maxd = lax.pmax(
+            jnp.max(jnp.where(mask, dist, -1), axis=0),
+            (ROW_AXIS, COL_AXIS),
+        )
+        levels = jnp.where(reached > 0, maxd + 1, 0).astype(jnp.int32)
+        visited = pack_byte_planes(mask.astype(jnp.uint8))
+        return (
+            visited,
+            jnp.zeros_like(visited),  # frontier: drained at convergence
+            f,
+            levels,
+            reached,
+            jnp.max(levels),  # the synchronous loop's executed-level count
+            jnp.bool_(False),
+            wb,
+            sp,
+        )
+
+    return jax.shard_map(
+        shard_body,
+        mesh=mesh,
+        in_specs=(_PLANE_SPEC, P(), P()),
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 7,
+    )(neg, wire_bytes, sparse_rounds)
 
 
 # ---- streamed mesh residency (over-HBM tile sets) -------------------------
@@ -812,15 +1184,17 @@ def _mstream_exchange(mesh: Mesh, frontier, lsub: int, lt: int):
     )(frontier)
 
 
-@partial(jax.jit, static_argnames=("mesh", "pieces"))
-def _mstream_level(mesh: Mesh, v_prev, cols, pieces):
-    """Streamed-residency leg B: one forest level's gather/OR over the
-    just-uploaded (R, C, S) col slice — ops.streamed._segment_or on each
-    device's block, sentinel-extended exactly like the single-chip
-    streamed forest pass, so the tile semantics are shared, not cloned."""
+@partial(jax.jit, static_argnames=("mesh", "pieces", "fold"))
+def _mstream_level(mesh: Mesh, v_prev, cols, pieces, fold: str = "or"):
+    """Streamed-residency leg B: one forest level's gather/fold over the
+    just-uploaded (R, C, S) col slice — ops.streamed._segment_fold on
+    each device's block, sentinel-extended exactly like the single-chip
+    streamed forest pass, so the tile semantics are shared, not cloned.
+    ``fold`` is "or" for the synchronous bit planes, "max" for the async
+    drive's int32 neg-distance planes."""
 
     def body(v_prev, cols):
-        return _segment_or(_extend(v_prev), cols[0, 0], pieces)
+        return _segment_fold(_extend(v_prev), cols[0, 0], pieces, fold)
 
     return jax.shard_map(
         body,
@@ -896,6 +1270,137 @@ def _mstream_apply(mesh: Mesh, final_slot, carry, outs, lsub: int, tree: str):
     )(final_slot, *carry, *outs)
 
 
+# ---- streamed residency x async drive (round 19) --------------------------
+# The async drive's exchange and local waves re-use the streamed forest
+# pass (_mstream_level with fold="max") between a pair of thin legs: a
+# source-assembly leg producing the padded (Lt, Kpad) col-block under
+# _TILE_SPEC, and a commit leg folding the accumulated max partials into
+# the neg planes via neg_commit.  The streamed wire is always dense, so
+# the ledger adds the analytic constant like _mstream_apply's.
+
+
+@partial(jax.jit, static_argnames=("mesh", "lsub", "lt"))
+def _mstream_async_exchange(mesh: Mesh, neg, changed, lsub: int, lt: int):
+    """Streamed async leg A: ship changed neg entries, row-gather the
+    col-block, pad to the harmonized Lt — the reconciling exchange's
+    source, fed into the streamed forest max pass."""
+    rows = mesh.shape[ROW_AXIS]
+    lc = rows * lsub
+
+    def body(neg_own, changed_own):
+        send = jnp.where(changed_own, neg_own, 0)
+        colblock = lax.all_gather(send, ROW_AXIS, tiled=True)
+        if lt > lc:
+            colblock = jnp.pad(colblock, ((0, lt - lc), (0, 0)))
+        return colblock
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_PLANE_SPEC,) * 2,
+        out_specs=_TILE_SPEC,
+    )(neg, changed)
+
+
+@partial(jax.jit, static_argnames=("mesh", "lsub", "lt"))
+def _mstream_async_local_src(mesh: Mesh, neg, delta, lsub: int, lt: int):
+    """Streamed async leg A': a collective-free wave's source — the own
+    delta-masked segment embedded at its col-block offset, zero (hence
+    inert under max) everywhere else.  No wire traffic."""
+    rows = mesh.shape[ROW_AXIS]
+    lc = rows * lsub
+
+    def body(neg_own, delta_own):
+        src = jnp.where(delta_own, neg_own, 0)
+        i = lax.axis_index(ROW_AXIS)
+        block = jnp.zeros((lc, neg_own.shape[1]), dtype=neg_own.dtype)
+        block = lax.dynamic_update_slice_in_dim(
+            block, src, i * lsub, axis=0
+        )
+        if lt > lc:
+            block = jnp.pad(block, ((0, lt - lc), (0, 0)))
+        return block
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_PLANE_SPEC,) * 2,
+        out_specs=_TILE_SPEC,
+    )(neg, delta)
+
+
+@partial(jax.jit, static_argnames=("mesh", "lsub", "tree", "max_levels"))
+def _mstream_async_commit(
+    mesh: Mesh, final_slot, neg, outs, lsub: int, tree: str, max_levels
+):
+    """Streamed async leg C: final-slot gather over the accumulated max
+    partials, candidate decrement, the col-axis max-reduce-scatter, and
+    neg_commit.  Status row [go, bytes] is one fetchable buffer like the
+    synchronous streamed loop's."""
+    rows = mesh.shape[ROW_AXIS]
+    cols = mesh.shape[COL_AXIS]
+    lr = cols * lsub
+
+    def body(final_slot, neg_own, *outs_l):
+        hits = _final_hits(final_slot[0, 0], *outs_l)[:lr]
+        cand = _async_cand(hits, max_levels)
+        own = _or_reduce_scatter(
+            cand, cols, lsub, "ring" if tree == "pipelined" else tree,
+            op="max",
+        )
+        merged, delta = neg_commit(neg_own, own)
+        go = lax.pmax(
+            jnp.any(delta).astype(jnp.int32), (ROW_AXIS, COL_AXIS)
+        )
+        lvl_bytes = level_collective_bytes(
+            rows, cols, lsub, neg_own.shape[1], tree
+        )
+        status = jnp.stack(
+            [go.astype(jnp.int64), jnp.int64(lvl_bytes)]
+        )
+        return merged, delta, status
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS), _PLANE_SPEC)
+        + (_TILE_SPEC,) * len(outs),
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),),
+    )(final_slot, neg, *outs)
+
+
+@partial(jax.jit, static_argnames=("mesh", "lsub", "max_levels"))
+def _mstream_async_local_commit(
+    mesh: Mesh, final_slot, neg, changed, outs, lsub: int, max_levels
+):
+    """Streamed async leg C': commit a collective-free wave — the wave's
+    source held only own-segment values, so the relevant candidates sit
+    at the device's own destination rows; slice, decrement, commit,
+    accumulate the running changed mask for the next exchange."""
+    cols = mesh.shape[COL_AXIS]
+    lr = cols * lsub
+
+    def body(final_slot, neg_own, changed_own, *outs_l):
+        hits = _final_hits(final_slot[0, 0], *outs_l)[:lr]
+        cand = _async_cand(hits, max_levels)
+        j = lax.axis_index(COL_AXIS)
+        own = lax.dynamic_slice_in_dim(cand, j * lsub, lsub, axis=0)
+        merged, delta = neg_commit(neg_own, own)
+        go = lax.pmax(
+            jnp.any(delta).astype(jnp.int32), (ROW_AXIS, COL_AXIS)
+        )
+        return merged, delta, changed_own | delta, go
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS),)
+        + (_PLANE_SPEC,) * 2
+        + (_TILE_SPEC,) * len(outs),
+        out_specs=(_PLANE_SPEC,) * 3 + (P(),),
+    )(final_slot, neg, changed, *outs)
+
+
 class Mesh2DEngine(QueryEngineBase):
     """The 2D-partitioned bitbell engine: adjacency tiled over an
     ('r', 'c') mesh, queries replicated (all K advance together as bit
@@ -912,9 +1417,14 @@ class Mesh2DEngine(QueryEngineBase):
     ``residency`` overrides MSBFS_MESH_RESIDENCY — ``hbm`` commits the
     stacked tile forest to the mesh, ``streamed`` keeps it in host RAM
     and double-buffers uploads behind the ICI exchange (over-HBM tile
-    sets; negotiate with the ``streamed`` capability token).  ``w`` is
-    the device count — the supervisor's rebuild cap and survivor
-    accounting read it like every engine."""
+    sets; negotiate with the ``streamed`` capability token).
+    ``async_levels`` overrides MSBFS_ASYNC_LEVELS — k > 1 switches to
+    the bounded-staleness drive (k-1 collective-free local waves per
+    reconciling exchange round, ``async`` capability token); the result
+    is bit-identical to the synchronous schedule by the quiet-round
+    termination argument (docs/MULTIHOST.md "Asynchronous rounds").
+    ``w`` is the device count — the supervisor's rebuild cap and
+    survivor accounting read it like every engine."""
 
     CAPABILITIES = frozenset(
         {
@@ -923,6 +1433,7 @@ class Mesh2DEngine(QueryEngineBase):
             "reshard",
             "collective_bytes",
             "streamed",
+            "async",
         }
     )
 
@@ -940,6 +1451,7 @@ class Mesh2DEngine(QueryEngineBase):
         residency: Optional[str] = None,
         wire_sparse: Union[None, int, str] = None,
         wire_chunks: Optional[int] = None,
+        async_levels: Optional[int] = None,
     ):
         if ROW_AXIS not in mesh.shape or COL_AXIS not in mesh.shape:
             raise ValueError(
@@ -982,6 +1494,14 @@ class Mesh2DEngine(QueryEngineBase):
                 wire_chunks
                 if wire_chunks is not None
                 else knobs.get_int("MSBFS_WIRE_CHUNKS", 4)
+            ),
+        )
+        self.async_levels = max(
+            1,
+            int(
+                async_levels
+                if async_levels is not None
+                else knobs.get_int("MSBFS_ASYNC_LEVELS", 1)
             ),
         )
         self.part = Partition2D(
@@ -1069,7 +1589,12 @@ class Mesh2DEngine(QueryEngineBase):
 
     def _run(self, queries: np.ndarray):
         placed, k = self._prep(queries)
-        if self.residency == "streamed":
+        if self.async_levels > 1:
+            if self.residency == "streamed":
+                carry = self._run_async_streamed(placed)
+            else:
+                carry = self._run_async(placed)
+        elif self.residency == "streamed":
             carry = self._run_streamed(placed)
         else:
             carry = _mesh2d_run_chunked(
@@ -1084,35 +1609,143 @@ class Mesh2DEngine(QueryEngineBase):
             )
         return carry, k
 
-    # ---- streamed drive ---------------------------------------------------
-    def _stream_level_once(self, carry):
-        """One streamed-residency BFS level: dispatch the ICI exchange,
-        stream the host tile forest through the device BEHIND it (the
-        prefetch window issues uploads before their consumer program,
-        and the exchange itself is still in flight when the first upload
-        starts), then fold the carry.  Returns (carry, status) with
-        ``status`` the device-side (3,) int64 [level, updated, bytes]."""
+    # ---- bounded-staleness async drive ------------------------------------
+    def _run_async(self, placed):
+        """The async host loop over the hbm tile forest: each dispatch
+        advances <= level_chunk ROUNDS (one reconciling exchange + up to
+        k-1 collective-free local waves each), the quiet-round flag in
+        the fetched carry decides convergence, and the wire / round
+        ledgers difference the carry's counters exactly like the
+        synchronous chunked drive — record_collective_rounds ticks once
+        per exchange, which is the whole point of the mode."""
+        lsub = self.part.lsub
+        carry = _mesh2d_async_init(self.mesh, placed, lsub)
+        bound = np.int32(self.level_chunk)
+        prev_bytes = prev_rounds = 0
+        while True:
+            carry = _mesh2d_async_chunk(
+                self.mesh,
+                self.forest,
+                tuple(carry),
+                bound,
+                lsub,
+                self.max_levels,
+                self.tree,
+                self._wire_of(placed.shape[0]),
+                self.async_levels,
+            )
+            record_dispatch()
+            trip("dispatch")
+            wb = int(np.asarray(carry[4]))
+            record_collective_bytes(max(0, wb - prev_bytes))
+            prev_bytes = wb
+            rounds = int(np.asarray(carry[3]))
+            record_collective_rounds(max(0, rounds - prev_rounds))
+            prev_rounds = rounds
+            if not int(np.asarray(carry[2])):
+                break
+        return tuple(
+            _mesh2d_async_finalize(
+                self.mesh, carry[0], carry[4], carry[5], lsub
+            )
+        )
+
+    def _run_async_streamed(self, placed):
+        """Async drive over the streamed residency: the exchange round
+        streams the full host tile forest behind the row gather (fold =
+        max over neg planes), then each local wave re-streams it with a
+        collective-free source/commit pair.  One blocking status fetch
+        per leg — the async mode saves collective BARRIERS; the host
+        upload loop runs per wave regardless, which is the documented
+        tradeoff of composing the two modes."""
         mesh = self.mesh
         lsub = self.part.lsub
-        colblock = _mstream_exchange(mesh, carry[1], lsub, self.part.lt)
+        carry = _mesh2d_async_init(mesh, placed, lsub)
+        record_dispatch()
+        neg, changed = carry[0], carry[1]
+        wire_total = 0
+        if not int(np.asarray(carry[2])):
+            changed = None  # no sources anywhere: skip the loop
+        while changed is not None:
+            trip("dispatch")
+            colblock = _mstream_async_exchange(
+                mesh, neg, changed, lsub, self.part.lt
+            )
+            outs = self._stream_forest(colblock, like=neg, fold="max")
+            neg, delta, status = _mstream_async_commit(
+                mesh, self._stream_final_slot, neg, outs, lsub,
+                self.tree, self.max_levels,
+            )
+            row = np.asarray(status)
+            record_dispatch()
+            record_collective_rounds(1)
+            record_collective_bytes(int(row[1]))
+            wire_total += int(row[1])
+            changed = delta
+            if not int(row[0]):
+                break
+            for _ in range(self.async_levels - 1):
+                src = _mstream_async_local_src(
+                    mesh, neg, delta, lsub, self.part.lt
+                )
+                outs = self._stream_forest(src, like=neg, fold="max")
+                neg, delta, changed, lgo = _mstream_async_local_commit(
+                    mesh, self._stream_final_slot, neg, changed, outs,
+                    lsub, self.max_levels,
+                )
+                record_dispatch()
+                if not int(np.asarray(lgo)):
+                    break
+        return tuple(
+            _mesh2d_async_finalize(
+                mesh,
+                neg,
+                jnp.int64(wire_total),
+                jnp.int32(0),
+                lsub,
+            )
+        )
+
+    # ---- streamed drive ---------------------------------------------------
+    def _stream_forest(self, v0, like, fold="or"):
+        """Stream the whole host tile forest through the device against
+        source block ``v0``: the prefetch window issues uploads before
+        their consumer program, so the DMA rides behind whatever
+        collective produced ``v0``.  Returns the per-forest-level output
+        list the final-slot gather consumes."""
+        mesh = self.mesh
         feed = prefetched_uploads(
             self._stream_slices,
             lambda a: jax.device_put(a, self._stream_sharding),
             self.prefetch,
         )
-        v_prev = colblock
+        v_prev = v0
         outs = []
         for pieces in self._stream_plan:
             if pieces is None:
-                v_prev = _mstream_empty(mesh, carry[1])
+                v_prev = _mstream_empty(mesh, like)
             else:
-                v_prev = _mstream_level(mesh, v_prev, next(feed), pieces)
+                v_prev = _mstream_level(
+                    mesh, v_prev, next(feed), pieces, fold
+                )
             outs.append(v_prev)
+        return tuple(outs)
+
+    def _stream_level_once(self, carry):
+        """One streamed-residency BFS level: dispatch the ICI exchange,
+        stream the host tile forest through the device BEHIND it (the
+        exchange is still in flight when the first upload starts), then
+        fold the carry.  Returns (carry, status) with ``status`` the
+        device-side (3,) int64 [level, updated, bytes]."""
+        mesh = self.mesh
+        lsub = self.part.lsub
+        colblock = _mstream_exchange(mesh, carry[1], lsub, self.part.lt)
+        outs = self._stream_forest(colblock, like=carry[1], fold="or")
         *out, status = _mstream_apply(
             mesh,
             self._stream_final_slot,
             tuple(carry),
-            tuple(outs),
+            outs,
             lsub,
             self.tree,
         )
@@ -1137,6 +1770,7 @@ class Mesh2DEngine(QueryEngineBase):
             carry, dev_status = self._stream_level_once(carry)
             row = np.asarray(dev_status)
             record_dispatch()
+            record_collective_rounds(1)  # one exchange per level
             wb = int(row[2])
             record_collective_bytes(max(0, wb - prev_bytes))
             prev_bytes = wb
@@ -1161,7 +1795,10 @@ class Mesh2DEngine(QueryEngineBase):
     def level_stats(self, queries):
         """Per-level trace (MSBFS_STATS=2): the shared stepped driver over
         this engine's init/step programs; counters are replicated, so
-        ``finish`` is a read, not a merge."""
+        ``finish`` is a read, not a merge.  Always drives the SYNCHRONOUS
+        step program regardless of ``async_levels`` — per-level frontier
+        counts are a level-schedule concept, and the async drive's quiesced
+        planes are bit-identical to it, so the trace stays truthful."""
         from .distributed import stepped_level_stats
 
         placed, k = self._prep(queries)
@@ -1212,6 +1849,10 @@ class Mesh2DEngine(QueryEngineBase):
                 "wire_trace drives the chunked hbm loop; streamed "
                 "residency records dense bytes by construction"
             )
+        # Like level_stats, the trace drives the SYNCHRONOUS step program
+        # even when async_levels > 1: per-level encoding decisions are a
+        # level-schedule concept, and the quiesced async planes are
+        # bit-identical to the synchronous ones.
         placed, k = self._prep(queries)
         wire = self._wire_of(placed.shape[0])
         carry = _mesh2d_init(self.mesh, placed, self.part.lsub)
@@ -1264,8 +1905,9 @@ class Mesh2DEngine(QueryEngineBase):
         (arxiv 2112.01075): nothing references the lost devices' buffers.
         Raises DeviceError when no full row survives; bit-identity to a
         from-scratch shard holds by construction (this IS one).  The
-        resolved wire format and residency carry over — a reshard must
-        not silently flip the run back to env-derived defaults."""
+        resolved wire format, residency and async round depth carry over
+        — a reshard must not silently flip the run back to env-derived
+        defaults."""
         from ..runtime.supervisor import DeviceError
 
         failed = {int(r) for r in failed_ranks}
@@ -1290,4 +1932,5 @@ class Mesh2DEngine(QueryEngineBase):
             residency=self.residency,
             wire_sparse=self._wire_spec,
             wire_chunks=self.wire_chunks,
+            async_levels=self.async_levels,
         )

@@ -153,6 +153,43 @@ def reset_collective_bytes() -> None:
         _collective_bytes = 0
 
 
+# --- Collective-round accounting (round 19) -----------------------------------
+# The async 2D drive (MSBFS_ASYNC_LEVELS, parallel.partition2d) exists to
+# pay FEWER collective barriers, not fewer bytes: each round a tile runs k
+# local level steps and then one row-gather + col-reduce-scatter reconciles
+# the deltas.  "Fewer barriers" is the claim, so it gets its own ground-
+# truth counter recorded at every merge commit — the synchronous drive
+# records one round per executed level, the async drive one per exchange —
+# making the k=4-vs-k=1 round diet CI-observable on the virtual CPU mesh
+# (bench detail.multichip.async, the perf-smoke async-collective-rounds
+# guard, the MULTICHIP dryrun JSON) the same way the byte diets are.
+# Thread-safe for the same reason as the other counters.
+
+_collective_rounds = 0
+_collective_rounds_lock = threading.Lock()
+
+
+def record_collective_rounds(n: int = 1) -> None:
+    """Account ``n`` collective merge commits (one per reconciling
+    row-gather + col-reduce-scatter round the mesh executed)."""
+    global _collective_rounds
+    with _collective_rounds_lock:
+        _collective_rounds += int(n)
+
+
+def collective_rounds() -> int:
+    """Rounds recorded since the last :func:`reset_collective_rounds`."""
+    with _collective_rounds_lock:
+        return _collective_rounds
+
+
+def reset_collective_rounds() -> None:
+    """Zero the collective-round accumulator (callers bracket a span)."""
+    global _collective_rounds
+    with _collective_rounds_lock:
+        _collective_rounds = 0
+
+
 # --- MXU tile accounting (round 8) -------------------------------------------
 # The mxu engine's matmul level is FLOP-bound, not stream-bound: per level
 # it issues 2*T*T*K FLOPs for every NONZERO adjacency tile (ops/mxu.py),
@@ -206,12 +243,14 @@ def reset_mxu_tiles() -> None:
 
 def counter_totals() -> dict:
     """All engine counters in one dict: dispatches, plane_pass_bytes,
-    collective_bytes, mxu_flops/mxu_tiles_skipped/mxu_tiles_total."""
+    collective_bytes, collective_rounds,
+    mxu_flops/mxu_tiles_skipped/mxu_tiles_total."""
     flops, skipped, total = mxu_tile_counts()
     return {
         "dispatches": dispatch_count(),
         "plane_pass_bytes": plane_pass_bytes(),
         "collective_bytes": collective_bytes(),
+        "collective_rounds": collective_rounds(),
         "mxu_flops": flops,
         "mxu_tiles_skipped": skipped,
         "mxu_tiles_total": total,

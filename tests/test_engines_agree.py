@@ -381,6 +381,35 @@ def _mesh2d_streamed(g):
     return Mesh2DEngine(make_mesh2d(2, 4), g, residency="streamed")
 
 
+def _mesh2d_async(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+        Mesh2DEngine,
+    )
+
+    # Round-19 bounded-staleness drive: 4 local level steps per
+    # reconciling collective round; bit-identity to the synchronous
+    # schedule is the mode's whole correctness claim, so it rides the
+    # full cross-engine matrix (and the certify-audit arm below).
+    return Mesh2DEngine(make_mesh2d(2, 4), g, async_levels=4)
+
+
+def _mesh2d_async_sparse(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+        Mesh2DEngine,
+    )
+
+    # Async drive composed with the density-adaptive sparse wire: the
+    # exchange ships int32 neg planes through the same (index, word)
+    # seams the synchronous wire uses.
+    return Mesh2DEngine(make_mesh2d(2, 4), g, async_levels=4, wire_sparse=4096)
+
+
 # The lowk drive-loop variants (chunked/megachunk) and the sub-batch
 # splitter are pinned against the oracle and the bit-plane reference in
 # tests/test_lowk.py; only the base byte-flag arm needs the full
@@ -415,6 +444,8 @@ ENGINES = {
     "mesh2d_sparse": _mesh2d_sparse,
     "mesh2d_pipelined": _mesh2d_pipelined,
     "mesh2d_streamed": _mesh2d_streamed,
+    "mesh2d_async": _mesh2d_async,
+    "mesh2d_async_sparse": _mesh2d_async_sparse,
 }
 
 
@@ -609,6 +640,7 @@ AUDIT_SLOW = {
     "mesh2d_1x8",
     "mesh2d_pipelined",
     "mesh2d_streamed",
+    "mesh2d_async_sparse",
 }
 
 

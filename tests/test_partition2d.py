@@ -57,7 +57,9 @@ from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.fault
 )
 from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (
     collective_bytes,
+    collective_rounds,
     reset_collective_bytes,
+    reset_collective_rounds,
 )
 
 needs_mesh = pytest.mark.skipif(
@@ -251,6 +253,12 @@ WIRE_ARMS = [
     ("overflow_fallback", dict(wire_sparse=1)),
     ("pipelined", dict(merge_tree="pipelined", wire_chunks=2, wire_sparse=0)),
     ("streamed", dict(residency="streamed")),
+    # Round-19 bounded-staleness drive, alone and composed with the
+    # sparse wire / streamed residency — quiet-round termination must
+    # land the exact synchronous planes under every wire schedule.
+    ("async", dict(async_levels=4)),
+    ("async_sparse", dict(async_levels=4, wire_sparse=4096)),
+    ("async_streamed", dict(async_levels=4, residency="streamed")),
 ]
 
 
@@ -310,16 +318,18 @@ def test_without_ranks_no_survivors_raises(workload):
             marks=pytest.mark.slow,
         ),
         ("streamed", dict(residency="streamed")),
+        ("async", dict(async_levels=4)),
     ],
-    ids=["dense", "sparse", "pipelined", "streamed"],
+    ids=["dense", "sparse", "pipelined", "streamed", "async"],
 )
 def test_mid_drive_chip_loss_reshards_bit_identical(workload, label, kw):
     """Kill a simulated chip MID-DRIVE (the dispatch fault seam inside
     the drive loop, count 2: the supervisor's own dispatch trip consumes
     count 1) and assert the supervisor's reshard rung lands on the
     survivor mesh with bit-identical results to the clean run — under
-    every wire format and residency, which must survive the rebuild
-    (without_ranks carries the resolved knobs over)."""
+    every wire format, residency, and the async drive, all of which must
+    survive the rebuild (without_ranks carries the resolved knobs
+    over)."""
     g, queries, f, levels, reached = workload
     plan = FaultPlan.parse("chip:rank0:2")
     eng = Mesh2DEngine(make_mesh2d(2, 2), g, **kw)
@@ -331,3 +341,78 @@ def test_mid_drive_chip_loss_reshards_bit_identical(workload, label, kw):
     assert len(reshards) == 1
     assert reshards[0]["failed_ranks"] == [0]
     assert reshards[0]["survivor_shards"] == 2
+    if "async_levels" in kw:
+        # The resolved round depth must survive the reshard — a rebuilt
+        # engine silently falling back to k=1 would still be correct,
+        # which is exactly why the knob passthrough needs its own pin.
+        assert sup.engine.async_levels == kw["async_levels"]
+
+
+# ---- round 19: bounded-staleness async drive ------------------------------
+
+
+@needs_mesh
+def test_sync_drive_records_one_round_per_level(workload):
+    """The synchronous schedule's record_collective_rounds baseline: one
+    reconciling round per executed level, for both residencies — the
+    counter the async drive's diet is measured against."""
+    g, queries, f, levels, reached = workload
+    for kw in (dict(), dict(residency="streamed")):
+        eng = Mesh2DEngine(make_mesh2d(2, 4), g, **kw)
+        reset_collective_rounds()
+        np.testing.assert_array_equal(np.asarray(eng.f_values(queries)), f)
+        assert collective_rounds() == int(levels.max())
+
+
+@needs_mesh
+def test_async_round_diet_measured(workload):
+    """k=4 must pay measurably fewer reconciling rounds than k=1 on the
+    same workload while producing the identical planes (the perf-smoke
+    async-collective-rounds row pins the <= 0.5x version of this on the
+    deep grid fixture; this is the tier-1 any-graph sanity bound)."""
+    g, queries, f, levels, reached = workload
+    eng = Mesh2DEngine(make_mesh2d(2, 4), g, async_levels=4)
+    assert eng.async_levels == 4
+    reset_collective_rounds()
+    np.testing.assert_array_equal(np.asarray(eng.f_values(queries)), f)
+    # Quiet-round termination pays at most one extra (empty) exchange.
+    assert collective_rounds() <= int(levels.max()) + 1
+
+
+@needs_mesh
+def test_async_straggler_overshoot_converges_to_sync_plane():
+    """The quiet-round termination argument, pinned on a graph built to
+    make a tile overshoot: segment 0 holds an intra-segment chain
+    0->1->2->3 that local run-ahead waves explore immediately (setting
+    dist(3)=3 without any collective), while the TRUE shortest path
+    0->4->3 crosses a segment boundary and only lands at the next
+    exchange — the straggler's late discovery must lower the overshot
+    distance (max-merge on the negated lattice) and the drive must not
+    terminate before it does.  A deep cross-segment tail behind vertex 3
+    makes any premature quiescence visible in every downstream count."""
+    n = 16  # 2x2 mesh -> lsub = 4: segments are 4-vertex bands
+    chain = [(0, 1), (1, 2), (2, 3), (0, 4), (4, 3),
+             (3, 8), (8, 9), (9, 10), (10, 11), (11, 12),
+             (12, 13), (13, 14), (14, 15)]
+    edges = np.asarray(
+        chain + [(b, a) for a, b in chain], dtype=np.int32
+    )
+    g = CSRGraph.from_edges(n, edges)
+    queries = np.asarray([[0], [15], [3]], dtype=np.int32)
+    oracle = BitBellEngine(BellGraph.from_host(g))
+    want = [np.asarray(x) for x in oracle.query_stats(queries)]
+    sync = Mesh2DEngine(make_mesh2d(2, 2), g)
+    reset_collective_rounds()
+    s_stats = [np.asarray(x) for x in sync.query_stats(queries)]
+    sync_rounds = collective_rounds()
+    for a, b in zip(s_stats, want):
+        np.testing.assert_array_equal(a, b)
+    eng = Mesh2DEngine(make_mesh2d(2, 2), g, async_levels=4)
+    reset_collective_rounds()
+    a_stats = [np.asarray(x) for x in eng.query_stats(queries)]
+    async_rounds = collective_rounds()
+    for a, b in zip(a_stats, want):
+        np.testing.assert_array_equal(a, b)
+    # The deep tail gives the local waves real work: fewer exchanges
+    # than synchronous levels, not just equality-with-overshoot.
+    assert async_rounds < sync_rounds

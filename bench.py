@@ -42,7 +42,10 @@ and overlap each stripe's ring exchange with the previous stripe's tile
 pass), BENCH_WIRE_SPARSE the density-adaptive sparse wire budget
 (empty = auto Lsub*W/8 pairs, 0 = always dense), BENCH_RESIDENCY
 hbm|streamed the tile-forest residency (streamed = host RAM with
-double-buffered uploads), and rows carry detail.multichip: measured
+double-buffered uploads), BENCH_PLANE bit|byte the frontier plane layout
+(byte = ops.lowk's low-K uint8 lanes riding the mesh wire, round 20),
+BENCH_KERNEL xla|mxu the expansion kernel (mxu = per-device tile matmul
+with the direction switch, round 20), and rows carry detail.multichip: measured
 collective bytes, ICI roofline, scaling efficiency vs the same engine on
 a 1x1 mesh, plus the round-15 wire ledger detail.multichip.wire),
 BENCH_EDGE_CHUNKS (packed engine HBM knob, default 1),
@@ -56,7 +59,7 @@ BASELINE.md; empty disables), BENCH_WAIT_S (device-probe budget, default
 420), BENCH_RUN_S (workload hard deadline, default 1500),
 BENCH_GRAPH (rmat|road — road builds the config-4 grid at side 2^(scale/2)),
 BENCH_CONFIGS (comma list of BASELINE config ids, DEFAULT
-"2,2c,4,1,5,6,6r,7,7t,7l,7s,7a,8,8m,9": sweep
+"2,2c,4,1,5,6,6r,7,7t,7l,7s,7a,7k,7m,8,8m,9": sweep
 mode — each config runs in its own deadline-bounded child and gets its own
 value/error in detail.sweep; the cumulative record re-emits after every
 config so a partial outage cannot zero what was already measured; the
@@ -71,7 +74,11 @@ a forced 8-virtual-device CPU mesh; rows carry detail.multichip.  "7s"
 detail.multichip.wire ledger records the density-adaptive encoding per
 level and measured-vs-dense-model bytes; "7a" (round 19) reruns it with
 BENCH_ASYNC_LEVELS=4 (the bounded-staleness drive) and records the
-measured collective-round diet in detail.multichip.async.  The "8"
+measured collective-round diet in detail.multichip.async; "7k" / "7m"
+(round 20) are the lattice compositions — lowk byte planes on the
+streamed mesh drive (detail.multichip.lowk states the per-leg byte
+diet) and the MXU tile matmul on the mesh (detail.mxu rides alongside
+detail.multichip).  The "8"
 family is the round-11 dynamic-graph workload (BENCH_DYNAMIC=1):
 localized-delta incremental BFS repair vs full recompute, host-side, with
 BENCH_DELTA_SIZE/BENCH_DELTA_LOCALITY shaping the seeded delta (gen_cli
@@ -717,6 +724,13 @@ def run_workload() -> None:
                 # Round 19: BENCH_ASYNC_LEVELS=k > 1 switches the engine
                 # to the bounded-staleness drive (config 7a pins k=4).
                 async_env = os.environ.get("BENCH_ASYNC_LEVELS", "")
+                # Round 20 lattice knobs: BENCH_PLANE bit|byte picks the
+                # frontier plane layout (byte = the low-K uint8 lanes of
+                # ops.lowk on the mesh wire, config 7k), BENCH_KERNEL
+                # xla|mxu the expansion kernel (mxu = per-device tile
+                # matmul with the direction switch, config 7m).  Invalid
+                # compositions fail loud at construction — same
+                # ValueError route as the other knobs.
                 return Mesh2DEngine(
                     make_mesh2d(rows, cols),
                     g,
@@ -728,6 +742,8 @@ def run_workload() -> None:
                         int(wire_chunks_env) if wire_chunks_env else None
                     ),
                     async_levels=int(async_env) if async_env else None,
+                    plane=os.environ.get("BENCH_PLANE") or None,
+                    kernel=os.environ.get("BENCH_KERNEL") or None,
                 )
             except ValueError as e:
                 sys.exit(f"BENCH_ENGINE=mesh2d: {e}")
@@ -784,6 +800,12 @@ def run_workload() -> None:
     engine = build_engine()
     engine_build_s = time.perf_counter() - t0
     e_directed = g.num_directed_edges
+    # Round 20: the row's engine identity is the token-derived lattice
+    # label when the engine exposes one ("mesh2d+byte", "mesh2d+mxu",
+    # "mesh2d+byte+streamed", ...) — detail keys and the trend gate
+    # match on the resolved axes, never on the knob name, so a
+    # composition can't masquerade as the base engine's row.
+    row_label = getattr(engine, "label", engine_kind)
 
     def measure(num_queries: int):
         """One operating point: compile (untimed) + best-of-repeats run."""
@@ -893,6 +915,32 @@ def run_workload() -> None:
             "directions": [d["direction"] for d in trace],
             "levels": trace,
         }
+    elif (
+        row_label.startswith("mesh2d")
+        and getattr(engine, "kernel", "xla") == "mxu"
+    ):
+        # Round 20 kernel:mxu x partition:mesh2d — the per-device
+        # harmonized tile stacks.  Counters are the analytic
+        # issued-if-matmul model from utils.timing.record_mxu_tiles for
+        # the last timed repeat (read here, before the multichip
+        # single-chip leg below re-drives the engine).
+        mxu_flops, mxu_skipped, mxu_tiles = mxu_tile_counts()
+        ntr, tile, switch, nt_max = engine._mxu
+        mxu_detail = {
+            "tile_flops": mxu_flops,
+            "tile_flops_per_s": (
+                round(mxu_flops / best_s) if mxu_flops else None
+            ),
+            "tiles_skipped_measured": mxu_skipped,
+            "tiles_accounted_measured": mxu_tiles,
+            "zero_tile_skip_rate": (
+                round(mxu_skipped / mxu_tiles, 4) if mxu_tiles else None
+            ),
+            "tile": tile,
+            "tile_rows_per_device": ntr,
+            "tiles_nonzero_max_per_device": nt_max,
+            "switch": switch,
+        }
 
     # Multi-chip accounting (round 10): mesh shape, the measured analytic
     # collective bytes the timed best() moved over the mesh
@@ -903,7 +951,7 @@ def run_workload() -> None:
     # the T1 denominator, so efficiency = T1 / (n_devices * Tp) compares
     # like with like.
     multichip_detail = None
-    if engine_kind == "mesh2d":
+    if row_label.startswith("mesh2d"):
         from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
             make_mesh2d,
         )
@@ -915,10 +963,14 @@ def run_workload() -> None:
         single_teps = scaling_eff = None
         if n_dev > 1:
             try:
+                # Same plane/kernel on the 1x1 denominator so the
+                # scaling efficiency compares the SAME composition.
                 single = Mesh2DEngine(
                     make_mesh2d(1, 1),
                     g,
                     level_chunk=engine.level_chunk,
+                    plane=engine.plane,
+                    kernel=engine.kernel,
                 )
                 single.compile(queries.shape)
                 s_times = []
@@ -942,6 +994,12 @@ def run_workload() -> None:
             "mesh_shape": f"{engine.rows}x{engine.cols}",
             "n_devices": n_dev,
             "merge_tree": engine.tree,
+            # Round 20 lattice identity: the resolved axes this row ran
+            # (the label above is derived from exactly these tokens).
+            "engine_label": row_label,
+            "plane": getattr(engine, "plane", "bit"),
+            "kernel": getattr(engine, "kernel", "xla"),
+            "residency": getattr(engine, "residency", "hbm"),
             "collective_bytes": measured_coll_bytes,
             "level_bytes_model": engine.level_bytes(k),
             "collective_bytes_per_s": coll_per_s,
@@ -960,6 +1018,18 @@ def run_workload() -> None:
                 "statement on the simulated CPU mesh"
             ),
         }
+        if getattr(engine, "plane", "bit") == "byte":
+            # Round 20 plane:byte x partition:mesh2d (config 7k): the
+            # low-K byte diet stated per collective leg — K uint8 lanes
+            # per row vs the word-padded bit plane's ceil(K/32) uint32
+            # words, the ratio the perf-smoke lowk-mesh row pins.
+            bit_row = 4 * (-(-k // 32))
+            multichip_detail["lowk"] = {
+                "k": k,
+                "bytes_per_row_leg": max(1, k),
+                "bit_plane_bytes_per_row_leg": bit_row,
+                "wire_diet_vs_bit": round(max(1, k) / bit_row, 4),
+            }
         # Round 15: the per-level wire ledger (encoding the density cond
         # took, measured bytes) vs the dense wire model — the ratio the
         # perf-smoke sparse-wire row pins.  Untimed diagnostic re-drive,
@@ -1139,7 +1209,7 @@ def run_workload() -> None:
                 graph_kind,
                 mesh=(
                     (multichip_detail or {}).get("mesh_shape", "")
-                    if engine_kind == "mesh2d"
+                    if row_label.startswith("mesh2d")
                     else ""
                 ),
             )
@@ -1166,7 +1236,10 @@ def run_workload() -> None:
                 "minF": min_f,
                 "minK_1based": min_k + 1,
                 "device": str(jax.devices()[0]),
-                "engine": engine_kind,
+                # Token-derived lattice label (== the knob name for
+                # single-axis engines; "mesh2d+byte" etc. for round-20
+                # compositions — what trend.py's config matching reads).
+                "engine": row_label,
                 "query_chunk": chunk,
                 "edge_chunks": edge_chunks,
                 "levels_sum": levels_sum,
@@ -1368,6 +1441,28 @@ CONFIG_PRESETS = {
            "BENCH_MESH": "2x4", "BENCH_REPEATS": "1",
            "BENCH_EXTRA_KS": "", "BENCH_VIRTUAL_CPU": "8",
            "BENCH_ASYNC_LEVELS": "4"},
+    # 7k (round 20): plane:byte x residency:streamed x partition:mesh2d
+    # — the low-K uint8 lanes of ops.lowk on the partitioned streamed
+    # drive.  K=4 queries ship n*K=4 bytes per row per collective leg
+    # instead of the word-padded bit plane's 4 bytes * ceil(K/32) words
+    # — at K=4 that's 1 byte/row/query vs 4 bytes/row for the whole
+    # group, the diet detail.multichip.lowk states and the perf-smoke
+    # lowk-mesh-bytes row pins at K=2 (exactly 0.5x).  Road workload:
+    # deep thin frontiers are lowk's serving regime.
+    "7k": {"BENCH_GRAPH": "road", "BENCH_ENGINE": "mesh2d",
+           "BENCH_SCALE": "16", "BENCH_K": "4", "BENCH_MAX_S": "4",
+           "BENCH_MESH": "2x4", "BENCH_PLANE": "byte",
+           "BENCH_RESIDENCY": "streamed", "BENCH_REPEATS": "1",
+           "BENCH_EXTRA_KS": "", "BENCH_VIRTUAL_CPU": "8"},
+    # 7m (round 20): kernel:mxu x partition:mesh2d — per-device
+    # harmonized tile stacks (ops.mxu.tile_matmul_hits) with the
+    # mesh-uniform direction switch, on the config-6 tile-dense RMAT
+    # shape.  Rows carry detail.mxu (tile FLOPs, measured skip rate,
+    # device-grid geometry) alongside detail.multichip.
+    "7m": {"BENCH_GRAPH": "rmat", "BENCH_ENGINE": "mesh2d",
+           "BENCH_SCALE": "14", "BENCH_K": "64", "BENCH_MESH": "2x4",
+           "BENCH_KERNEL": "mxu", "BENCH_REPEATS": "2",
+           "BENCH_EXTRA_KS": "", "BENCH_VIRTUAL_CPU": "8"},
     # Config 8 family (round 11): dynamic graphs — localized-delta
     # incremental BFS repair (dynamic/repair.py) vs full recompute,
     # host-side.  "8" is the street-closure scenario on the road grid
@@ -1606,7 +1701,7 @@ def main() -> int:
     configs = [
         c.strip()
         for c in os.environ.get(
-            "BENCH_CONFIGS", "2,2c,4,1,5,6,6r,7,7t,7l,7s,7a,8,8m,9"
+            "BENCH_CONFIGS", "2,2c,4,1,5,6,6r,7,7t,7l,7s,7a,7k,7m,8,8m,9"
         ).split(",")
         if c.strip()
     ]

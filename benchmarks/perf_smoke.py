@@ -79,6 +79,12 @@ also adds the cross-round trend gate (benchmarks/trend.py): the
 BENCH_r*.json trajectory must show no >10% drop of a gated config's
 latest value vs its best prior round.
 
+Round 20 adds the low-K byte-plane wire guard (parallel/partition2d,
+plane:byte on the engine lattice): at K=2 the byte plane's measured
+collective bytes must be exactly half the bit plane's word-padded wire
+on the same mesh run — the lane-layout diet ops.lowk brings to the
+partitioned engine, measured not modeled.
+
 Exit 0 on pass; exits 1 with a per-workload report on any violation.
 """
 
@@ -236,6 +242,17 @@ BUDGET = {
     # allows ~45% jitter — growth past it means the local waves or the
     # quiet-round termination stopped biting.
     "async-collective-rounds": 48,
+    # Round 20 low-K byte plane (parallel/partition2d, plane:byte):
+    # measured collective bytes of one 4x4-mesh best() on the RMAT-10
+    # fixture at K=2 with the byte plane (lsub*kpad=2 uint8 B per
+    # segment) vs the SAME run on the bit plane (one word-padded uint32
+    # = lsub*4 B; a word holds 32 queries, so K=2 pays for 30 empty
+    # lanes).  Both runs pin wire_sparse=0 and measure through
+    # record_collective_bytes, so the generic opt*2<=base gate IS the
+    # exact 0.5x diet the lane layout predicts (measured today: 61,440
+    # vs 122,880 B over 5 levels x 16 chips x 6 segments).  The budget
+    # allows one extra level (6 x 12,288) of jitter only.
+    "lowk-mesh-bytes": 73_728,
     # Round 15 cross-round trend (benchmarks/trend.py): violations is
     # the count of gated configs whose latest BENCH_r*.json value
     # dropped >10% below their best prior round; exact zero-budget pin
@@ -913,6 +930,29 @@ def _multichip_child() -> int:
     want_a, rounds_k1 = rrounds()
     got_a, rounds_k4 = rrounds(async_levels=4)
     assert got_a == want_a, f"async k=4 {got_a} != sync {want_a}"
+
+    # Round 20 leg: the low-K byte plane on the mesh wire (plane:byte x
+    # partition:mesh2d, the ops.lowk lane layout on the collective
+    # seams).  K=2 queries ship lsub*2 uint8 bytes per collective leg
+    # where the bit plane ships one word-padded uint32 word (lsub*4 B —
+    # a word holds up to 32 queries, so low K pays for the whole word):
+    # the exact 0.5x diet at K=2.  Both runs pin wire_sparse=0 so the
+    # legs compare plane layout ALONE, measured through the same
+    # counter on the same rmat fixture and drive.
+    kq = pad_queries(
+        generators.random_queries(n, 2, max_group=4, seed=43), pad_to=4
+    )
+
+    def pcoll(**kw):
+        engine = Mesh2DEngine(make_mesh2d(4, 4), host, wire_sparse=0, **kw)
+        engine.compile(kq.shape)
+        reset_collective_bytes()
+        got = engine.best(kq)
+        return got, collective_bytes()
+
+    want_b, bytes_bit = pcoll()
+    got_b, bytes_byte = pcoll(plane="byte")
+    assert got_b == want_b, f"byte plane {got_b} != bit plane {want_b}"
     print(
         json.dumps(
             {
@@ -922,6 +962,8 @@ def _multichip_child() -> int:
                 "wire_sparse": wire_sparse,
                 "rounds_k1": rounds_k1,
                 "rounds_k4": rounds_k4,
+                "bytes_bit": bytes_bit,
+                "bytes_byte": bytes_byte,
             }
         ),
         flush=True,
@@ -958,6 +1000,7 @@ def run_multichip():
         ("multichip-frontier-bytes-ratio", rec["bytes_1d"], rec["bytes_2d"]),
         ("sparse-wire-bytes", rec["wire_dense"], rec["wire_sparse"]),
         ("async-collective-rounds", rec["rounds_k1"], rec["rounds_k4"]),
+        ("lowk-mesh-bytes", rec["bytes_bit"], rec["bytes_byte"]),
     ]
 
 

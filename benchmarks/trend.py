@@ -44,8 +44,10 @@ import sys
 # Configs whose regressions gate (the headline family): config 2 is the
 # BASELINE headline workload; the others each anchor a subsystem round.
 # Diagnostic variants (2c, 7t, 7l, ...) ride the table but not the gate
-# — they exist to explain the anchors, not to pin them.
-GATED_CONFIGS = ("2", "4", "5", "6", "7", "7s", "7a", "8", "9")
+# — they exist to explain the anchors, not to pin them.  7k / 7m are
+# the round-20 lattice compositions (lowk byte planes on the
+# streamed mesh; MXU tile matmul on the mesh).
+GATED_CONFIGS = ("2", "4", "5", "6", "7", "7s", "7a", "7k", "7m", "8", "9")
 
 
 def load_rounds(root):

@@ -74,9 +74,13 @@ def parse_args(argv: List[str]):
 # BFS exits the in-dispatch loop on convergence either way.
 _AUTO_LEVEL_CHUNK = 128
 
-# Backends with no distributed variant: at -gn > 1 they warn and fall back
-# to the distributed bitbell.  ("csr"/"vmap" map to the per-query pull and
-# "push" to real multi-chip routes, so they are absent here.)
+# Backends with no 1D-distributed variant: at -gn > 1 WITHOUT a 2D mesh
+# they warn and fall back to the distributed bitbell.  ("csr"/"vmap" map
+# to the per-query pull and "push" to real multi-chip routes, so they are
+# absent here.)  The MSBFS_MESH route does NOT consult this list — it
+# resolves the engine lattice instead, where lowk (plane:byte), mxu
+# (kernel:mxu) and streamed (residency:streamed) all compose with
+# partition:mesh2d and the rest fail loud naming the missing token.
 _SINGLE_CHIP_ONLY_BACKENDS = (
     "dense",
     "pallas",
@@ -588,15 +592,22 @@ def main(argv: Optional[List[str]] = None) -> int:
             # instead of the 1D row shard's full-frontier allgather.
             # MSBFS_MERGE_TREE picks the col-axis reduction tree
             # (auto/oneshot/ring/halving/pipelined); MSBFS_WIRE_SPARSE /
-            # MSBFS_WIRE_CHUNKS shape the density-adaptive wire format,
-            # and MSBFS_MESH_RESIDENCY=streamed keeps the tile forest in
-            # host RAM (over-HBM tile sets), which ADDS the "streamed"
-            # token to the required capability set — the composition is
-            # negotiated, not hand-wired.  Engine selection goes through
-            # capability negotiation (ops.engine.negotiate_engine) so the
-            # route fails loud if no registered engine can serve a 2D
-            # mesh with live reshard (and streamed residency when asked).
-            from .ops.engine import negotiate_engine
+            # MSBFS_WIRE_CHUNKS shape the density-adaptive wire format.
+            # The route resolves the FULL engine lattice: MSBFS_BACKEND
+            # pins axis defaults (lowk -> plane:byte, mxu -> kernel:mxu)
+            # and the direct axis knobs MSBFS_MESH_PLANE /
+            # MSBFS_MESH_KERNEL / MSBFS_MESH_RESIDENCY override per axis,
+            # so "low-K byte planes on a streamed mesh" or "MXU tile
+            # matmul on the mesh" are negotiated compositions, not new
+            # engine classes.  resolve_axes + negotiate_engine fail loud
+            # (typed NegotiationError naming the missing tokens) when no
+            # registered engine composes the ask — e.g. stencil's banded
+            # layout or a word-plane backend on the 2D mesh.
+            from .ops.engine import (
+                engine_label,
+                negotiate_engine,
+                resolve_axes,
+            )
             from .parallel.mesh import make_mesh2d, parse_mesh_spec
             from .parallel.partition2d import Mesh2DEngine
 
@@ -607,26 +618,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"MSBFS_MESH={mesh_spec} wants {rows * cols} chips "
                         f"but -gn selected {n_chips}"
                     )
+                backend = knobs.raw("MSBFS_BACKEND", "auto")
+                if backend in ("auto", "csr"):
+                    backend = "bitbell"  # the mesh default plane layout
                 residency = (
                     knobs.raw("MSBFS_MESH_RESIDENCY") or "hbm"
                 ).strip().lower()
+                plane = (
+                    knobs.raw("MSBFS_MESH_PLANE") or ""
+                ).strip().lower() or None
+                kernel = (
+                    knobs.raw("MSBFS_MESH_KERNEL") or ""
+                ).strip().lower() or None
                 async_levels = max(
                     1, knobs.get_int("MSBFS_ASYNC_LEVELS", 1)
                 )
-                required = {"mesh2d", "reshard"}
-                if residency == "streamed":
-                    required.add("streamed")
-                if async_levels > 1:
-                    # The bounded-staleness drive is a negotiated mode,
-                    # not a new engine class — same pattern as streamed.
-                    required.add("async")
-                label = (
-                    "mesh2d+streamed"
-                    if residency == "streamed"
-                    else "mesh2d"
+                axes, required = resolve_axes(
+                    backend,
+                    partition="mesh2d",
+                    residency=residency,
+                    plane=plane,
+                    kernel=kernel,
+                    async_levels=async_levels,
                 )
-                if async_levels > 1:
-                    label += f"+async{async_levels}"
+                label = engine_label(axes, async_levels=async_levels)
                 _, engine = negotiate_engine(
                     required,
                     [
@@ -643,8 +658,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                                     knobs.raw("MSBFS_MERGE_TREE")
                                     or None
                                 ),
-                                residency=residency,
+                                residency=axes["residency"],
                                 async_levels=async_levels,
+                                plane=axes["plane"],
+                                kernel=axes["kernel"],
                             ),
                         ),
                     ],
@@ -655,6 +672,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # style as the push route.
                 print(str(exc), file=sys.stderr)
                 return 1
+            print(
+                f"mesh route: {label} ({rows}x{cols}, "
+                f"{', '.join(sorted(required))})",
+                file=sys.stderr,
+            )
             announce_chunk()
         elif n_chips > 1:
             # MSBFS_VSHARD=v splits the CSR over a 'v' mesh axis of that

@@ -231,6 +231,12 @@ def bell_f_values(
 class BellEngine(PackedEngineBase):
     """All-queries-at-once scatter-free engine over a BellGraph."""
 
+    # Lattice axes (ops.engine.resolve_axes): word distances over the
+    # bucketed-ELL forest (the bit-plane variant is ops.bitbell).
+    CAPABILITIES = frozenset(
+        {"plane:word", "residency:hbm", "partition:single", "kernel:xla"}
+    )
+
     def __init__(
         self,
         graph: BellGraph,

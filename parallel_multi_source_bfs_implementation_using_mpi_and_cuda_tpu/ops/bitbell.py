@@ -892,6 +892,12 @@ class BitBellEngine(FusedBestEngine):
     (:func:`resolve_megachunk`; None = auto / MSBFS_MEGACHUNK).  Callers
     whose ``level_chunk`` is a deliberate bound pass 1."""
 
+    # Lattice axes (ops.engine.resolve_axes): the default single-chip
+    # packed-bit-plane configuration.
+    CAPABILITIES = frozenset(
+        {"plane:bit", "residency:hbm", "partition:single", "kernel:xla"}
+    )
+
     k_align = WORD_BITS
 
     def __init__(

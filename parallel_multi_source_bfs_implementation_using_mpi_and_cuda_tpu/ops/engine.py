@@ -214,6 +214,192 @@ class QueryEngineBase:
         return None
 
 
+# ---------------------------------------------------------------------------
+# The engine lattice: four orthogonal, negotiated axes.
+#
+# An engine is a *configuration* on these axes, not a class: the same
+# Mesh2DEngine instance can run bit or byte planes, HBM or streamed
+# residency, XLA-pull or MXU tile-matmul kernels.  Routing code resolves
+# a backend name plus knobs to an ``axes`` dict via :func:`resolve_axes`,
+# turns it into capability tokens (``axis:value`` strings) and lets
+# :func:`negotiate_engine` pick a class that declares them — so an
+# impossible combination fails loud naming the missing token instead of
+# silently running a lesser engine, and the agreement matrix stops
+# growing one hand-wired class per combination.
+AXES = {
+    "plane": ("bit", "byte", "word"),
+    "residency": ("hbm", "streamed"),
+    "partition": ("single", "1d", "mesh2d"),
+    "kernel": ("xla", "pallas", "mxu"),
+}
+
+#: backend name -> the axis values that backend pins (unset axes keep
+#: the lattice defaults: bit planes, HBM residency, XLA kernel).
+BACKEND_AXES = {
+    "bitbell": {"plane": "bit"},
+    "bell": {"plane": "word"},
+    "lowk": {"plane": "byte"},
+    "mxu": {"plane": "bit", "kernel": "mxu"},
+    "streamed": {"plane": "bit", "residency": "streamed"},
+    "stencil": {"plane": "bit"},
+    "packed": {"plane": "word"},
+    "ppush": {"plane": "word"},
+    "push": {"plane": "word"},
+    "dense": {"plane": "word"},
+    "vmap": {"plane": "word"},
+    "pallas": {"plane": "word", "kernel": "pallas"},
+}
+
+#: extra (non-axis) tokens a backend demands beyond its axis values.
+BACKEND_EXTRAS = {
+    "stencil": frozenset({"banded"}),
+}
+
+
+class NegotiationError(ValueError):
+    """A knob combination that cannot negotiate.
+
+    Subclasses ValueError so every existing ``except ValueError`` route
+    (CLI fail-loud paths, serve routing) keeps working; the distinct
+    type lets the negotiation property sweep assert *typed* failure —
+    no silent fallback, no bare crash."""
+
+
+def axis_tokens(axes) -> frozenset:
+    """``axes`` dict -> the ``axis:value`` capability tokens it demands."""
+    return frozenset(f"{axis}:{value}" for axis, value in axes.items())
+
+
+# Axis-value pairs that no engine composes (and none is planned to):
+# checked up front so the failure names the *pair*, not just a missing
+# token on whichever candidate happened to be tried first.
+_INCOMPATIBLE = (
+    # MXU tile-matmul consumes packed bit planes (unpack_byte_planes on
+    # a (n, W) uint32 frontier); byte planes never reach it.
+    ("plane:byte", "kernel:mxu"),
+    # The async negated-distance drive runs int32 word planes; the byte
+    # plane's 0/1 flags carry no distance to relax chaotically.
+    ("plane:byte", "async"),
+    # MXU tiles are device-resident adjacency blocks; streaming them
+    # per level would re-upload the whole tile set every dispatch.
+    ("kernel:mxu", "residency:streamed"),
+    ("kernel:mxu", "async"),
+)
+
+
+def resolve_axes(
+    backend: str,
+    partition: str = "single",
+    residency: Optional[str] = None,
+    plane: Optional[str] = None,
+    kernel: Optional[str] = None,
+    async_levels: int = 1,
+    weighted: bool = False,
+):
+    """Map a backend name + routing knobs to the lattice.
+
+    Returns ``(axes, required)``: the resolved axes dict and the full
+    capability-token set a route should demand from
+    :func:`negotiate_engine`.  ``residency``/``plane``/``kernel`` are
+    the direct axis knobs (MSBFS_MESH_RESIDENCY / MSBFS_MESH_PLANE /
+    MSBFS_MESH_KERNEL) — an explicit value overrides the backend's
+    default for that axis.  Raises :class:`NegotiationError` for a
+    combination no engine composes (naming the offending tokens) or an
+    unknown backend/axis value — the typed fail-loud contract the
+    negotiation sweep test pins."""
+    if backend not in BACKEND_AXES:
+        raise NegotiationError(
+            f"unknown backend {backend!r}: not on the engine lattice "
+            f"(known: {', '.join(sorted(BACKEND_AXES))})"
+        )
+    if partition not in AXES["partition"]:
+        raise NegotiationError(
+            f"unknown partition {partition!r} (axis values: "
+            f"{', '.join(AXES['partition'])})"
+        )
+    for axis, value in (
+        ("residency", residency), ("plane", plane), ("kernel", kernel)
+    ):
+        if value is not None and value not in AXES[axis]:
+            raise NegotiationError(
+                f"unknown {axis} {value!r} (axis values: "
+                f"{', '.join(AXES[axis])})"
+            )
+    axes = {
+        "plane": "bit",
+        "residency": "hbm",
+        "partition": partition,
+        "kernel": "xla",
+    }
+    axes.update(BACKEND_AXES[backend])
+    # Explicit axis knobs override the backend default for that axis
+    # (backend "streamed" already pinned residency, "mxu" the kernel —
+    # an explicit knob can still re-point them, and the incompatibility
+    # screen below judges the RESULT, wherever each value came from).
+    if residency is not None:
+        axes["residency"] = residency
+    if plane is not None:
+        axes["plane"] = plane
+    if kernel is not None:
+        axes["kernel"] = kernel
+    required = set(axis_tokens(axes))
+    required |= BACKEND_EXTRAS.get(backend, frozenset())
+    if axes["partition"] == "mesh2d":
+        # Mesh routes always demand survivability: the supervisor's
+        # degrade-to-survivors path needs without_ranks.
+        required.add("reshard")
+    if async_levels > 1:
+        required.add("async")
+    if weighted:
+        required.add("weighted")
+    bad = [
+        (a, b)
+        for a, b in _INCOMPATIBLE
+        if a in required and b in required
+    ]
+    if bad:
+        raise NegotiationError(
+            "no engine composes "
+            + " or ".join(f"{a} with {b}" for a, b in bad)
+            + f" (backend={backend}, partition={axes['partition']})"
+        )
+    return axes, frozenset(required)
+
+
+def engine_label(axes, async_levels: int = 1, extras=()) -> str:
+    """Canonical engine label derived from resolved axes.
+
+    This is the single source for ``label``/``describe`` strings and
+    the ``detail.*`` bench keys — derived from the token set, never
+    hand-built per class, so a rename can't silently fork the trend
+    gate's config matching.  Existing labels are preserved exactly
+    ("mesh2d", "mesh2d+streamed", "mesh2d+asyncK", "bitbell", ...)."""
+    if axes.get("partition") == "mesh2d":
+        label = "mesh2d"
+        if axes.get("plane") == "byte":
+            label += "+byte"
+        if axes.get("kernel") == "mxu":
+            label += "+mxu"
+        if axes.get("residency") == "streamed":
+            label += "+streamed"
+        if async_levels > 1:
+            label += f"+async{async_levels}"
+        return label
+    if axes.get("kernel") == "mxu":
+        return "mxu"
+    if axes.get("kernel") == "pallas":
+        return "pallas"
+    if "banded" in extras:
+        return "stencil"
+    if axes.get("residency") == "streamed":
+        return "streamed"
+    if axes.get("plane") == "byte":
+        return "lowk"
+    if axes.get("plane") == "word":
+        return "dense"
+    return "bitbell"
+
+
 def negotiate_engine(required, candidates):
     """Pick the first candidate whose declared capabilities cover
     ``required``.
@@ -221,7 +407,8 @@ def negotiate_engine(required, candidates):
     ``candidates`` is a sequence of ``(label, engine_cls, factory)``
     triples in preference order; the winner's ``factory()`` is invoked
     (construction is the expensive part — losers never build) and
-    ``(label, engine)`` returned.  No winner raises ValueError naming
+    ``(label, engine)`` returned.  No winner raises
+    :class:`NegotiationError` (a ValueError) naming
     every candidate's missing tokens, so a route asked for an impossible
     combination (e.g. ``MSBFS_MESH`` with an engine family that cannot
     tile) fails loud instead of silently running a lesser engine."""
@@ -233,7 +420,7 @@ def negotiate_engine(required, candidates):
         if not missing:
             return label, factory()
         misses.append(f"{label} lacks {{{', '.join(sorted(missing))}}}")
-    raise ValueError(
+    raise NegotiationError(
         f"no engine provides {{{', '.join(sorted(required))}}}: "
         + "; ".join(misses)
     )
@@ -249,6 +436,20 @@ class Engine(QueryEngineBase):
     every graph representation this engine hosts — CSR pull, dense-MXU,
     Pallas-ELL); None keeps the whole BFS in one fused dispatch.
     """
+
+    # Lattice axes: the generic word-plane host.  Declares BOTH kernel
+    # values — the ``expand`` argument is the kernel axis here (CSR pull
+    # and dense-MXU run XLA, the ELL slab runs the Pallas chain), the
+    # same one-class-many-configurations shape as Mesh2DEngine.
+    CAPABILITIES = frozenset(
+        {
+            "plane:word",
+            "residency:hbm",
+            "partition:single",
+            "kernel:xla",
+            "kernel:pallas",
+        }
+    )
 
     def __init__(
         self,

@@ -228,6 +228,11 @@ class LowKEngine(FusedBestEngine):
     pulls).  ``level_chunk``/``megachunk``: per-dispatch level bound and
     fusion factor, same contract as the other bit-plane engines."""
 
+    # Lattice axes (ops.engine.resolve_axes): the low-K byte-plane point.
+    CAPABILITIES = frozenset(
+        {"plane:byte", "residency:hbm", "partition:single", "kernel:xla"}
+    )
+
     k_align = 1
 
     def __init__(

@@ -228,6 +228,30 @@ class MxuGraph:
         return cls(*arrays, n=n, tile=tile)
 
 
+def densify_pairs(u: np.ndarray, v: np.ndarray, tile: int, ntr: int):
+    """Host-side densification of directed (u, v) edge pairs over an
+    (ntr, ntr) tile grid: the nonzero (T, T) int8 blocks plus their
+    sorted (tile_row, tile_col) index — :meth:`MxuGraph.from_host`'s
+    core, reusable on pair lists that did NOT come from a square dedup
+    CSR (the 2D mesh's rectangular tile cuts, whose row and col
+    coordinates live in different spaces so ``deduped_pairs``' self-loop
+    test would eat real edges).  Returns ``(tiles, tile_row, tile_col)``
+    NumPy arrays with ``nt >= 0`` leading length."""
+    u = np.asarray(u, dtype=np.int64)
+    v = np.asarray(v, dtype=np.int64)
+    tid = (u // tile) * ntr + (v // tile)
+    uniq, inv = np.unique(tid, return_inverse=True)
+    nt = int(uniq.size)
+    tiles = np.zeros((nt, tile, tile), dtype=np.int8)
+    if nt:
+        tiles[inv, u % tile, v % tile] = 1
+    return (
+        tiles,
+        (uniq // ntr).astype(np.int32),
+        (uniq % ntr).astype(np.int32),
+    )
+
+
 # --- level expansion ---------------------------------------------------------
 
 
@@ -243,32 +267,52 @@ def _tile_products_xla(tiles: jax.Array, rhs: jax.Array) -> jax.Array:
     )
 
 
-def mxu_matmul_hits(
-    graph: MxuGraph, frontier: jax.Array, kernel: bool = False
+def tile_matmul_hits(
+    tiles: jax.Array,
+    tile_row: jax.Array,
+    tile_col: jax.Array,
+    ntr: int,
+    frontier: jax.Array,
+    kernel: bool = False,
 ) -> jax.Array:
-    """(n_pad, W) uint32 frontier planes -> (n_pad, W) hit planes via the
-    blocked tile x frontier matmul.  OR-accumulate semantics: per-tile
-    products are nonneg neighbor counts, the sorted segment-sum over
-    destination tiles adds them exactly, and ``count > 0`` IS the
-    neighbor-OR."""
-    if graph.nt == 0:  # edgeless: nothing can be hit
+    """The blocked tile x frontier matmul on raw tile arrays: (ntr*T, W)
+    uint32 frontier planes -> same-shape hit planes.  OR-accumulate
+    semantics: per-tile products are nonneg neighbor counts, the sorted
+    segment-sum over destination tiles adds them exactly, and
+    ``count > 0`` IS the neighbor-OR.  Factored out of the MxuGraph path
+    so the 2D mesh can run the identical kernel over its per-device
+    harmonized tile stacks (parallel.partition2d, kernel="mxu") —
+    duplicate ``tile_row`` entries (the mesh's zero-tile padding) are
+    fine: they contribute nothing to the segment sum."""
+    if tiles.shape[0] == 0:  # edgeless: nothing can be hit
         return jnp.zeros_like(frontier)
-    t, ntr = graph.tile, graph.ntr
+    t = tiles.shape[1]
     fr = unpack_byte_planes(frontier).astype(jnp.int8)  # (n_pad, K) 0/1
     k = fr.shape[1]
     blocks = fr.reshape(ntr, t, k)
-    rhs = jnp.take(blocks, graph.tile_col, axis=0)  # (nt, T, K)
+    rhs = jnp.take(blocks, tile_col, axis=0)  # (nt, T, K)
     products = (
         _pallas_tile_products if kernel else _tile_products_xla
-    )(graph.tiles, rhs)
+    )(tiles, rhs)
     acc = jax.ops.segment_sum(
         products,
-        graph.tile_row,
+        tile_row,
         num_segments=ntr,
         indices_are_sorted=True,
     )  # (ntr, T, K) f32 neighbor counts
-    hits = (acc > 0).astype(jnp.uint8).reshape(graph.n_pad, k)
+    hits = (acc > 0).astype(jnp.uint8).reshape(ntr * t, k)
     return pack_byte_planes(hits)
+
+
+def mxu_matmul_hits(
+    graph: MxuGraph, frontier: jax.Array, kernel: bool = False
+) -> jax.Array:
+    """(n_pad, W) uint32 frontier planes -> (n_pad, W) hit planes via
+    :func:`tile_matmul_hits` over the graph's nonzero-tile index."""
+    return tile_matmul_hits(
+        graph.tiles, graph.tile_row, graph.tile_col, graph.ntr,
+        frontier, kernel,
+    )
 
 
 def mxu_expand(
@@ -445,6 +489,12 @@ class MxuEngine(FusedBestEngine):
     the exact per-level split.  The unchunked fused path records
     nothing: it fetches no per-chunk level counter (the stencil
     plane-pass precedent)."""
+
+    # Lattice axes (ops.engine.resolve_axes): the tensor-core kernel on
+    # single-chip HBM bit planes.
+    CAPABILITIES = frozenset(
+        {"plane:bit", "residency:hbm", "partition:single", "kernel:mxu"}
+    )
 
     k_align = WORD_BITS
 

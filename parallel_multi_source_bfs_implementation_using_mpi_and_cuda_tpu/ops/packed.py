@@ -336,6 +336,11 @@ class PackedEngine(PackedEngineBase):
     ``k_align`` pads the query axis to a vector-friendly multiple.
     """
 
+    # Lattice axes (ops.engine.resolve_axes): coalesced word planes.
+    CAPABILITIES = frozenset(
+        {"plane:word", "residency:hbm", "partition:single", "kernel:xla"}
+    )
+
     def __init__(
         self,
         graph: DeviceCSR,

@@ -340,6 +340,12 @@ class PushEngine(QueryEngineBase):
     stderr.  An explicit int is a hard bound: overflow raises
     :class:`FrontierOverflow` (results are never truncated)."""
 
+    # Lattice axes (ops.engine.resolve_axes): word distances, compacted
+    # queue expansion (PackedPushEngine inherits — same lattice point).
+    CAPABILITIES = frozenset(
+        {"plane:word", "residency:hbm", "partition:single", "kernel:xla"}
+    )
+
     def __init__(
         self,
         graph: PaddedAdjacency,

@@ -658,6 +658,21 @@ class StencilEngine(FusedBestEngine):
     fetches no per-chunk level counter, and the guard drives chunked
     engines."""
 
+    # Lattice axes + the structural "banded" token: stencil layouts only
+    # exist for bandable graphs (ops.engine.BACKEND_EXTRAS demands it).
+    CAPABILITIES = frozenset(
+        {
+            "banded",
+            "plane:bit",
+            "residency:hbm",
+            "partition:single",
+            "kernel:xla",
+            # MSBFS_STENCIL_KERNEL=1 runs the masked-shift sweep through
+            # the Pallas chain — the kernel axis on this class.
+            "kernel:pallas",
+        }
+    )
+
     k_align = WORD_BITS
 
     def __init__(

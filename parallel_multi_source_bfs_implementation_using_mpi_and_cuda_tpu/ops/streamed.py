@@ -180,7 +180,17 @@ class StreamedBitBellEngine(PackedEngineBase):
     tests/test_dispatch_opt.py.
     """
 
-    CAPABILITIES = frozenset({"streamed"})
+    CAPABILITIES = frozenset(
+        {
+            "streamed",
+            # Lattice axes: single-chip bit planes with the forest
+            # host-resident (residency:streamed IS this engine's point).
+            "plane:bit",
+            "residency:streamed",
+            "partition:single",
+            "kernel:xla",
+        }
+    )
 
     k_align = WORD_BITS
 

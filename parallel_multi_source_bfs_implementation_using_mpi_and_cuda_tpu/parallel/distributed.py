@@ -297,7 +297,18 @@ class DistributedEngine(QueryEngineBase):
     graph at any -gn (per-rank serial BFS, main.cu:303-322), and this is
     what keeps that promise on TPU (see ops.bitbell.bitbell_run_chunked)."""
 
-    CAPABILITIES = frozenset({"query_sharded", "reshard"})
+    CAPABILITIES = frozenset(
+        {
+            "query_sharded",
+            "reshard",
+            # Lattice axes: replicated-graph query sharding (bit
+            # planes per shard through the bitbell inner engine).
+            "plane:bit",
+            "residency:hbm",
+            "partition:1d",
+            "kernel:xla",
+        }
+    )
 
     def __init__(
         self,

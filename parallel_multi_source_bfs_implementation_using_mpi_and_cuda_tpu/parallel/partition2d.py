@@ -128,7 +128,19 @@ from ..ops.bitbell import (
     pack_queries,
     unpack_counts,
 )
-from ..ops.engine import QueryEngineBase, frontier_activity
+from ..ops.engine import (
+    QueryEngineBase,
+    axis_tokens,
+    engine_label,
+    frontier_activity,
+)
+from ..ops.lowk import _lowk_counts, lowk_pack
+from ..ops.mxu import (
+    AUTO_SWITCH_DIVISOR,
+    densify_pairs,
+    resolve_tile,
+    tile_matmul_hits,
+)
 from ..ops.push import compact_indices
 from ..ops.streamed import (
     _extend,
@@ -143,6 +155,7 @@ from ..utils.timing import (
     record_collective_bytes,
     record_collective_rounds,
     record_dispatch,
+    record_mxu_tiles,
 )
 from .mesh import COL_AXIS, ROW_AXIS, make_mesh2d
 from .sharded_bell import harmonize_forests
@@ -226,15 +239,19 @@ def select_merge_tree(c_size: int, override: Optional[str] = None) -> str:
 
 
 def level_collective_bytes(
-    rows: int, cols: int, lsub: int, words: int, tree: str
+    rows: int, cols: int, lsub: int, words: int, tree: str,
+    itemsize: int = 4,
 ) -> int:
     """Whole-mesh wire payload ONE dense 2D level moves (the analytic
     quantity utils.timing.record_collective_bytes accounts): every device
     receives (R-1) segments in the row-axis frontier gather plus the
     tree's col-axis reduce-scatter traffic — (C-1)*Lsub words on
     ring/halving (``pipelined`` stripes the same ring hops, identical
-    bytes), (C-1)*Lr on the one-shot gather-and-fold."""
-    seg = lsub * words * 4
+    bytes), (C-1)*Lr on the one-shot gather-and-fold.  ``itemsize`` is
+    the plane element width: 4 for uint32 bit / int32 neg planes, 1 for
+    the low-K byte planes (plane="byte") — the whole point of riding
+    K <= 4 byte flags on the mesh wire."""
+    seg = lsub * words * itemsize
     r_recv = (rows - 1) * seg
     if tree in ("ring", "halving", "pipelined"):
         c_recv = (cols - 1) * seg
@@ -433,6 +450,111 @@ class Partition2D:
         )
 
 
+def mesh_tile_arrays(
+    part: Partition2D, g: CSRGraph, tile: Optional[int] = None,
+    max_tiles: Optional[int] = None,
+):
+    """Per-device MXU tile stacks for the mesh matmul kernel
+    (kernel="mxu"): every (i, j) tile CSR is densified over the shared
+    square (Lt, Lt) space (ops.mxu.densify_pairs — the rectangular cut's
+    row/col coordinate spaces differ, so MxuGraph.from_host's dedup
+    would eat real edges) and harmonized to ONE nonzero-tile count
+    ``nt_max`` by appending all-zero blocks at the grid's last
+    (ntr-1, ntr-1) slot — sorted order is preserved (that tid is the
+    maximum) and zero tiles contribute nothing to the segment sum, so
+    all R*C devices run one SPMD matmul program.  Returns
+    ``(arrays, ntr, nt_max)`` with ``arrays`` a dict of NumPy leaves
+    shaped (R, C, nt_max, T, T) int8 / (R, C, nt_max) int32, ready for
+    P('r', 'c') placement next to the forest.  Raises ValueError when
+    the harmonized total R*C*nt_max exceeds ``max_tiles``
+    (MSBFS_MXU_MAX_TILES) — same fail-loud densification ceiling as the
+    single-chip engine."""
+    tile = resolve_tile(tile)
+    if max_tiles is None:
+        max_tiles = knobs.get_int("MSBFS_MXU_MAX_TILES", 0) or (1 << 15)
+    lt = part.lt
+    ntr = max(1, -(-lt // tile))
+    per = []
+    nt_max = 1  # >= 1 so the stacked arrays never have a zero axis
+    for i in range(part.rows):
+        for j in range(part.cols):
+            tcsr = part._tile_csr(g, i, j)
+            ro = np.asarray(tcsr.row_offsets, dtype=np.int64)
+            u = np.repeat(
+                np.arange(lt, dtype=np.int64), np.diff(ro)
+            )
+            v = np.asarray(tcsr.col_indices, dtype=np.int64)
+            tiles, trow, tcol = densify_pairs(u, v, tile, ntr)
+            per.append((tiles, trow, tcol))
+            nt_max = max(nt_max, tiles.shape[0])
+    total = part.rows * part.cols * nt_max
+    if total > max_tiles:
+        raise ValueError(
+            f"mesh mxu densification needs {total} harmonized "
+            f"{tile}x{tile} tiles over {part.rows}x{part.cols} devices "
+            f"(> MSBFS_MXU_MAX_TILES={max_tiles}): graph too tile-dense "
+            "for the mesh MXU kernel; use kernel=xla"
+        )
+    stacks = {"tiles": [], "tile_row": [], "tile_col": []}
+    last = np.int32(ntr - 1)
+    for tiles, trow, tcol in per:
+        pad = nt_max - tiles.shape[0]
+        if pad:
+            tiles = np.concatenate(
+                [tiles, np.zeros((pad, tile, tile), np.int8)]
+            )
+            trow = np.concatenate([trow, np.full(pad, last, np.int32)])
+            tcol = np.concatenate([tcol, np.full(pad, last, np.int32)])
+        stacks["tiles"].append(tiles)
+        stacks["tile_row"].append(trow)
+        stacks["tile_col"].append(tcol)
+    arrays = {
+        k: np.stack(v).reshape(
+            part.rows, part.cols, *v[0].shape
+        )
+        for k, v in stacks.items()
+    }
+    return arrays, ntr, nt_max
+
+
+def _mxu_mesh_hits_fn(
+    mt, local: BellGraph, lr: int, ntr: int, tile: int, switch: int
+):
+    """The mesh matmul kernel's padded-block -> (hits, units) hook for
+    :func:`_mesh2d_expand_wire`: a mesh-UNIFORM direction switch (pmax
+    over both axes, the sparse-wire predicate pattern) routes dense
+    levels through ops.mxu.tile_matmul_hits on this device's harmonized
+    tile stack and thin levels through the BELL pull — every device takes
+    the same branch, so the int64 ``units`` ledger (nonzero-tile products
+    issued this level, 0 on pull levels) stays replicated like the other
+    carry scalars."""
+    n_pad_t = ntr * tile
+    nt = int(mt["tiles"].shape[0])
+
+    def hits_fn(block):
+        active = (block != 0).any(axis=1)
+        cnt = jnp.sum(active, dtype=jnp.int32)
+        use_mm = (
+            lax.pmax(cnt, (ROW_AXIS, COL_AXIS)) > jnp.int32(switch)
+        )
+
+        def mm(b):
+            if n_pad_t > b.shape[0]:
+                b = jnp.pad(b, ((0, n_pad_t - b.shape[0]), (0, 0)))
+            return tile_matmul_hits(
+                mt["tiles"], mt["tile_row"], mt["tile_col"], ntr, b
+            )[:lr]
+
+        def pull(b):
+            return bell_hits_or(b, local)[:lr]
+
+        hits = lax.cond(use_mm, mm, pull, block)
+        units = jnp.where(use_mm, jnp.int64(nt), jnp.int64(0))
+        return hits, units
+
+    return hits_fn
+
+
 def _merge_op(op: str):
     """The reduce-scatter combine for one static merge semiring: ``or``
     (uint32 bit planes — the synchronous schedule) or ``max`` (int32
@@ -607,19 +729,35 @@ def _pipelined_own_hits(
 
 
 def _mesh2d_expand_wire(
-    local: BellGraph, rows: int, cols: int, lsub: int, tree: str, wire
+    local: BellGraph, rows: int, cols: int, lsub: int, tree: str, wire,
+    plane: str = "bit", hits_fn=None,
 ):
     """The wire-format-aware 2D expansion: (visited_own, frontier_own) ->
     (newly-reached own planes, this level's whole-mesh wire bytes, the
-    sparse-level flag).  ``wire`` = (sparse pair budget, pipelined stripe
-    count), both static.  Every route is bit-identical — only the wire
-    schedule and the byte ledger differ; the predicates are mesh-uniform
-    pmax reductions, so the branch choice and the recorded counters stay
-    replicated (the P() out-spec contract of the drive loop)."""
+    sparse-level flag, the kernel-unit count).  ``wire`` = (sparse pair
+    budget, pipelined stripe count), both static.  ``plane`` sets the
+    wire accounting element width (uint32 bit planes vs the low-K uint8
+    byte flags — the collective legs, forest pass and carry fold are all
+    dtype-generic, so ONLY the byte ledger changes).  ``hits_fn`` maps
+    one padded (Lt, W) col-block to ``(hits[:Lr], units)`` — None is the
+    BELL pull with units 0; the mesh MXU kernel passes
+    :func:`_mxu_mesh_hits_fn`.  Every route is bit-identical — only the
+    wire schedule and the byte ledger differ; the predicates are
+    mesh-uniform pmax reductions, so the branch choice and the recorded
+    counters stay replicated (the P() out-spec contract of the drive
+    loop)."""
     budget, n_stripes = wire
     lc = rows * lsub
     lr = cols * lsub
     lt = local.n
+    itemsize = 1 if plane == "byte" else 4
+    # One sparse wire entry = 4-byte flat index + the plane element.
+    pair_bytes = 4 + itemsize
+
+    if hits_fn is None:
+
+        def hits_fn(block):  # noqa: F811 - the default hook
+            return bell_hits_or(block, local)[:lr], jnp.int64(0)
 
     def pad_block(colblock):
         if lt > lc:
@@ -628,25 +766,37 @@ def _mesh2d_expand_wire(
 
     def dense_own(frontier_own):
         if tree == "pipelined" and n_stripes > 1:
-            return _pipelined_own_hits(
-                frontier_own, local, rows, cols, lsub, n_stripes
+            # The striped schedule keeps the plain forest pass: stripes
+            # are word-column slices, which the tile matmul does not
+            # split over (kernel="mxu" gates pipelined off at the ctor).
+            return (
+                _pipelined_own_hits(
+                    frontier_own, local, rows, cols, lsub, n_stripes
+                ),
+                jnp.int64(0),
             )
         colblock = lax.all_gather(frontier_own, ROW_AXIS, tiled=True)
-        hits = bell_hits_or(pad_block(colblock), local)[:lr]
+        hits, units = hits_fn(pad_block(colblock))
         # A single-stripe "pipelined" plane degenerates to the ring tree.
-        return _or_reduce_scatter(
-            hits, cols, lsub, "ring" if tree == "pipelined" else tree
+        return (
+            _or_reduce_scatter(
+                hits, cols, lsub, "ring" if tree == "pipelined" else tree
+            ),
+            units,
         )
 
     def expand(visited_own, frontier_own):
         w = frontier_own.shape[1]
-        dense_bytes = level_collective_bytes(rows, cols, lsub, w, tree)
+        dense_bytes = level_collective_bytes(
+            rows, cols, lsub, w, tree, itemsize
+        )
         if budget <= 0 or rows * cols == 1:
-            new = dense_own(frontier_own) & ~visited_own
-            return new, jnp.int64(dense_bytes), jnp.int32(0)
+            own, units = dense_own(frontier_own)
+            new = own & ~visited_own
+            return new, jnp.int64(dense_bytes), jnp.int32(0), units
 
-        seg_bytes = lsub * w * 4
-        pair = budget * WIRE_PAIR_BYTES
+        seg_bytes = lsub * w * itemsize
+        pair = budget * pair_bytes
         row_sparse = rows * cols * (rows - 1) * pair
         col_sparse = rows * cols * (cols - 1) * pair
         col_dense_tree = "ring" if tree == "pipelined" else tree
@@ -661,7 +811,7 @@ def _mesh2d_expand_wire(
                 if rows == 1
                 else _sparse_row_gather(frontier_own, rows, lsub, budget)
             )
-            hits = bell_hits_or(pad_block(colblock), local)[:lr]
+            hits, units = hits_fn(pad_block(colblock))
             if cols == 1:
                 own = hits
                 col_bytes = jnp.int64(0)
@@ -699,12 +849,13 @@ def _mesh2d_expand_wire(
                     else col_ok.astype(jnp.int32)  # R==1: only the col leg
                 )
             new = own & ~visited_own
-            return new, jnp.int64(row_sparse) + col_bytes, flag
+            return new, jnp.int64(row_sparse) + col_bytes, flag, units
 
         def dense_path(args):
             visited_own, frontier_own = args
-            new = dense_own(frontier_own) & ~visited_own
-            return new, jnp.int64(dense_bytes), jnp.int32(0)
+            own, units = dense_own(frontier_own)
+            new = own & ~visited_own
+            return new, jnp.int64(dense_bytes), jnp.int32(0), units
 
         sparse_ok = (
             lax.pmax(
@@ -720,11 +871,12 @@ def _mesh2d_expand_wire(
 
 
 def _wire_level_chunk(carry, expand_wire, chunk, max_levels, counts_of):
-    """ops.bitbell.bit_level_chunk over the 9-slot mesh carry — the
+    """ops.bitbell.bit_level_chunk over the 10-slot mesh carry — the
     shared 7-tuple level loop plus the wire ledger: slot 7 accumulates
     each level's whole-mesh wire bytes (the branch the density cond
     ACTUALLY took — measured, not modeled), slot 8 counts the levels the
-    sparse encoding carried."""
+    sparse encoding carried, slot 9 the kernel units (per-device tile
+    products the MXU direction issued; 0 on every XLA route)."""
     start = carry[5]
 
     def cond(c):
@@ -734,28 +886,38 @@ def _wire_level_chunk(carry, expand_wire, chunk, max_levels, counts_of):
         return go
 
     def body(c):
-        new, lvl_bytes, sparse = expand_wire(c[0], c[1])
+        new, lvl_bytes, sparse, units = expand_wire(c[0], c[1])
         return bit_level_apply(c[:7], new, counts_of) + (
             c[7] + lvl_bytes,
             c[8] + sparse,
+            c[9] + units,
         )
 
     return lax.while_loop(cond, body, carry)
 
 
-@partial(jax.jit, static_argnames=("mesh", "lsub"))
-def _mesh2d_init(mesh: Mesh, queries: jax.Array, lsub: int):
+@partial(jax.jit, static_argnames=("mesh", "lsub", "plane"))
+def _mesh2d_init(mesh: Mesh, queries: jax.Array, lsub: int,
+                 plane: str = "bit"):
     """Per-device own-segment loop carry: planes (Lsub, W) split over
     ('c','r')-major segments; counters replicated on the whole mesh (the
     per-level psum spans both axes, so no finish-time merge exists).
     Slots 7/8 are the wire ledger — int64 bytes moved, int32 sparse
-    levels — shared by both residencies."""
+    levels — and slot 9 the int64 kernel-unit ledger, shared by both
+    residencies.  ``plane`` picks the frontier layout: the uint32 bit
+    packing (W = Kpad/32 lanes) or the low-K uint8 byte flags (W = Kpad
+    lanes, ops.lowk.lowk_pack) — everything downstream of the packing is
+    layout-generic."""
     rows = mesh.shape[ROW_AXIS]
     n_pad = rows * mesh.shape[COL_AXIS] * lsub
 
     def shard_body(queries):
-        frontier0 = pack_queries(n_pad, queries)
-        counts0 = unpack_counts(frontier0)
+        if plane == "byte":
+            frontier0 = lowk_pack(n_pad, queries)
+            counts0 = _lowk_counts(frontier0)
+        else:
+            frontier0 = pack_queries(n_pad, queries)
+            counts0 = unpack_counts(frontier0)
         i = lax.axis_index(ROW_AXIS)
         j = lax.axis_index(COL_AXIS)
         seg = j * rows + i
@@ -763,40 +925,62 @@ def _mesh2d_init(mesh: Mesh, queries: jax.Array, lsub: int):
         return bit_level_init(own0, counts0) + (
             jnp.int64(0),
             jnp.int32(0),
+            jnp.int64(0),
         )
 
     return jax.shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(P(),),
-        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 7,
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 8,
     )(queries)
 
 
 @partial(
-    jax.jit, static_argnames=("mesh", "lsub", "max_levels", "tree", "wire")
+    jax.jit,
+    static_argnames=(
+        "mesh", "lsub", "max_levels", "tree", "wire", "plane", "mxu"
+    ),
 )
 def _mesh2d_chunk(
-    mesh: Mesh, forest, carry, chunk, lsub: int, max_levels, tree: str, wire
+    mesh: Mesh, forest, mxu_tiles, carry, chunk, lsub: int, max_levels,
+    tree: str, wire, plane: str = "bit", mxu=None,
 ):
     """Advance every device's own-segment carry by <= ``chunk`` levels in
     one dispatch.  Per-level discovery counts psum over BOTH mesh axes
     (each segment counted exactly once), so the loop counters — and the
     convergence flag the host loop syncs — are replicated mesh-wide.
     ``wire`` is the static (sparse budget, stripe count) pair keying the
-    compiled wire schedule."""
+    compiled wire schedule; ``plane`` the frontier layout; ``mxu`` the
+    static (ntr, tile, switch) triple enabling the tensor-core direction
+    over ``mxu_tiles`` (an EMPTY dict — no leaves — on the XLA kernel,
+    so the compiled signature stays shared)."""
     rows = mesh.shape[ROW_AXIS]
     cols = mesh.shape[COL_AXIS]
 
-    def shard_body(forest, *carry):
+    def shard_body(forest, mxu_tiles, *carry):
         local = jax.tree.map(lambda x: x[0, 0], forest)
+        if mxu is not None:
+            ntr, tile, switch = mxu[:3]
+            mt = {k: v[0, 0] for k, v in mxu_tiles.items()}
+            hits_fn = _mxu_mesh_hits_fn(
+                mt, local, cols * lsub, ntr, tile, switch
+            )
+        else:
+            hits_fn = None
+        if plane == "byte":
+            counts = _lowk_counts
+        else:
+            counts = unpack_counts
         out = _wire_level_chunk(
             carry,
-            _mesh2d_expand_wire(local, rows, cols, lsub, tree, wire),
+            _mesh2d_expand_wire(
+                local, rows, cols, lsub, tree, wire, plane, hits_fn
+            ),
             chunk,
             max_levels,
             counts_of=lambda new: lax.psum(
-                unpack_counts(new), (ROW_AXIS, COL_AXIS)
+                counts(new), (ROW_AXIS, COL_AXIS)
             ),
         )
         return out + (out[6].astype(jnp.int32), out[5])
@@ -804,11 +988,11 @@ def _mesh2d_chunk(
     return jax.shard_map(
         shard_body,
         mesh=mesh,
-        in_specs=(P(ROW_AXIS, COL_AXIS),)
+        in_specs=(P(ROW_AXIS, COL_AXIS), P(ROW_AXIS, COL_AXIS))
         + (_PLANE_SPEC,) * 2
-        + (P(),) * 7,
-        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 9,
-    )(forest, *carry)
+        + (P(),) * 8,
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 10,
+    )(forest, mxu_tiles, *carry)
 
 
 def _mesh2d_run_chunked(
@@ -820,6 +1004,9 @@ def _mesh2d_run_chunked(
     level_chunk: int,
     tree: str,
     wire,
+    plane: str = "bit",
+    mxu=None,
+    mxu_tiles=None,
 ):
     """Host-chunked 2D drive loop: bounded per-dispatch work (the same
     high-diameter safety contract as every chunked engine) AND the
@@ -828,13 +1015,19 @@ def _mesh2d_run_chunked(
     per level, not an analytic constant.  The per-iteration
     ``trip("dispatch")`` is the chip-loss fault seam: an injected
     mid-drive device loss surfaces here, between level chunks, exactly
-    where a real ICI failure would."""
-    carry = _mesh2d_init(mesh, queries, lsub)
+    where a real ICI failure would.  Under ``mxu`` the carry's
+    kernel-unit slot feeds utils.timing.record_mxu_tiles — measured
+    issued tile products (harmonized stacks included), mesh-wide."""
+    rows = mesh.shape[ROW_AXIS]
+    cols = mesh.shape[COL_AXIS]
+    lanes = int(queries.shape[0])
+    carry = _mesh2d_init(mesh, queries, lsub, plane)
     bound = np.int32(level_chunk)
-    prev_bytes = prev_levels = 0
+    prev_bytes = prev_levels = prev_units = 0
     while True:
         *carry, any_up, max_level = _mesh2d_chunk(
-            mesh, forest, tuple(carry), bound, lsub, max_levels, tree, wire
+            mesh, forest, mxu_tiles if mxu_tiles is not None else {},
+            tuple(carry), bound, lsub, max_levels, tree, wire, plane, mxu,
         )
         record_dispatch()
         trip("dispatch")
@@ -847,6 +1040,23 @@ def _mesh2d_run_chunked(
         lvl = int(np.asarray(carry[5]))
         record_collective_rounds(max(0, lvl - prev_levels))
         prev_levels = lvl
+        if mxu is not None:
+            ntr, tile, _, nt_max = mxu
+            units = int(np.asarray(carry[9]))
+            du = units - prev_units
+            prev_units = units
+            if du > 0:
+                # du = (matmul levels this chunk) * nt_max: every device
+                # issues the same harmonized stack, so mesh-wide issued
+                # products are du * R * C, and each matmul device-level
+                # skipped the (ntr^2 - nt_max) zero tiles of its grid.
+                p = rows * cols
+                levels_mm = du // max(1, nt_max)
+                record_mxu_tiles(
+                    du * p * 2 * tile * tile * lanes,
+                    levels_mm * p * (ntr * ntr - nt_max),
+                    levels_mm * p * ntr * ntr,
+                )
         if not int(np.asarray(any_up)):
             break
         if max_levels is not None and int(np.asarray(max_level)) >= max_levels:
@@ -1117,7 +1327,7 @@ def _mesh2d_async_chunk(
 
 @partial(jax.jit, static_argnames=("mesh", "lsub"))
 def _mesh2d_async_finalize(mesh: Mesh, neg, wire_bytes, sparse_rounds, lsub):
-    """Fold the quiesced neg planes into the synchronous drive's 9-slot
+    """Fold the quiesced neg planes into the synchronous drive's 10-slot
     carry so every downstream consumer (f_values, query_stats, best, the
     certify audit) reads the async result through the identical seam.
     The arithmetic mirrors ops.bitbell.bit_level_init/apply exactly:
@@ -1150,13 +1360,14 @@ def _mesh2d_async_finalize(mesh: Mesh, neg, wire_bytes, sparse_rounds, lsub):
             jnp.bool_(False),
             wb,
             sp,
+            jnp.int64(0),  # kernel units: the async drive is XLA-only
         )
 
     return jax.shard_map(
         shard_body,
         mesh=mesh,
         in_specs=(_PLANE_SPEC, P(), P()),
-        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 7,
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 8,
     )(neg, wire_bytes, sparse_rounds)
 
 
@@ -1217,17 +1428,25 @@ def _mstream_empty(mesh: Mesh, like):
     )(like)
 
 
-@partial(jax.jit, static_argnames=("mesh", "lsub", "tree"))
-def _mstream_apply(mesh: Mesh, final_slot, carry, outs, lsub: int, tree: str):
+@partial(jax.jit, static_argnames=("mesh", "lsub", "tree", "plane"))
+def _mstream_apply(
+    mesh: Mesh, final_slot, carry, outs, lsub: int, tree: str,
+    plane: str = "bit",
+):
     """Streamed-residency leg C: final-slot gather over the accumulated
     forest-level outputs, the col-axis OR-reduce-scatter, and the shared
     carry fold (ops.bitbell.bit_level_apply) — plus the wire ledger and
     the host loop's [level, updated, bytes] status row in ONE fetchable
-    buffer, so the per-level host sync stays a single blocking read."""
+    buffer, so the per-level host sync stays a single blocking read.
+    ``plane`` only switches the discovery counts and the byte accounting
+    (uint8 flags move 1/4 the dense leg bytes); the fold machinery is
+    dtype-generic."""
     rows = mesh.shape[ROW_AXIS]
     cols = mesh.shape[COL_AXIS]
     lr = cols * lsub
     n_carry = len(carry)
+    itemsize = 1 if plane == "byte" else 4
+    counts = _lowk_counts if plane == "byte" else unpack_counts
 
     def body(final_slot, *args):
         c = args[:n_carry]
@@ -1241,15 +1460,15 @@ def _mstream_apply(mesh: Mesh, final_slot, carry, outs, lsub: int, tree: str):
         # nothing once uploads dominate), so the ledger adds the
         # analytic constant and the sparse counter stays put.
         lvl_bytes = level_collective_bytes(
-            rows, cols, lsub, new.shape[1], tree
+            rows, cols, lsub, new.shape[1], tree, itemsize
         )
         out = bit_level_apply(
             c[:7],
             new,
             counts_of=lambda p: lax.psum(
-                unpack_counts(p), (ROW_AXIS, COL_AXIS)
+                counts(p), (ROW_AXIS, COL_AXIS)
             ),
-        ) + (c[7] + jnp.int64(lvl_bytes), c[8])
+        ) + (c[7] + jnp.int64(lvl_bytes), c[8], c[9])
         status = jnp.stack(
             [
                 out[5].astype(jnp.int64),
@@ -1264,9 +1483,9 @@ def _mstream_apply(mesh: Mesh, final_slot, carry, outs, lsub: int, tree: str):
         mesh=mesh,
         in_specs=(P(ROW_AXIS, COL_AXIS),)
         + (_PLANE_SPEC,) * 2
-        + (P(),) * 7
+        + (P(),) * 8
         + (_TILE_SPEC,) * len(outs),
-        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 8,
+        out_specs=(_PLANE_SPEC,) * 2 + (P(),) * 9,
     )(final_slot, *carry, *outs)
 
 
@@ -1423,8 +1642,17 @@ class Mesh2DEngine(QueryEngineBase):
     reconciling exchange round, ``async`` capability token); the result
     is bit-identical to the synchronous schedule by the quiet-round
     termination argument (docs/MULTIHOST.md "Asynchronous rounds").
-    ``w`` is the device count — the supervisor's rebuild cap and
-    survivor accounting read it like every engine."""
+    ``plane`` overrides MSBFS_MESH_PLANE — ``bit`` (uint32 packed, the
+    default) or ``byte`` (the low-K uint8 flags of ops.lowk riding the
+    mesh wire: K <= 4 queries ship n*K bytes per collective leg instead
+    of word-padded planes).  ``kernel`` overrides MSBFS_MESH_KERNEL —
+    ``xla`` (the BELL forest pull) or ``mxu`` (per-device harmonized
+    tile stacks driving ops.mxu.tile_matmul_hits with a mesh-uniform
+    per-level direction switch).  Compositions no engine supports fail
+    loud at construction: byte x mxu, byte x async, mxu x streamed,
+    mxu x async, mxu x pipelined.  ``w`` is the device count — the
+    supervisor's rebuild cap and survivor accounting read it like every
+    engine."""
 
     CAPABILITIES = frozenset(
         {
@@ -1434,10 +1662,21 @@ class Mesh2DEngine(QueryEngineBase):
             "collective_bytes",
             "streamed",
             "async",
+            # Lattice axis tokens (ops.engine.resolve_axes): the values
+            # this ONE class composes — an engine is a configuration.
+            "partition:mesh2d",
+            "plane:bit",
+            "plane:byte",
+            "residency:hbm",
+            "residency:streamed",
+            "kernel:xla",
+            "kernel:mxu",
         }
     )
 
     RESIDENCIES = ("hbm", "streamed")
+    PLANES = ("bit", "byte")
+    KERNELS = ("xla", "mxu")
 
     def __init__(
         self,
@@ -1452,6 +1691,8 @@ class Mesh2DEngine(QueryEngineBase):
         wire_sparse: Union[None, int, str] = None,
         wire_chunks: Optional[int] = None,
         async_levels: Optional[int] = None,
+        plane: Optional[str] = None,
+        kernel: Optional[str] = None,
     ):
         if ROW_AXIS not in mesh.shape or COL_AXIS not in mesh.shape:
             raise ValueError(
@@ -1504,11 +1745,56 @@ class Mesh2DEngine(QueryEngineBase):
                 else knobs.get_int("MSBFS_ASYNC_LEVELS", 1)
             ),
         )
+        pl = (
+            plane
+            if plane is not None
+            else (knobs.raw("MSBFS_MESH_PLANE") or "bit")
+        )
+        pl = str(pl).strip().lower() or "bit"
+        if pl not in self.PLANES:
+            raise ValueError(f"mesh plane {pl!r} not in {self.PLANES}")
+        self.plane = pl
+        kn = (
+            kernel
+            if kernel is not None
+            else (knobs.raw("MSBFS_MESH_KERNEL") or "xla")
+        )
+        kn = str(kn).strip().lower() or "xla"
+        if kn not in self.KERNELS:
+            raise ValueError(f"mesh kernel {kn!r} not in {self.KERNELS}")
+        self.kernel = kn
+        # Lattice gates: compositions no arm of the class supports fail
+        # loud HERE, naming both axis values — never a silent fallback.
+        if pl == "byte" and kn == "mxu":
+            raise ValueError(
+                "plane:byte does not compose with kernel:mxu — the tile "
+                "matmul consumes packed bit planes"
+            )
+        if pl == "byte" and self.async_levels > 1:
+            raise ValueError(
+                "plane:byte does not compose with async (bounded-staleness"
+                " drive reconciles packed bit planes)"
+            )
+        if kn == "mxu" and res == "streamed":
+            raise ValueError(
+                "kernel:mxu does not compose with residency:streamed — "
+                "tile stacks are HBM-resident"
+            )
+        if kn == "mxu" and self.async_levels > 1:
+            raise ValueError(
+                "kernel:mxu does not compose with async — the direction "
+                "switch needs the per-level reconciled frontier"
+            )
         self.part = Partition2D(
             graph, self.rows, self.cols, widths, min_bucket_rows,
             device=(res != "streamed"),
         )
         self.tree = select_merge_tree(self.cols, merge_tree)
+        if kn == "mxu" and self.tree == "pipelined":
+            raise ValueError(
+                "kernel:mxu does not compose with the pipelined merge "
+                "tree — the direction switch needs whole-row frontiers"
+            )
         self.max_levels = max_levels
         from ..ops.bfs import validate_level_chunk
 
@@ -1550,18 +1836,42 @@ class Mesh2DEngine(QueryEngineBase):
                 self.part.stacked,
                 NamedSharding(mesh, P(ROW_AXIS, COL_AXIS)),
             )
+        if kn == "mxu":
+            arrays, ntr, nt_max = mesh_tile_arrays(self.part, graph)
+            self._mxu_tiles = {
+                name: jax.device_put(
+                    arr, NamedSharding(mesh, P(ROW_AXIS, COL_AXIS))
+                )
+                for name, arr in arrays.items()
+            }
+            tile = int(arrays["tiles"].shape[-1])
+            env = knobs.raw("MSBFS_MXU_SWITCH")
+            switch = (
+                int(env)
+                if env
+                else max(1, self.part.lt // AUTO_SWITCH_DIVISOR)
+            )
+            self._mxu = (ntr, tile, switch, nt_max)
+        else:
+            self._mxu_tiles = {}
+            self._mxu = None
 
     # ---- query prep -------------------------------------------------------
     def _prep(self, queries: np.ndarray):
         """Bounds-remap vs the TRUE vertex count (ids in [n, n_pad) would
         hit phantom padding vertices — same rationale as the 1D engine)
-        and right-pad K to a multiple of 32 with inert -1 rows."""
+        and right-pad K to a multiple of 32 with inert -1 rows.  Byte
+        planes carry one uint8 lane per query, so they only pad the
+        degenerate K = 0 batch (one inert lane keeps shapes non-empty)."""
         queries = np.asarray(queries)
         queries = np.where(
             (queries >= 0) & (queries < self.n), queries, -1
         ).astype(np.int32)
         k = queries.shape[0]
-        pad = (-k) % 32 if k else 32  # K = 0 still needs one plane word
+        if self.plane == "byte":
+            pad = 0 if k else 1
+        else:
+            pad = (-k) % 32 if k else 32  # K = 0 still needs a plane word
         if pad:
             queries = np.vstack(
                 [queries, np.full((pad, queries.shape[1]), -1, np.int32)]
@@ -1573,7 +1883,14 @@ class Mesh2DEngine(QueryEngineBase):
     def level_bytes(self, k: int) -> int:
         """Analytic whole-mesh DENSE wire bytes per level for a K-query
         batch — the model the sparse wire's measured ledger is judged
-        against (bench ``detail.multichip.wire.bytes_dense_model``)."""
+        against (bench ``detail.multichip.wire.bytes_dense_model``).
+        Byte planes ship K uint8 lanes per row instead of ceil(K/32)
+        uint32 words — the low-K collective diet, measured per leg."""
+        if self.plane == "byte":
+            return level_collective_bytes(
+                self.rows, self.cols, self.part.lsub, max(1, k),
+                self.tree, itemsize=1,
+            )
         words = -(-k // 32)
         return level_collective_bytes(
             self.rows, self.cols, self.part.lsub, words, self.tree
@@ -1582,7 +1899,10 @@ class Mesh2DEngine(QueryEngineBase):
     def _wire_of(self, kpad: int):
         """The static (sparse budget, stripe count) pair for a padded
         batch — part of the compiled chunk's cache key."""
-        words = max(1, kpad // 32)
+        if self.plane == "byte":
+            words = max(1, kpad)
+        else:
+            words = max(1, kpad // 32)
         budget = resolve_wire_budget(self._wire_spec, self.part.lsub, words)
         stripes = self.wire_chunks if self.tree == "pipelined" else 0
         return (budget, stripes)
@@ -1606,6 +1926,9 @@ class Mesh2DEngine(QueryEngineBase):
                 self.level_chunk,
                 self.tree,
                 self._wire_of(placed.shape[0]),
+                plane=self.plane,
+                mxu=self._mxu,
+                mxu_tiles=self._mxu_tiles,
             )
         return carry, k
 
@@ -1748,6 +2071,7 @@ class Mesh2DEngine(QueryEngineBase):
             outs,
             lsub,
             self.tree,
+            plane=self.plane,
         )
         return tuple(out), status
 
@@ -1756,7 +2080,9 @@ class Mesh2DEngine(QueryEngineBase):
         level (the apply's stacked [level, updated, bytes] row), the
         same convergence contract as the chunked drive, and the same
         ``trip("dispatch")`` chip-loss seam between levels."""
-        carry = _mesh2d_init(self.mesh, placed, self.part.lsub)
+        carry = _mesh2d_init(
+            self.mesh, placed, self.part.lsub, plane=self.plane
+        )
         status = np.asarray(_stream_status(carry[5], carry[6]))
         record_dispatch()
         prev_bytes = 0
@@ -1805,7 +2131,9 @@ class Mesh2DEngine(QueryEngineBase):
         wire = self._wire_of(placed.shape[0])
 
         def init():
-            return _mesh2d_init(self.mesh, placed, self.part.lsub)
+            return _mesh2d_init(
+                self.mesh, placed, self.part.lsub, plane=self.plane
+            )
 
         if self.residency == "streamed":
 
@@ -1819,12 +2147,15 @@ class Mesh2DEngine(QueryEngineBase):
                 *out, _, _ = _mesh2d_chunk(
                     self.mesh,
                     self.forest,
+                    self._mxu_tiles,
                     tuple(carry),
                     np.int32(1),
                     self.part.lsub,
                     self.max_levels,
                     self.tree,
                     wire,
+                    plane=self.plane,
+                    mxu=self._mxu,
                 )
                 return tuple(out)
 
@@ -1855,19 +2186,24 @@ class Mesh2DEngine(QueryEngineBase):
         # bit-identical to the synchronous ones.
         placed, k = self._prep(queries)
         wire = self._wire_of(placed.shape[0])
-        carry = _mesh2d_init(self.mesh, placed, self.part.lsub)
+        carry = _mesh2d_init(
+            self.mesh, placed, self.part.lsub, plane=self.plane
+        )
         levels: List[dict] = []
         prev_b = prev_s = 0
         while True:
             *carry, any_up, max_level = _mesh2d_chunk(
                 self.mesh,
                 self.forest,
+                self._mxu_tiles,
                 tuple(carry),
                 np.int32(1),
                 self.part.lsub,
                 self.max_levels,
                 self.tree,
                 wire,
+                plane=self.plane,
+                mxu=self._mxu,
             )
             record_dispatch()
             wb = int(np.asarray(carry[7]))
@@ -1933,4 +2269,29 @@ class Mesh2DEngine(QueryEngineBase):
             wire_sparse=self._wire_spec,
             wire_chunks=self.wire_chunks,
             async_levels=self.async_levels,
+            plane=self.plane,
+            kernel=self.kernel,
+        )
+
+    # ---- lattice identity -------------------------------------------------
+    @property
+    def axes(self) -> dict:
+        """The resolved lattice point this instance sits on — the single
+        source for labels, describe strings and bench detail keys."""
+        return {
+            "plane": self.plane,
+            "residency": self.residency,
+            "partition": "mesh2d",
+            "kernel": self.kernel,
+        }
+
+    @property
+    def label(self) -> str:
+        return engine_label(self.axes, async_levels=self.async_levels)
+
+    def describe(self) -> str:
+        toks = ", ".join(sorted(axis_tokens(self.axes)))
+        return (
+            f"{self.label}: {self.rows}x{self.cols} mesh, "
+            f"tree={self.tree}, {toks}"
         )

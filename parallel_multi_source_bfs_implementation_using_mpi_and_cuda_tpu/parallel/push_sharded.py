@@ -380,7 +380,17 @@ class ShardedPushEngine(QueryEngineBase):
     graphs, so the bound is always on.
     """
 
-    CAPABILITIES = frozenset({"query_sharded", "vertex_sharded"})
+    CAPABILITIES = frozenset(
+        {
+            "query_sharded",
+            "vertex_sharded",
+            # Lattice axes: owner-partitioned word push on a 1D shard.
+            "plane:word",
+            "residency:hbm",
+            "partition:1d",
+            "kernel:xla",
+        }
+    )
 
     def __init__(
         self,

@@ -722,7 +722,16 @@ class ShardedBellEngine(QueryEngineBase):
     ``push_budget`` (the in-block push edge budget)."""
 
     CAPABILITIES = frozenset(
-        {"query_sharded", "vertex_sharded", "collective_bytes"}
+        {
+            "query_sharded",
+            "vertex_sharded",
+            "collective_bytes",
+            # Lattice axes: bit planes on a 1D row shard.
+            "plane:bit",
+            "residency:hbm",
+            "partition:1d",
+            "kernel:xla",
+        }
     )
 
     def __init__(

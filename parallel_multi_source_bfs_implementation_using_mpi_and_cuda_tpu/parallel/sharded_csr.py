@@ -196,7 +196,17 @@ class ShardedEngine(QueryEngineBase):
     """Query execution with the CSR sharded over the 'v' mesh axis and
     queries round-robin over 'q' — the full ('q','v') mesh."""
 
-    CAPABILITIES = frozenset({"query_sharded", "vertex_sharded"})
+    CAPABILITIES = frozenset(
+        {
+            "query_sharded",
+            "vertex_sharded",
+            # Lattice axes: word distances on a 1D row shard.
+            "plane:word",
+            "residency:hbm",
+            "partition:1d",
+            "kernel:xla",
+        }
+    )
 
     def __init__(
         self,

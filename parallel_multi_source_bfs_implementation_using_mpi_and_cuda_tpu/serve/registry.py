@@ -191,6 +191,7 @@ def build_supervised_engine(graph, content_digest: Optional[str] = None) -> Chun
     backend = knobs.raw("MSBFS_BACKEND", "auto")
     ladder = []
     engine = None
+    label = "stencil"
     if backend == "stencil" or (
         backend == "auto"
         and _road_class(graph)
@@ -226,49 +227,77 @@ def build_supervised_engine(graph, content_digest: Optional[str] = None) -> Chun
             )
     if engine is not None:
         pass
-    elif backend in ("vmap", "csr"):
-        from ..ops.engine import Engine
-
-        engine = Engine(graph.to_device(), level_chunk=level_chunk)
-    elif backend == "mxu":
-        # Tensor-core route (ops.mxu): adjacency densified into per-tile
-        # blocks with the all-zero tiles skipped, direction-switched back
-        # to the gather push on thin frontiers.  The packed tile index is
-        # the route's only host preprocessing cost, so it rides the
-        # content-digest cache above: a warm reload of unchanged bytes
-        # re-registers without re-packing.  A forced backend=mxu tile-cap
-        # failure is the operator's routing error and raises (the stencil
-        # precedent).
-        from ..ops.mxu import MxuEngine
-
-        engine = MxuEngine(
-            _cached_mxu_graph(graph, content_digest),
-            level_chunk=level_chunk,
-            megachunk=megachunk,
-        )
-    elif backend == "lowk":
-        # Explicit low-K route (ops.lowk): serving buckets queries by
-        # shape, so an operator pinning a K <= 4 workload can serve the
-        # byte-flag planes; the auto route stays with bitbell because a
-        # served graph sees arbitrary K over its lifetime.
-        from ..models.bell import BellGraph
-        from ..ops.lowk import LowKEngine
-
-        engine = LowKEngine(
-            BellGraph.from_host(graph),
-            level_chunk=level_chunk,
-            megachunk=megachunk,
-        )
     else:
+        # The non-stencil routes go through the engine lattice
+        # (ops.engine.resolve_axes): the backend name resolves to axis
+        # tokens and negotiate_engine picks the first candidate class
+        # declaring them, so the served route label comes out of the
+        # negotiation — never hand-assigned per branch.  Candidate notes:
+        #   * mxu — adjacency densified into per-tile blocks (all-zero
+        #     tiles skipped), direction-switched back to the gather push
+        #     on thin frontiers.  The packed tile index rides the
+        #     content-digest cache above, so a warm reload of unchanged
+        #     bytes re-registers without re-packing; a forced
+        #     backend=mxu tile-cap failure is the operator's routing
+        #     error and raises (the stencil precedent).
+        #   * lowk — serving buckets queries by shape, so an operator
+        #     pinning a K <= 4 workload can serve the byte-flag planes;
+        #     the auto route stays with bitbell because a served graph
+        #     sees arbitrary K over its lifetime.
+        #   * vmap/csr — the generic word-plane per-query pull.
+        # Backends with no served variant (push/packed/dense/streamed/
+        # pallas) keep the historical bitbell fallback.
         from ..models.bell import BellGraph
         from ..ops.bitbell import BitBellEngine
+        from ..ops.engine import Engine, negotiate_engine, resolve_axes
+        from ..ops.lowk import LowKEngine
+        from ..ops.mxu import MxuEngine
 
-        engine = BitBellEngine(
-            BellGraph.from_host(graph),
-            level_chunk=level_chunk,
-            megachunk=megachunk,
+        routed = backend if backend in ("vmap", "mxu", "lowk") else (
+            "vmap" if backend == "csr" else "bitbell"
         )
-        ladder = _bitbell_ladder(graph, level_chunk)
+        _, required = resolve_axes(routed)
+        label, engine = negotiate_engine(
+            required,
+            [
+                (
+                    "bitbell",
+                    BitBellEngine,
+                    lambda: BitBellEngine(
+                        BellGraph.from_host(graph),
+                        level_chunk=level_chunk,
+                        megachunk=megachunk,
+                    ),
+                ),
+                (
+                    "lowk",
+                    LowKEngine,
+                    lambda: LowKEngine(
+                        BellGraph.from_host(graph),
+                        level_chunk=level_chunk,
+                        megachunk=megachunk,
+                    ),
+                ),
+                (
+                    "mxu",
+                    MxuEngine,
+                    lambda: MxuEngine(
+                        _cached_mxu_graph(graph, content_digest),
+                        level_chunk=level_chunk,
+                        megachunk=megachunk,
+                    ),
+                ),
+                (
+                    "vmap",
+                    Engine,
+                    lambda: Engine(
+                        graph.to_device(), level_chunk=level_chunk
+                    ),
+                ),
+            ],
+        )
+        if label == "bitbell":
+            ladder = _bitbell_ladder(graph, level_chunk)
     # Output certification (MSBFS_AUDIT): the supervisor audits served
     # f_values against the host-CSR distance certificate and escalates —
     # retry, alternate rung, typed CorruptionError — before any
@@ -279,7 +308,7 @@ def build_supervised_engine(graph, content_digest: Optional[str] = None) -> Chun
         from ..ops.certify import make_auditor
 
         auditor = make_auditor(graph)
-    return ChunkSupervisor(
+    sup = ChunkSupervisor(
         engine,
         policy=RetryPolicy(
             max_retries=_env_int("MSBFS_RETRIES", 2),
@@ -291,6 +320,11 @@ def build_supervised_engine(graph, content_digest: Optional[str] = None) -> Chun
         auditor=auditor,
         audit_sample=sample,
     )
+    # Observability: the negotiated route label rides the supervisor so
+    # the registry's describe() can report WHICH lattice point serves
+    # each graph (entries built before this attribute report None).
+    sup.engine_label = label
+    return sup
 
 
 def build_supervised_weighted_engine(graph) -> ChunkSupervisor:
@@ -421,6 +455,7 @@ class GraphEntry:
             "n": int(self.graph.n),
             "directed_edges": int(self.graph.num_directed_edges),
             "weighted": bool(getattr(self.graph, "has_weights", False)),
+            "engine": getattr(self.supervisor, "engine_label", None),
             "loaded_at": round(self.loaded_at, 3),
         }
 

@@ -71,6 +71,8 @@ _ALL = (
     _k("MSBFS_WIRE_SPARSE", None, "spec", "2D engine sparse wire budget in (index, word) pairs: auto/unset = Lsub*W/8, 0/off = always dense, int = exact budget"),
     _k("MSBFS_WIRE_CHUNKS", "4", "int", "2D engine pipelined merge tree: word-plane stripes overlapped per level"),
     _k("MSBFS_MESH_RESIDENCY", "hbm", "str", "2D engine tile-forest residency: hbm (device-committed) / streamed (host RAM, double-buffered uploads)"),
+    _k("MSBFS_MESH_PLANE", "bit", "str", "2D engine plane layout: bit (packed uint32 words) / byte (low-K uint8 lanes, K bytes per row on the wire)"),
+    _k("MSBFS_MESH_KERNEL", "xla", "str", "2D engine expansion kernel: xla (BELL forest pull) / mxu (per-device tile matmul with direction switch)"),
     _k("MSBFS_ASYNC_LEVELS", "1", "int", "2D engine bounded-staleness drive: local relax steps per collective round; 1 = level-synchronous"),
     _k("MSBFS_VSHARD", "0", "int", "split the CSR over a 'v' mesh axis of this size at -gn > 1"),
     _k("MSBFS_HALO_BUDGET", None, "int", "vertex-sharded engine: compacted-halo threshold in own-frontier rows; 0 always dense"),

@@ -410,6 +410,47 @@ def _mesh2d_async_sparse(g):
     return Mesh2DEngine(make_mesh2d(2, 4), g, async_levels=4, wire_sparse=4096)
 
 
+def _mesh2d_byte(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+        Mesh2DEngine,
+    )
+
+    # Round-20 plane:byte x partition:mesh2d — the low-K uint8 lanes of
+    # ops.lowk riding the mesh wire (n*K bytes per collective leg).
+    return Mesh2DEngine(make_mesh2d(2, 4), g, plane="byte")
+
+
+def _mesh2d_mxu(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+        Mesh2DEngine,
+    )
+
+    # Round-20 kernel:mxu x partition:mesh2d — per-device harmonized
+    # tile stacks through ops.mxu.tile_matmul_hits with the mesh-uniform
+    # per-level direction switch.
+    return Mesh2DEngine(make_mesh2d(2, 4), g, kernel="mxu")
+
+
+def _mesh2d_byte_streamed(g):
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.mesh import (
+        make_mesh2d,
+    )
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+        Mesh2DEngine,
+    )
+
+    # Round-20 plane:byte x residency:streamed x partition:mesh2d — the
+    # three-axis composition: uint8 lanes, host-resident forest chunks
+    # streamed per level, mesh collectives.
+    return Mesh2DEngine(make_mesh2d(2, 4), g, plane="byte", residency="streamed")
+
+
 # The lowk drive-loop variants (chunked/megachunk) and the sub-batch
 # splitter are pinned against the oracle and the bit-plane reference in
 # tests/test_lowk.py; only the base byte-flag arm needs the full
@@ -446,6 +487,9 @@ ENGINES = {
     "mesh2d_streamed": _mesh2d_streamed,
     "mesh2d_async": _mesh2d_async,
     "mesh2d_async_sparse": _mesh2d_async_sparse,
+    "mesh2d_byte": _mesh2d_byte,
+    "mesh2d_mxu": _mesh2d_mxu,
+    "mesh2d_byte_streamed": _mesh2d_byte_streamed,
 }
 
 
@@ -478,9 +522,23 @@ def _arms(engines, slow):
     ]
 
 
+# Tier-1 keeps one mesh2d arm per lattice axis value (bit/byte plane,
+# xla/mxu kernel, hbm/streamed residency, sync/async drive); arms that
+# vary only the wire format or mesh shape are superseded and ride
+# `make multichip` instead.
 @pytest.mark.parametrize(
     "name",
-    _arms(ENGINES, slow={"mxu_chunked", "mesh2d_oneshot", "mesh2d_1x8"}),
+    _arms(
+        ENGINES,
+        slow={
+            "mxu_chunked",
+            "mesh2d_oneshot",
+            "mesh2d_1x8",
+            "mesh2d_ring",
+            "mesh2d_sparse",
+            "mesh2d_async_sparse",
+        },
+    ),
 )
 def test_engine_agrees(workload, name):
     g, padded, reference = workload
@@ -638,9 +696,11 @@ AUDIT_SLOW = {
     "mesh2d_ring",
     "mesh2d_oneshot",
     "mesh2d_1x8",
+    "mesh2d_sparse",
     "mesh2d_pipelined",
     "mesh2d_streamed",
     "mesh2d_async_sparse",
+    "mesh2d_byte_streamed",
 }
 
 

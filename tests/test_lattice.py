@@ -1,0 +1,326 @@
+"""Negotiation property sweep over the engine lattice (round 20).
+
+The engine stack is four orthogonal axes — plane (bit/byte/word),
+residency (hbm/streamed), partition (single/1d/mesh2d), kernel
+(xla/pallas/mxu) — and an engine is a *configuration* resolved by
+``ops.engine.resolve_axes`` + ``negotiate_engine`` from capability
+tokens.  This suite enumerates the FULL knob cross-product
+programmatically (backend x partition x residency x plane x kernel x
+async x weighted, including out-of-lattice values) and asserts every
+combination either resolves to a token set with the lattice invariants
+intact, or raises the *typed* fail-loud :class:`NegotiationError`
+naming the offending value / missing token — no silent fallback, no
+bare crash.
+"""
+
+import itertools
+
+import pytest
+
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.engine import (
+    AXES,
+    BACKEND_AXES,
+    BACKEND_EXTRAS,
+    Engine,
+    NegotiationError,
+    axis_tokens,
+    engine_label,
+    negotiate_engine,
+    resolve_axes,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.bitbell import (
+    BitBellEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.lowk import (
+    LowKEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.mxu import (
+    MxuEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.stencil import (
+    StencilEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.streamed import (
+    StreamedBitBellEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.ops.push import (
+    PushEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.partition2d import (
+    Mesh2DEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_bell import (
+    ShardedBellEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.sharded_csr import (
+    ShardedEngine,
+)
+from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.parallel.distributed import (
+    DistributedEngine,
+)
+
+# The axis-value pairs resolve_axes screens up front (mirrors
+# ops.engine._INCOMPATIBLE; duplicated here so a silent edit to either
+# side breaks this suite rather than passing unnoticed).
+FORBIDDEN_PAIRS = (
+    ("plane:byte", "kernel:mxu"),
+    ("plane:byte", "async"),
+    ("kernel:mxu", "residency:streamed"),
+    ("kernel:mxu", "async"),
+)
+
+# Tokens resolve_axes may demand beyond the four axis values.
+EXTRA_TOKENS = {"banded", "reshard", "async", "weighted"}
+
+
+def _registry():
+    """The full candidate registry, preference order, sentinel factories.
+
+    Factories return a sentinel instead of constructing (construction is
+    the expensive part and negotiation must not build losers — asserted
+    below), so the sweep exercises every real class's CAPABILITIES
+    declaration without ever touching a graph.
+    """
+    classes = [
+        ("bitbell", BitBellEngine),
+        ("lowk", LowKEngine),
+        ("mxu", MxuEngine),
+        ("stencil", StencilEngine),
+        ("streamed", StreamedBitBellEngine),
+        ("mesh2d", Mesh2DEngine),
+        ("sharded_bell", ShardedBellEngine),
+        ("sharded_csr", ShardedEngine),
+        ("distributed", DistributedEngine),
+        ("push", PushEngine),
+        ("vmap", Engine),
+    ]
+    return [
+        (label, cls, lambda label=label: ("sentinel", label))
+        for label, cls in classes
+    ]
+
+
+def _combos():
+    backends = sorted(BACKEND_AXES) + ["warp"]
+    partitions = list(AXES["partition"]) + ["torus3d"]
+    residencies = [None] + list(AXES["residency"]) + ["disk"]
+    planes = [None] + list(AXES["plane"]) + ["nibble"]
+    kernels = [None] + list(AXES["kernel"]) + ["cuda"]
+    return itertools.product(
+        backends, partitions, residencies, planes, kernels, (1, 3), (False, True)
+    )
+
+
+def test_negotiation_error_is_a_value_error():
+    # Every existing `except ValueError` fail-loud route keeps catching.
+    assert issubclass(NegotiationError, ValueError)
+
+
+def test_full_cross_product_resolves_or_fails_loud():
+    resolved = failed = 0
+    for backend, part, res, plane, kernel, alv, weighted in _combos():
+        try:
+            axes, required = resolve_axes(
+                backend,
+                partition=part,
+                residency=res,
+                plane=plane,
+                kernel=kernel,
+                async_levels=alv,
+                weighted=weighted,
+            )
+        except NegotiationError as e:
+            failed += 1
+            msg = str(e)
+            # The typed failure names the offending piece: an unknown
+            # backend/axis value, or the forbidden token pair.
+            assert (
+                "unknown" in msg or "no engine composes" in msg
+            ), f"untyped failure text for {backend}/{part}: {msg}"
+            continue
+        resolved += 1
+        # Lattice invariants on every successful resolution.
+        assert set(axes) == set(AXES)
+        for axis, value in axes.items():
+            assert value in AXES[axis], (axis, value)
+        required = frozenset(required)
+        assert required >= axis_tokens(axes)
+        assert required - axis_tokens(axes) <= EXTRA_TOKENS
+        # Explicit knobs override the backend default for that axis.
+        if res is not None:
+            assert axes["residency"] == res
+        if plane is not None:
+            assert axes["plane"] == plane
+        if kernel is not None:
+            assert axes["kernel"] == kernel
+        assert axes["partition"] == part
+        # Demand tokens follow the drive knobs.
+        assert ("async" in required) == (alv > 1)
+        assert ("weighted" in required) == weighted
+        if part == "mesh2d":
+            assert "reshard" in required
+        if backend in BACKEND_EXTRAS:
+            assert required >= BACKEND_EXTRAS[backend]
+        # No forbidden pair survives resolution.
+        for a, b in FORBIDDEN_PAIRS:
+            assert not (a in required and b in required), (backend, a, b)
+        # The label is derived from the tokens, always.
+        assert isinstance(engine_label(axes, async_levels=alv), str)
+    # The sweep actually exercised both arms.
+    assert resolved > 1000 and failed > 1000
+
+
+def test_every_resolving_combo_negotiates_or_names_missing_tokens():
+    registry = _registry()
+    winners = losses = 0
+    for backend, part, res, plane, kernel, alv, weighted in _combos():
+        try:
+            _, required = resolve_axes(
+                backend,
+                partition=part,
+                residency=res,
+                plane=plane,
+                kernel=kernel,
+                async_levels=alv,
+                weighted=weighted,
+            )
+        except NegotiationError:
+            continue
+        covering = [
+            label
+            for label, cls, _ in registry
+            if required <= frozenset(cls.CAPABILITIES)
+        ]
+        try:
+            label, engine = negotiate_engine(required, registry)
+        except NegotiationError as e:
+            losses += 1
+            assert not covering, (required, covering)
+            msg = str(e)
+            assert "no engine provides" in msg
+            # Every candidate's miss is named, with at least one of the
+            # demanded tokens in it.
+            for cand, _, _ in registry:
+                assert f"{cand} lacks" in msg
+            assert any(tok in msg for tok in sorted(required))
+        else:
+            winners += 1
+            # First-covering-candidate wins; losers never build.
+            assert covering and label == covering[0]
+            assert engine == ("sentinel", label)
+    assert winners > 100 and losses > 100
+
+
+def test_every_known_backend_negotiates_at_defaults():
+    # Each backend name, default knobs, single chip: someone on the
+    # registry must cover it — the lattice has no orphaned backend.
+    registry = _registry()
+    for backend in sorted(BACKEND_AXES):
+        _, required = resolve_axes(backend)
+        label, engine = negotiate_engine(required, registry)
+        assert engine == ("sentinel", label)
+
+
+def test_negotiation_never_builds_losers():
+    calls = []
+
+    def factory(label):
+        def build():
+            calls.append(label)
+            return ("sentinel", label)
+
+        return build
+
+    registry = [
+        (label, cls, factory(label))
+        for label, cls, _ in _registry()
+    ]
+    _, required = resolve_axes("lowk")  # plane:byte -> LowKEngine wins
+    label, _ = negotiate_engine(required, registry)
+    assert label == "lowk" and calls == ["lowk"]
+
+
+def test_unknown_backend_message_names_the_lattice():
+    with pytest.raises(NegotiationError, match="unknown backend 'warp'"):
+        resolve_axes("warp")
+
+
+def test_forbidden_pair_messages_name_both_tokens():
+    cases = [
+        (dict(backend="lowk", kernel="mxu"), "plane:byte with kernel:mxu"),
+        (dict(backend="lowk", async_levels=4), "plane:byte with async"),
+        (
+            dict(backend="mxu", residency="streamed"),
+            "kernel:mxu with residency:streamed",
+        ),
+        (dict(backend="mxu", async_levels=2), "kernel:mxu with async"),
+    ]
+    for kwargs, needle in cases:
+        with pytest.raises(NegotiationError) as ei:
+            resolve_axes(**kwargs)
+        assert needle in str(ei.value), (kwargs, str(ei.value))
+
+
+def test_no_winner_error_format_is_stable():
+    # serve/CLI operators grep for this exact shape; pin it.
+    class _A:
+        CAPABILITIES = frozenset({"plane:bit"})
+
+    class _B:
+        CAPABILITIES = frozenset()
+
+    with pytest.raises(NegotiationError) as ei:
+        negotiate_engine(
+            {"plane:bit", "reshard"},
+            [("a", _A, lambda: None), ("b", _B, lambda: None)],
+        )
+    assert str(ei.value) == (
+        "no engine provides {plane:bit, reshard}: "
+        "a lacks {reshard}; b lacks {plane:bit, reshard}"
+    )
+
+
+def test_labels_cover_the_named_engine_families():
+    # engine_label is the single source for label/describe/detail.*
+    # keys; pin the family names routing and bench depend on.
+    cases = [
+        (resolve_axes("bitbell")[0], 1, (), "bitbell"),
+        (resolve_axes("lowk")[0], 1, (), "lowk"),
+        (resolve_axes("mxu")[0], 1, (), "mxu"),
+        (resolve_axes("pallas")[0], 1, (), "pallas"),
+        (resolve_axes("stencil")[0], 1, ("banded",), "stencil"),
+        (resolve_axes("streamed")[0], 1, (), "streamed"),
+        (resolve_axes("dense")[0], 1, (), "dense"),
+        (resolve_axes("bitbell", partition="mesh2d")[0], 1, (), "mesh2d"),
+        (
+            resolve_axes("bitbell", partition="mesh2d", plane="byte")[0],
+            1,
+            (),
+            "mesh2d+byte",
+        ),
+        (
+            resolve_axes("bitbell", partition="mesh2d", kernel="mxu")[0],
+            1,
+            (),
+            "mesh2d+mxu",
+        ),
+        (
+            resolve_axes(
+                "bitbell",
+                partition="mesh2d",
+                plane="byte",
+                residency="streamed",
+            )[0],
+            1,
+            (),
+            "mesh2d+byte+streamed",
+        ),
+        (
+            resolve_axes("bitbell", partition="mesh2d", async_levels=4)[0],
+            4,
+            (),
+            "mesh2d+async4",
+        ),
+    ]
+    for axes, alv, extras, want in cases:
+        assert engine_label(axes, async_levels=alv, extras=extras) == want

@@ -206,6 +206,115 @@ def test_level_collective_bytes_pins():
     assert level_collective_bytes(1, 1, 73, 1, "none") == 0
 
 
+def test_level_collective_bytes_byte_plane_diet():
+    """The low-K byte-plane wire diet, analytically: K <= 4 ships K uint8
+    lanes per row where the bit plane ships a whole padded uint32 word —
+    at K = 2 that is exactly half the dense bytes on every leg, the
+    ratio the perf-smoke lowk-mesh row pins on measured counters."""
+    bit = level_collective_bytes(2, 4, 10, 1, "halving")  # 1 word = 4 B
+    byte = level_collective_bytes(2, 4, 10, 2, "halving", itemsize=1)
+    assert byte * 2 == bit
+    # K = 4 breaks even with the one-word bit plane; K = 1 is 4x thinner.
+    assert level_collective_bytes(2, 4, 10, 4, "halving", itemsize=1) == bit
+    assert level_collective_bytes(2, 4, 10, 1, "halving", itemsize=1) * 4 == bit
+
+
+@needs_mesh
+def test_byte_plane_measured_bytes_match_model(workload):
+    """The byte-plane drive's measured counter matches levels x the
+    itemsize=1 model with the sparse wire off — the collective diet is
+    measured on the real wire, not inferred from the layout."""
+    g, queries, f, levels, reached = workload
+    q2 = queries[:2]
+    oracle = BitBellEngine(BellGraph.from_host(g))
+    lv2, _, f2 = (np.asarray(x) for x in oracle.query_stats(q2))
+    eng = Mesh2DEngine(
+        make_mesh2d(2, 4), g, plane="byte", level_chunk=1, wire_sparse=0
+    )
+    eng.compile(q2.shape)
+    reset_collective_bytes()
+    np.testing.assert_array_equal(np.asarray(eng.f_values(q2)), f2)
+    got = collective_bytes()
+    want = int(lv2.max()) * eng.level_bytes(2)
+    assert got == want, (got, want)
+    # And the diet vs the bit plane is exactly 2x at K = 2.
+    bit_eng = Mesh2DEngine(
+        make_mesh2d(2, 4), g, level_chunk=1, wire_sparse=0
+    )
+    assert eng.level_bytes(2) * 2 == bit_eng.level_bytes(2)
+
+
+@needs_mesh
+def test_mxu_mesh_multi_tile_matches_oracle(workload, monkeypatch):
+    """tile=16 over this lt forces a real multi-tile grid per device:
+    the harmonized (nt_max-padded) tile stacks must stay bit-identical
+    to the oracle, and the level accounting must record issued tile
+    FLOPs plus the all-zero tiles the densification skipped."""
+    from parallel_multi_source_bfs_implementation_using_mpi_and_cuda_tpu.utils.timing import (
+        mxu_tile_counts,
+        reset_mxu_tiles,
+    )
+
+    monkeypatch.setenv("MSBFS_MXU_TILE", "16")
+    g, queries, f, levels, reached = workload
+    reset_mxu_tiles()
+    eng = Mesh2DEngine(make_mesh2d(2, 4), g, kernel="mxu")
+    ntr, tile, _, nt_max = eng._mxu
+    assert tile == 16 and ntr > 1 and nt_max <= ntr * ntr
+    np.testing.assert_array_equal(np.asarray(eng.f_values(queries)), f)
+    flops, skipped, total = mxu_tile_counts()
+    assert flops > 0 and total > 0
+    assert 0 <= skipped < total
+
+
+@needs_mesh
+def test_incompatible_axis_compositions_fail_loud(workload):
+    """Every axis pair no engine composes fails at construction naming
+    both values — the fail-loud half of the lattice contract (the
+    resolve_axes screen is pinned in tests/test_lattice.py; this pins
+    the engine's own last-line gates)."""
+    g, *_ = workload
+    mesh = make_mesh2d(2, 2)
+    for kw, frag in [
+        (dict(plane="byte", kernel="mxu"), "kernel:mxu"),
+        (dict(plane="byte", async_levels=2), "async"),
+        (dict(kernel="mxu", residency="streamed"), "streamed"),
+        (dict(kernel="mxu", async_levels=2), "async"),
+        (dict(kernel="mxu", merge_tree="pipelined"), "pipelined"),
+        (dict(plane="word"), "plane"),
+        (dict(kernel="pallas"), "kernel"),
+    ]:
+        with pytest.raises(ValueError, match=frag):
+            Mesh2DEngine(mesh, g, **kw)
+
+
+@needs_mesh
+def test_label_derives_from_axes(workload):
+    """Engine labels come from the resolved token set (ops.engine.
+    engine_label), never hand-built — the seam that keeps bench detail
+    keys and trend configs stable across renames."""
+    g, *_ = workload
+    mesh = make_mesh2d(2, 2)
+    assert Mesh2DEngine(mesh, g).label == "mesh2d"
+    assert Mesh2DEngine(mesh, g, plane="byte").label == "mesh2d+byte"
+    assert Mesh2DEngine(mesh, g, kernel="mxu").label == "mesh2d+mxu"
+    assert (
+        Mesh2DEngine(mesh, g, residency="streamed").label
+        == "mesh2d+streamed"
+    )
+    eng = Mesh2DEngine(
+        mesh, g, plane="byte", residency="streamed", async_levels=1
+    )
+    assert eng.label == "mesh2d+byte+streamed"
+    assert "plane:byte" in eng.describe()
+    assert eng.axes == {
+        "plane": "byte",
+        "residency": "streamed",
+        "partition": "mesh2d",
+        "kernel": "xla",
+    }
+
+
 @needs_mesh
 def test_measured_collective_bytes_match_model(workload):
     """With the sparse wire OFF the chunked drive's counter is levels x
@@ -259,6 +368,12 @@ WIRE_ARMS = [
     ("async", dict(async_levels=4)),
     ("async_sparse", dict(async_levels=4, wire_sparse=4096)),
     ("async_streamed", dict(async_levels=4, residency="streamed")),
+    # Round-20 lattice compositions: low-K byte planes on the mesh wire
+    # (alone and on the streamed residency) and the MXU tile-matmul
+    # kernel — the two headline axis compositions, same oracle contract.
+    ("byte", dict(plane="byte")),
+    ("byte_streamed", dict(plane="byte", residency="streamed")),
+    ("mxu", dict(kernel="mxu")),
 ]
 
 
@@ -319,8 +434,14 @@ def test_without_ranks_no_survivors_raises(workload):
         ),
         ("streamed", dict(residency="streamed")),
         ("async", dict(async_levels=4)),
+        # Round-20 lattice compositions: the reshard rung must carry the
+        # plane / kernel axes over to the survivor engine too.
+        ("byte", dict(plane="byte")),
+        pytest.param(
+            "mxu", dict(kernel="mxu"), marks=pytest.mark.slow
+        ),
     ],
-    ids=["dense", "sparse", "pipelined", "streamed", "async"],
+    ids=["dense", "sparse", "pipelined", "streamed", "async", "byte", "mxu"],
 )
 def test_mid_drive_chip_loss_reshards_bit_identical(workload, label, kw):
     """Kill a simulated chip MID-DRIVE (the dispatch fault seam inside
@@ -346,6 +467,10 @@ def test_mid_drive_chip_loss_reshards_bit_identical(workload, label, kw):
         # engine silently falling back to k=1 would still be correct,
         # which is exactly why the knob passthrough needs its own pin.
         assert sup.engine.async_levels == kw["async_levels"]
+    if "plane" in kw:
+        assert sup.engine.plane == kw["plane"]
+    if "kernel" in kw:
+        assert sup.engine.kernel == kw["kernel"]
 
 
 # ---- round 19: bounded-staleness async drive ------------------------------
